@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""Benchmark entry point (driver contract): prints ONE JSON line
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Current benchmark: MNIST ConvNet (BASELINE.json configs[0]) train-step
+throughput on the available accelerator.  The reference publishes no
+numbers (BASELINE.md), so vs_baseline is reported relative to a recorded
+first-round figure once one exists (1.0 until then).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid.executor import Scope, scope_guard
+    from paddle_tpu.models import mnist
+
+    batch = 512
+    main_prog, startup, feeds, fetches = mnist.build_train_program(
+        optimizer=fluid.optimizer.Adam(learning_rate=0.001),
+        batch_size=batch)
+
+    rng = np.random.RandomState(0)
+    imgs = rng.rand(batch, 1, 28, 28).astype("float32")
+    labels = rng.randint(0, 10, size=(batch, 1)).astype("int64")
+
+    scope = Scope()
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        feed = {"img": imgs, "label": labels}
+        # warmup + compile
+        for _ in range(3):
+            exe.run(main_prog, feed=feed, fetch_list=fetches)
+        n_steps = 30
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            out = exe.run(main_prog, feed=feed, fetch_list=fetches)
+        _ = [np.asarray(o) for o in out]  # sync
+        dt = time.perf_counter() - t0
+
+    ips = batch * n_steps / dt
+    print(json.dumps({
+        "metric": "mnist_convnet_images_per_sec",
+        "value": round(ips, 2),
+        "unit": "images/sec",
+        "vs_baseline": 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,36 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the capabilities
+of Fluid-era PaddlePaddle (reference: breeze1982/Paddle, read-only at
+/root/reference — studied for behavior/API, re-designed for TPU).
+
+Architecture (vs. the reference, SURVEY.md §7):
+  * Program IR (paddle_tpu/fluid/framework.py) — pure-Python serializable
+    graph instead of a C++ protobuf + Python mirror pair.
+  * Op lowering registry (paddle_tpu/ops/) — op -> jax/XLA emitter instead
+    of per-(place,dtype,layout) kernel registries.
+  * Executor (paddle_tpu/fluid/executor.py) — whole-block jit compilation
+    instead of a per-op interpreter.
+  * append_backward (paddle_tpu/fluid/backward.py) — grad-op synthesis via
+    cached jax.vjp instead of 650 hand-written GradOpMakers.
+  * Distributed (paddle_tpu/parallel/, paddle_tpu/distributed/) — device
+    meshes + XLA collectives over ICI instead of NCCL rings + program
+    transpilers.
+"""
+
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from . import fluid
+from . import ops
+from .fluid import (CPUPlace, CUDAPlace, TPUPlace, Executor, ParamAttr,
+                    Program, Variable, append_backward, cpu_places,
+                    cuda_places, default_main_program,
+                    default_startup_program, global_scope, program_guard,
+                    scope_guard, tpu_places, in_dygraph_mode)
+from .fluid.layers.tensor import data
+
+enable_static = lambda: None  # static mode is the default, as in 1.x
+
+
+def disable_static():
+    raise NotImplementedError("dygraph mode: see paddle_tpu.fluid.dygraph")

@@ -1,0 +1,72 @@
+"""paddle_tpu.fluid — the Fluid-compatible static-graph front end,
+re-designed TPU-native (see SURVEY.md §7 and per-module docstrings)."""
+
+from __future__ import annotations
+
+from . import core, unique_name
+from .framework import (Program, Variable, Parameter, OpRole,
+                        default_main_program, default_startup_program,
+                        program_guard, in_dygraph_mode)
+from .executor import Executor, Scope, global_scope, scope_guard
+from .backward import append_backward, gradients
+from . import initializer, regularizer, clip
+from .param_attr import ParamAttr, WeightNormParamAttr
+from . import layers
+from . import optimizer
+from .layers.tensor import data
+
+
+class CPUPlace:
+    """Host platform (place.h:26 in the reference)."""
+
+    def __repr__(self):
+        return "CPUPlace"
+
+
+class TPUPlace:
+    """TPU device identity — the new first-class Place the north star asks
+    for (BASELINE.json).  device_id indexes jax.devices()."""
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"TPUPlace({self.device_id})"
+
+
+# CUDAPlace name kept as an alias so reference scripts run unchanged: on
+# this framework "the accelerator" is the TPU.
+CUDAPlace = TPUPlace
+
+
+def tpu_places(device_ids=None):
+    import jax
+
+    n = len(jax.devices())
+    ids = device_ids if device_ids is not None else range(n)
+    return [TPUPlace(i) for i in ids]
+
+
+cuda_places = tpu_places
+
+
+def cpu_places(device_count=1):
+    return [CPUPlace() for _ in range(device_count)]
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_tpu():
+    return True
+
+
+def device_count():
+    import jax
+
+    return len(jax.devices())
+
+
+from ..parallel.compiler import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa: E402
+from . import compiler  # noqa: E402

@@ -1,0 +1,83 @@
+"""Gradient clipping (mirror of
+/root/reference/python/paddle/fluid/clip.py: GradientClipByValue,
+GradientClipByNorm, GradientClipByGlobalNorm:386).  Each is a callable over
+params_grads appending clip ops."""
+
+from __future__ import annotations
+
+from .layer_helper import LayerHelper
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def __call__(self, params_grads):
+        from .layers import nn
+
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                continue
+            out.append((p, nn.clip(g, self.min, self.max)))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        from .layers import nn
+
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                continue
+            out.append((p, nn.clip_by_norm(g, self.clip_norm)))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """g_i <- g_i * clip_norm / max(global_norm, clip_norm), with
+    global_norm = sqrt(Σ ||g_i||²) — one fused XLA computation."""
+
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        from .layers import nn, tensor
+
+        helper = LayerHelper("global_norm_clip")
+        sq_sums = []
+        for p, g in params_grads:
+            if g is None:
+                continue
+            sq = helper.create_variable_for_type_inference(dtype=g.dtype)
+            helper.append_op("squared_l2_norm", inputs={"X": [g]},
+                             outputs={"Out": [sq]}, attrs={"op_role": 1})
+            sq_sums.append(sq)
+        total = helper.create_variable_for_type_inference(dtype="float32")
+        helper.append_op("sum", inputs={"X": sq_sums},
+                         outputs={"Out": [total]}, attrs={"op_role": 1})
+        global_norm = nn.sqrt(total)
+        clip_var = tensor.fill_constant([1], "float32", self.clip_norm)
+        scale = clip_var / nn.elementwise_max(global_norm, clip_var)
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                continue
+            out.append((p, nn.elementwise_mul(g, scale)))
+        return out
+
+
+# legacy fluid names
+GradientClipByValue = ClipGradByValue
+GradientClipByNorm = ClipGradByNorm
+GradientClipByGlobalNorm = ClipGradByGlobalNorm

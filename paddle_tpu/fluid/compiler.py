@@ -1,0 +1,4 @@
+"""fluid.compiler — re-export of the TPU-native CompiledProgram
+(mirror of /root/reference/python/paddle/fluid/compiler.py:87)."""
+
+from ..parallel.compiler import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa: F401

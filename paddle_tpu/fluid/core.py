@@ -1,0 +1,96 @@
+"""Core dtype/type utilities for the TPU-native Fluid-style framework.
+
+Re-designs the reference's VarType/proto dtype enums
+(/root/reference/paddle/fluid/framework/framework.proto:104-163) as plain
+string dtype names that map 1:1 onto JAX/NumPy dtypes.  There is no C++
+Tensor here: device data is `jax.Array`, host data is `numpy.ndarray`, and
+XLA owns device memory (the reference's entire memory/allocation layer,
+/root/reference/paddle/fluid/memory/, collapses into XLA buffer
+assignment + donation — see SURVEY.md §2.2).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# Canonical dtype names (the framework-wide currency).
+_DTYPE_ALIASES = {
+    "float32": "float32",
+    "fp32": "float32",
+    "float": "float32",
+    "float64": "float64",
+    "fp64": "float64",
+    "double": "float64",
+    "float16": "float16",
+    "fp16": "float16",
+    "half": "float16",
+    "bfloat16": "bfloat16",
+    "bf16": "bfloat16",
+    "int8": "int8",
+    "int16": "int16",
+    "int32": "int32",
+    "int64": "int64",
+    "uint8": "uint8",
+    "uint16": "uint16",
+    "uint32": "uint32",
+    "bool": "bool",
+    "complex64": "complex64",
+    "complex128": "complex128",
+}
+
+FLOAT_DTYPES = ("float16", "bfloat16", "float32", "float64")
+INT_DTYPES = ("int8", "int16", "int32", "int64", "uint8", "uint16", "uint32")
+
+
+class VarType:
+    """Variable kind tags, mirroring the reference's VarType enum
+    (framework.proto:104).  On TPU only dense tensors exist at runtime;
+    the rest are front-end/bookkeeping kinds."""
+
+    LOD_TENSOR = "lod_tensor"
+    SELECTED_ROWS = "selected_rows"
+    LOD_TENSOR_ARRAY = "lod_tensor_array"
+    READER = "reader"
+    STEP_SCOPES = "step_scopes"
+    RAW = "raw"
+
+
+def convert_dtype(dtype) -> str:
+    """Normalize any dtype spec (str alias, numpy dtype, jnp dtype, python
+    type) to a canonical dtype name string."""
+    if dtype is None:
+        return "float32"
+    if isinstance(dtype, str):
+        key = dtype.lower()
+        if key in _DTYPE_ALIASES:
+            return _DTYPE_ALIASES[key]
+        raise ValueError(f"unsupported dtype string: {dtype!r}")
+    # numpy dtype, jnp dtype object, or python scalar type
+    try:
+        name = np.dtype(dtype).name
+    except TypeError:
+        name = getattr(dtype, "name", None) or getattr(dtype, "__name__", None)
+    if name in _DTYPE_ALIASES:
+        return _DTYPE_ALIASES[name]
+    # np.dtype(bfloat16) raises; ml_dtypes gives name 'bfloat16'
+    if name is None and "bfloat16" in str(dtype):
+        return "bfloat16"
+    raise ValueError(f"unsupported dtype: {dtype!r}")
+
+
+def np_dtype(name: str):
+    """Canonical name -> numpy dtype (bfloat16 via ml_dtypes)."""
+    name = convert_dtype(name)
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def is_float_dtype(name) -> bool:
+    return convert_dtype(name) in FLOAT_DTYPES
+
+
+def is_int_dtype(name) -> bool:
+    return convert_dtype(name) in INT_DTYPES

@@ -1,0 +1,13 @@
+"""fluid.layers — the op-emitting layer library.
+
+Mirror of /root/reference/python/paddle/fluid/layers/ (nn.py 15.2k LoC,
+tensor.py, control_flow.py, loss.py, learning_rate_scheduler.py).
+"""
+
+from . import math_op_patch  # installs Variable operator sugar
+from .tensor import *  # noqa: F401,F403
+from .nn import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .control_flow import *  # noqa: F401,F403
+from .learning_rate_scheduler import *  # noqa: F401,F403
+from . import tensor, nn, loss, control_flow, learning_rate_scheduler  # noqa: F401

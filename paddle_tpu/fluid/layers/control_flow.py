@@ -1,0 +1,122 @@
+"""Control-flow layers.
+
+The reference builds while/cond as sub-block ops run by the interpreter
+(fluid/layers/control_flow.py:While :1040, cond via conditional_block).
+Here sub-blocks lower to lax.while_loop/lax.cond
+(paddle_tpu/ops/control_flow_ops.py).
+"""
+
+from __future__ import annotations
+
+from ..framework import default_main_program
+from ..layer_helper import LayerHelper
+
+__all__ = ["While", "while_loop", "cond", "increment_", "array_write",
+           "array_read"]
+
+
+class While:
+    """`with While(cond_var).block(): ...` — ops appended inside the guard
+    go to a new sub-block executed while cond_var holds."""
+
+    def __init__(self, cond, is_test=False, name=None):
+        self.cond_var = cond
+        self.helper = LayerHelper("while", name=name)
+        self._sub_block = None
+
+    def block(self):
+        return _WhileGuard(self)
+
+
+class _WhileGuard:
+    def __init__(self, while_op: While):
+        self.while_op = while_op
+
+    def __enter__(self):
+        prog = default_main_program()
+        self.block = prog._create_block()
+        return self.block
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            return False
+        prog = default_main_program()
+        sub_idx = self.block.idx
+        prog._rollback()
+        w = self.while_op
+        # loop-carried vars = sub-block writes that exist in parent
+        parent = prog.current_block()
+        reads, writes = [], []
+        seen_r, seen_w, defined = set(), set(), set()
+        for op in self.block.ops:
+            for n in op.input_arg_names():
+                if n not in defined and n not in seen_r:
+                    seen_r.add(n)
+                    reads.append(n)
+            for n in op.output_arg_names():
+                seen_w.add(n)
+                defined.add(n)
+        outer_touch = [n for n in (set(reads) | seen_w)
+                       if parent.has_var_recursive(n)]
+        out_names = [n for n in seen_w if parent.has_var_recursive(n)]
+        parent.append_op(
+            "while",
+            inputs={"X": sorted(outer_touch),
+                    "Condition": [w.cond_var.name]},
+            outputs={"Out": sorted(out_names),
+                     "StepScopes": ["@EMPTY@"]},
+            attrs={"sub_block": sub_idx, "is_test": False},
+            infer_shape=False)
+        return True
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """Functional while_loop (reference control_flow.py:while_loop).  Builds
+    the sub-block by calling `body` under a block guard."""
+    from .nn import logical_not  # noqa: F401  (parity import)
+
+    prog = default_main_program()
+    cond_var = cond(*loop_vars)
+    w = While(cond_var, is_test, name)
+    with w.block():
+        new_vars = body(*loop_vars)
+        new_vars = new_vars if isinstance(new_vars, (list, tuple)) else [new_vars]
+        from .tensor import assign
+
+        for old, new in zip(loop_vars, new_vars):
+            if new is not old:
+                assign(new, old)
+        # recompute condition on updated vars
+        c2 = cond(*loop_vars)
+        assign(c2, cond_var)
+    return loop_vars
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """Two-branch conditional (reference layers/control_flow.py cond): both
+    branches are traced into the main block and the result selected —
+    matching XLA's eager-both-branches cost model for small branches."""
+    from .tensor import cast, where
+
+    t_out = true_fn() if true_fn is not None else None
+    f_out = false_fn() if false_fn is not None else None
+    if t_out is None:
+        return None
+    if isinstance(t_out, (list, tuple)):
+        return [where(pred, t, f) for t, f in zip(t_out, f_out)]
+    # broadcast pred to output shape via where lowering
+    return where(pred, t_out, f_out)
+
+
+def increment_(x, value=1.0):
+    from .tensor import increment
+
+    return increment(x, value)
+
+
+def array_write(x, i, array=None):
+    raise NotImplementedError("LoDTensorArray: pending lax.scan-based design")
+
+
+def array_read(array, i):
+    raise NotImplementedError("LoDTensorArray: pending lax.scan-based design")

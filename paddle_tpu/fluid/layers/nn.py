@@ -1,0 +1,817 @@
+"""Neural-network layers (fluid/layers/nn.py — 15.2k LoC, 214 defs in the
+reference).  Each layer creates parameters via LayerHelper and appends ops;
+the heavy lifting is in the op lowerings (paddle_tpu/ops/)."""
+
+from __future__ import annotations
+
+from .. import core
+from ..framework import Variable
+from ..initializer import ConstantInitializer, XavierInitializer
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "fc", "embedding", "conv2d", "conv2d_transpose", "conv3d", "pool2d",
+    "adaptive_pool2d", "batch_norm", "layer_norm", "instance_norm",
+    "group_norm", "dropout", "softmax", "log_softmax", "relu", "relu6",
+    "sigmoid", "tanh", "sqrt", "square", "abs", "exp", "log", "floor",
+    "ceil", "round", "sin", "cos", "gelu", "leaky_relu", "elu", "softplus",
+    "softsign", "swish", "hard_sigmoid", "hard_swish", "prelu", "maxout",
+    "erf", "rsqrt", "reciprocal", "sign",
+    "mean", "mul", "matmul", "bmm", "dot",
+    "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_div", "elementwise_pow", "elementwise_max",
+    "elementwise_min", "elementwise_mod", "elementwise_floordiv",
+    "reduce_sum", "reduce_mean", "reduce_max", "reduce_min", "reduce_prod",
+    "reduce_all", "reduce_any", "clip", "clip_by_norm", "scale", "pow",
+    "reshape", "transpose", "flatten", "topk", "accuracy", "one_hot",
+    "l2_normalize", "label_smooth", "pad", "pad2d", "unfold",
+    "image_resize", "resize_nearest", "resize_bilinear",
+    "equal", "not_equal", "less_than", "less_equal", "greater_than",
+    "greater_equal", "logical_and", "logical_or", "logical_not",
+    "logical_xor", "maximum", "minimum", "cumsum", "isfinite",
+    "interpolate",
+]
+
+
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, name=None):
+    """Fully-connected (reference layers/nn.py fc): flattens trailing dims,
+    matmul against a created weight, optional bias + activation; lowers to
+    one MXU matmul + fused epilogue."""
+    helper = LayerHelper("fc", name=name, act=act, bias_attr=bias_attr)
+    input_shape = input.shape
+    in_features = 1
+    for s in input_shape[num_flatten_dims:]:
+        in_features *= int(s)
+    w = helper.create_parameter(param_attr, shape=[in_features, size],
+                                dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op("mul", inputs={"X": [input], "Y": [w]},
+                     outputs={"Out": [out]},
+                     attrs={"x_num_col_dims": num_flatten_dims,
+                            "y_num_col_dims": 1})
+    out = helper.append_bias_op(out, bias_attr)
+    return helper.append_activation(out, act)
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    """lookup_table_v2 (reference nn.py embedding).  is_sparse is accepted
+    for API parity; on TPU the gradient is a dense scatter-add that XLA
+    fuses (SelectedRows sparse grads don't exist in XLA's memory model)."""
+    helper = LayerHelper("embedding")
+    w = helper.create_parameter(param_attr, shape=list(size), dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    padding_idx = -1 if padding_idx is None else (
+        padding_idx if padding_idx >= 0 else size[0] + padding_idx)
+    helper.append_op("lookup_table_v2",
+                     inputs={"W": [w], "Ids": [input]},
+                     outputs={"Out": [out]},
+                     attrs={"padding_idx": padding_idx,
+                            "is_sparse": is_sparse})
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCHW"):
+    helper = LayerHelper("conv2d", name=name, act=act, bias_attr=bias_attr)
+    if isinstance(filter_size, int):
+        filter_size = [filter_size, filter_size]
+    stride = [stride, stride] if isinstance(stride, int) else list(stride)
+    dilation = ([dilation, dilation] if isinstance(dilation, int)
+                else list(dilation))
+    if isinstance(padding, str):
+        padding_algorithm = padding.upper()
+        padding = [0, 0]
+    else:
+        padding_algorithm = "EXPLICIT"
+        padding = ([padding, padding] if isinstance(padding, int)
+                   else list(padding))
+    channels = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    w_shape = [num_filters, channels // groups] + list(filter_size)
+    import math
+
+    fan_in = (channels // groups) * filter_size[0] * filter_size[1]
+    std = math.sqrt(2.0 / fan_in)
+    from ..initializer import NormalInitializer
+
+    w = helper.create_parameter(param_attr, shape=w_shape, dtype=input.dtype,
+                                default_initializer=NormalInitializer(0.0, std))
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    op_type = ("depthwise_conv2d"
+               if groups == channels and num_filters % channels == 0
+               and groups > 1 else "conv2d")
+    helper.append_op(op_type,
+                     inputs={"Input": [input], "Filter": [w]},
+                     outputs={"Output": [out]},
+                     attrs={"strides": stride, "paddings": padding,
+                            "dilations": dilation, "groups": groups,
+                            "padding_algorithm": padding_algorithm,
+                            "data_format": data_format})
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, shape=[num_filters],
+                                    dtype=input.dtype, is_bias=True)
+        if b is not None:
+            pre_act = helper.create_variable_for_type_inference(input.dtype)
+            helper.append_op("elementwise_add",
+                             inputs={"X": [out], "Y": [b]},
+                             outputs={"Out": [pre_act]},
+                             attrs={"axis": 1 if data_format == "NCHW" else -1})
+            out = pre_act
+    return helper.append_activation(out, act)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None):
+    helper = LayerHelper("conv2d_transpose", name=name, act=act)
+    stride = [stride, stride] if isinstance(stride, int) else list(stride)
+    dilation = ([dilation, dilation] if isinstance(dilation, int)
+                else list(dilation))
+    padding = ([padding, padding] if isinstance(padding, int)
+               else list(padding))
+    if filter_size is None:
+        assert output_size is not None
+        output_size = ([output_size, output_size]
+                       if isinstance(output_size, int) else list(output_size))
+        h_in, w_in = input.shape[2], input.shape[3]
+        filter_size = [
+            (output_size[0] - (h_in - 1) * stride[0] + 2 * padding[0]
+             - 1) // dilation[0] + 1,
+            (output_size[1] - (w_in - 1) * stride[1] + 2 * padding[1]
+             - 1) // dilation[1] + 1]
+    elif isinstance(filter_size, int):
+        filter_size = [filter_size, filter_size]
+    channels = input.shape[1]
+    w = helper.create_parameter(
+        param_attr, shape=[channels, num_filters // groups] + filter_size,
+        dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op("conv2d_transpose",
+                     inputs={"Input": [input], "Filter": [w]},
+                     outputs={"Output": [out]},
+                     attrs={"strides": stride, "paddings": padding,
+                            "dilations": dilation, "groups": groups,
+                            "padding_algorithm": "EXPLICIT"})
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, shape=[num_filters],
+                                    dtype=input.dtype, is_bias=True)
+        if b is not None:
+            pre = helper.create_variable_for_type_inference(input.dtype)
+            helper.append_op("elementwise_add", inputs={"X": [out], "Y": [b]},
+                             outputs={"Out": [pre]}, attrs={"axis": 1})
+            out = pre
+    return helper.append_activation(out, act)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None, name=None):
+    helper = LayerHelper("conv3d", name=name, act=act)
+    fs = ([filter_size] * 3 if isinstance(filter_size, int)
+          else list(filter_size))
+    stride = [stride] * 3 if isinstance(stride, int) else list(stride)
+    padding = [padding] * 3 if isinstance(padding, int) else list(padding)
+    dilation = [dilation] * 3 if isinstance(dilation, int) else list(dilation)
+    channels = input.shape[1]
+    w = helper.create_parameter(param_attr,
+                                shape=[num_filters, channels // groups] + fs,
+                                dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op("conv3d", inputs={"Input": [input], "Filter": [w]},
+                     outputs={"Output": [out]},
+                     attrs={"strides": stride, "paddings": padding,
+                            "dilations": dilation, "groups": groups,
+                            "padding_algorithm": "EXPLICIT"})
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, shape=[num_filters],
+                                    dtype=input.dtype, is_bias=True)
+        if b is not None:
+            pre = helper.create_variable_for_type_inference(input.dtype)
+            helper.append_op("elementwise_add", inputs={"X": [out], "Y": [b]},
+                             outputs={"Out": [pre]}, attrs={"axis": 1})
+            out = pre
+    return helper.append_activation(out, act)
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, name=None, exclusive=True, data_format="NCHW"):
+    helper = LayerHelper("pool2d", name=name)
+    pool_size = ([pool_size, pool_size] if isinstance(pool_size, int)
+                 else list(pool_size))
+    pool_stride = ([pool_stride, pool_stride]
+                   if isinstance(pool_stride, int) else list(pool_stride))
+    if isinstance(pool_padding, str):
+        padding_algorithm = pool_padding.upper()
+        pool_padding = [0, 0]
+    else:
+        padding_algorithm = "EXPLICIT"
+        pool_padding = ([pool_padding, pool_padding]
+                        if isinstance(pool_padding, int) else list(pool_padding))
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op("pool2d", inputs={"X": [input]}, outputs={"Out": [out]},
+                     attrs={"pooling_type": pool_type, "ksize": pool_size,
+                            "strides": pool_stride, "paddings": pool_padding,
+                            "global_pooling": global_pooling,
+                            "ceil_mode": ceil_mode, "exclusive": exclusive,
+                            "adaptive": False,
+                            "padding_algorithm": padding_algorithm,
+                            "data_format": data_format})
+    return out
+
+
+def adaptive_pool2d(input, pool_size, pool_type="max", name=None):
+    helper = LayerHelper("adaptive_pool2d", name=name)
+    pool_size = ([pool_size, pool_size] if isinstance(pool_size, int)
+                 else list(pool_size))
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op("pool2d", inputs={"X": [input]}, outputs={"Out": [out]},
+                     attrs={"pooling_type": pool_type, "ksize": pool_size,
+                            "strides": [1, 1], "paddings": [0, 0],
+                            "global_pooling": False, "adaptive": True,
+                            "ceil_mode": False, "exclusive": True,
+                            "padding_algorithm": "EXPLICIT",
+                            "data_format": "NCHW"})
+    return out
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var=True,
+               use_global_stats=False):
+    helper = LayerHelper("batch_norm", name=name, act=act)
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    dtype = input.dtype
+    scale = helper.create_parameter(param_attr, shape=[c], dtype=dtype,
+                                    default_initializer=ConstantInitializer(1.0))
+    bias = helper.create_parameter(bias_attr, shape=[c], dtype=dtype,
+                                   is_bias=True)
+    from ..param_attr import ParamAttr
+
+    mean = helper.create_parameter(
+        ParamAttr(name=moving_mean_name, trainable=False,
+                  initializer=ConstantInitializer(0.0)),
+        shape=[c], dtype=dtype)
+    variance = helper.create_parameter(
+        ParamAttr(name=moving_variance_name, trainable=False,
+                  initializer=ConstantInitializer(1.0)),
+        shape=[c], dtype=dtype)
+    mean.stop_gradient = True
+    variance.stop_gradient = True
+
+    y = helper.create_variable_for_type_inference(dtype)
+    saved_mean = helper.create_variable_for_type_inference(dtype,
+                                                           stop_gradient=True)
+    saved_var = helper.create_variable_for_type_inference(dtype,
+                                                          stop_gradient=True)
+    reserve = helper.create_variable_for_type_inference(dtype,
+                                                        stop_gradient=True)
+    helper.append_op(
+        "batch_norm",
+        inputs={"X": [input], "Scale": [scale], "Bias": [bias],
+                "Mean": [mean], "Variance": [variance]},
+        outputs={"Y": [y], "MeanOut": [mean], "VarianceOut": [variance],
+                 "SavedMean": [saved_mean], "SavedVariance": [saved_var],
+                 "ReserveSpace": [reserve]},
+        attrs={"momentum": momentum, "epsilon": epsilon,
+               "is_test": is_test, "data_layout": data_layout,
+               "use_global_stats": use_global_stats})
+    return helper.append_activation(y, act)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    helper = LayerHelper("layer_norm", name=name, act=act)
+    dtype = input.dtype
+    norm_size = 1
+    for s in input.shape[begin_norm_axis:]:
+        norm_size *= int(s)
+    inputs = {"X": [input]}
+    if scale:
+        s_p = helper.create_parameter(param_attr, shape=[norm_size],
+                                      dtype=dtype,
+                                      default_initializer=ConstantInitializer(1.0))
+        inputs["Scale"] = [s_p]
+    if shift:
+        b_p = helper.create_parameter(bias_attr, shape=[norm_size],
+                                      dtype=dtype, is_bias=True)
+        if b_p is not None:
+            inputs["Bias"] = [b_p]
+    y = helper.create_variable_for_type_inference(dtype)
+    mean = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    var = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    helper.append_op("layer_norm", inputs=inputs,
+                     outputs={"Y": [y], "Mean": [mean], "Variance": [var]},
+                     attrs={"epsilon": epsilon,
+                            "begin_norm_axis": begin_norm_axis})
+    return helper.append_activation(y, act)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    helper = LayerHelper("instance_norm", name=name)
+    c = input.shape[1]
+    dtype = input.dtype
+    inputs = {"X": [input]}
+    if param_attr is not False:
+        scale = helper.create_parameter(param_attr, shape=[c], dtype=dtype,
+                                        default_initializer=ConstantInitializer(1.0))
+        inputs["Scale"] = [scale]
+    if bias_attr is not False:
+        bias = helper.create_parameter(bias_attr, shape=[c], dtype=dtype,
+                                       is_bias=True)
+        inputs["Bias"] = [bias]
+    y = helper.create_variable_for_type_inference(dtype)
+    sm = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    sv = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    helper.append_op("instance_norm", inputs=inputs,
+                     outputs={"Y": [y], "SavedMean": [sm],
+                              "SavedVariance": [sv]},
+                     attrs={"epsilon": epsilon})
+    return y
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    helper = LayerHelper("group_norm", name=name, act=act)
+    c = input.shape[1]
+    dtype = input.dtype
+    inputs = {"X": [input]}
+    if param_attr is not False:
+        scale = helper.create_parameter(param_attr, shape=[c], dtype=dtype,
+                                        default_initializer=ConstantInitializer(1.0))
+        inputs["Scale"] = [scale]
+    if bias_attr is not False:
+        bias = helper.create_parameter(bias_attr, shape=[c], dtype=dtype,
+                                       is_bias=True)
+        inputs["Bias"] = [bias]
+    y = helper.create_variable_for_type_inference(dtype)
+    mean = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    var = helper.create_variable_for_type_inference(dtype, stop_gradient=True)
+    helper.append_op("group_norm", inputs=inputs,
+                     outputs={"Y": [y], "Mean": [mean], "Variance": [var]},
+                     attrs={"epsilon": epsilon, "groups": groups})
+    return helper.append_activation(y, act)
+
+
+def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
+            dropout_implementation="downgrade_in_infer"):
+    helper = LayerHelper("dropout", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    mask = helper.create_variable_for_type_inference(dtype="uint8",
+                                                     stop_gradient=True)
+    helper.append_op("dropout", inputs={"X": [x]},
+                     outputs={"Out": [out], "Mask": [mask]},
+                     attrs={"dropout_prob": dropout_prob, "is_test": is_test,
+                            "seed": seed or 0, "fix_seed": seed is not None,
+                            "dropout_implementation": dropout_implementation})
+    return out
+
+
+# -- simple wrappers --------------------------------------------------------
+
+def _unary_layer(op_type):
+    def layer(x, name=None, **attrs):
+        helper = LayerHelper(op_type, name=name)
+        out = helper.create_variable_for_type_inference(dtype=x.dtype)
+        helper.append_op(op_type, inputs={"X": [x]}, outputs={"Out": [out]},
+                         attrs=attrs)
+        return out
+
+    layer.__name__ = op_type
+    return layer
+
+
+softmax = _unary_layer("softmax")
+log_softmax = _unary_layer("log_softmax")
+relu = _unary_layer("relu")
+relu6 = _unary_layer("relu6")
+sigmoid = _unary_layer("sigmoid")
+tanh = _unary_layer("tanh")
+sqrt = _unary_layer("sqrt")
+rsqrt = _unary_layer("rsqrt")
+square = _unary_layer("square")
+abs = _unary_layer("abs")
+exp = _unary_layer("exp")
+log = _unary_layer("log")
+floor = _unary_layer("floor")
+ceil = _unary_layer("ceil")
+round = _unary_layer("round")
+sin = _unary_layer("sin")
+cos = _unary_layer("cos")
+erf = _unary_layer("erf")
+reciprocal = _unary_layer("reciprocal")
+sign = _unary_layer("sign")
+softsign = _unary_layer("softsign")
+softplus = _unary_layer("softplus")
+
+
+def gelu(x, approximate=False):
+    helper = LayerHelper("gelu")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("gelu", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"approximate": approximate})
+    return out
+
+
+def leaky_relu(x, alpha=0.02, name=None):
+    helper = LayerHelper("leaky_relu", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("leaky_relu", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"alpha": alpha})
+    return out
+
+
+def elu(x, alpha=1.0):
+    helper = LayerHelper("elu")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("elu", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"alpha": alpha})
+    return out
+
+
+def swish(x, beta=1.0):
+    helper = LayerHelper("swish")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("swish", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"beta": beta})
+    return out
+
+
+def hard_sigmoid(x, slope=0.2, offset=0.5):
+    helper = LayerHelper("hard_sigmoid")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("hard_sigmoid", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"slope": slope, "offset": offset})
+    return out
+
+
+def hard_swish(x, threshold=6.0, scale=6.0, offset=3.0):
+    helper = LayerHelper("hard_swish")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("hard_swish", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"threshold": threshold, "scale": scale,
+                            "offset": offset})
+    return out
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    helper = LayerHelper("prelu", name=name)
+    if mode == "all":
+        alpha_shape = [1]
+    elif mode == "channel":
+        alpha_shape = [x.shape[1]]
+    else:
+        alpha_shape = list(x.shape[1:])
+    alpha = helper.create_parameter(param_attr, shape=alpha_shape,
+                                    dtype=x.dtype,
+                                    default_initializer=ConstantInitializer(0.25))
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("prelu", inputs={"X": [x], "Alpha": [alpha]},
+                     outputs={"Out": [out]}, attrs={"mode": mode})
+    return out
+
+
+def maxout(x, groups, name=None, axis=1):
+    helper = LayerHelper("maxout", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("maxout", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"groups": groups, "axis": axis})
+    return out
+
+
+def mean(x, name=None):
+    helper = LayerHelper("mean", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("mean", inputs={"X": [x]}, outputs={"Out": [out]})
+    return out
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    helper = LayerHelper("mul", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("mul", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]},
+                     attrs={"x_num_col_dims": x_num_col_dims,
+                            "y_num_col_dims": y_num_col_dims})
+    return out
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    helper = LayerHelper("matmul", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("matmul", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]},
+                     attrs={"transpose_X": transpose_x,
+                            "transpose_Y": transpose_y,
+                            "alpha": float(alpha)})
+    return out
+
+
+def bmm(x, y, name=None):
+    helper = LayerHelper("bmm", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("bmm", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def dot(x, y, name=None):
+    helper = LayerHelper("dot", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("dot", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def _binary_layer(op_type):
+    def layer(x, y, axis=-1, act=None, name=None):
+        helper = LayerHelper(op_type, name=name)
+        out = helper.create_variable_for_type_inference(dtype=x.dtype)
+        helper.append_op(op_type, inputs={"X": [x], "Y": [y]},
+                         outputs={"Out": [out]}, attrs={"axis": axis})
+        return helper.append_activation(out, act)
+
+    layer.__name__ = op_type
+    return layer
+
+
+elementwise_add = _binary_layer("elementwise_add")
+elementwise_sub = _binary_layer("elementwise_sub")
+elementwise_mul = _binary_layer("elementwise_mul")
+elementwise_div = _binary_layer("elementwise_div")
+elementwise_pow = _binary_layer("elementwise_pow")
+elementwise_max = _binary_layer("elementwise_max")
+elementwise_min = _binary_layer("elementwise_min")
+elementwise_mod = _binary_layer("elementwise_mod")
+elementwise_floordiv = _binary_layer("elementwise_floordiv")
+
+
+def _compare_layer(op_type):
+    def layer(x, y, cond=None, name=None):
+        helper = LayerHelper(op_type, name=name)
+        out = cond or helper.create_variable_for_type_inference(dtype="bool")
+        out.stop_gradient = True
+        helper.append_op(op_type, inputs={"X": [x], "Y": [y]},
+                         outputs={"Out": [out]})
+        return out
+
+    layer.__name__ = op_type
+    return layer
+
+
+equal = _compare_layer("equal")
+not_equal = _compare_layer("not_equal")
+less_than = _compare_layer("less_than")
+less_equal = _compare_layer("less_equal")
+greater_than = _compare_layer("greater_than")
+greater_equal = _compare_layer("greater_equal")
+
+
+def _logical_layer(op_type, unary=False):
+    def layer(x, y=None, out=None, name=None):
+        helper = LayerHelper(op_type, name=name)
+        if out is None:
+            out = helper.create_variable_for_type_inference(dtype="bool")
+        ins = {"X": [x]} if unary else {"X": [x], "Y": [y]}
+        helper.append_op(op_type, inputs=ins, outputs={"Out": [out]})
+        return out
+
+    layer.__name__ = op_type
+    return layer
+
+
+logical_and = _logical_layer("logical_and")
+logical_or = _logical_layer("logical_or")
+logical_xor = _logical_layer("logical_xor")
+logical_not = _logical_layer("logical_not", unary=True)
+maximum = _binary_layer("elementwise_max")
+minimum = _binary_layer("elementwise_min")
+
+
+def _reduce_layer(op_type):
+    def layer(input, dim=None, keep_dim=False, name=None):
+        helper = LayerHelper(op_type, name=name)
+        out = helper.create_variable_for_type_inference(dtype=input.dtype)
+        if dim is None:
+            attrs = {"dim": [0], "keep_dim": keep_dim, "reduce_all": True}
+        else:
+            dim = [dim] if isinstance(dim, int) else list(dim)
+            attrs = {"dim": dim, "keep_dim": keep_dim, "reduce_all": False}
+        helper.append_op(op_type, inputs={"X": [input]},
+                         outputs={"Out": [out]}, attrs=attrs)
+        return out
+
+    layer.__name__ = op_type
+    return layer
+
+
+reduce_sum = _reduce_layer("reduce_sum")
+reduce_mean = _reduce_layer("reduce_mean")
+reduce_max = _reduce_layer("reduce_max")
+reduce_min = _reduce_layer("reduce_min")
+reduce_prod = _reduce_layer("reduce_prod")
+reduce_all = _reduce_layer("reduce_all")
+reduce_any = _reduce_layer("reduce_any")
+
+
+def clip(x, min, max, name=None):
+    helper = LayerHelper("clip", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("clip", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"min": float(min), "max": float(max)})
+    return out
+
+
+def clip_by_norm(x, max_norm, name=None):
+    helper = LayerHelper("clip_by_norm", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("clip_by_norm", inputs={"X": [x]},
+                     outputs={"Out": [out]},
+                     attrs={"max_norm": float(max_norm)})
+    return out
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    helper = LayerHelper("scale", name=name, act=act)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("scale", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"scale": float(scale), "bias": float(bias),
+                            "bias_after_scale": bias_after_scale})
+    return helper.append_activation(out, act)
+
+
+def pow(x, factor=1.0, name=None):
+    helper = LayerHelper("pow", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("pow", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"factor": float(factor)})
+    return out
+
+
+def reshape(x, shape, actual_shape=None, act=None, inplace=False, name=None):
+    helper = LayerHelper("reshape", name=name, act=act)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    xshape = helper.create_variable_for_type_inference(dtype=x.dtype,
+                                                       stop_gradient=True)
+    helper.append_op("reshape2", inputs={"X": [x]},
+                     outputs={"Out": [out], "XShape": [xshape]},
+                     attrs={"shape": [int(s) for s in shape]})
+    return helper.append_activation(out, act)
+
+
+def transpose(x, perm, name=None):
+    helper = LayerHelper("transpose", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    xshape = helper.create_variable_for_type_inference(dtype=x.dtype,
+                                                       stop_gradient=True)
+    helper.append_op("transpose2", inputs={"X": [x]},
+                     outputs={"Out": [out], "XShape": [xshape]},
+                     attrs={"axis": list(perm)})
+    return out
+
+
+def flatten(x, axis=1, name=None):
+    helper = LayerHelper("flatten", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    xshape = helper.create_variable_for_type_inference(dtype=x.dtype,
+                                                       stop_gradient=True)
+    helper.append_op("flatten2", inputs={"X": [x]},
+                     outputs={"Out": [out], "XShape": [xshape]},
+                     attrs={"axis": axis})
+    return out
+
+
+def topk(input, k, name=None):
+    helper = LayerHelper("top_k", name=name)
+    values = helper.create_variable_for_type_inference(dtype=input.dtype)
+    indices = helper.create_variable_for_type_inference(dtype="int64",
+                                                        stop_gradient=True)
+    helper.append_op("top_k_v2", inputs={"X": [input]},
+                     outputs={"Out": [values], "Indices": [indices]},
+                     attrs={"k": int(k), "axis": -1, "largest": True,
+                            "sorted": True})
+    return values, indices
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """(reference layers/metric_op.py accuracy): top-k accuracy."""
+    helper = LayerHelper("accuracy")
+    _, indices = topk(input, k)
+    acc = helper.create_variable_for_type_inference(dtype="float32",
+                                                    stop_gradient=True)
+    correct = correct or helper.create_variable_for_type_inference(
+        dtype="int32", stop_gradient=True)
+    total = total or helper.create_variable_for_type_inference(
+        dtype="int32", stop_gradient=True)
+    helper.append_op("accuracy",
+                     inputs={"Out": [input], "Indices": [indices],
+                             "Label": [label]},
+                     outputs={"Accuracy": [acc], "Correct": [correct],
+                              "Total": [total]})
+    return acc
+
+
+def one_hot(input, depth, allow_out_of_range=False):
+    from .tensor import one_hot as _oh
+
+    return _oh(input, depth, allow_out_of_range)
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    sq = square(x)
+    summed = reduce_sum(sq, dim=axis, keep_dim=True)
+    norm = sqrt(elementwise_add(summed, fill_like_scalar(summed, epsilon)))
+    return elementwise_div(x, norm)
+
+
+def fill_like_scalar(x, value):
+    from .tensor import _like
+
+    return _like(x, value)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32",
+                 name=None):
+    helper = LayerHelper("label_smooth", name=name)
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    ins = {"X": [label]}
+    if prior_dist is not None:
+        ins["PriorDist"] = [prior_dist]
+    helper.append_op("label_smooth", inputs=ins, outputs={"Out": [out]},
+                     attrs={"epsilon": float(epsilon)})
+    return out
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    helper = LayerHelper("pad", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("pad", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"paddings": list(paddings),
+                            "pad_value": float(pad_value)})
+    return out
+
+
+def pad2d(x, paddings=[0, 0, 0, 0], mode="constant", pad_value=0.0,
+          data_format="NCHW", name=None):
+    helper = LayerHelper("pad2d", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("pad2d", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"paddings": list(paddings), "mode": mode,
+                            "pad_value": float(pad_value),
+                            "data_format": data_format})
+    return out
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    raise NotImplementedError("unfold: pending im2col lowering")
+
+
+def image_resize(input, out_shape=None, scale=None, resample="BILINEAR",
+                 name=None):
+    op = ("bilinear_interp_v2" if resample.upper() == "BILINEAR"
+          else "nearest_interp_v2")
+    helper = LayerHelper("image_resize", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    attrs = {}
+    if out_shape is not None:
+        attrs["out_h"], attrs["out_w"] = int(out_shape[0]), int(out_shape[1])
+    else:
+        attrs["out_h"] = attrs["out_w"] = -1
+        attrs["scale"] = scale
+    helper.append_op(op, inputs={"X": [input]}, outputs={"Out": [out]},
+                     attrs=attrs)
+    return out
+
+
+def resize_nearest(input, out_shape=None, scale=None, name=None):
+    return image_resize(input, out_shape, scale, "NEAREST", name)
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None):
+    return image_resize(input, out_shape, scale, "BILINEAR", name)
+
+
+def cumsum(x, axis=-1, exclusive=False, reverse=False):
+    from .tensor import cumsum as _cumsum
+
+    return _cumsum(x, axis, exclusive, reverse)
+
+
+def isfinite(x):
+    helper = LayerHelper("isfinite")
+    out = helper.create_variable_for_type_inference(dtype="bool",
+                                                    stop_gradient=True)
+    helper.append_op("isfinite", inputs={"X": [x]}, outputs={"Out": [out]})
+    return out
+
+
+def interpolate(input, out_shape=None, scale=None, mode="nearest",
+                align_corners=False, name=None):
+    return image_resize(input, out_shape, scale,
+                        "BILINEAR" if mode == "bilinear" else "NEAREST", name)

@@ -1,0 +1,2 @@
+"""Model zoo built on the static-graph API (mirrors the reference's
+book/PaddleCV/PaddleNLP configs named in BASELINE.json)."""

@@ -1,0 +1,14 @@
+"""Op lowering library: importing this package registers every op's
+lowering rule (the TPU replacement for the reference's static
+REGISTER_OPERATOR / REGISTER_OP_*_KERNEL macros,
+/root/reference/paddle/fluid/framework/op_registry.h:256)."""
+
+from . import registry  # noqa: F401
+from . import math_ops  # noqa: F401
+from . import tensor_ops  # noqa: F401
+from . import nn_ops  # noqa: F401
+from . import random_ops  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import collective_ops  # noqa: F401
+from . import control_flow_ops  # noqa: F401
+from .registry import register_op, register_grad, registered_ops, has_op  # noqa: F401

@@ -1,0 +1,193 @@
+"""Collective op lowerings: c_allreduce / c_broadcast / c_allgather / ...
+
+The reference implements these as NCCL kernel launches on dedicated comm
+streams (/root/reference/paddle/fluid/operators/collective/ — 43 files:
+c_allreduce_op.h:38,109,157, c_broadcast_op.cu.cc, c_allgather_op.cu.cc,
+c_reducescatter_op.cu.cc, send_v2/recv_v2, plus c_gen_nccl_id/c_comm_init
+bootstrap and c_sync_*_stream fences).  TPU-native, each maps to an XLA
+collective over ICI (`lax.psum/all_gather/ppermute/...`) emitted inside the
+`shard_map` that the data-parallel compiler wraps around the program
+(paddle_tpu/parallel/compiler.py).  `ring_id` maps to a mesh axis name via
+ctx.mesh_axes; outside any mesh (single-device trace) every collective is
+the identity, so the same Program runs unmodified on one chip.
+
+Stream-sync fences and comm bootstrap become no-ops: XLA schedules
+collectives, and mesh construction replaces NCCL-id exchange
+(SURVEY.md §5.8).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import first, register_op
+
+
+def _axis_for(ctx, op):
+    """Resolve the mesh axis name for this op's ring_id; None when tracing
+    without a mesh (single device)."""
+    ring = op.attr("ring_id", 0)
+    axes = ctx.mesh_axes or {}
+    if f"ring_{ring}" in axes:
+        return axes[f"ring_{ring}"]
+    return axes.get("data")
+
+
+def _allreduce(reduce_fn):
+    def lower(ctx, op, ins):
+        x = first(ins, "X")
+        axis = _axis_for(ctx, op)
+        if axis is None:
+            return {"Out": [x]}
+        return {"Out": [reduce_fn(x, axis)]}
+
+    return lower
+
+
+register_op("c_allreduce_sum")(_allreduce(lambda x, a: lax.psum(x, a)))
+register_op("c_allreduce_max")(_allreduce(lambda x, a: lax.pmax(x, a)))
+register_op("c_allreduce_min")(_allreduce(lambda x, a: lax.pmin(x, a)))
+register_op("c_allreduce_prod")(_allreduce(
+    lambda x, a: jnp.exp(lax.psum(jnp.log(x), a))))
+register_op("mp_allreduce_sum")(_allreduce(lambda x, a: lax.psum(x, a)))
+
+
+@register_op("c_reduce_sum")
+def _c_reduce_sum(ctx, op, ins):
+    # All-reduce then mask would waste nothing on TPU: XLA's AllReduce is
+    # the primitive; every rank keeps the value (root semantics preserved
+    # for the root rank's consumers).
+    x = first(ins, "X")
+    axis = _axis_for(ctx, op)
+    return {"Out": [x if axis is None else lax.psum(x, axis)]}
+
+
+@register_op("c_broadcast")
+def _c_broadcast(ctx, op, ins):
+    x = first(ins, "X")
+    axis = _axis_for(ctx, op)
+    if axis is None:
+        return {"Out": [x]}
+    root = op.attr("root", 0)
+    idx = lax.axis_index(axis)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return {"Out": [lax.psum(masked, axis)]}
+
+
+@register_op("c_allgather")
+def _c_allgather(ctx, op, ins):
+    x = first(ins, "X")
+    axis = _axis_for(ctx, op)
+    if axis is None:
+        return {"Out": [x]}
+    g = lax.all_gather(x, axis)  # (nranks, ...) leading axis
+    return {"Out": [g.reshape((-1,) + x.shape[1:])]}
+
+
+@register_op("c_reducescatter")
+def _c_reducescatter(ctx, op, ins):
+    x = first(ins, "X")
+    axis = _axis_for(ctx, op)
+    if axis is None:
+        return {"Out": [x]}
+    n = lax.axis_size(axis)
+    return {"Out": [lax.psum_scatter(x, axis, scatter_dimension=0,
+                                     tiled=True)]}
+
+
+@register_op("c_concat")
+def _c_concat(ctx, op, ins):
+    x = first(ins, "X")
+    axis = _axis_for(ctx, op)
+    if axis is None:
+        return {"Out": [x]}
+    g = lax.all_gather(x, axis)
+    return {"Out": [jnp.concatenate(list(g), axis=-1)]}
+
+
+@register_op("c_split")
+def _c_split(ctx, op, ins):
+    x = first(ins, "X")
+    axis = _axis_for(ctx, op)
+    if axis is None:
+        return {"Out": [x]}
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    piece = x.shape[-1] // n
+    return {"Out": [lax.dynamic_slice_in_dim(x, idx * piece, piece, axis=-1)]}
+
+
+@register_op("c_identity")
+def _c_identity(ctx, op, ins):
+    return {"Out": [first(ins, "X")]}
+
+
+@register_op("alltoall")
+def _alltoall(ctx, op, ins):
+    x = first(ins, "X")
+    axis = _axis_for(ctx, op)
+    if axis is None:
+        return {"Out": [x]}
+    n = lax.axis_size(axis)
+    xs = x.reshape((n, x.shape[0] // n) + x.shape[1:])
+    out = lax.all_to_all(xs, axis, split_axis=0, concat_axis=0, tiled=False)
+    return {"Out": [out.reshape(x.shape)]}
+
+
+@register_op("c_sync_calc_stream")
+@register_op("c_sync_comm_stream")
+def _sync_stream(ctx, op, ins):
+    # XLA schedules compute/comm overlap itself; fences are identities.
+    xs = ins.get("X", [])
+    return {"Out": list(xs)}
+
+
+@register_op("barrier")
+def _barrier(ctx, op, ins):
+    x = first(ins, "X")
+    axis = _axis_for(ctx, op)
+    if axis is None or x is None:
+        return {"Out": [x]}
+    # A psum of zeros orders all ranks (XLA collective is the barrier).
+    z = lax.psum(jnp.zeros((), jnp.float32), axis)
+    return {"Out": [x + z.astype(x.dtype) * 0]}
+
+
+@register_op("c_comm_init")
+@register_op("c_comm_init_all")
+@register_op("c_gen_nccl_id")
+@register_op("c_wait_calc_stream")
+@register_op("c_wait_comm_stream")
+def _comm_bootstrap(ctx, op, ins):
+    # Comm setup is mesh construction in JAX (jax.distributed.initialize +
+    # Mesh); these startup ops are no-ops kept for program compatibility.
+    return {}
+
+
+@register_op("send_v2")
+def _send_v2(ctx, op, ins):
+    # P2P send: on TPU expressed as ppermute by the pipeline compiler;
+    # standalone send is a no-op at trace level (value is carried
+    # functionally by the paired recv's ppermute).
+    return {}
+
+
+@register_op("recv_v2")
+def _recv_v2(ctx, op, ins):
+    x = first(ins, "X", None)
+    if x is not None:
+        axis = _axis_for(ctx, op)
+        if axis is not None:
+            src = op.attr("peer", 0)
+            n = lax.axis_size(axis)
+            perm = [(src, d) for d in range(n)]
+            return {"Out": [lax.ppermute(x, axis, perm)]}
+        return {"Out": [x]}
+    shape = tuple(op.attr("out_shape", []))
+    import numpy as _np
+
+    from .registry import jdt
+
+    return {"Out": [jnp.zeros(shape, jdt(op.attr("dtype", "float32")))]}

@@ -1,0 +1,154 @@
+"""Control-flow op lowerings.
+
+The reference's control flow is interpreter-based sub-block execution
+(/root/reference/paddle/fluid/operators/controlflow/while_op.cc,
+conditional_block_op.cc, recurrent_op.cc) — an OpDesc holds a `sub_block`
+attr and the op re-enters the Executor on that block.  XLA requires
+functionalized control flow (`lax.while_loop` / `lax.cond`), so sub-blocks
+are lowered as pure functions over an explicit state vector: the set of
+vars the sub-block reads from / writes to the outer scope, computed
+statically here.
+
+`select_input`/`select_output` (used by the cond layer), `assert`, `print`
+are also lowered here.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import first, register_op
+
+
+def _subblock_io(block, extra_reads=()):
+    """Vars a sub-block reads from outer scope (before local def) and vars
+    it writes (locals included); returns (reads, writes) in stable order."""
+    defined = set()
+    reads, writes = [], []
+    seen_r, seen_w = set(), set()
+    for op in block.ops:
+        for n in op.input_arg_names():
+            if n not in defined and n not in seen_r:
+                seen_r.add(n)
+                reads.append(n)
+        for n in op.output_arg_names():
+            if n not in seen_w:
+                seen_w.add(n)
+                writes.append(n)
+            defined.add(n)
+    for n in extra_reads:
+        if n not in seen_r:
+            reads.append(n)
+    return reads, writes
+
+
+@register_op("while")
+def _while(ctx, op, ins):
+    from . import registry
+
+    block = ctx.block.program.blocks[op.attr("sub_block")]
+    cond_name = op.input("Condition")[0]
+    # State: every outer var the body reads or writes (loop-carried).
+    reads, writes = _subblock_io(block)
+    outer_env = {}
+    for slot, names in op.inputs.items():
+        for n, v in zip(names, ins.get(slot, [])):
+            outer_env[n] = v
+    carried = sorted(set(w for w in writes if w in outer_env) | {cond_name})
+    closed = [n for n in reads if n in outer_env and n not in carried]
+
+    def body(state):
+        i, vals = state
+        env = dict(zip(carried, vals))
+        env.update({n: outer_env[n] for n in closed})
+        # fold the loop counter into the rng key so random ops (dropout...)
+        # draw fresh values every iteration
+        bctx = registry.LowerCtx(jax.random.fold_in(ctx.base_key, i),
+                                 block=block, mesh_axes=ctx.mesh_axes)
+        registry.lower_block(bctx, block, env)
+        return (i + 1, tuple(env[n] for n in carried))
+
+    def cond(state):
+        _, vals = state
+        env = dict(zip(carried, vals))
+        return env[cond_name].reshape(())
+
+    init = (jnp.zeros((), jnp.int32), tuple(outer_env[n] for n in carried))
+    _, final = lax.while_loop(cond, body, init)
+    env = dict(zip(carried, final))
+    out_names = op.output("Out")
+    return {"Out": [env.get(n, outer_env.get(n)) for n in out_names],
+            "StepScopes": [jnp.zeros((0,), jnp.float32)]}
+
+
+@register_op("conditional_block")
+def _conditional_block(ctx, op, ins):
+    # Lowered by the cond layer into select_input form; direct conditional
+    # execution of an arbitrary sub-block uses lax.cond with the block's
+    # write-set as the result. Both branches must produce the same pytree;
+    # the single-block form runs the block and selects outputs vs. outer
+    # values.
+    from . import registry
+
+    block = ctx.block.program.blocks[op.attr("sub_block")]
+    cond_v = first(ins, "Cond")
+    outer_env = {}
+    for slot, names in op.inputs.items():
+        for n, v in zip(names, ins.get(slot, [])):
+            outer_env[n] = v
+    reads, writes = _subblock_io(block)
+    out_names = op.output("Out")
+
+    def run_block(_):
+        env = dict(outer_env)
+        bctx = registry.LowerCtx(ctx.base_key, block=block,
+                                 mesh_axes=ctx.mesh_axes)
+        registry.lower_block(bctx, block, env)
+        return tuple(env[n] for n in out_names)
+
+    # Both lax.cond branches must produce identical pytrees: derive the
+    # true-branch structure abstractly and zero-fill the skip branch for
+    # outputs with no outer value.
+    out_struct = jax.eval_shape(run_block, None)
+
+    def skip(_):
+        return tuple(
+            outer_env[n] if n in outer_env
+            else jnp.zeros(s.shape, s.dtype)
+            for n, s in zip(out_names, out_struct))
+
+    outs = lax.cond(cond_v.reshape(()), run_block, skip, operand=None)
+    return {"Out": list(outs), "Scope": [jnp.zeros((0,), jnp.float32)]}
+
+
+@register_op("select_input")
+def _select_input(ctx, op, ins):
+    xs = ins.get("X", [])
+    mask = first(ins, "Mask").reshape(()).astype(jnp.int32)
+    out = xs[0]
+    for i, x in enumerate(xs[1:], start=1):
+        out = jnp.where(mask == i, x, out)
+    return {"Out": [out]}
+
+
+@register_op("select_output")
+def _select_output(ctx, op, ins):
+    x = first(ins, "X")
+    return {"Out": [x for _ in op.output("Out")]}
+
+
+@register_op("assert")
+def _assert(ctx, op, ins):
+    # checkify-style asserts are host-side; under jit this is a no-op kept
+    # for program parity (reference assert_op.cc).
+    return {}
+
+
+@register_op("print")
+def _print(ctx, op, ins):
+    x = first(ins, "In")
+    if not ctx.abstract:
+        jax.debug.print(op.attr("message", "") + " {}", x)
+    return {"Out": [x]}

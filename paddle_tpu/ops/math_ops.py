@@ -1,0 +1,457 @@
+"""Math / elementwise / reduction / activation op lowerings.
+
+Capability parity with /root/reference/paddle/fluid/operators/
+(elementwise/*, activation_op.cc, matmul_op.cc, matmul_v2_op.cc, mul_op.cc,
+reduce_ops/*, softmax_op.cc, cast_op.cc, clip_op.cc, cum_op.cc,
+compare_op.cc, logical_op.cc, sum_op.cc, mean_op.cc).  Each rule emits
+jnp/lax ops; XLA fuses them into surrounding computations (the reference
+needs hand-written fusion passes + NVRTC codegen for this, SURVEY.md §2.3
+"fusion_group").
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import first, jdt, register_op
+
+
+def _bcast_y(x, y, axis):
+    """Paddle elementwise broadcast: align y's shape to x starting at
+    `axis` (elementwise_op_function.h in the reference); axis==-1 means
+    right-aligned numpy broadcasting.  Trailing 1-dims of y beyond x's
+    rank at that alignment are stripped first (paddle semantics)."""
+    if axis == -1:
+        return y
+    axis = axis if axis >= 0 else x.ndim - y.ndim
+    yshape = list(y.shape)
+    while yshape and yshape[-1] == 1 and axis + len(yshape) > x.ndim:
+        yshape.pop()
+    new_shape = [1] * axis + yshape + [1] * (x.ndim - axis - len(yshape))
+    return jnp.reshape(y, new_shape)
+
+
+def _elementwise(fn):
+    def lower(ctx, op, ins):
+        x, y = first(ins, "X"), first(ins, "Y")
+        y = _bcast_y(x, y, op.attr("axis", -1))
+        return {"Out": [fn(x, y)]}
+
+    return lower
+
+
+register_op("elementwise_add")(_elementwise(jnp.add))
+register_op("elementwise_sub")(_elementwise(jnp.subtract))
+register_op("elementwise_mul")(_elementwise(jnp.multiply))
+register_op("elementwise_div")(_elementwise(jnp.divide))
+register_op("elementwise_min")(_elementwise(jnp.minimum))
+register_op("elementwise_max")(_elementwise(jnp.maximum))
+register_op("elementwise_pow")(_elementwise(jnp.power))
+register_op("elementwise_mod")(_elementwise(jnp.mod))
+register_op("elementwise_floordiv")(_elementwise(jnp.floor_divide))
+
+
+@register_op("scale")
+def _scale(ctx, op, ins):
+    x = first(ins, "X")
+    scale = first(ins, "ScaleTensor", op.attr("scale", 1.0))
+    bias = op.attr("bias", 0.0)
+    if op.attr("bias_after_scale", True):
+        out = x * jnp.asarray(scale, x.dtype) + jnp.asarray(bias, x.dtype)
+    else:
+        out = (x + jnp.asarray(bias, x.dtype)) * jnp.asarray(scale, x.dtype)
+    return {"Out": [out]}
+
+
+@register_op("sum")
+def _sum(ctx, op, ins):
+    xs = [v for v in ins.get("X", []) if v is not None]
+    out = xs[0]
+    for v in xs[1:]:
+        out = out + v
+    return {"Out": [out]}
+
+
+@register_op("mean")
+def _mean(ctx, op, ins):
+    return {"Out": [jnp.mean(first(ins, "X"))]}
+
+
+@register_op("matmul")
+def _matmul(ctx, op, ins):
+    x, y = first(ins, "X"), first(ins, "Y")
+    if op.attr("transpose_X", False):
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if op.attr("transpose_Y", False):
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    out = x @ y
+    alpha = op.attr("alpha", 1.0)
+    if alpha != 1.0:
+        out = out * jnp.asarray(alpha, out.dtype)
+    return {"Out": [out]}
+
+
+@register_op("matmul_v2")
+def _matmul_v2(ctx, op, ins):
+    x, y = first(ins, "X"), first(ins, "Y")
+    if op.attr("trans_x", False) and x.ndim > 1:
+        x = jnp.swapaxes(x, -1, -2)
+    if op.attr("trans_y", False) and y.ndim > 1:
+        y = jnp.swapaxes(y, -1, -2)
+    return {"Out": [x @ y]}
+
+
+@register_op("mul")
+def _mul(ctx, op, ins):
+    x, y = first(ins, "X"), first(ins, "Y")
+    xn = op.attr("x_num_col_dims", 1)
+    yn = op.attr("y_num_col_dims", 1)
+    xm = x.reshape((-1, _prod(x.shape[xn:])))
+    ym = y.reshape((int(_prod(y.shape[:yn])), -1))
+    out = xm @ ym
+    out_shape = x.shape[:xn] + y.shape[yn:]
+    return {"Out": [out.reshape(out_shape)]}
+
+
+def _prod(t):
+    p = 1
+    for v in t:
+        p *= int(v)
+    return p
+
+
+@register_op("bmm")
+def _bmm(ctx, op, ins):
+    return {"Out": [jnp.matmul(first(ins, "X"), first(ins, "Y"))]}
+
+
+@register_op("dot")
+def _dot(ctx, op, ins):
+    x, y = first(ins, "X"), first(ins, "Y")
+    return {"Out": [jnp.sum(x * y, axis=-1)]}
+
+
+@register_op("mv")
+def _mv(ctx, op, ins):
+    return {"Out": [first(ins, "X") @ first(ins, "Vec")]}
+
+
+@register_op("addmm")
+def _addmm(ctx, op, ins):
+    inp, x, y = first(ins, "Input"), first(ins, "X"), first(ins, "Y")
+    alpha = op.attr("Alpha", 1.0)
+    beta = op.attr("Beta", 1.0)
+    return {"Out": [beta * inp + alpha * (x @ y)]}
+
+
+# -- reductions -------------------------------------------------------------
+
+def _reduce(fn):
+    def lower(ctx, op, ins):
+        x = first(ins, "X")
+        if op.attr("reduce_all", False):
+            axis = None
+        else:
+            axis = tuple(int(a) if a >= 0 else int(a) + x.ndim
+                         for a in op.attr("dim", [0]))
+        out = fn(x, axis=axis, keepdims=op.attr("keep_dim", False))
+        return {"Out": [out]}
+
+    return lower
+
+
+register_op("reduce_sum")(_reduce(jnp.sum))
+register_op("reduce_mean")(_reduce(jnp.mean))
+register_op("reduce_max")(_reduce(jnp.max))
+register_op("reduce_min")(_reduce(jnp.min))
+register_op("reduce_prod")(_reduce(jnp.prod))
+register_op("reduce_any")(_reduce(jnp.any))
+register_op("reduce_all")(_reduce(jnp.all))
+
+
+@register_op("logsumexp")
+def _logsumexp(ctx, op, ins):
+    x = first(ins, "X")
+    axis = op.attr("axis", None)
+    if op.attr("reduce_all", False) or axis is None:
+        axis = None
+    else:
+        axis = tuple(int(a) for a in axis)
+    return {"Out": [jax.scipy.special.logsumexp(x, axis=axis,
+                                                keepdims=op.attr("keepdim", False))]}
+
+
+@register_op("squared_l2_norm")
+def _squared_l2_norm(ctx, op, ins):
+    x = first(ins, "X")
+    return {"Out": [jnp.sum(jnp.square(x))]}
+
+
+@register_op("p_norm")
+def _p_norm(ctx, op, ins):
+    x = first(ins, "X")
+    porder = op.attr("porder", 2.0)
+    axis = op.attr("axis", -1)
+    keepdim = op.attr("keepdim", False)
+    out = jnp.linalg.norm(x, ord=porder, axis=axis, keepdims=keepdim)
+    return {"Out": [out.astype(x.dtype)]}
+
+
+@register_op("frobenius_norm")
+def _frob(ctx, op, ins):
+    x = first(ins, "X")
+    axis = tuple(op.attr("dim", [-2, -1]))
+    return {"Out": [jnp.sqrt(jnp.sum(jnp.square(x), axis=axis,
+                                     keepdims=op.attr("keep_dim", False)))]}
+
+
+# -- unary activations ------------------------------------------------------
+
+def _unary(fn):
+    def lower(ctx, op, ins):
+        return {"Out": [fn(first(ins, "X"))]}
+
+    return lower
+
+
+register_op("relu")(_unary(jax.nn.relu))
+register_op("sigmoid")(_unary(jax.nn.sigmoid))
+register_op("logsigmoid")(_unary(jax.nn.log_sigmoid))
+register_op("tanh")(_unary(jnp.tanh))
+register_op("tanh_shrink")(_unary(lambda x: x - jnp.tanh(x)))
+register_op("sqrt")(_unary(jnp.sqrt))
+register_op("rsqrt")(_unary(lax.rsqrt))
+register_op("square")(_unary(jnp.square))
+register_op("abs")(_unary(jnp.abs))
+register_op("exp")(_unary(jnp.exp))
+register_op("expm1")(_unary(jnp.expm1))
+register_op("log")(_unary(jnp.log))
+register_op("log2")(_unary(jnp.log2))
+register_op("log10")(_unary(jnp.log10))
+register_op("log1p")(_unary(jnp.log1p))
+register_op("floor")(_unary(jnp.floor))
+register_op("ceil")(_unary(jnp.ceil))
+register_op("round")(_unary(jnp.round))
+register_op("sin")(_unary(jnp.sin))
+register_op("cos")(_unary(jnp.cos))
+register_op("tan")(_unary(jnp.tan))
+register_op("asin")(_unary(jnp.arcsin))
+register_op("acos")(_unary(jnp.arccos))
+register_op("atan")(_unary(jnp.arctan))
+register_op("sinh")(_unary(jnp.sinh))
+register_op("cosh")(_unary(jnp.cosh))
+register_op("asinh")(_unary(jnp.arcsinh))
+register_op("acosh")(_unary(jnp.arccosh))
+register_op("atanh")(_unary(jnp.arctanh))
+register_op("reciprocal")(_unary(jnp.reciprocal))
+register_op("sign")(_unary(jnp.sign))
+register_op("erf")(_unary(jax.scipy.special.erf))
+register_op("softsign")(_unary(jax.nn.soft_sign))
+register_op("silu")(_unary(jax.nn.silu))
+register_op("mish")(_unary(lambda x: x * jnp.tanh(jax.nn.softplus(x))))
+
+
+@register_op("gelu")
+def _gelu(ctx, op, ins):
+    return {"Out": [jax.nn.gelu(first(ins, "X"),
+                                approximate=op.attr("approximate", False))]}
+
+
+@register_op("leaky_relu")
+def _leaky_relu(ctx, op, ins):
+    return {"Out": [jax.nn.leaky_relu(first(ins, "X"),
+                                      negative_slope=op.attr("alpha", 0.02))]}
+
+
+@register_op("relu6")
+def _relu6(ctx, op, ins):
+    return {"Out": [jnp.clip(first(ins, "X"), 0.0, op.attr("threshold", 6.0))]}
+
+
+@register_op("elu")
+def _elu(ctx, op, ins):
+    return {"Out": [jax.nn.elu(first(ins, "X"), alpha=op.attr("alpha", 1.0))]}
+
+
+@register_op("softplus")
+def _softplus(ctx, op, ins):
+    x = first(ins, "X")
+    beta = op.attr("beta", 1.0)
+    threshold = op.attr("threshold", 20.0)
+    out = jnp.where(x * beta > threshold, x, jax.nn.softplus(x * beta) / beta)
+    return {"Out": [out]}
+
+
+@register_op("swish")
+def _swish(ctx, op, ins):
+    x = first(ins, "X")
+    beta = op.attr("beta", 1.0)
+    return {"Out": [x * jax.nn.sigmoid(beta * x)]}
+
+
+@register_op("hard_sigmoid")
+def _hard_sigmoid(ctx, op, ins):
+    x = first(ins, "X")
+    slope = op.attr("slope", 0.2)
+    offset = op.attr("offset", 0.5)
+    return {"Out": [jnp.clip(slope * x + offset, 0.0, 1.0)]}
+
+
+@register_op("hard_swish")
+def _hard_swish(ctx, op, ins):
+    x = first(ins, "X")
+    threshold = op.attr("threshold", 6.0)
+    scale = op.attr("scale", 6.0)
+    offset = op.attr("offset", 3.0)
+    return {"Out": [x * jnp.clip(x + offset, 0.0, threshold) / scale]}
+
+
+@register_op("hard_shrink")
+def _hard_shrink(ctx, op, ins):
+    x = first(ins, "X")
+    t = op.attr("threshold", 0.5)
+    return {"Out": [jnp.where(jnp.abs(x) > t, x, jnp.zeros_like(x))]}
+
+
+@register_op("softshrink")
+def _softshrink(ctx, op, ins):
+    x = first(ins, "X")
+    l = op.attr("lambda", 0.5)
+    return {"Out": [jnp.where(x > l, x - l, jnp.where(x < -l, x + l,
+                                                      jnp.zeros_like(x)))]}
+
+
+@register_op("pow")
+def _pow(ctx, op, ins):
+    x = first(ins, "X")
+    factor = first(ins, "FactorTensor", op.attr("factor", 1.0))
+    return {"Out": [jnp.power(x, jnp.asarray(factor, x.dtype))]}
+
+
+@register_op("stanh")
+def _stanh(ctx, op, ins):
+    x = first(ins, "X")
+    a = op.attr("scale_a", 0.67)
+    b = op.attr("scale_b", 1.7159)
+    return {"Out": [b * jnp.tanh(a * x)]}
+
+
+@register_op("clip")
+def _clip(ctx, op, ins):
+    x = first(ins, "X")
+    mn = first(ins, "Min", op.attr("min", 0.0))
+    mx = first(ins, "Max", op.attr("max", 0.0))
+    return {"Out": [jnp.clip(x, mn, mx)]}
+
+
+@register_op("clip_by_norm")
+def _clip_by_norm(ctx, op, ins):
+    x = first(ins, "X")
+    max_norm = op.attr("max_norm", 1.0)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    scale = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12), 1.0)
+    return {"Out": [x * scale.astype(x.dtype)]}
+
+
+@register_op("cast")
+def _cast(ctx, op, ins):
+    out_dtype = op.attr("out_dtype", "float32")
+    return {"Out": [first(ins, "X").astype(jdt(out_dtype))]}
+
+
+@register_op("softmax")
+def _softmax(ctx, op, ins):
+    return {"Out": [jax.nn.softmax(first(ins, "X"), axis=op.attr("axis", -1))]}
+
+
+@register_op("log_softmax")
+def _log_softmax(ctx, op, ins):
+    return {"Out": [jax.nn.log_softmax(first(ins, "X"), axis=op.attr("axis", -1))]}
+
+
+@register_op("cumsum")
+def _cumsum(ctx, op, ins):
+    x = first(ins, "X")
+    axis = op.attr("axis", -1)
+    if op.attr("flatten", False):
+        x = x.reshape(-1)
+        axis = 0
+    if op.attr("reverse", False):
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis=axis)
+    if op.attr("exclusive", False):
+        out = out - x
+    if op.attr("reverse", False):
+        out = jnp.flip(out, axis)
+    return {"Out": [out]}
+
+
+@register_op("cumprod")
+def _cumprod(ctx, op, ins):
+    return {"Out": [jnp.cumprod(first(ins, "X"), axis=op.attr("dim", -1))]}
+
+
+@register_op("kron")
+def _kron(ctx, op, ins):
+    return {"Out": [jnp.kron(first(ins, "X"), first(ins, "Y"))]}
+
+
+@register_op("trace")
+def _trace(ctx, op, ins):
+    x = first(ins, "Input")
+    return {"Out": [jnp.trace(x, offset=op.attr("offset", 0),
+                              axis1=op.attr("axis1", 0), axis2=op.attr("axis2", 1))]}
+
+
+# -- comparisons / logical --------------------------------------------------
+
+def _compare(fn):
+    def lower(ctx, op, ins):
+        x, y = first(ins, "X"), first(ins, "Y")
+        y = _bcast_y(x, y, op.attr("axis", -1))
+        return {"Out": [fn(x, y)]}
+
+    return lower
+
+
+register_op("equal")(_compare(jnp.equal))
+register_op("not_equal")(_compare(jnp.not_equal))
+register_op("less_than")(_compare(jnp.less))
+register_op("less_equal")(_compare(jnp.less_equal))
+register_op("greater_than")(_compare(jnp.greater))
+register_op("greater_equal")(_compare(jnp.greater_equal))
+register_op("logical_and")(_compare(jnp.logical_and))
+register_op("logical_or")(_compare(jnp.logical_or))
+register_op("logical_xor")(_compare(jnp.logical_xor))
+register_op("maximum")(_compare(jnp.maximum))
+register_op("minimum")(_compare(jnp.minimum))
+
+
+@register_op("logical_not")
+def _logical_not(ctx, op, ins):
+    return {"Out": [jnp.logical_not(first(ins, "X"))]}
+
+
+@register_op("isfinite_v2")
+def _isfinite_v2(ctx, op, ins):
+    return {"Out": [jnp.isfinite(first(ins, "X"))]}
+
+
+@register_op("isinf_v2")
+def _isinf_v2(ctx, op, ins):
+    return {"Out": [jnp.isinf(first(ins, "X"))]}
+
+
+@register_op("isnan_v2")
+def _isnan_v2(ctx, op, ins):
+    return {"Out": [jnp.isnan(first(ins, "X"))]}
+
+
+@register_op("isfinite")
+def _isfinite(ctx, op, ins):
+    # v1 semantics: single bool — "does X contain any inf/nan" (reference
+    # isfinite_op.cc reduces over the whole tensor).
+    x = first(ins, "X")
+    return {"Out": [jnp.logical_not(jnp.all(jnp.isfinite(x)))]}

@@ -1,0 +1,521 @@
+"""Neural-network op lowerings: conv / pool / norm / embedding / losses.
+
+Capability parity with /root/reference/paddle/fluid/operators/
+(conv_op.cc, conv_transpose_op.cc, pool_op.cc, batch_norm_op.cc,
+layer_norm_op.cc, instance_norm_op.cc, group_norm_op.cc, dropout_op.cc,
+lookup_table_v2_op.cc, softmax_with_cross_entropy_op.cc, cross_entropy_op.cc,
+sigmoid_cross_entropy_with_logits_op.cc, bce_loss_op.cc, huber_loss_op.cc,
+accuracy_op.cc, label_smooth_op.cc, interpolate_op.cc).
+
+Convs lower to `lax.conv_general_dilated`, which XLA tiles straight onto the
+MXU; there is no im2col/cudnn-algo layer (reference operators/math/im2col.cc)
+to port.  Running-stat updates (batch_norm) are functional: MeanOut aliases
+the Mean input *by variable name*, and the Executor rebinds the new value
+into the scope after the step (the donation-based replacement for the
+reference's in-place variable mutation).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .registry import first, jdt, register_op
+
+
+def _conv_paddings(padding_algorithm, paddings, ksize, dilations):
+    if padding_algorithm == "SAME":
+        return "SAME"
+    if padding_algorithm == "VALID":
+        return [(0, 0)] * len(ksize)
+    if len(paddings) == len(ksize):
+        return [(int(p), int(p)) for p in paddings]
+    # [before0, after0, before1, after1, ...]
+    return [(int(paddings[2 * i]), int(paddings[2 * i + 1]))
+            for i in range(len(ksize))]
+
+
+@register_op("conv2d")
+@register_op("depthwise_conv2d")
+def _conv2d(ctx, op, ins):
+    x = first(ins, "Input")
+    w = first(ins, "Filter")
+    strides = tuple(op.attr("strides", [1, 1]))
+    dilations = tuple(op.attr("dilations", [1, 1]))
+    groups = op.attr("groups", 1)
+    if op.type == "depthwise_conv2d" and groups <= 1:
+        groups = x.shape[1] if op.attr("data_format", "NCHW") != "NHWC" else x.shape[-1]
+    fmt = op.attr("data_format", "NCHW")
+    if fmt in ("NCHW", "AnyLayout"):
+        dn = ("NCHW", "OIHW", "NCHW")
+    else:
+        dn = ("NHWC", "HWIO", "NHWC")
+        w = jnp.transpose(w, (2, 3, 1, 0))
+    pads = _conv_paddings(op.attr("padding_algorithm", "EXPLICIT"),
+                          op.attr("paddings", [0, 0]), w.shape[-2:], dilations)
+    out = lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=pads,
+        rhs_dilation=dilations, dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=None,
+    )
+    return {"Output": [out]}
+
+
+@register_op("conv2d_transpose")
+def _conv2d_transpose(ctx, op, ins):
+    x = first(ins, "Input")
+    w = first(ins, "Filter")  # (in_c, out_c/groups, kh, kw)
+    strides = tuple(op.attr("strides", [1, 1]))
+    dilations = tuple(op.attr("dilations", [1, 1]))
+    groups = op.attr("groups", 1)
+    pads = _conv_paddings(op.attr("padding_algorithm", "EXPLICIT"),
+                          op.attr("paddings", [0, 0]), w.shape[-2:], dilations)
+    if pads == "SAME":
+        kh, kw = w.shape[-2:]
+        pads = [((kh - 1) // 2, kh // 2), ((kw - 1) // 2, kw // 2)]
+    # conv_transpose = gradient of conv wrt input: use transposed conv via
+    # lax.conv_transpose with IOHW kernel spec.
+    out = lax.conv_transpose(
+        x, w, strides=strides,
+        padding=[(dilations[i] * (w.shape[-2:][i] - 1) - pads[i][0],
+                  dilations[i] * (w.shape[-2:][i] - 1) - pads[i][1])
+                 for i in range(2)],
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "IOHW", "NCHW"),
+        transpose_kernel=False,
+    ) if groups == 1 else _grouped_conv_transpose(x, w, strides, pads, dilations, groups)
+    output_padding = op.attr("output_padding", [])
+    if output_padding:
+        cfg = [(0, 0), (0, 0)] + [(0, int(p)) for p in output_padding]
+        out = jnp.pad(out, cfg)
+    return {"Output": [out]}
+
+
+def _grouped_conv_transpose(x, w, strides, pads, dilations, groups):
+    xs = jnp.split(x, groups, axis=1)
+    ws = jnp.split(w, groups, axis=0)
+    outs = []
+    for xg, wg in zip(xs, ws):
+        outs.append(lax.conv_transpose(
+            xg, wg, strides=strides,
+            padding=[(dilations[i] * (wg.shape[-2:][i] - 1) - pads[i][0],
+                      dilations[i] * (wg.shape[-2:][i] - 1) - pads[i][1])
+                     for i in range(2)],
+            rhs_dilation=dilations,
+            dimension_numbers=("NCHW", "IOHW", "NCHW")))
+    return jnp.concatenate(outs, axis=1)
+
+
+@register_op("conv3d")
+def _conv3d(ctx, op, ins):
+    x = first(ins, "Input")
+    w = first(ins, "Filter")
+    strides = tuple(op.attr("strides", [1, 1, 1]))
+    dilations = tuple(op.attr("dilations", [1, 1, 1]))
+    pads = _conv_paddings(op.attr("padding_algorithm", "EXPLICIT"),
+                          op.attr("paddings", [0, 0, 0]), w.shape[-3:], dilations)
+    out = lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=pads,
+        rhs_dilation=dilations, dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=op.attr("groups", 1))
+    return {"Output": [out]}
+
+
+@register_op("pool2d")
+def _pool2d(ctx, op, ins):
+    x = first(ins, "X")
+    fmt = op.attr("data_format", "NCHW")
+    ptype = op.attr("pooling_type", "max")
+    assert fmt in ("NCHW", "AnyLayout"), "NHWC pool: transpose at layer level"
+    if op.attr("global_pooling", False) or (
+            op.attr("adaptive", False) and list(op.attr("ksize")) == [1, 1]):
+        red = jnp.max if ptype == "max" else jnp.mean
+        return {"Out": [red(x, axis=(2, 3), keepdims=True)]}
+    if op.attr("adaptive", False):
+        oh, ow = op.attr("ksize")
+        h, w = x.shape[2], x.shape[3]
+        assert h % oh == 0 and w % ow == 0, (
+            "adaptive pool needs divisible output size on TPU (static shapes)")
+        x5 = x.reshape(x.shape[0], x.shape[1], oh, h // oh, ow, w // ow)
+        red = jnp.max if ptype == "max" else jnp.mean
+        return {"Out": [red(x5, axis=(3, 5))]}
+    ksize = tuple(op.attr("ksize", [2, 2]))
+    strides = tuple(op.attr("strides", [1, 1]))
+    pads = _conv_paddings(op.attr("padding_algorithm", "EXPLICIT"),
+                          op.attr("paddings", [0, 0]), ksize, (1, 1))
+    if pads == "SAME":
+        pads = "SAME"
+        pad_cfg = None
+    else:
+        pad_cfg = [(0, 0), (0, 0)] + list(pads)
+    window = (1, 1) + ksize
+    strides4 = (1, 1) + strides
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        out = lax.reduce_window(x, init, lax.max, window, strides4,
+                                padding=pads if pad_cfg is None else pad_cfg)
+    else:
+        summed = lax.reduce_window(x, 0.0, lax.add, window, strides4,
+                                   padding=pads if pad_cfg is None else pad_cfg)
+        if op.attr("exclusive", True):
+            ones = jnp.ones(x.shape, x.dtype)
+            counts = lax.reduce_window(ones, 0.0, lax.add, window, strides4,
+                                       padding=pads if pad_cfg is None else pad_cfg)
+            out = summed / counts
+        else:
+            out = summed / (ksize[0] * ksize[1])
+    return {"Out": [out]}
+
+
+@register_op("batch_norm")
+def _batch_norm(ctx, op, ins):
+    x = first(ins, "X")
+    scale = first(ins, "Scale")
+    bias = first(ins, "Bias")
+    mean = first(ins, "Mean")
+    var = first(ins, "Variance")
+    eps = op.attr("epsilon", 1e-5)
+    momentum = op.attr("momentum", 0.9)
+    layout = op.attr("data_layout", "NCHW")
+    is_test = op.attr("is_test", False) or op.attr("use_global_stats", False)
+
+    axes = tuple(i for i in range(x.ndim)
+                 if i != (1 if layout in ("NCHW", "AnyLayout") else x.ndim - 1))
+    c_axis = 1 if layout in ("NCHW", "AnyLayout") else x.ndim - 1
+    bshape = [1] * x.ndim
+    bshape[c_axis] = x.shape[c_axis]
+
+    if is_test:
+        use_mean, use_var = mean, var
+        saved_mean = jnp.zeros_like(mean)
+        saved_inv_std = jnp.zeros_like(var)
+        mean_out, var_out = mean, var
+    else:
+        bm = jnp.mean(x, axis=axes)
+        bv = jnp.mean(jnp.square(x), axis=axes) - jnp.square(bm)
+        if "data" in ctx.mesh_axes and op.type == "sync_batch_norm":
+            axis_name = ctx.mesh_axes["data"]
+            bm = lax.pmean(bm, axis_name)
+            bv = lax.pmean(jnp.mean(jnp.square(x), axis=axes), axis_name) - jnp.square(bm)
+        use_mean, use_var = bm, bv
+        mean_out = mean * momentum + bm * (1 - momentum)
+        var_out = var * momentum + bv * (1 - momentum)
+        saved_mean = bm
+        saved_inv_std = lax.rsqrt(bv + eps)
+
+    inv_std = lax.rsqrt(use_var + eps)
+    y = (x - use_mean.reshape(bshape)) * inv_std.reshape(bshape)
+    y = y * scale.reshape(bshape) + bias.reshape(bshape)
+    return {
+        "Y": [y],
+        "MeanOut": [mean_out],
+        "VarianceOut": [var_out],
+        "SavedMean": [saved_mean],
+        "SavedVariance": [saved_inv_std],
+        "ReserveSpace": [jnp.zeros((0,), x.dtype)],
+    }
+
+
+register_op("sync_batch_norm")(_batch_norm)
+
+
+@register_op("layer_norm")
+def _layer_norm(ctx, op, ins):
+    x = first(ins, "X")
+    scale = first(ins, "Scale", None)
+    bias = first(ins, "Bias", None)
+    eps = op.attr("epsilon", 1e-5)
+    bna = op.attr("begin_norm_axis", 1)
+    axes = tuple(range(bna, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
+    inv = lax.rsqrt(var + eps)
+    y = (x - mean) * inv
+    norm_shape = x.shape[bna:]
+    if scale is not None:
+        y = y * scale.reshape(norm_shape)
+    if bias is not None:
+        y = y + bias.reshape(norm_shape)
+    red = tuple(range(bna))
+    lead = 1
+    for s in x.shape[:bna]:
+        lead *= int(s)
+    return {"Y": [y], "Mean": [mean.reshape(lead)],
+            "Variance": [var.reshape(lead)]}
+
+
+@register_op("instance_norm")
+def _instance_norm(ctx, op, ins):
+    x = first(ins, "X")  # NCHW
+    scale = first(ins, "Scale", None)
+    bias = first(ins, "Bias", None)
+    eps = op.attr("epsilon", 1e-5)
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + eps)
+    if scale is not None:
+        y = y * scale.reshape((1, -1) + (1,) * (x.ndim - 2))
+    if bias is not None:
+        y = y + bias.reshape((1, -1) + (1,) * (x.ndim - 2))
+    n, c = x.shape[0], x.shape[1]
+    return {"Y": [y], "SavedMean": [mean.reshape(n * c)],
+            "SavedVariance": [lax.rsqrt(var + eps).reshape(n * c)]}
+
+
+@register_op("group_norm")
+def _group_norm(ctx, op, ins):
+    x = first(ins, "X")  # NCHW
+    scale = first(ins, "Scale", None)
+    bias = first(ins, "Bias", None)
+    eps = op.attr("epsilon", 1e-5)
+    groups = op.attr("groups", 1)
+    n, c = x.shape[0], x.shape[1]
+    xg = x.reshape((n, groups, c // groups) + x.shape[2:])
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xg - mean), axis=axes, keepdims=True)
+    y = ((xg - mean) * lax.rsqrt(var + eps)).reshape(x.shape)
+    if scale is not None:
+        y = y * scale.reshape((1, c) + (1,) * (x.ndim - 2))
+    if bias is not None:
+        y = y + bias.reshape((1, c) + (1,) * (x.ndim - 2))
+    return {"Y": [y], "Mean": [mean.reshape(n, groups)],
+            "Variance": [var.reshape(n, groups)]}
+
+
+@register_op("dropout")
+def _dropout(ctx, op, ins):
+    x = first(ins, "X")
+    p = op.attr("dropout_prob", 0.5)
+    is_test = op.attr("is_test", False)
+    impl = op.attr("dropout_implementation", "downgrade_in_infer")
+    if is_test:
+        out = x if impl == "upscale_in_train" else x * (1.0 - p)
+        return {"Out": [out], "Mask": [jnp.ones(x.shape, jnp.uint8)]}
+    keep = jax.random.bernoulli(ctx.rng_key(op), 1.0 - p, x.shape)
+    if impl == "upscale_in_train":
+        out = jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+    else:
+        out = jnp.where(keep, x, 0.0).astype(x.dtype)
+    return {"Out": [out], "Mask": [keep.astype(jnp.uint8)]}
+
+
+@register_op("lookup_table_v2")
+@register_op("lookup_table")
+def _lookup_table(ctx, op, ins):
+    w = first(ins, "W")
+    ids = first(ins, "Ids")
+    padding_idx = op.attr("padding_idx", -1)
+    if op.type == "lookup_table" and ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    out = jnp.take(w, ids, axis=0)
+    if padding_idx != -1:
+        mask = (ids == padding_idx)[..., None]
+        out = jnp.where(mask, jnp.zeros_like(out), out)
+    return {"Out": [out]}
+
+
+@register_op("softmax_with_cross_entropy")
+def _softmax_with_cross_entropy(ctx, op, ins):
+    logits = first(ins, "Logits")
+    label = first(ins, "Label")
+    axis = op.attr("axis", -1)
+    soft_label = op.attr("soft_label", False)
+    ignore_index = op.attr("ignore_index", -100)
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    softmax = jnp.exp(logp)
+    if soft_label:
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        lab = label
+        squeeze_axis = axis if axis >= 0 else axis + logits.ndim
+        if lab.ndim == logits.ndim and lab.shape[squeeze_axis] == 1:
+            lab = jnp.squeeze(lab, axis=squeeze_axis)
+        lab_safe = jnp.where(lab == ignore_index, 0, lab)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(lab_safe, squeeze_axis), axis=squeeze_axis)
+        loss = -picked
+        loss = jnp.where(jnp.expand_dims(lab == ignore_index, squeeze_axis),
+                         jnp.zeros_like(loss), loss)
+    return {"Softmax": [softmax], "Loss": [loss]}
+
+
+@register_op("cross_entropy")
+@register_op("cross_entropy2")
+def _cross_entropy(ctx, op, ins):
+    x = first(ins, "X")  # probabilities
+    label = first(ins, "Label")
+    soft_label = op.attr("soft_label", False)
+    ignore_index = op.attr("ignore_index", -100)
+    eps = 1e-12
+    if soft_label:
+        loss = -jnp.sum(label * jnp.log(x + eps), axis=-1, keepdims=True)
+    else:
+        lab = label
+        if lab.ndim == x.ndim and lab.shape[-1] == 1:
+            lab = lab[..., 0]
+        lab_safe = jnp.where(lab == ignore_index, 0, lab)
+        picked = jnp.take_along_axis(x, lab_safe[..., None], axis=-1)
+        loss = -jnp.log(picked + eps)
+        loss = jnp.where((lab == ignore_index)[..., None],
+                         jnp.zeros_like(loss), loss)
+    out = {"Y": [loss]}
+    if "XShape" in op.outputs:
+        out["XShape"] = [jnp.zeros((0,) + x.shape, x.dtype)]
+    if "MatchX" in op.outputs:
+        out["MatchX"] = [jnp.zeros_like(loss)]
+    return out
+
+
+@register_op("sigmoid_cross_entropy_with_logits")
+def _sce_logits(ctx, op, ins):
+    x = first(ins, "X")
+    label = first(ins, "Label")
+    ignore_index = op.attr("ignore_index", -100)
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    mask = label == ignore_index
+    loss = jnp.where(mask, jnp.zeros_like(loss), loss)
+    if op.attr("normalize", False):
+        denom = jnp.maximum(jnp.sum(1.0 - mask.astype(x.dtype)), 1.0)
+        loss = loss / denom
+    return {"Out": [loss]}
+
+
+@register_op("bce_loss")
+def _bce_loss(ctx, op, ins):
+    x = first(ins, "X")
+    label = first(ins, "Label")
+    eps = 1e-12
+    loss = -(label * jnp.log(x + eps) + (1 - label) * jnp.log(1 - x + eps))
+    return {"Out": [loss]}
+
+
+@register_op("huber_loss")
+def _huber_loss(ctx, op, ins):
+    x = first(ins, "X")
+    y = first(ins, "Y")
+    delta = op.attr("delta", 1.0)
+    r = y - x
+    ar = jnp.abs(r)
+    loss = jnp.where(ar <= delta, 0.5 * jnp.square(r),
+                     delta * (ar - 0.5 * delta))
+    return {"Out": [loss], "Residual": [r]}
+
+
+@register_op("smooth_l1_loss")
+def _smooth_l1(ctx, op, ins):
+    x = first(ins, "X")
+    y = first(ins, "Y")
+    sigma = op.attr("sigma", 1.0)
+    s2 = sigma * sigma
+    diff = x - y
+    ad = jnp.abs(diff)
+    elem = jnp.where(ad < 1.0 / s2, 0.5 * s2 * jnp.square(diff), ad - 0.5 / s2)
+    out = jnp.sum(elem.reshape(x.shape[0], -1), axis=1, keepdims=True)
+    return {"Out": [out], "Diff": [diff]}
+
+
+@register_op("kldiv_loss")
+def _kldiv(ctx, op, ins):
+    x = first(ins, "X")  # log-probs
+    target = first(ins, "Target")
+    loss = jnp.where(target > 0, target * (jnp.log(target) - x),
+                     jnp.zeros_like(target))
+    red = op.attr("reduction", "mean")
+    if red == "mean":
+        loss = jnp.mean(loss)
+    elif red == "sum":
+        loss = jnp.sum(loss)
+    elif red == "batchmean":
+        loss = jnp.sum(loss) / x.shape[0]
+    return {"Loss": [loss]}
+
+
+@register_op("label_smooth")
+def _label_smooth(ctx, op, ins):
+    x = first(ins, "X")
+    eps = op.attr("epsilon", 0.0)
+    dist = first(ins, "PriorDist", None)
+    k = x.shape[-1]
+    if dist is not None:
+        out = (1 - eps) * x + eps * dist
+    else:
+        out = (1 - eps) * x + eps / k
+    return {"Out": [out]}
+
+
+@register_op("accuracy")
+def _accuracy(ctx, op, ins):
+    indices = first(ins, "Indices")
+    label = first(ins, "Label")
+    if label.ndim == 2 and label.shape[1] == 1:
+        lab = label[:, 0]
+    else:
+        lab = label
+    correct_k = jnp.any(indices == lab[:, None].astype(indices.dtype), axis=1)
+    num_correct = jnp.sum(correct_k.astype(jnp.int32))
+    total = jnp.asarray(indices.shape[0], jnp.int32)
+    acc = num_correct.astype(jnp.float32) / total.astype(jnp.float32)
+    return {"Accuracy": [acc], "Correct": [num_correct], "Total": [total]}
+
+
+@register_op("nearest_interp_v2")
+@register_op("nearest_interp")
+def _nearest_interp(ctx, op, ins):
+    x = first(ins, "X")  # NCHW
+    oh = op.attr("out_h", -1)
+    ow = op.attr("out_w", -1)
+    scale = op.attr("scale", 0.0)
+    if oh <= 0:
+        if isinstance(scale, (list, tuple)):
+            sh, sw = scale[0], scale[1] if len(scale) > 1 else scale[0]
+        else:
+            sh = sw = scale
+        oh = int(x.shape[2] * sh)
+        ow = int(x.shape[3] * sw)
+    out = jax.image.resize(x, (x.shape[0], x.shape[1], oh, ow), method="nearest")
+    return {"Out": [out]}
+
+
+@register_op("bilinear_interp_v2")
+@register_op("bilinear_interp")
+def _bilinear_interp(ctx, op, ins):
+    x = first(ins, "X")
+    oh = op.attr("out_h", -1)
+    ow = op.attr("out_w", -1)
+    if oh <= 0:
+        scale = op.attr("scale", 1.0)
+        if isinstance(scale, (list, tuple)):
+            sh, sw = scale[0], scale[1] if len(scale) > 1 else scale[0]
+        else:
+            sh = sw = scale
+        oh = int(x.shape[2] * sh)
+        ow = int(x.shape[3] * sw)
+    out = jax.image.resize(x, (x.shape[0], x.shape[1], oh, ow), method="bilinear")
+    return {"Out": [out]}
+
+
+@register_op("prelu")
+def _prelu(ctx, op, ins):
+    x = first(ins, "X")
+    alpha = first(ins, "Alpha")
+    mode = op.attr("mode", "all")
+    if mode == "channel":
+        a = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    else:
+        a = alpha.reshape((1,) * (x.ndim - alpha.ndim) + alpha.shape) \
+            if mode == "element" else alpha.reshape(())
+    return {"Out": [jnp.where(x >= 0, x, a * x)]}
+
+
+@register_op("maxout")
+def _maxout(ctx, op, ins):
+    x = first(ins, "X")  # NCHW
+    groups = op.attr("groups", 1)
+    n, c = x.shape[0], x.shape[1]
+    xg = x.reshape((n, c // groups, groups) + x.shape[2:])
+    return {"Out": [jnp.max(xg, axis=2)]}

@@ -1,0 +1,119 @@
+"""Random op lowerings over JAX's counter-based PRNG.
+
+Capability parity with /root/reference/paddle/fluid/operators/
+(gaussian_random_op.cc, uniform_random_op.cc,
+truncated_gaussian_random_op.cc, randint_op.cc, randperm_op.cc,
+bernoulli_op.cc, multinomial_op.cc) and the per-device Generator state
+(/root/reference/paddle/fluid/framework/generator.cc).
+
+The reference threads mutable generator state through kernels; here every op
+derives a deterministic key — `fold_in(step_key, op_id)`, or PRNGKey(seed)
+when the op carries a nonzero `seed` attr (OpTest reproducibility).  This is
+what makes whole-block XLA compilation and grad-op replay sound.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import first, jdt, register_op
+
+
+def _shape_attr(ctx, op, ins):
+    shape = first(ins, "ShapeTensor", op.attr("shape", []))
+    if hasattr(shape, "tolist"):
+        shape = shape.tolist()
+    return tuple(int(s) for s in shape)
+
+
+@register_op("gaussian_random")
+def _gaussian_random(ctx, op, ins):
+    shape = _shape_attr(ctx, op, ins)
+    dt = jdt(op.attr("dtype", "float32"))
+    mean = op.attr("mean", 0.0)
+    std = op.attr("std", 1.0)
+    x = jax.random.normal(ctx.rng_key(op), shape, dtype=dt)
+    return {"Out": [x * std + mean]}
+
+
+@register_op("uniform_random")
+def _uniform_random(ctx, op, ins):
+    shape = _shape_attr(ctx, op, ins)
+    dt = jdt(op.attr("dtype", "float32"))
+    lo = op.attr("min", -1.0)
+    hi = op.attr("max", 1.0)
+    x = jax.random.uniform(ctx.rng_key(op), shape, dtype=dt,
+                           minval=lo, maxval=hi)
+    return {"Out": [x]}
+
+
+@register_op("uniform_random_batch_size_like")
+def _uniform_random_bsl(ctx, op, ins):
+    inp = first(ins, "Input")
+    shape = list(op.attr("shape", []))
+    shape[op.attr("output_dim_idx", 0)] = inp.shape[op.attr("input_dim_idx", 0)]
+    dt = jdt(op.attr("dtype", "float32"))
+    x = jax.random.uniform(ctx.rng_key(op), tuple(shape), dtype=dt,
+                           minval=op.attr("min", -1.0), maxval=op.attr("max", 1.0))
+    return {"Out": [x]}
+
+
+@register_op("truncated_gaussian_random")
+def _truncated_gaussian(ctx, op, ins):
+    shape = tuple(int(s) for s in op.attr("shape", []))
+    dt = jdt(op.attr("dtype", "float32"))
+    mean = op.attr("mean", 0.0)
+    std = op.attr("std", 1.0)
+    x = jax.random.truncated_normal(ctx.rng_key(op), -2.0, 2.0, shape, dtype=dt)
+    return {"Out": [x * std + mean]}
+
+
+@register_op("randint")
+def _randint(ctx, op, ins):
+    shape = _shape_attr(ctx, op, ins)
+    dt = jdt(op.attr("dtype", "int64"))
+    x = jax.random.randint(ctx.rng_key(op), shape,
+                           op.attr("low", 0), op.attr("high", 1), dtype=dt)
+    return {"Out": [x]}
+
+
+@register_op("randperm")
+def _randperm(ctx, op, ins):
+    n = op.attr("n", 1)
+    dt = jdt(op.attr("dtype", "int64"))
+    return {"Out": [jax.random.permutation(ctx.rng_key(op), n).astype(dt)]}
+
+
+@register_op("bernoulli")
+def _bernoulli(ctx, op, ins):
+    x = first(ins, "X")
+    out = jax.random.bernoulli(ctx.rng_key(op), x).astype(x.dtype)
+    return {"Out": [out]}
+
+
+@register_op("multinomial")
+def _multinomial(ctx, op, ins):
+    x = first(ins, "X")
+    n = op.attr("num_samples", 1)
+    replacement = op.attr("replacement", False)
+    logits = jnp.log(jnp.maximum(x, 1e-30))
+    if replacement:
+        out = jax.random.categorical(ctx.rng_key(op), logits, axis=-1,
+                                     shape=(n,) + x.shape[:-1]).T
+        if x.ndim == 1:
+            out = out.reshape(n)
+    else:
+        # Gumbel top-k trick for sampling without replacement.
+        g = jax.random.gumbel(ctx.rng_key(op), x.shape)
+        _, out = jax.lax.top_k(logits + g, n)
+    return {"Out": [out.astype(jnp.int64)]}
+
+
+@register_op("shuffle_channel")
+def _shuffle_channel(ctx, op, ins):
+    x = first(ins, "X")
+    group = op.attr("group", 1)
+    n, c, h, w = x.shape
+    out = x.reshape(n, group, c // group, h, w).swapaxes(1, 2).reshape(x.shape)
+    return {"Out": [out]}

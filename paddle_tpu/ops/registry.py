@@ -1,0 +1,300 @@
+"""Op lowering registry: the TPU-native replacement for the reference's
+OpKernel machinery.
+
+The reference registers per-(place, dtype, layout) kernel functors into a
+global OpInfoMap (/root/reference/paddle/fluid/framework/op_registry.h:256,
+operator.h:465) and dispatches them one-by-one from an interpreter loop
+(executor.cc:474).  Here an op type maps to a single *lowering rule*: a
+Python function that emits jax/XLA operations.  The Executor traces every op
+of a block through these rules into ONE jitted XLA computation; XLA then does
+the fusion/layout/memory work the reference implements by hand (fusion
+passes, allocators, GC — SURVEY.md §7).
+
+Gradients are generic: `append_backward` (fluid/backward.py) emits
+`<type>_grad` ops carrying a `fwd_op_id` attr.  During block tracing, the
+forward op is evaluated under `jax.vjp` (only when some grad op references
+it) and the vjp function is cached so the backward op reuses the forward
+residuals — i.e. exact reverse-mode AD over the program IR, with zero
+recompute inside one XLA computation.  Ops can still register a custom grad
+lowering (`register_grad`) when the vjp of the forward rule is not the right
+derivative (or a Pallas kernel is faster).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..fluid import core
+from ..fluid.framework import EMPTY_VAR_NAME, Operator
+
+# slot-name-map of jnp values: {"X": [arr], "Y": [arr0, arr1], ...}
+InsOuts = Dict[str, List[Any]]
+
+_FORWARD: Dict[str, Callable] = {}
+_GRAD: Dict[str, Callable] = {}
+# ops whose lowering rule *intentionally* mutates no state and has no
+# outputs (e.g. barriers); the tracer skips env assignment for them.
+
+
+def register_op(op_type: str):
+    """Register the forward lowering rule for `op_type`.
+
+    Rule signature: fn(ctx: LowerCtx, op: Operator, ins: InsOuts) -> InsOuts
+    """
+
+    def deco(fn):
+        _FORWARD[op_type] = fn
+        return fn
+
+    return deco
+
+
+def register_grad(op_type: str):
+    """Register a custom grad lowering for `<op_type>_grad`, overriding the
+    generic vjp path.  Signature:
+    fn(ctx, grad_op, fwd_ins, fwd_outs, out_grads) -> {input_slot: [grads]}
+    where out_grads maps fwd output slots to cotangents (None if absent)."""
+
+    def deco(fn):
+        _GRAD[op_type] = fn
+        return fn
+
+    return deco
+
+
+def has_op(op_type: str) -> bool:
+    if op_type in _FORWARD:
+        return True
+    if op_type.endswith("_grad") and op_type[: -len("_grad")] in _FORWARD:
+        return True
+    return False
+
+
+def registered_ops() -> List[str]:
+    return sorted(_FORWARD)
+
+
+class LowerCtx:
+    """Per-trace context: deterministic RNG, vjp cache, distributed axis
+    info.  One instance per block trace."""
+
+    def __init__(self, base_key, block=None, mesh_axes: Optional[dict] = None,
+                 abstract: bool = False):
+        self.base_key = base_key
+        self.block = block
+        # fwd op id -> (out_struct, vjp_fn, diff_paths) for grad reuse
+        self.vjp_cache: Dict[int, tuple] = {}
+        # fwd op ids referenced by *_grad ops in the block being traced
+        self.need_vjp: set = set()
+        # axis names available for collectives when tracing under shard_map
+        self.mesh_axes = mesh_axes or {}
+        self.abstract = abstract  # True during eval_shape-based InferShape
+
+    def rng_key(self, op: Operator):
+        """Deterministic per-op key: seed attr wins (OpTest reproducibility),
+        else fold the op id into the per-step base key."""
+        seed = op.attr("seed", 0)
+        if seed:
+            return jax.random.PRNGKey(seed)
+        return jax.random.fold_in(self.base_key, op.id & 0x7FFFFFFF)
+
+
+# ---------------------------------------------------------------------------
+# Helpers for lowering rules
+# ---------------------------------------------------------------------------
+
+def first(ins: InsOuts, slot: str, default=None):
+    vals = ins.get(slot) or []
+    return vals[0] if vals else default
+
+
+def jdt(dtype_name) -> jnp.dtype:
+    return jnp.dtype(core.np_dtype(dtype_name))
+
+
+def _is_diff(x) -> bool:
+    return jnp.issubdtype(jnp.result_type(x), jnp.inexact)
+
+
+# ---------------------------------------------------------------------------
+# Block tracing
+# ---------------------------------------------------------------------------
+
+def scan_need_vjp(block) -> set:
+    """Forward op ids whose vjp must be cached (referenced by grad ops that
+    have no custom grad lowering)."""
+    need = set()
+    for op in block.ops:
+        fid = op.attr("fwd_op_id", None)
+        if fid is None:
+            continue
+        fwd_type = op.attr("fwd_op_type", "")
+        if fwd_type not in _GRAD:
+            need.add(fid)
+    return need
+
+
+def lower_block(ctx: LowerCtx, block, env: Dict[str, Any]) -> None:
+    """Trace every op of `block` into jax ops, reading/writing `env`
+    (var name -> traced value).  This is the single-XLA-computation
+    replacement for the reference's interpreter hot loop
+    (executor.cc:474)."""
+    ctx.need_vjp |= scan_need_vjp(block)
+    for op in block.ops:
+        lower_op(ctx, op, env)
+
+
+def _gather_ins(op: Operator, env) -> InsOuts:
+    ins: InsOuts = {}
+    for slot, names in op.inputs.items():
+        ins[slot] = [env[n] if n != EMPTY_VAR_NAME else None for n in names]
+    return ins
+
+
+def _bind_outs(op: Operator, outs: InsOuts, env) -> None:
+    for slot, names in op.outputs.items():
+        vals = outs.get(slot, [])
+        for i, name in enumerate(names):
+            if name == EMPTY_VAR_NAME:
+                continue
+            if i < len(vals) and vals[i] is not None:
+                env[name] = vals[i]
+
+
+def lower_op(ctx: LowerCtx, op: Operator, env: Dict[str, Any]) -> None:
+    if op.attr("fwd_op_id", None) is not None:
+        _lower_grad_op(ctx, op, env)
+        return
+    fn = _FORWARD.get(op.type)
+    if fn is None:
+        raise NotImplementedError(f"no lowering registered for op {op.type!r}")
+
+    if op.id in ctx.need_vjp:
+        outs = _eval_with_vjp(ctx, op, fn, _gather_ins(op, env))
+    else:
+        outs = fn(ctx, op, _gather_ins(op, env))
+    _bind_outs(op, outs, env)
+
+
+def _eval_with_vjp(ctx: LowerCtx, op: Operator, fn, ins: InsOuts) -> InsOuts:
+    """Evaluate a forward op under jax.vjp, caching the vjp function so the
+    matching grad op later in the same trace reuses residuals."""
+    diff_paths = []  # (slot, index)
+    diff_vals = []
+    for slot, vals in ins.items():
+        for i, v in enumerate(vals):
+            if v is not None and _is_diff(v):
+                diff_paths.append((slot, i))
+                diff_vals.append(v)
+
+    def f(dvals):
+        merged = {s: list(vs) for s, vs in ins.items()}
+        for (slot, i), v in zip(diff_paths, dvals):
+            merged[slot][i] = v
+        return fn(ctx, op, merged)
+
+    outs, vjp_fn = jax.vjp(f, diff_vals)
+    ctx.vjp_cache[op.id] = (outs, vjp_fn, diff_paths)
+    return outs
+
+
+def _zeros_like_out(v):
+    return jnp.zeros(jnp.shape(v), jnp.result_type(v)) if v is not None else None
+
+
+def _lower_grad_op(ctx: LowerCtx, op: Operator, env) -> None:
+    fwd_type = op.attr("fwd_op_type")
+    fwd_id = op.attr("fwd_op_id")
+
+    # Split grad-op inputs into forward inputs/outputs and output-cotangents.
+    fwd_ins: InsOuts = {}
+    fwd_outs: InsOuts = {}
+    out_grads: InsOuts = {}
+    fwd_in_slots = set(op.attr("fwd_input_slots", []))
+    fwd_out_slots = set(op.attr("fwd_output_slots", []))
+    for slot, names in op.inputs.items():
+        vals = [env.get(n) if n != EMPTY_VAR_NAME else None for n in names]
+        if slot.endswith("@GRAD"):
+            out_grads[slot[: -len("@GRAD")]] = vals
+        elif slot in fwd_in_slots:
+            fwd_ins[slot] = vals
+        elif slot in fwd_out_slots:
+            fwd_outs[slot] = vals
+
+    custom = _GRAD.get(fwd_type)
+    if custom is not None:
+        in_grads = custom(ctx, op, fwd_ins, fwd_outs, out_grads)
+        _bind_outs(op, {f"{s}@GRAD": v for s, v in in_grads.items()}, env)
+        return
+
+    cached = ctx.vjp_cache.get(fwd_id)
+    if cached is None:
+        # Backward-only program (e.g. a pruned grad block): re-lower the
+        # forward op under vjp now.  XLA CSE dedupes any recompute that
+        # overlaps the forward pass.
+        fwd_op = Operator(op.block, fwd_id, fwd_type, {}, {},
+                          {k: v for k, v in op.attrs.items()
+                           if k not in ("fwd_op_id", "fwd_op_type",
+                                        "fwd_input_slots", "fwd_output_slots")})
+        fwd_op.inputs = {s: [f"__in_{s}_{i}" for i in range(len(v))]
+                         for s, v in fwd_ins.items()}
+        fn = _FORWARD[fwd_type]
+        _eval_with_vjp(ctx, fwd_op, fn, fwd_ins)
+        cached = ctx.vjp_cache[fwd_id]
+
+    outs, vjp_fn, diff_paths = cached
+    # Build cotangent pytree matching `outs` structure.
+    ct = {}
+    for slot, vals in outs.items():
+        g = out_grads.get(slot)
+        ct[slot] = [
+            (g[i] if g is not None and i < len(g) and g[i] is not None
+             else _zeros_like_out(v))
+            for i, v in enumerate(vals)
+        ]
+    (d_in_vals,) = vjp_fn(ct)
+
+    grads: InsOuts = {}
+    for (slot, i), g in zip(diff_paths, d_in_vals):
+        grads.setdefault(f"{slot}@GRAD", [])
+        lst = grads[f"{slot}@GRAD"]
+        while len(lst) <= i:
+            lst.append(None)
+        lst[i] = g
+    _bind_outs(op, grads, env)
+
+
+# ---------------------------------------------------------------------------
+# Build-time shape inference via eval_shape (framework.Block._infer_shapes)
+# ---------------------------------------------------------------------------
+
+def eval_op_shape(op: Operator, block, batch_probe: int) -> Dict[str, list]:
+    """Abstractly evaluate one op's lowering with -1 dims replaced by
+    `batch_probe`; returns {slot: [ShapeDtypeStruct,...]}."""
+    specs: InsOuts = {}
+    for slot, names in op.inputs.items():
+        vals = []
+        for n in names:
+            if n == EMPTY_VAR_NAME:
+                vals.append(None)
+                continue
+            v = block._var_recursive(n)
+            if v.shape is None:
+                raise ValueError(f"input {n} has unknown shape")
+            shape = tuple(batch_probe if d == -1 else d for d in v.shape)
+            vals.append(jax.ShapeDtypeStruct(shape, jdt(v.dtype)))
+        specs[slot] = vals
+    fn = _FORWARD.get(op.type)
+    if fn is None:
+        raise NotImplementedError(op.type)
+
+    ctx = LowerCtx(jax.random.PRNGKey(0), block=block, abstract=True)
+
+    def f(ins):
+        return fn(ctx, op, ins)
+
+    return jax.eval_shape(f, specs)

@@ -1,0 +1,585 @@
+"""Tensor creation / manipulation op lowerings.
+
+Capability parity with /root/reference/paddle/fluid/operators/
+(fill_constant_op.cc, reshape_op.cc, transpose_op.cc, concat_op.cc,
+split_op.cc, stack_op.cc, slice_op.cc, expand_v2_op.cc, tile_op.cc,
+gather_op.cc, gather_nd_op.cc, scatter_op.cc, index_select_op.cc,
+where_op.cc, one_hot_v2_op.cc, arg_max_op.cc, argsort_op.cc,
+top_k_v2_op.cc, range_op.cc, linspace_op.cc, eye_op.cc, assign_op.cc,
+increment_op.cc, pad3d_op.cc, roll_op.cc, flip_op.cc, tril_triu_op.cc,
+shape_op.cc, squeeze_op.cc, unsqueeze_op.cc, flatten_op.cc).
+
+XLA requires static shapes, so value-dependent-shape ops of the reference
+(where_index/masked_select) are exposed at the layer level with explicit
+max-size + validity-mask semantics rather than as dynamic-shape kernels.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import first, jdt, register_op
+
+
+@register_op("fill_constant")
+def _fill_constant(ctx, op, ins):
+    shape = first(ins, "ShapeTensor", op.attr("shape", []))
+    if hasattr(shape, "tolist"):
+        shape = [int(s) for s in shape.tolist()]
+    value = op.attr("value", 0.0)
+    sv = op.attr("str_value", "")
+    if sv:
+        value = float(sv)
+    dt = jdt(op.attr("dtype", "float32"))
+    return {"Out": [jnp.full(tuple(int(s) for s in shape), value, dtype=dt)]}
+
+
+@register_op("fill_constant_batch_size_like")
+def _fill_constant_bsl(ctx, op, ins):
+    x = first(ins, "Input")
+    shape = list(op.attr("shape", []))
+    in_idx = op.attr("input_dim_idx", 0)
+    out_idx = op.attr("output_dim_idx", 0)
+    shape[out_idx] = x.shape[in_idx]
+    dt = jdt(op.attr("dtype", "float32"))
+    return {"Out": [jnp.full(tuple(shape), op.attr("value", 0.0), dtype=dt)]}
+
+
+@register_op("fill_zeros_like")
+def _fill_zeros_like(ctx, op, ins):
+    return {"Out": [jnp.zeros_like(first(ins, "X"))]}
+
+
+@register_op("fill_any_like")
+def _fill_any_like(ctx, op, ins):
+    x = first(ins, "X")
+    dt = op.attr("dtype", None)
+    dt = x.dtype if dt in (None, -1) else jdt(dt)
+    return {"Out": [jnp.full(x.shape, op.attr("value", 0.0), dtype=dt)]}
+
+
+@register_op("assign")
+def _assign(ctx, op, ins):
+    return {"Out": [first(ins, "X")]}
+
+
+@register_op("shape")
+def _shape(ctx, op, ins):
+    x = first(ins, "Input")
+    return {"Out": [jnp.array(x.shape, dtype=jnp.int32)]}
+
+
+@register_op("size")
+def _size(ctx, op, ins):
+    x = first(ins, "Input")
+    return {"Out": [jnp.array(x.size, dtype=jnp.int64)]}
+
+
+def _do_reshape(x, shape):
+    shape = list(shape)
+    out = []
+    for i, s in enumerate(shape):
+        if s == 0:  # copy input dim (paddle semantics)
+            out.append(x.shape[i])
+        else:
+            out.append(int(s))
+    return jnp.reshape(x, tuple(out))
+
+
+@register_op("reshape")
+def _reshape(ctx, op, ins):
+    return {"Out": [_do_reshape(first(ins, "X"), op.attr("shape", []))]}
+
+
+@register_op("reshape2")
+def _reshape2(ctx, op, ins):
+    x = first(ins, "X")
+    shape = first(ins, "Shape", None)
+    if shape is not None and hasattr(shape, "tolist"):
+        shape = [int(s) for s in shape.tolist()]
+    if shape is None:
+        shape = op.attr("shape", [])
+    return {"Out": [_do_reshape(x, shape)],
+            "XShape": [jnp.zeros((0,) + x.shape, dtype=x.dtype)]}
+
+
+@register_op("transpose")
+@register_op("transpose2")
+def _transpose2(ctx, op, ins):
+    x = first(ins, "X")
+    perm = op.attr("axis", list(range(x.ndim))[::-1])
+    out = {"Out": [jnp.transpose(x, perm)]}
+    if "XShape" in op.outputs:
+        out["XShape"] = [jnp.zeros((0,) + x.shape, dtype=x.dtype)]
+    return out
+
+
+@register_op("squeeze")
+@register_op("squeeze2")
+def _squeeze2(ctx, op, ins):
+    x = first(ins, "X")
+    axes = op.attr("axes", [])
+    if not axes:
+        axes = [i for i, s in enumerate(x.shape) if s == 1]
+    axes = [a if a >= 0 else a + x.ndim for a in axes]
+    axes = [a for a in axes if x.shape[a] == 1]
+    out = {"Out": [jnp.squeeze(x, axis=tuple(axes)) if axes else x]}
+    if "XShape" in op.outputs:
+        out["XShape"] = [jnp.zeros((0,) + x.shape, dtype=x.dtype)]
+    return out
+
+
+@register_op("unsqueeze")
+@register_op("unsqueeze2")
+def _unsqueeze2(ctx, op, ins):
+    x = first(ins, "X")
+    axes = list(op.attr("axes", []))
+    out_ndim = x.ndim + len(axes)
+    axes = [a if a >= 0 else a + out_ndim for a in axes]
+    y = x
+    for a in sorted(axes):
+        y = jnp.expand_dims(y, a)
+    out = {"Out": [y]}
+    if "XShape" in op.outputs:
+        out["XShape"] = [jnp.zeros((0,) + x.shape, dtype=x.dtype)]
+    return out
+
+
+@register_op("flatten")
+@register_op("flatten2")
+def _flatten2(ctx, op, ins):
+    x = first(ins, "X")
+    axis = op.attr("axis", 1)
+    lead = 1
+    for s in x.shape[:axis]:
+        lead *= int(s)
+    out = {"Out": [jnp.reshape(x, (lead, -1))]}
+    if "XShape" in op.outputs:
+        out["XShape"] = [jnp.zeros((0,) + x.shape, dtype=x.dtype)]
+    return out
+
+
+@register_op("flatten_contiguous_range")
+def _flatten_range(ctx, op, ins):
+    x = first(ins, "X")
+    start = op.attr("start_axis", 1)
+    stop = op.attr("stop_axis", -1)
+    start = start if start >= 0 else start + x.ndim
+    stop = stop if stop >= 0 else stop + x.ndim
+    shape = x.shape[:start] + (-1,) + x.shape[stop + 1:]
+    out = {"Out": [jnp.reshape(x, shape)]}
+    if "XShape" in op.outputs:
+        out["XShape"] = [jnp.zeros((0,) + x.shape, dtype=x.dtype)]
+    return out
+
+
+@register_op("concat")
+def _concat(ctx, op, ins):
+    xs = [v for v in ins.get("X", []) if v is not None]
+    axis = first(ins, "AxisTensor", op.attr("axis", 0))
+    return {"Out": [jnp.concatenate(xs, axis=int(axis))]}
+
+
+@register_op("split")
+def _split(ctx, op, ins):
+    x = first(ins, "X")
+    axis = int(op.attr("axis", 0))
+    sections = op.attr("sections", [])
+    num = op.attr("num", 0)
+    if sections:
+        total, splits, neg = 0, [], -1
+        for i, s in enumerate(sections):
+            if s == -1:
+                neg = i
+            else:
+                total += s
+        sections = list(sections)
+        if neg >= 0:
+            sections[neg] = x.shape[axis] - total
+        idx = []
+        acc = 0
+        for s in sections[:-1]:
+            acc += s
+            idx.append(acc)
+        outs = jnp.split(x, idx, axis=axis)
+    else:
+        outs = jnp.split(x, num, axis=axis)
+    return {"Out": list(outs)}
+
+
+@register_op("stack")
+def _stack(ctx, op, ins):
+    xs = [v for v in ins.get("X", []) if v is not None]
+    return {"Y": [jnp.stack(xs, axis=op.attr("axis", 0))]}
+
+
+@register_op("unstack")
+def _unstack(ctx, op, ins):
+    x = first(ins, "X")
+    axis = op.attr("axis", 0)
+    n = x.shape[axis if axis >= 0 else axis + x.ndim]
+    parts = jnp.split(x, n, axis=axis)
+    return {"Y": [jnp.squeeze(p, axis=axis) for p in parts]}
+
+
+@register_op("slice")
+def _slice(ctx, op, ins):
+    x = first(ins, "Input")
+    axes = op.attr("axes", [])
+    starts = op.attr("starts", [])
+    ends = op.attr("ends", [])
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        dim = x.shape[a]
+        s = max(s + dim, 0) if s < 0 else min(s, dim)
+        e = max(e + dim, 0) if e < 0 else min(e, dim)
+        idx[a] = slice(int(s), int(e))
+    out = x[tuple(idx)]
+    dec = op.attr("decrease_axis", [])
+    if dec:
+        out = jnp.squeeze(out, axis=tuple(dec))
+    return {"Out": [out]}
+
+
+@register_op("strided_slice")
+def _strided_slice(ctx, op, ins):
+    x = first(ins, "Input")
+    axes = op.attr("axes", [])
+    starts = op.attr("starts", [])
+    ends = op.attr("ends", [])
+    strides = op.attr("strides", [1] * len(axes))
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        idx[a] = slice(int(s), int(e), int(st))
+    return {"Out": [x[tuple(idx)]]}
+
+
+@register_op("expand_v2")
+def _expand_v2(ctx, op, ins):
+    x = first(ins, "X")
+    shape = list(op.attr("shape", []))
+    # -1 entries keep the input dim; missing leading dims broadcast
+    ndiff = len(shape) - x.ndim
+    full = []
+    for i, s in enumerate(shape):
+        if s == -1:
+            full.append(x.shape[i - ndiff] if i >= ndiff else 1)
+        else:
+            full.append(int(s))
+    return {"Out": [jnp.broadcast_to(x, tuple(full))]}
+
+
+@register_op("expand")
+def _expand(ctx, op, ins):
+    x = first(ins, "X")
+    times = op.attr("expand_times", [1] * x.ndim)
+    return {"Out": [jnp.tile(x, tuple(int(t) for t in times))]}
+
+
+@register_op("tile")
+def _tile(ctx, op, ins):
+    x = first(ins, "X")
+    times = op.attr("repeat_times", [1])
+    return {"Out": [jnp.tile(x, tuple(int(t) for t in times))]}
+
+
+@register_op("expand_as_v2")
+def _expand_as_v2(ctx, op, ins):
+    x = first(ins, "X")
+    shape = op.attr("target_shape", [])
+    return {"Out": [jnp.broadcast_to(x, tuple(shape))]}
+
+
+@register_op("broadcast_to")
+def _broadcast_to(ctx, op, ins):
+    return {"Out": [jnp.broadcast_to(first(ins, "X"),
+                                     tuple(op.attr("shape", [])))]}
+
+
+@register_op("gather")
+def _gather(ctx, op, ins):
+    x = first(ins, "X")
+    index = first(ins, "Index")
+    axis = int(first(ins, "Axis", op.attr("axis", 0)))
+    if index.ndim == 2 and index.shape[1] == 1:
+        index = index[:, 0]
+    return {"Out": [jnp.take(x, index, axis=axis)]}
+
+
+@register_op("gather_nd")
+def _gather_nd(ctx, op, ins):
+    x = first(ins, "X")
+    index = first(ins, "Index")
+    k = index.shape[-1]
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return {"Out": [x[idx]]}
+
+
+@register_op("scatter")
+def _scatter(ctx, op, ins):
+    x = first(ins, "X")
+    ids = first(ins, "Ids")
+    updates = first(ins, "Updates")
+    if ids.ndim == 2 and ids.shape[1] == 1:
+        ids = ids[:, 0]
+    if op.attr("overwrite", True):
+        out = x.at[ids].set(updates)
+    else:
+        out = x.at[ids].set(jnp.zeros_like(updates)).at[ids].add(updates)
+    return {"Out": [out]}
+
+
+@register_op("scatter_nd_add")
+def _scatter_nd_add(ctx, op, ins):
+    x = first(ins, "X")
+    index = first(ins, "Index")
+    updates = first(ins, "Updates")
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return {"Out": [x.at[idx].add(updates)]}
+
+
+@register_op("index_select")
+def _index_select(ctx, op, ins):
+    x = first(ins, "X")
+    index = first(ins, "Index")
+    return {"Out": [jnp.take(x, index, axis=op.attr("dim", 0))]}
+
+
+@register_op("index_sample")
+def _index_sample(ctx, op, ins):
+    x = first(ins, "X")
+    index = first(ins, "Index")
+    return {"Out": [jnp.take_along_axis(x, index, axis=1)]}
+
+
+@register_op("where")
+def _where(ctx, op, ins):
+    cond = first(ins, "Condition")
+    return {"Out": [jnp.where(cond, first(ins, "X"), first(ins, "Y"))]}
+
+
+@register_op("one_hot_v2")
+@register_op("one_hot")
+def _one_hot(ctx, op, ins):
+    x = first(ins, "X")
+    depth = int(first(ins, "depth_tensor", op.attr("depth", 1)))
+    if x.ndim >= 1 and x.shape[-1] == 1:
+        x = x[..., 0]
+    return {"Out": [jax.nn.one_hot(x, depth, dtype=jnp.float32)]}
+
+
+@register_op("arg_max")
+def _arg_max(ctx, op, ins):
+    x = first(ins, "X")
+    axis = op.attr("axis", -1)
+    keepdims = op.attr("keepdims", False)
+    out = jnp.argmax(x, axis=None if op.attr("flatten", False) else axis)
+    if keepdims and not op.attr("flatten", False):
+        out = jnp.expand_dims(out, axis)
+    dt = op.attr("dtype", "int64")
+    return {"Out": [out.astype(jdt(dt if dt != -1 else "int64"))]}
+
+
+@register_op("arg_min")
+def _arg_min(ctx, op, ins):
+    x = first(ins, "X")
+    axis = op.attr("axis", -1)
+    out = jnp.argmin(x, axis=axis)
+    if op.attr("keepdims", False):
+        out = jnp.expand_dims(out, axis)
+    return {"Out": [out.astype(jnp.int64)]}
+
+
+@register_op("argsort")
+def _argsort(ctx, op, ins):
+    x = first(ins, "X")
+    axis = op.attr("axis", -1)
+    descending = op.attr("descending", False)
+    key = -x if descending else x
+    idx = jnp.argsort(key, axis=axis)
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    return {"Out": [out], "Indices": [idx.astype(jnp.int64)]}
+
+
+@register_op("top_k")
+@register_op("top_k_v2")
+def _top_k(ctx, op, ins):
+    x = first(ins, "X")
+    k = int(first(ins, "K", op.attr("k", 1)))
+    axis = op.attr("axis", -1)
+    largest = op.attr("largest", True)
+    if axis not in (-1, x.ndim - 1):
+        xm = jnp.moveaxis(x, axis, -1)
+    else:
+        xm = x
+    if largest:
+        vals, idx = jax.lax.top_k(xm, k)
+    else:
+        vals, idx = jax.lax.top_k(-xm, k)
+        vals = -vals
+    if axis not in (-1, x.ndim - 1):
+        vals = jnp.moveaxis(vals, -1, axis)
+        idx = jnp.moveaxis(idx, -1, axis)
+    return {"Out": [vals], "Indices": [idx.astype(jnp.int64)]}
+
+
+@register_op("range")
+def _range(ctx, op, ins):
+    start = op.attr("start", None)
+    if start is None:
+        start = float(first(ins, "Start"))
+    end = op.attr("end", None)
+    if end is None:
+        end = float(first(ins, "End"))
+    step = op.attr("step", None)
+    if step is None:
+        step = float(first(ins, "Step"))
+    dt = jdt(op.attr("dtype", "int64"))
+    return {"Out": [jnp.arange(start, end, step, dtype=dt)]}
+
+
+@register_op("linspace")
+def _linspace(ctx, op, ins):
+    start = op.attr("start", float(first(ins, "Start", 0.0)))
+    stop = op.attr("stop", float(first(ins, "Stop", 1.0)))
+    num = op.attr("num", int(first(ins, "Num", 1)))
+    dt = jdt(op.attr("dtype", "float32"))
+    return {"Out": [jnp.linspace(start, stop, int(num), dtype=dt)]}
+
+
+@register_op("eye")
+def _eye(ctx, op, ins):
+    n = op.attr("num_rows", 1)
+    m = op.attr("num_columns", -1)
+    m = n if m in (-1, None) else m
+    return {"Out": [jnp.eye(int(n), int(m), dtype=jdt(op.attr("dtype", "float32")))]}
+
+
+@register_op("increment")
+def _increment(ctx, op, ins):
+    x = first(ins, "X")
+    return {"Out": [x + jnp.asarray(op.attr("step", 1.0), x.dtype)]}
+
+
+@register_op("pad")
+def _pad(ctx, op, ins):
+    x = first(ins, "X")
+    paddings = op.attr("paddings", [])
+    cfg = [(paddings[2 * i], paddings[2 * i + 1]) for i in range(x.ndim)]
+    return {"Out": [jnp.pad(x, cfg, constant_values=op.attr("pad_value", 0.0))]}
+
+
+@register_op("pad2d")
+def _pad2d(ctx, op, ins):
+    x = first(ins, "X")
+    p = op.attr("paddings", [0, 0, 0, 0])  # top,bottom,left,right
+    mode = op.attr("mode", "constant")
+    fmt = op.attr("data_format", "NCHW")
+    if fmt == "NCHW":
+        cfg = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
+    else:
+        cfg = [(0, 0), (p[0], p[1]), (p[2], p[3]), (0, 0)]
+    kw = {}
+    if mode == "constant":
+        kw["constant_values"] = op.attr("pad_value", 0.0)
+        np_mode = "constant"
+    elif mode == "reflect":
+        np_mode = "reflect"
+    else:
+        np_mode = "edge"
+    return {"Out": [jnp.pad(x, cfg, mode=np_mode, **kw)]}
+
+
+@register_op("pad3d")
+def _pad3d(ctx, op, ins):
+    x = first(ins, "X")
+    p = op.attr("paddings", [0] * 6)  # l,r,t,b,f,bk
+    fmt = op.attr("data_format", "NCDHW")
+    mode = op.attr("mode", "constant")
+    if fmt == "NCDHW":
+        cfg = [(0, 0), (0, 0), (p[4], p[5]), (p[2], p[3]), (p[0], p[1])]
+    else:
+        cfg = [(0, 0), (p[4], p[5]), (p[2], p[3]), (p[0], p[1]), (0, 0)]
+    kw = {}
+    if mode == "constant":
+        kw["constant_values"] = op.attr("value", 0.0)
+        np_mode = "constant"
+    elif mode == "reflect":
+        np_mode = "reflect"
+    elif mode == "replicate":
+        np_mode = "edge"
+    else:
+        np_mode = "wrap"
+    return {"Out": [jnp.pad(x, cfg, mode=np_mode, **kw)]}
+
+
+@register_op("roll")
+def _roll(ctx, op, ins):
+    x = first(ins, "X")
+    shifts = op.attr("shifts", [0])
+    axis = op.attr("axis", [])
+    if not axis:
+        return {"Out": [jnp.roll(x.reshape(-1), shifts[0]).reshape(x.shape)]}
+    return {"Out": [jnp.roll(x, tuple(shifts), axis=tuple(axis))]}
+
+
+@register_op("flip")
+def _flip(ctx, op, ins):
+    x = first(ins, "X")
+    return {"Out": [jnp.flip(x, axis=tuple(op.attr("axis", [0])))]}
+
+
+@register_op("tril_triu")
+def _tril_triu(ctx, op, ins):
+    x = first(ins, "X")
+    diagonal = op.attr("diagonal", 0)
+    if op.attr("lower", True):
+        return {"Out": [jnp.tril(x, diagonal)]}
+    return {"Out": [jnp.triu(x, diagonal)]}
+
+
+@register_op("diag_v2")
+def _diag_v2(ctx, op, ins):
+    x = first(ins, "X")
+    offset = op.attr("offset", 0)
+    if x.ndim == 1:
+        out = jnp.diag(x, offset)
+        pv = op.attr("padding_value", 0.0)
+        if pv:
+            mask = jnp.diag(jnp.ones_like(x), offset) > 0
+            out = jnp.where(mask, out, jnp.asarray(pv, out.dtype))
+        return {"Out": [out]}
+    return {"Out": [jnp.diagonal(x, offset)]}
+
+
+@register_op("meshgrid")
+def _meshgrid(ctx, op, ins):
+    xs = [v for v in ins.get("X", []) if v is not None]
+    return {"Out": list(jnp.meshgrid(*xs, indexing="ij"))}
+
+
+@register_op("unique")
+def _unique(ctx, op, ins):
+    # Static-shape variant: returns sorted unique values padded with the
+    # max value (XLA cannot produce dynamic shapes; see module docstring).
+    x = first(ins, "X")
+    vals = jnp.unique(x, size=x.size, fill_value=None)
+    return {"Out": [vals]}
+
+
+@register_op("masked_fill")
+def _masked_fill(ctx, op, ins):
+    x = first(ins, "X")
+    mask = first(ins, "Mask")
+    value = op.attr("value", 0.0)
+    return {"Out": [jnp.where(mask, jnp.asarray(value, x.dtype), x)]}
+
+
+@register_op("assign_value")
+def _assign_value(ctx, op, ins):
+    vals = op.attr("values")
+    import numpy as np
+
+    arr = np.asarray(vals).reshape(op.attr("shape", None) or np.shape(vals))
+    return {"Out": [jnp.asarray(arr, dtype=jdt(op.attr("dtype", "float32")))]}

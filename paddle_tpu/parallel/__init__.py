@@ -1,0 +1,5 @@
+"""Distributed/parallel layer: mesh abstraction, data-parallel compiler,
+Fleet facade.  TPU-native replacement for the reference's ParallelExecutor +
+NCCL stack (SURVEY.md §2.9)."""
+
+from .compiler import CompiledProgram  # noqa: F401

@@ -1,0 +1,66 @@
+"""Device mesh abstraction.
+
+TPU-native replacement for the reference's Place lists + NCCLContextMap
+(/root/reference/paddle/fluid/platform/nccl_helper.h:92,185) and the
+ParallelExecutor device topology (parallel_executor.cc:231).  A mesh is a
+`jax.sharding.Mesh` over jax.devices() with named axes; parallel strategies
+(dp/mp/pp/sharding) are expressed as shardings over these axes and XLA emits
+the ICI collectives (SURVEY.md §5.8).
+
+Axis-name conventions used across the framework:
+  "data"  — data parallelism (batch sharding, gradient psum)
+  "model" — tensor/model parallelism (column/row-parallel matmuls)
+  "pipe"  — pipeline stages
+  "seq"   — sequence/context parallelism (ring attention)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+PIPE_AXIS = "pipe"
+SEQ_AXIS = "seq"
+
+_current_mesh: Optional[Mesh] = None
+
+
+def make_mesh(axes: Optional[Dict[str, int]] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a Mesh.  `axes` maps axis name -> size; a -1 size absorbs the
+    remaining devices.  Default: all devices on the "data" axis."""
+    devices = list(devices) if devices is not None else jax.devices()
+    n = len(devices)
+    if not axes:
+        axes = {DATA_AXIS: n}
+    names = list(axes)
+    sizes = [axes[k] for k in names]
+    n_fixed = int(np.prod([s for s in sizes if s != -1]))
+    sizes = [n // max(n_fixed, 1) if s == -1 else s for s in sizes]
+    total = int(np.prod(sizes))
+    assert total == n, f"mesh {dict(zip(names, sizes))} != {n} devices"
+    dev_array = np.array(devices).reshape(sizes)
+    return Mesh(dev_array, tuple(names))
+
+
+def set_current_mesh(mesh: Optional[Mesh]):
+    global _current_mesh
+    _current_mesh = mesh
+
+
+def current_mesh() -> Optional[Mesh]:
+    return _current_mesh
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharded(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
+    return NamedSharding(mesh, P(axis))

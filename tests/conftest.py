@@ -1,0 +1,35 @@
+"""Test config: force an 8-device virtual CPU mesh BEFORE jax initializes
+(SURVEY.md §7 "Distributed test story": XLA's
+--xla_force_host_platform_device_count replaces the reference's
+multi-process TestDistBase harness for mesh/collective tests)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def fresh_programs():
+    """Guard: fresh main/startup programs + scope + unique-name generator."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import framework, unique_name
+    from paddle_tpu.fluid.executor import Scope, scope_guard
+
+    main, startup = framework.Program(), framework.Program()
+    scope = Scope()
+    with framework.program_guard(main, startup):
+        with unique_name.guard():
+            with scope_guard(scope):
+                yield main, startup, scope

@@ -1,0 +1,73 @@
+"""Program IR tests: build, shape inference, clone, serialization.
+(Modeled on the reference's test_program.py / test_operator_desc.py.)"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework
+
+
+def test_program_build_and_shapes(fresh_programs):
+    main, startup, scope = fresh_programs
+    x = fluid.data("x", [-1, 8], "float32")
+    y = fluid.layers.fc(x, 16, act="relu")
+    z = fluid.layers.reduce_sum(y, dim=1)
+    assert y.shape == (-1, 16)
+    assert z.shape == (-1,)
+    assert main.global_block().ops[0].type == "mul"
+    # startup got weight + bias init ops
+    assert len(startup.global_block().ops) >= 2
+
+
+def test_unique_names(fresh_programs):
+    main, startup, scope = fresh_programs
+    x = fluid.data("x", [-1, 4], "float32")
+    a = fluid.layers.fc(x, 4)
+    b = fluid.layers.fc(x, 4)
+    params = main.all_parameters()
+    assert len({p.name for p in params}) == 4  # 2 weights + 2 biases
+
+
+def test_serialization_roundtrip(fresh_programs):
+    main, startup, scope = fresh_programs
+    x = fluid.data("x", [-1, 4], "float32")
+    y = fluid.layers.fc(x, 3, act="tanh")
+    loss = fluid.layers.reduce_mean(y)
+    fluid.append_backward(loss)
+
+    s = main.to_json()
+    restored = framework.Program.from_json(s)
+    assert restored.num_ops() == main.num_ops()
+    assert set(restored.global_block().vars) == set(main.global_block().vars)
+    # restored program still runs
+    exe = fluid.Executor()
+    exe.run(startup, scope=scope)
+    out1 = exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+                   fetch_list=[loss.name], scope=scope)
+    out2 = exe.run(restored, feed={"x": np.ones((2, 4), "float32")},
+                   fetch_list=[loss.name], scope=scope)
+    np.testing.assert_allclose(out1[0], out2[0], rtol=1e-6)
+
+
+def test_clone_for_test_prunes_backward(fresh_programs):
+    main, startup, scope = fresh_programs
+    x = fluid.data("x", [-1, 4], "float32")
+    y = fluid.layers.fc(x, 3)
+    d = fluid.layers.dropout(y, 0.5)
+    loss = fluid.layers.reduce_mean(d)
+    fluid.optimizer.SGD(0.1).minimize(loss)
+    test_prog = main.clone(for_test=True)
+    assert test_prog.num_ops() < main.num_ops()
+    for op in test_prog.global_block().ops:
+        assert "fwd_op_id" not in op.attrs  # no grad ops
+        if op.type == "dropout":
+            assert op.attr("is_test") is True
+
+
+def test_program_guard_isolation():
+    p1, p2 = framework.Program(), framework.Program()
+    with framework.program_guard(p1, p2):
+        assert framework.default_main_program() is p1
+        assert framework.default_startup_program() is p2
+    assert framework.default_main_program() is not p1

@@ -1,0 +1,54 @@
+"""End-to-end "book" test: MNIST ConvNet trains and the loss drops
+(reference: python/paddle/fluid/tests/book/test_recognize_digits.py asserts
+loss decrease over a few iterations).  Uses synthetic class-prototype
+digits (no dataset download in CI)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework, unique_name
+from paddle_tpu.fluid.executor import Scope, scope_guard
+from paddle_tpu.models import mnist
+
+
+_PROTOS = np.random.RandomState(123).rand(10, 1, 28, 28).astype("float32")
+
+
+def synthetic_digits(rng, n):
+    labels = rng.randint(0, 10, size=(n, 1)).astype("int64")
+    imgs = _PROTOS[labels[:, 0]] + 0.05 * rng.randn(n, 1, 28, 28).astype("float32")
+    return imgs.astype("float32"), labels
+
+
+def test_mnist_convnet_trains():
+    main, startup, feeds, fetches = mnist.build_train_program(
+        optimizer=fluid.optimizer.Adam(learning_rate=0.001))
+    scope = Scope()
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(7)
+        losses, accs = [], []
+        for step in range(25):
+            imgs, labels = synthetic_digits(rng, 64)
+            loss, acc = exe.run(main, feed={"img": imgs, "label": labels},
+                                fetch_list=fetches)
+            losses.append(float(loss))
+            accs.append(float(acc))
+        assert losses[-1] < losses[0] * 0.5, losses
+        assert max(accs[-3:]) > 0.7, accs
+
+
+def test_mnist_test_program_matches_train_eval():
+    main, startup, feeds, fetches = mnist.build_train_program()
+    test_prog = main.clone(for_test=True)
+    scope = Scope()
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(3)
+        imgs, labels = synthetic_digits(rng, 16)
+        loss, acc = exe.run(test_prog, feed={"img": imgs, "label": labels},
+                            fetch_list=[f.name for f in fetches])
+        assert np.isfinite(loss)
