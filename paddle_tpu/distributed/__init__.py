@@ -1,0 +1,35 @@
+"""paddle_tpu.distributed — process/device topology + collective API.
+
+Mirror of /root/reference/python/paddle/distributed/ (launch.py, spawn.py,
+parallel.py:57 init_parallel_env, collective.py) re-based on JAX:
+process bootstrap is `jax.distributed.initialize` (replacing gloo/NCCL-id
+rendezvous), topology comes from TPU pod env vars, and collectives are XLA
+ICI collectives (SURVEY.md §5.8).
+"""
+
+from __future__ import annotations
+
+import os
+
+from . import collective  # noqa: F401
+from .collective import (all_gather, all_reduce, barrier, broadcast,  # noqa: F401
+                         get_rank, get_world_size, scatter)
+from .parallel import init_parallel_env, ParallelEnv  # noqa: F401
+
+
+def get_world_size() -> int:  # noqa: F811 — canonical definition
+    import jax
+
+    try:
+        return jax.process_count() * max(1, jax.local_device_count())
+    except RuntimeError:
+        return int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+
+
+def get_rank() -> int:  # noqa: F811
+    import jax
+
+    try:
+        return jax.process_index()
+    except RuntimeError:
+        return int(os.environ.get("PADDLE_TRAINER_ID", "0"))

@@ -1,0 +1,102 @@
+"""Python collective API (static-graph flavor): appends c_* ops to the
+current program, exactly like the reference's
+/root/reference/python/paddle/distributed/collective.py (broadcast:87,
+all_reduce:140, all_gather:199, scatter:254, barrier:302) and
+fluid/layers/collective.py.  The ops lower to XLA ICI collectives when the
+program is compiled over a mesh (paddle_tpu/ops/collective_ops.py)."""
+
+from __future__ import annotations
+
+from ..fluid.layer_helper import LayerHelper
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+
+
+_RED_OP = {ReduceOp.SUM: "c_allreduce_sum", ReduceOp.MAX: "c_allreduce_max",
+           ReduceOp.MIN: "c_allreduce_min", ReduceOp.PROD: "c_allreduce_prod"}
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=0, use_calc_stream=True):
+    helper = LayerHelper("all_reduce")
+    out = helper.create_variable_for_type_inference(dtype=tensor.dtype)
+    helper.append_op(_RED_OP[op], inputs={"X": [tensor]},
+                     outputs={"Out": [out]},
+                     attrs={"ring_id": group,
+                            "use_calc_stream": use_calc_stream})
+    return out
+
+
+def broadcast(tensor, src, group=0, use_calc_stream=True):
+    helper = LayerHelper("broadcast")
+    out = helper.create_variable_for_type_inference(dtype=tensor.dtype)
+    helper.append_op("c_broadcast", inputs={"X": [tensor]},
+                     outputs={"Out": [out]},
+                     attrs={"ring_id": group, "root": src,
+                            "use_calc_stream": use_calc_stream})
+    return out
+
+
+def all_gather(tensor_list_or_tensor, tensor=None, group=0,
+               use_calc_stream=True, nranks=None):
+    # 2.0 signature: all_gather(tensor_list, tensor); also usable
+    # functionally: out = all_gather(tensor)
+    if tensor is None:
+        t = tensor_list_or_tensor
+        sink = None
+    else:
+        t = tensor
+        sink = tensor_list_or_tensor
+    helper = LayerHelper("all_gather")
+    out = helper.create_variable_for_type_inference(dtype=t.dtype)
+    helper.append_op("c_allgather", inputs={"X": [t]},
+                     outputs={"Out": [out]},
+                     attrs={"ring_id": group, "nranks": nranks or 0,
+                            "use_calc_stream": use_calc_stream})
+    if sink is not None:
+        sink.append(out)
+    return out
+
+
+def reduce_scatter(tensor, group=0):
+    helper = LayerHelper("reduce_scatter")
+    out = helper.create_variable_for_type_inference(dtype=tensor.dtype)
+    helper.append_op("c_reducescatter", inputs={"X": [tensor]},
+                     outputs={"Out": [out]}, attrs={"ring_id": group})
+    return out
+
+
+def scatter(tensor, tensor_list=None, src=0, group=0):
+    helper = LayerHelper("scatter_collective")
+    out = helper.create_variable_for_type_inference(dtype=tensor.dtype)
+    helper.append_op("c_split", inputs={"X": [tensor]},
+                     outputs={"Out": [out]},
+                     attrs={"ring_id": group, "root": src})
+    return out
+
+
+def barrier(group=0):
+    from ..fluid.layers import tensor as tl
+
+    helper = LayerHelper("barrier")
+    x = tl.fill_constant([1], "float32", 0.0)
+    out = helper.create_variable_for_type_inference(dtype="float32")
+    helper.append_op("barrier", inputs={"X": [x]}, outputs={"Out": [out]},
+                     attrs={"ring_id": group})
+    return out
+
+
+def get_rank():
+    from . import get_rank as _gr
+
+    return _gr()
+
+
+def get_world_size():
+    from . import get_world_size as _gws
+
+    return _gws()

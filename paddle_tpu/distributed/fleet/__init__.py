@@ -1,0 +1,35 @@
+"""paddle_tpu.distributed.fleet — the Fleet distributed-training API
+(mirror of /root/reference/python/paddle/distributed/fleet/__init__.py):
+
+    fleet.init(is_collective=True)
+    strategy = fleet.DistributedStrategy()
+    strategy.amp = True
+    opt = fleet.distributed_optimizer(fluid.optimizer.Adam(1e-3), strategy)
+    opt.minimize(loss)
+
+Strategies map to TPU mechanisms per SURVEY.md §2.9 (see
+meta_optimizers/)."""
+
+from .base import (DistributedStrategy, Fleet, PaddleCloudRoleMaker,  # noqa: F401
+                   Role, UserDefinedRoleMaker, fleet)
+from . import meta_optimizers  # noqa: F401
+
+# module-level delegation so `from paddle_tpu.distributed import fleet;
+# fleet.init(...)` works like the reference
+init = fleet.init
+is_first_worker = fleet.is_first_worker
+worker_index = fleet.worker_index
+worker_num = fleet.worker_num
+is_worker = fleet.is_worker
+worker_endpoints = fleet.worker_endpoints
+is_server = fleet.is_server
+barrier_worker = fleet.barrier_worker
+init_worker = fleet.init_worker
+stop_worker = fleet.stop_worker
+init_server = fleet.init_server
+run_server = fleet.run_server
+distributed_optimizer = fleet.distributed_optimizer
+distributed_model = fleet.distributed_model
+save_inference_model = fleet.save_inference_model
+save_persistables = fleet.save_persistables
+minimize = fleet.minimize

@@ -1,0 +1,19 @@
+"""Fleet meta-optimizers: strategy-driven program rewrites.
+
+Mirror of /root/reference/python/paddle/distributed/fleet/meta_optimizers/
+(amp_optimizer.py, recompute_optimizer.py, gradient_merge_optimizer.py,
+sharding_optimizer.py:33, lamb_optimizer.py, lars_optimizer.py,
+localsgd_optimizer.py, fp16_allreduce_optimizer.py,
+graph_execution_optimizer.py).  Each wraps an inner Optimizer and rewrites
+the Program; the TPU lowering of each rewrite is documented per class.
+"""
+
+from .meta_optimizer_base import MetaOptimizerBase  # noqa: F401
+from .amp_optimizer import AMPOptimizer  # noqa: F401
+from .recompute_optimizer import RecomputeOptimizer  # noqa: F401
+from .gradient_merge_optimizer import GradientMergeOptimizer  # noqa: F401
+from .sharding_optimizer import ShardingOptimizer  # noqa: F401
+from .lamb_optimizer import LambOptimizer  # noqa: F401
+from .lars_optimizer import LarsOptimizer  # noqa: F401
+from .graph_execution_optimizer import GraphExecutionOptimizer  # noqa: F401
+from .localsgd_optimizer import LocalSGDOptimizer  # noqa: F401

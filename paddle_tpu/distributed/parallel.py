@@ -1,0 +1,97 @@
+"""Process-group bootstrap.
+
+Mirror of /root/reference/python/paddle/distributed/parallel.py:57
+(`init_parallel_env`): where the reference exchanges NCCL unique ids over a
+gloo HTTP store and spawns NCCLParallelContext rings, the TPU build calls
+`jax.distributed.initialize` (GCE metadata / env-driven) and builds the
+global device mesh.  ParallelEnv mirrors fluid.dygraph.ParallelEnv.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+class ParallelEnv:
+    def __init__(self):
+        import jax
+
+        self._rank = int(os.environ.get("PADDLE_TRAINER_ID",
+                                        str(_safe_process_index())))
+        self._world_size = int(os.environ.get(
+            "PADDLE_TRAINERS_NUM", str(_safe_process_count())))
+        self._device_id = 0
+        self._endpoints = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def world_size(self):
+        return self._world_size
+
+    @property
+    def local_rank(self):
+        return self._rank
+
+    @property
+    def nranks(self):
+        return self._world_size
+
+    @property
+    def dev_id(self):
+        return self._device_id
+
+    @property
+    def trainer_endpoints(self):
+        return self._endpoints.split(",") if self._endpoints else []
+
+
+def _safe_process_index():
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def _safe_process_count():
+    try:
+        import jax
+
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+_initialized = False
+
+
+def init_parallel_env(coordinator_address: Optional[str] = None,
+                      num_processes: Optional[int] = None,
+                      process_id: Optional[int] = None):
+    """Initialize multi-host JAX (no-op on a single host / single process).
+    Reads the reference's PADDLE_* env contract when explicit args are
+    absent, so reference launch scripts keep working."""
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    import jax
+
+    if coordinator_address is None:
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        if eps:
+            coordinator_address = eps.split(",")[0]
+    if num_processes is None:
+        num_processes = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if process_id is None:
+        process_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if num_processes > 1 and coordinator_address:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    _initialized = True
+    return ParallelEnv()

@@ -9,7 +9,7 @@ from .framework import (Program, Variable, Parameter, OpRole,
                         program_guard, in_dygraph_mode)
 from .executor import Executor, Scope, global_scope, scope_guard
 from .backward import append_backward, gradients
-from . import initializer, regularizer, clip
+from . import initializer, regularizer, clip, io
 from .param_attr import ParamAttr, WeightNormParamAttr
 from . import layers
 from . import optimizer

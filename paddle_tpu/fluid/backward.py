@@ -35,7 +35,7 @@ def _requires_grad_set(block, no_grad: set) -> set:
     for v in block.vars.values():
         if isinstance(v, Parameter) and v.trainable and v.name not in no_grad:
             req.add(v.name)
-        elif (not v.stop_gradient and not v.is_data
+        elif (not v.stop_gradient
               and core.is_float_dtype(v.dtype) and v.name not in no_grad
               and v.name not in produced):
             # leaf var explicitly marked differentiable
@@ -98,10 +98,10 @@ def _record_grad(block, fwd_name: str, grad_map: Dict[str, List[str]]) -> str:
     return name
 
 
-def _append_grad_ops(block, target_name: str, req: set, no_grad: set,
-                     stop_at_ops: Optional[set] = None) -> Dict[str, List[str]]:
-    """Emit grad ops for every relevant forward op, in reverse order.
-    Returns the grad map (fwd var -> contribution list)."""
+
+def _seed_target_grad(block, target_name: str) -> Dict[str, List[str]]:
+    """Create the d(target)/d(target)=1 seed var+op; returns a fresh grad
+    map."""
     target = block._var_recursive(target_name)
     loss_grad = grad_var_name(target_name)
     block.create_var(name=loss_grad, shape=target.shape, dtype=target.dtype,
@@ -111,7 +111,29 @@ def _append_grad_ops(block, target_name: str, req: set, no_grad: set,
         attrs={"shape": list(target.shape or ()), "dtype": target.dtype,
                "value": 1.0, "op_role": OpRole.Backward | OpRole.Loss},
         infer_shape=False)
-    grad_map: Dict[str, List[str]] = {target_name: [loss_grad]}
+    return {target_name: [loss_grad]}
+
+
+def _finalize_params_grads(block, program, parameter_list, grad_map):
+    if parameter_list is not None:
+        params = [block._var_recursive(p) if isinstance(p, str) else p
+                  for p in parameter_list]
+    else:
+        params = [p for p in program.all_parameters() if p.trainable]
+    params_and_grads = []
+    for p in params:
+        g = _merge_grads(block, p.name, grad_map)
+        if g is None:
+            continue
+        params_and_grads.append((p, block.var(g)))
+    return params_and_grads
+
+
+def _append_grad_ops(block, target_name: str, req: set, no_grad: set,
+                     stop_at_ops: Optional[set] = None) -> Dict[str, List[str]]:
+    """Emit grad ops for every relevant forward op, in reverse order.
+    Returns the grad map (fwd var -> contribution list)."""
+    grad_map = _seed_target_grad(block, target_name)
 
     fwd_ops = [op for op in block.ops
                if "fwd_op_id" not in op.attrs
@@ -186,20 +208,7 @@ def append_backward(loss: Variable, parameter_list=None, no_grad_set=None,
 
     grad_map = _append_grad_ops(block, loss.name, req, no_grad)
 
-    if parameter_list is not None:
-        params = [block._var_recursive(p) if isinstance(p, str) else p
-                  for p in parameter_list]
-    else:
-        params = [p for p in program.all_parameters() if p.trainable]
-
-    params_and_grads = []
-    for p in params:
-        g = _merge_grads(block, p.name, grad_map)
-        if g is None:
-            continue
-        gv = block.var(g)
-        params_and_grads.append((p, gv))
-    return params_and_grads
+    return _finalize_params_grads(block, program, parameter_list, grad_map)
 
 
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
@@ -230,3 +239,78 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
         g = _merge_grads(block, v.name, grad_map)
         outs.append(block.var(g) if g else None)
     return outs
+
+
+def append_backward_with_checkpoints(loss, checkpoints, parameter_list=None,
+                                     no_grad_set=None):
+    """Recompute-aware backward (mirror of the reference's
+    `_append_backward_ops_with_checkpoints_`, backward.py:689): forward ops
+    are grouped into segments split at user-marked checkpoint vars; each
+    segment gets ONE `recompute_segment_grad` op whose lowering re-runs the
+    segment under `jax.checkpoint` (rematerialization with an XLA
+    optimization barrier), so only the checkpoint boundaries stay live
+    between forward and backward."""
+    block = loss.block
+    program = block.program
+    no_grad = set(no_grad_set or ())
+    req = _requires_grad_set(block, no_grad)
+    req.add(loss.name)
+    ckpt_names = {c.name if isinstance(c, Variable) else str(c)
+                  for c in checkpoints}
+
+    fwd_ops = [op for op in block.ops
+               if "fwd_op_id" not in op.attrs
+               and op.attr("op_role", 0) not in (OpRole.Backward,
+                                                 OpRole.Optimize)]
+    # segment boundaries: after the op that produces each checkpoint var
+    cut_after = set()
+    for i, op in enumerate(fwd_ops):
+        if set(op.output_arg_names()) & ckpt_names:
+            cut_after.add(i)
+    segments = []
+    start = 0
+    for i in sorted(cut_after):
+        segments.append((start, i + 1))
+        start = i + 1
+    if start < len(fwd_ops):
+        segments.append((start, len(fwd_ops)))
+
+    grad_map = _seed_target_grad(block, loss.name)
+
+    for a, b in reversed(segments):
+        seg_ops = fwd_ops[a:b]
+        produced = set()
+        seg_inputs = []
+        seen = set()
+        for op in seg_ops:
+            for n in op.input_arg_names():
+                if n != EMPTY_VAR_NAME and n not in produced and n not in seen:
+                    seen.add(n)
+                    seg_inputs.append(n)
+            produced |= set(op.output_arg_names())
+        seg_outputs = [n for n in dict.fromkeys(
+            n for op in seg_ops for n in op.output_arg_names())
+            if n in grad_map]
+        if not seg_outputs:
+            continue
+        targets = [n for n in seg_inputs if n in req and n not in no_grad]
+        if not targets:
+            continue
+        out_grad_names = [_merge_grads(block, n, grad_map)
+                          for n in seg_outputs]
+        in_grad_names = []
+        for n in seg_inputs:
+            if n in targets:
+                in_grad_names.append(_record_grad(block, n, grad_map))
+            else:
+                in_grad_names.append(EMPTY_VAR_NAME)
+        block.append_op(
+            "recompute_segment_grad",
+            inputs={"Inputs": seg_inputs, "OutGrads": out_grad_names},
+            outputs={"InGrads": in_grad_names},
+            attrs={"seg_op_ids": [o.id for o in seg_ops],
+                   "seg_inputs": seg_inputs, "seg_outputs": seg_outputs,
+                   "op_role": OpRole.Backward},
+            infer_shape=False)
+
+    return _finalize_params_grads(block, program, parameter_list, grad_map)

@@ -1,0 +1,4 @@
+"""fluid.contrib (mirror of /root/reference/python/paddle/fluid/contrib/):
+mixed_precision is the maintained piece; slim/quant land later."""
+
+from . import mixed_precision  # noqa: F401

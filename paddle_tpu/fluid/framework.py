@@ -569,3 +569,22 @@ class OpRole:
 
 def grad_var_name(name: str) -> str:
     return name + GRAD_SUFFIX
+
+
+def block_io(blk: "Block"):
+    """(reads-before-write, writes) of a block — shared helper for sub-block
+    op construction (conditional_block / while wrappers)."""
+    defined = set()
+    reads, writes = [], []
+    seen_r, seen_w = set(), set()
+    for op in blk.ops:
+        for n in op.input_arg_names():
+            if n not in defined and n not in seen_r:
+                seen_r.add(n)
+                reads.append(n)
+        for n in op.output_arg_names():
+            if n not in seen_w:
+                seen_w.add(n)
+                writes.append(n)
+            defined.add(n)
+    return reads, writes

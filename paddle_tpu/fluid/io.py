@@ -1,0 +1,150 @@
+"""Checkpoint / inference-model save & load.
+
+Mirror of /root/reference/python/paddle/fluid/io.py
+(save_persistables/save_inference_model/load_persistables/
+load_inference_model) and the save/load ops (save_op.cc, load_op.cc,
+save_combine).  The reference serializes LoDTensors via save/load ops
+executed by a generated program; here persistable state lives in the Scope
+as arrays, saved as an .npz bundle ("save_combine" equivalent), and the
+Program itself serializes as JSON (the ProgramDesc-protobuf analogue —
+framework.py Program.to_dict).  Inference export prunes the program to the
+fetch targets and flips is_test, like prune()+clone(for_test) in the
+reference (framework/prune.cc)."""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from . import core
+from .executor import global_scope
+from .framework import (Program, Variable, default_main_program)
+
+_PARAMS_FILE = "params.npz"
+_PROGRAM_FILE = "program.json"
+_META_FILE = "meta.json"
+
+
+def _persistable_names(program: Program) -> List[str]:
+    return [v.name for v in program.list_vars() if v.persistable]
+
+
+def save_persistables(executor, dirname, main_program: Optional[Program] = None,
+                      filename=None):
+    """Save every persistable var of `main_program` from the scope
+    (io.py save_persistables in the reference)."""
+    os.makedirs(dirname, exist_ok=True)
+    program = main_program or default_main_program()
+    scope = global_scope()
+    arrays = {}
+    for name in _persistable_names(program):
+        if scope.has(name) and scope.get(name) is not None:
+            arr = np.asarray(scope.get(name))
+            if arr.dtype.name not in np.sctypeDict and "bfloat16" in str(arr.dtype):
+                arr = arr.astype("float32")
+            arrays[name] = arr
+    np.savez(os.path.join(dirname, filename or _PARAMS_FILE), **arrays)
+
+
+save_params = save_persistables
+
+
+def load_persistables(executor, dirname, main_program: Optional[Program] = None,
+                      filename=None):
+    program = main_program or default_main_program()
+    scope = global_scope()
+    path = os.path.join(dirname, filename or _PARAMS_FILE)
+    if not path.endswith(".npz") and not os.path.exists(path):
+        path = path + ".npz"
+    data = np.load(path)
+    wanted = set(_persistable_names(program))
+    for name in data.files:
+        if name in wanted:
+            var = next(v for v in program.list_vars() if v.name == name)
+            arr = data[name]
+            scope.set(name, arr.astype(core.np_dtype(var.dtype)))
+
+
+load_params = load_persistables
+
+
+def _prune_for_targets(program: Program, feed_names, target_names):
+    """Backward slice: keep only ops needed to compute targets from feeds
+    (framework/prune.cc in the reference).  The slice stops at declared
+    feeds — their producers are dropped so the exported model reads the
+    feed instead of recomputing it — and feeds that cannot reach any
+    target are rejected."""
+    pruned = program.clone(for_test=True)
+    block = pruned.global_block()
+    feeds = set(feed_names)
+    needed = set(target_names)
+    kept = []
+    for op in reversed(block.ops):
+        if set(op.output_arg_names()) & (needed - feeds):
+            kept.append(op)
+            needed |= {n for n in op.input_arg_names()}
+    block.ops = [op for op in block.ops if op in set(kept)]
+    unused = feeds - needed
+    if unused:
+        raise ValueError(
+            f"feed variables {sorted(unused)} do not reach any of the "
+            f"target vars {sorted(target_names)}")
+    pruned._bump_version()
+    return pruned
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program: Optional[Program] = None,
+                         model_filename=None, params_filename=None,
+                         export_for_deployment=True, program_only=False):
+    os.makedirs(dirname, exist_ok=True)
+    program = main_program or default_main_program()
+    target_names = [v.name if isinstance(v, Variable) else str(v)
+                    for v in target_vars]
+    pruned = _prune_for_targets(program, feeded_var_names, target_names)
+    with open(os.path.join(dirname, model_filename or _PROGRAM_FILE),
+              "w") as f:
+        f.write(pruned.to_json())
+    with open(os.path.join(dirname, _META_FILE), "w") as f:
+        json.dump({"feed": list(feeded_var_names),
+                   "fetch": target_names,
+                   "format": "paddle_tpu.inference.v1"}, f)
+    if not program_only:
+        save_persistables(executor, dirname, pruned,
+                          params_filename)
+    return target_names
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    with open(os.path.join(dirname, model_filename or _PROGRAM_FILE)) as f:
+        program = Program.from_json(f.read())
+    with open(os.path.join(dirname, _META_FILE)) as f:
+        meta = json.load(f)
+    load_persistables(executor, dirname, program, params_filename)
+    block = program.global_block()
+    fetch_vars = [block.var(n) for n in meta["fetch"]]
+    return program, meta["feed"], fetch_vars
+
+
+# -- 2.0-style state_dict save/load (paddle.save/paddle.load) --------------
+
+def save(state_dict_or_program, path):
+    if isinstance(state_dict_or_program, Program):
+        with open(path, "w") as f:
+            f.write(state_dict_or_program.to_json())
+        return
+    arrays = {k: np.asarray(v) for k, v in state_dict_or_program.items()}
+    np.savez(path if path.endswith(".npz") else path + ".npz", **arrays)
+
+
+def load(path):
+    if path.endswith(".json"):
+        with open(path) as f:
+            return Program.from_json(f.read())
+    p = path if path.endswith(".npz") else path + ".npz"
+    data = np.load(p)
+    return {k: data[k] for k in data.files}

@@ -1,0 +1,6 @@
+"""Program transpilers (mirror of
+/root/reference/python/paddle/fluid/transpiler/).  DistributeTranspiler
+(PS mode) is documented out of TPU north-star scope (SURVEY.md §2.9 #13);
+the collective transpilers are implemented in collective.py."""
+
+from .collective import Collective, GradAllReduce, LocalSGD  # noqa: F401

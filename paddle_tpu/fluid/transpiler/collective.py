@@ -1,0 +1,138 @@
+"""Collective transpilers: rewrite a single-device train program into the
+per-rank SPMD program of collective data parallelism.
+
+Mirror of /root/reference/python/paddle/fluid/transpiler/collective.py
+(Collective:36, GradAllReduce:178, LocalSGD, ring_id rotation :135-156).
+The reference inserts `c_gen_nccl_id`/`c_comm_init` startup ops and
+`c_allreduce_sum` + `c_sync_*` fences per gradient; here comm bootstrap is
+mesh construction (the startup ops are appended as no-op markers for
+program parity) and each gradient gets scale(1/nranks) + c_allreduce_sum —
+lowered to one XLA AllReduce over ICI inside the shard_map the compiler
+wraps around the program (paddle_tpu/parallel/compiler.py
+_compile_shard_map).
+"""
+
+from __future__ import annotations
+
+from ..framework import OpRole
+
+
+class Collective:
+    def __init__(self, nrings=1):
+        self.nrings = nrings
+        self.nranks = 1
+
+    def transpile(self, startup_program, main_program, rank, endpoints,
+                  current_endpoint, wait_port=True):
+        self.nranks = len(endpoints) if endpoints else 1
+        self.rank = rank
+        self.startup_program = startup_program
+        self.main_program = main_program
+        self._transpile_startup_program()
+        self._transpile_main_program()
+        return main_program
+
+    def _transpile_startup_program(self):
+        # comm bootstrap parity ops (no-op lowerings; mesh construction is
+        # the real init on TPU)
+        block = self.startup_program.global_block()
+        for ring_id in range(self.nrings):
+            block.append_op("c_comm_init_all", attrs={"ring_id": ring_id},
+                            infer_shape=False)
+
+    def _transpile_main_program(self):
+        raise NotImplementedError
+
+
+class GradAllReduce(Collective):
+    """Insert grad allreduce after the backward section
+    (collective.py:178 in the reference)."""
+
+    def __init__(self, nrings=1, scale_gradient=True):
+        super().__init__(nrings)
+        self.scale_gradient = scale_gradient
+
+    def _transpile_main_program(self):
+        block = self.main_program.global_block()
+        # find grad vars produced by backward ops that feed optimizer ops
+        opt_inputs = []
+        grad_names = set()
+        for op in block.ops:
+            if op.attr("op_role", 0) == OpRole.Optimize:
+                for n in op.input("Grad"):
+                    grad_names.add(n)
+        if not grad_names:
+            return
+        # insert scale + allreduce right before the first optimize op
+        first_opt = next(i for i, op in enumerate(block.ops)
+                         if op.attr("op_role", 0) == OpRole.Optimize)
+        new_ops = []
+        ring = 0
+        from ..framework import Operator
+
+        for g in sorted(grad_names):
+            attrs = {"op_role": OpRole.Backward}
+            if self.scale_gradient:
+                # scale by the RUNTIME data-axis size (divide_by_axis_size),
+                # not the static endpoint count: with multi-device hosts the
+                # psum spans every mesh shard, so 1/len(endpoints) would
+                # under-scale (multi-chip-per-process case)
+                new_ops.append(Operator(
+                    block, self.main_program._next_op_id(), "scale",
+                    {"X": [g]}, {"Out": [g]},
+                    {"scale": 1.0, "bias": 0.0, "bias_after_scale": True,
+                     "divide_by_axis_size": "data",
+                     "op_role": OpRole.Backward}))
+            new_ops.append(Operator(
+                block, self.main_program._next_op_id(), "c_allreduce_sum",
+                {"X": [g]}, {"Out": [g]},
+                {"ring_id": ring % self.nrings, "use_calc_stream": True,
+                 "op_role": OpRole.Backward}))
+            ring += 1
+        block.ops[first_opt:first_opt] = new_ops
+        self.main_program._bump_version()
+
+
+class LocalSGD(Collective):
+    """Periodically average params instead of grads
+    (localsgd: sync params every k steps; reference
+    transpiler/collective.py LocalSGD + fleet localsgd_optimizer.py)."""
+
+    def __init__(self, nrings=1, k_steps=1):
+        super().__init__(nrings)
+        self.k_steps = k_steps
+
+    def _transpile_main_program(self):
+        from ..layers import tensor as tl
+        from .. import framework as fw
+
+        main = self.main_program
+        block = main.global_block()
+        params = [p.name for p in main.all_parameters() if p.trainable]
+        if not params:
+            return
+        with fw.program_guard(main, self.startup_program):
+            step = tl.create_global_var([1], 0.0, "float32", persistable=True,
+                                        name="@LOCALSGD_STEP@")
+            tl.increment(step, 1.0)
+            # every k steps: p <- psum(p)/nranks via allreduce, selected by
+            # mask (XLA folds the no-op iterations)
+            from ..layers import nn
+
+            kvar = tl.fill_constant([1], "float32", float(self.k_steps))
+            rem = nn.elementwise_sub(
+                step, nn.elementwise_mul(
+                    nn.floor(nn.elementwise_div(step, kvar)), kvar))
+            mask = tl.cast(nn.less_than(rem, tl.fill_constant(
+                [1], "float32", 0.5)), "float32")
+            for p in params:
+                pvar = block.var(p)
+                avg = nn.scale(pvar, 1.0 / self.nranks)
+                block.append_op("c_allreduce_sum", inputs={"X": [avg]},
+                                outputs={"Out": [avg]},
+                                attrs={"ring_id": 0}, infer_shape=False)
+                mixed = nn.elementwise_add(
+                    nn.elementwise_mul(avg, mask),
+                    nn.elementwise_mul(pvar, nn.scale(mask, -1.0, 1.0)))
+                block.append_op("assign", inputs={"X": [mixed]},
+                                outputs={"Out": [pvar]}, infer_shape=False)

@@ -152,3 +152,50 @@ def _print(ctx, op, ins):
     if not ctx.abstract:
         jax.debug.print(op.attr("message", "") + " {}", x)
     return {"Out": [x]}
+
+
+@register_op("recompute_segment_grad")
+def _recompute_segment_grad(ctx, op, ins):
+    """Backward of a recompute segment: re-run the segment's forward ops
+    from its boundary inputs under jax.checkpoint and vjp through it.
+    Emitted by fluid.backward.append_backward_with_checkpoints (the
+    reference's RecomputeOptimizer mechanism, optimizer.py:4491 — here the
+    rematerialization itself is jax.checkpoint, i.e. XLA remat with an
+    optimization barrier, instead of cloned program ops)."""
+    from . import registry
+
+    seg_ids = op.attr("seg_op_ids")
+    seg_inputs = op.attr("seg_inputs")
+    seg_outputs = op.attr("seg_outputs")
+    block = ctx.block
+    ops_by_id = {o.id: o for o in block.ops}
+    seg_ops = [ops_by_id[i] for i in seg_ids]
+    in_vals = ins.get("Inputs", [])
+    out_grads = ins.get("OutGrads", [])
+
+    diff_idx = [i for i, v in enumerate(in_vals)
+                if v is not None and jnp.issubdtype(jnp.result_type(v),
+                                                    jnp.inexact)]
+    diff_vals = [in_vals[i] for i in diff_idx]
+
+    def f(dvals):
+        vals = list(in_vals)
+        for i, v in zip(diff_idx, dvals):
+            vals[i] = v
+        env = dict(zip(seg_inputs, vals))
+        # plain forward lowering; rng keys are deterministic per op id so
+        # the recompute replays identical randomness (dropout masks)
+        inner = registry.LowerCtx(ctx.base_key, block=block,
+                                  mesh_axes=ctx.mesh_axes)
+        for o in seg_ops:
+            registry.lower_op(inner, o, env)
+        return [env[n] for n in seg_outputs]
+
+    outs, vjp_fn = jax.vjp(jax.checkpoint(f), diff_vals)
+    ct = [g if g is not None else jnp.zeros(jnp.shape(o), jnp.result_type(o))
+          for o, g in zip(outs, out_grads)]
+    (dvals,) = vjp_fn(ct)
+    grads = [None] * len(in_vals)
+    for i, g in zip(diff_idx, dvals):
+        grads[i] = g
+    return {"InGrads": grads}

@@ -58,6 +58,13 @@ def _scale(ctx, op, ins):
     x = first(ins, "X")
     scale = first(ins, "ScaleTensor", op.attr("scale", 1.0))
     bias = op.attr("bias", 0.0)
+    # grad-averaging for collective DP: divide by the mesh axis size at
+    # lowering time (1 outside any mesh) — see transpiler/collective.py
+    div_axis = op.attr("divide_by_axis_size", None)
+    if div_axis is not None:
+        axis_name = (ctx.mesh_axes or {}).get(div_axis)
+        if axis_name is not None:
+            scale = scale / lax.axis_size(axis_name)
     if op.attr("bias_after_scale", True):
         out = x * jnp.asarray(scale, x.dtype) + jnp.asarray(bias, x.dtype)
     else:

@@ -113,7 +113,22 @@ class CompiledProgram:
             return [np.asarray(f) for f in fetches]
         return list(fetches)
 
+    def _has_collective_ops(self, program) -> bool:
+        for op in program.global_block().ops:
+            if op.type.startswith("c_") or op.type in ("barrier", "alltoall",
+                                                       "send_v2", "recv_v2"):
+                return True
+        return False
+
     def _compile(self, executor, program, feed_arrays, fetch_names, scope):
+        if self._has_collective_ops(program):
+            return self._compile_shard_map(executor, program, feed_arrays,
+                                           fetch_names, scope)
+        return self._compile_spmd(executor, program, feed_arrays,
+                                  fetch_names, scope)
+
+    def _compile_spmd(self, executor, program, feed_arrays, fetch_names,
+                      scope):
         from ..fluid.executor import _analyze_block
         from ..ops import registry
 
@@ -139,6 +154,21 @@ class CompiledProgram:
             else:
                 feed_shardings[n] = repl
 
+        def state_sharding(name):
+            """Honor ZeRO annotations (sharding_optimizer.py): vars marked
+            _sharding_axes get dim-0 sharded over that axis; XLA SPMD then
+            materializes the reduce-scatter/all-gather pattern."""
+            try:
+                v = block._var_recursive(name)
+            except ValueError:
+                return repl
+            axes = getattr(v, "_sharding_axes", None)
+            if axes and v.shape and len(v.shape) >= 1 and v.shape[0] != 1:
+                ax = axes[0]
+                if ax in mesh.axis_names and v.shape[0] % mesh.shape[ax] == 0:
+                    return NamedSharding(mesh, P(ax))
+            return repl
+
         def step_fn(mutable_state, const_state, feeds, seed):
             env: Dict[str, Any] = {}
             env.update(const_state)
@@ -153,12 +183,82 @@ class CompiledProgram:
         fn = jax.jit(
             step_fn,
             in_shardings=(
-                {n: repl for n in mutable_in},
-                {n: repl for n in const_in},
+                {n: state_sharding(n) for n in mutable_in},
+                {n: state_sharding(n) for n in const_in},
                 {n: feed_shardings[n] for n in feed_arrays},
                 None,
             ),
-            out_shardings=(None, {n: repl for n in mutable_out}),
+            out_shardings=(None, {n: state_sharding(n) for n in mutable_out}),
             donate_argnums=(0,),
         )
+        return fn, mutable_in, const_in, mutable_out, feed_shardings
+
+    def _compile_shard_map(self, executor, program, feed_arrays,
+                           fetch_names, scope):
+        """Explicit-collective mode: the program carries c_allreduce/... ops
+        (Fleet transpiler style, reference fluid/transpiler/collective.py:36,
+        178).  The whole block is traced inside ONE shard_map over the mesh;
+        collective ops lower to lax.psum/all_gather/... on the "data" axis
+        (paddle_tpu/ops/collective_ops.py).  This is the per-rank SPMD view
+        the reference runs as N processes — here it is N mesh shards in one
+        XLA program."""
+        from ..fluid.executor import _analyze_block
+        from ..ops import registry
+
+        mesh = self._mesh
+        block = program.global_block()
+        reads, persistable_writes = _analyze_block(block, feed_arrays.keys(),
+                                                   scope)
+        state_in = [n for n in reads if scope.has(n)]
+        missing = [n for n in reads if not scope.has(n)]
+        if missing:
+            raise RuntimeError(f"uninitialized variables: {missing}")
+        pw = set(persistable_writes)
+        mutable_in = sorted(n for n in state_in if n in pw)
+        const_in = sorted(n for n in state_in if n not in pw)
+        mutable_out = sorted(pw)
+
+        P_ = P
+        repl_spec = P_()
+        nd = mesh.shape[mesh_lib.DATA_AXIS]
+        feed_specs = {}
+        for n, a in feed_arrays.items():
+            if a.ndim >= 1 and a.shape[0] % nd == 0:
+                feed_specs[n] = P_(mesh_lib.DATA_AXIS)
+            else:
+                feed_specs[n] = repl_spec
+        # every ring maps onto the data axis unless a mesh axis of that
+        # name exists (model/pipe rings for hybrid parallelism)
+        mesh_axes = {"data": mesh_lib.DATA_AXIS}
+        for ax in mesh.axis_names:
+            mesh_axes[ax] = ax
+
+        def per_shard(mutable_state, const_state, feeds, seed):
+            env = dict(const_state)
+            env.update(mutable_state)
+            env.update(feeds)
+            key = jax.random.fold_in(
+                jax.random.PRNGKey(seed),
+                jax.lax.axis_index(mesh_lib.DATA_AXIS))
+            ctx = registry.LowerCtx(key, block=block, mesh_axes=mesh_axes)
+            registry.lower_block(ctx, block, env)
+            fetches = [env[n] for n in fetch_names]
+            new_state = {n: env[n] for n in mutable_out if n in env}
+            return fetches, new_state
+
+        import jax as _jax
+
+        sharded = _jax.shard_map(
+            per_shard, mesh=mesh,
+            in_specs=({n: repl_spec for n in mutable_in},
+                      {n: repl_spec for n in const_in},
+                      {n: feed_specs[n] for n in feed_arrays},
+                      repl_spec),
+            out_specs=([repl_spec for _ in fetch_names],
+                       {n: repl_spec for n in mutable_out}),
+            check_vma=False)
+        fn = _jax.jit(sharded, donate_argnums=(0,))
+
+        feed_shardings = {n: NamedSharding(mesh, feed_specs[n])
+                          for n in feed_arrays}
         return fn, mutable_in, const_in, mutable_out, feed_shardings

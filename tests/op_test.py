@@ -1,0 +1,156 @@
+"""Declarative op-test harness: the TPU port of the reference's OpTest
+methodology (/root/reference/python/paddle/fluid/tests/unittests/
+op_test.py:226 check_output:1021, check_grad:1324,
+get_numeric_gradient:101).
+
+A test sets `op_type`, `inputs`, `attrs`, `outputs` (NumPy oracle);
+`check_output` builds a one-op Program, runs it through the real Executor
+(whole-block XLA compilation), and compares.  `check_grad` compares
+append_backward's analytic gradients against central-difference numeric
+gradients of sum(output) computed by re-running the forward program.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core, framework, unique_name
+from paddle_tpu.fluid.executor import Scope, scope_guard
+
+
+class OpTest:
+    op_type: str = ""
+    inputs: dict = {}
+    outputs: dict = {}
+    attrs: dict = {}
+
+    # -- program construction ---------------------------------------------
+    def _build(self, for_grad=False, grad_inputs=(), grad_output=None):
+        main, startup = framework.Program(), framework.Program()
+        feed = {}
+        with framework.program_guard(main, startup), unique_name.guard():
+            block = main.global_block()
+            in_map = {}
+            for slot, val in self.inputs.items():
+                arrs = val if isinstance(val, list) else [val]
+                names = []
+                for i, a in enumerate(arrs):
+                    a = np.asarray(a)
+                    name = f"in_{slot}_{i}"
+                    block.create_var(
+                        name=name, shape=a.shape,
+                        dtype=core.convert_dtype(a.dtype), is_data=True,
+                        stop_gradient=not (for_grad and slot in grad_inputs))
+                    feed[name] = a
+                    names.append(name)
+                in_map[slot] = names
+            out_map = {}
+            fetch_names = []
+            for slot, val in self.outputs.items():
+                arrs = val if isinstance(val, list) else [val]
+                names = []
+                for i, a in enumerate(arrs):
+                    name = f"out_{slot}_{i}"
+                    block.create_var(name=name,
+                                     dtype=core.convert_dtype(
+                                         np.asarray(a).dtype))
+                    names.append(name)
+                    fetch_names.append((slot, i, name))
+                out_map[slot] = names
+            block.append_op(self.op_type, inputs=in_map, outputs=out_map,
+                            attrs=dict(self.attrs))
+
+            grad_fetch = []
+            if for_grad:
+                out_var = block.var(
+                    dict((s, n) for s, i, n in fetch_names
+                         if i == 0)[grad_output])
+                loss = fluid.layers.reduce_sum(out_var)
+                # cast non-f32 losses for a uniform scalar target
+                pgs = fluid.append_backward(
+                    loss, parameter_list=None,
+                    no_grad_set={n for s, ns in in_map.items()
+                                 for n in ns if s not in grad_inputs})
+                for slot in grad_inputs:
+                    for n in in_map[slot]:
+                        grad_fetch.append(framework.grad_var_name(n))
+        return main, startup, feed, fetch_names, grad_fetch
+
+    # -- checks ------------------------------------------------------------
+    def check_output(self, atol=1e-5, rtol=1e-5, no_check_set=()):
+        main, startup, feed, fetch_names, _ = self._build()
+        scope = Scope()
+        with scope_guard(scope):
+            exe = fluid.Executor()
+            if startup.num_ops():
+                exe.run(startup)
+            outs = exe.run(main, feed=feed,
+                           fetch_list=[n for _, _, n in fetch_names])
+        for (slot, i, name), got in zip(fetch_names, outs):
+            if slot in no_check_set:
+                continue
+            want = self.outputs[slot]
+            want = np.asarray(want[i] if isinstance(want, list) else want)
+            np.testing.assert_allclose(
+                np.asarray(got, dtype=np.float64)
+                if want.dtype.kind == "f" else got,
+                want.astype(np.float64) if want.dtype.kind == "f" else want,
+                atol=atol, rtol=rtol,
+                err_msg=f"{self.op_type} output {slot}[{i}]")
+
+    def check_grad(self, inputs_to_check, output_name,
+                   max_relative_error=5e-3, delta=5e-3,
+                   numeric_grad_delta=None):
+        delta = numeric_grad_delta or delta
+        main, startup, feed, fetch_names, grad_fetch = self._build(
+            for_grad=True, grad_inputs=tuple(inputs_to_check),
+            grad_output=output_name)
+        scope = Scope()
+        with scope_guard(scope):
+            exe = fluid.Executor()
+            if startup.num_ops():
+                exe.run(startup)
+            analytic = exe.run(main, feed=feed, fetch_list=grad_fetch)
+
+            # forward-only program for numeric diff
+            fwd_main, fwd_startup, fwd_feed, fwd_fetch, _ = self._build()
+            out_names = [n for s, i, n in fwd_fetch if s == output_name]
+
+            def f(feed_dict):
+                outs = exe.run(fwd_main, feed=feed_dict,
+                               fetch_list=out_names)
+                return float(sum(np.sum(np.asarray(o, np.float64))
+                                 for o in outs))
+
+            idx = 0
+            for slot in inputs_to_check:
+                arrs = self.inputs[slot]
+                arrs = arrs if isinstance(arrs, list) else [arrs]
+                for i, a in enumerate(arrs):
+                    a = np.asarray(a)
+                    name = f"in_{slot}_{i}"
+                    numeric = np.zeros(a.size, np.float64)
+                    flat = a.reshape(-1)
+                    for j in range(a.size):
+                        orig = flat[j]
+                        flat[j] = orig + delta
+                        fp = f(fwd_feed | {name: a})
+                        flat[j] = orig - delta
+                        fm = f(fwd_feed | {name: a})
+                        flat[j] = orig
+                        numeric[j] = (fp - fm) / (2 * delta)
+                    got = np.asarray(analytic[idx], np.float64).reshape(-1)
+                    idx += 1
+                    abs_err = np.abs(got - numeric)
+                    denom = np.maximum(np.maximum(np.abs(got),
+                                                  np.abs(numeric)), 1e-3)
+                    rel = (abs_err / denom).max()
+                    assert rel <= max_relative_error, (
+                        f"{self.op_type} grad {slot}: max rel err {rel:.4e} "
+                        f"(analytic {got[:5]}, numeric {numeric[:5]})")
+
+
+def randf(*shape, low=-1.0, high=1.0, seed=None):
+    rng = np.random.RandomState(seed if seed is not None else abs(hash(shape)) % 2**31)
+    return rng.uniform(low, high, size=shape).astype("float32")

@@ -1,0 +1,328 @@
+"""Distributed tests on the 8-device virtual CPU mesh.
+
+Replicates the reference's two-tier strategy (SURVEY.md §4):
+graph-level meta-optimizer assertions (fleet_meta_optimizer_base.py style —
+build, minimize, assert on inserted ops without running) and executable
+collective checks (TestDistBase style — here single-process multi-device,
+which XLA gives for free)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import DistributedStrategy, UserDefinedRoleMaker
+from paddle_tpu.fluid import framework, unique_name
+from paddle_tpu.fluid.executor import Scope, scope_guard
+
+
+def build_net():
+    x = fluid.data("x", [-1, 8], "float32")
+    label = fluid.data("label", [-1, 1], "int64")
+    h = fluid.layers.fc(x, 16, act="relu")
+    h2 = fluid.layers.fc(h, 16, act="relu")
+    pred = fluid.layers.fc(h2, 4)
+    loss = fluid.layers.reduce_mean(
+        fluid.layers.loss.softmax_with_cross_entropy(pred, label))
+    return x, label, h, loss
+
+
+def fleet_minimize(strategy, opt=None, nranks=1):
+    fleet.fleet.init(
+        role_maker=UserDefinedRoleMaker(worker_num=nranks, current_id=0),
+        strategy=strategy)
+    opt = opt or fluid.optimizer.Adam(0.001)
+    fo = fleet.fleet.distributed_optimizer(opt, strategy)
+    return fo
+
+
+# -- graph-level assertions (cheap CI coverage of rewrites) -----------------
+
+def test_amp_inserts_casts(fresh_programs):
+    main, startup, scope = fresh_programs
+    x, label, h, loss = build_net()
+    strategy = DistributedStrategy()
+    strategy.amp = True
+    fo = fleet_minimize(strategy)
+    fo.minimize(loss)
+    types = [op.type for op in main.global_block().ops]
+    assert "cast" in types
+    assert "AMPOptimizer" in fleet.fleet.applied_meta_list()
+    # mul runs in bf16: its inputs are cast vars
+    mul_ops = [op for op in main.global_block().ops if op.type == "mul"
+               and "fwd_op_id" not in op.attrs]
+    assert any(".cast_bfloat16" in n for op in mul_ops
+               for n in op.input_arg_names())
+
+
+def test_recompute_emits_segment_grads(fresh_programs):
+    main, startup, scope = fresh_programs
+    x, label, h, loss = build_net()
+    strategy = DistributedStrategy()
+    strategy.recompute = True
+    strategy.recompute_configs = {"checkpoints": [h.name]}
+    fo = fleet_minimize(strategy)
+    fo.minimize(loss)
+    types = [op.type for op in main.global_block().ops]
+    assert types.count("recompute_segment_grad") == 2  # two segments
+    assert "RecomputeOptimizer" in fleet.fleet.applied_meta_list()
+
+
+def test_gradient_merge_builds_conditional(fresh_programs):
+    main, startup, scope = fresh_programs
+    x, label, h, loss = build_net()
+    strategy = DistributedStrategy()
+    strategy.gradient_merge = True
+    strategy.gradient_merge_configs = {"k_steps": 4, "avg": True}
+    fo = fleet_minimize(strategy)
+    fo.minimize(loss)
+    types = [op.type for op in main.global_block().ops]
+    assert "conditional_block" in types
+    assert len(main.blocks) == 2  # sub-block with optimizer ops
+    sub_types = [op.type for op in main.blocks[1].ops]
+    assert "adam" in sub_types
+
+
+def test_lamb_swap(fresh_programs):
+    main, startup, scope = fresh_programs
+    x, label, h, loss = build_net()
+    strategy = DistributedStrategy()
+    strategy.lamb = True
+    fo = fleet_minimize(strategy)
+    fo.minimize(loss)
+    types = [op.type for op in main.global_block().ops]
+    assert "lamb" in types and "adam" not in types
+
+
+def test_grad_allreduce_transpile(fresh_programs):
+    main, startup, scope = fresh_programs
+    x, label, h, loss = build_net()
+    strategy = DistributedStrategy()
+    fo = fleet_minimize(strategy, nranks=8)
+    fo.minimize(loss)
+    types = [op.type for op in main.global_block().ops]
+    assert "c_allreduce_sum" in types
+    n_allreduce = types.count("c_allreduce_sum")
+    assert n_allreduce == 6  # one per param grad (3 weights + 3 biases)
+
+
+# -- executable collective checks ------------------------------------------
+
+def test_collective_allreduce_runs(fresh_programs):
+    """c_allreduce over 8 shards inside shard_map == global sum."""
+    import paddle_tpu.distributed.collective as coll
+
+    main, startup, scope = fresh_programs
+    x = fluid.data("x", [8, 4], "float32")
+    y = coll.all_reduce(x)
+    compiled = fluid.CompiledProgram(main).with_data_parallel()
+    exe = fluid.Executor()
+    X = np.arange(32, dtype="float32").reshape(8, 4)
+    (out,) = exe.run(compiled, feed={"x": X}, fetch_list=[y])
+    # each shard holds 1 row; allreduce sums the 8 rows on every shard
+    want = X.sum(axis=0, keepdims=True)
+    np.testing.assert_allclose(out[:1], want, rtol=1e-6)
+
+
+def test_collective_dp_training_matches_single(fresh_programs):
+    """Transpiled collective DP over 8 shards reproduces the single-device
+    loss trajectory (TestDistBase.check_with_place analogue,
+    reference test_dist_base.py:1119)."""
+    rng = np.random.RandomState(0)
+    X = rng.rand(16, 8).astype("float32")
+    L = rng.randint(0, 4, size=(16, 1)).astype("int64")
+
+    def run(nranks):
+        import paddle_tpu.distributed.collective as coll
+
+        main, startup = framework.Program(), framework.Program()
+        scope = Scope()
+        with framework.program_guard(main, startup), unique_name.guard(), \
+                scope_guard(scope):
+            x, label, h, loss = build_net()
+            main.random_seed = 11
+            startup.random_seed = 11
+            strategy = DistributedStrategy()
+            fo = fleet_minimize(strategy, opt=fluid.optimizer.SGD(0.1),
+                                nranks=nranks)
+            fo.minimize(loss)
+            # fetch the GLOBAL mean loss (the DP fetch is otherwise the
+            # local shard's loss, a different quantity)
+            fetch = loss
+            if nranks > 1:
+                fetch = fluid.layers.scale(coll.all_reduce(loss),
+                                           1.0 / nranks)
+            exe = fluid.Executor()
+            exe.run(startup)
+            prog = main
+            if nranks > 1:
+                prog = fluid.CompiledProgram(main).with_data_parallel(
+                    loss_name=loss.name)
+            losses = []
+            for _ in range(5):
+                (l,) = exe.run(prog, feed={"x": X, "label": L},
+                               fetch_list=[fetch])
+                losses.append(float(np.asarray(l).reshape(-1)[0]))
+        return losses
+
+    single = run(1)
+    dist = run(8)
+    np.testing.assert_allclose(single, dist, rtol=2e-3, atol=2e-4)
+
+
+def test_zero_sharding_runs(fresh_programs):
+    """ZeRO-1: adam moments sharded over the data axis; step still runs and
+    state shapes survive round-trip."""
+    main, startup, scope = fresh_programs
+    x, label, h, loss = build_net()
+    strategy = DistributedStrategy()
+    strategy.sharding = True
+    fo = fleet_minimize(strategy)
+    fo.minimize(loss)
+    # moments annotated
+    accs = fo._user_defined_optimizer._accumulators
+    annotated = [v for d in accs.values() for v in d.values()
+                 if getattr(v, "_sharding_axes", None)]
+    assert annotated
+    exe = fluid.Executor()
+    exe.run(startup)
+    compiled = fluid.CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name)
+    X = np.random.rand(16, 8).astype("float32")
+    L = np.random.randint(0, 4, (16, 1)).astype("int64")
+    for _ in range(2):
+        (l,) = exe.run(compiled, feed={"x": X, "label": L},
+                       fetch_list=[loss])
+    assert np.isfinite(np.asarray(l)).all()
+
+
+def test_amp_training_converges(fresh_programs):
+    main, startup, scope = fresh_programs
+    x, label, h, loss = build_net()
+    strategy = DistributedStrategy()
+    strategy.amp = True
+    fo = fleet_minimize(strategy, opt=fluid.optimizer.Adam(0.01))
+    fo.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(1)
+    X = rng.rand(32, 8).astype("float32")
+    L = rng.randint(0, 4, (32, 1)).astype("int64")
+    losses = []
+    for _ in range(40):
+        (l,) = exe.run(main, feed={"x": X, "label": L}, fetch_list=[loss])
+        losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.7
+
+
+def test_recompute_training_matches_plain(fresh_programs):
+    """Recompute changes memory behavior, not math: loss trajectories match
+    the plain backward."""
+    rng = np.random.RandomState(5)
+    X = rng.rand(8, 8).astype("float32")
+    L = rng.randint(0, 4, (8, 1)).astype("int64")
+
+    def run(recompute):
+        main, startup = framework.Program(), framework.Program()
+        scope = Scope()
+        with framework.program_guard(main, startup), unique_name.guard(), \
+                scope_guard(scope):
+            main.random_seed = 3
+            x, label, h, loss = build_net()
+            if recompute:
+                strategy = DistributedStrategy()
+                strategy.recompute = True
+                strategy.recompute_configs = {"checkpoints": [h.name]}
+                fo = fleet_minimize(strategy, opt=fluid.optimizer.SGD(0.5))
+                fo.minimize(loss)
+            else:
+                fluid.optimizer.SGD(0.5).minimize(loss)
+            exe = fluid.Executor()
+            exe.run(startup)
+            out = []
+            for _ in range(6):
+                (l,) = exe.run(main, feed={"x": X, "label": L},
+                               fetch_list=[loss])
+                out.append(float(l))
+        return out
+
+    np.testing.assert_allclose(run(False), run(True), rtol=1e-4, atol=1e-6)
+
+
+def test_gradient_merge_applies_every_k(fresh_programs):
+    """Params only move on every k-th step."""
+    main, startup, scope = fresh_programs
+    x, label, h, loss = build_net()
+    strategy = DistributedStrategy()
+    strategy.gradient_merge = True
+    strategy.gradient_merge_configs = {"k_steps": 3, "avg": True}
+    fo = fleet_minimize(strategy, opt=fluid.optimizer.SGD(0.5))
+    fo.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    pname = main.all_parameters()[0].name
+    X = np.random.rand(8, 8).astype("float32")
+    L = np.random.randint(0, 4, (8, 1)).astype("int64")
+    p0 = np.asarray(scope.get(pname)).copy()
+    exe.run(main, feed={"x": X, "label": L}, fetch_list=[loss])
+    p1 = np.asarray(scope.get(pname))
+    np.testing.assert_array_equal(p0, p1)  # step 1: no update
+    exe.run(main, feed={"x": X, "label": L}, fetch_list=[loss])
+    p2 = np.asarray(scope.get(pname))
+    np.testing.assert_array_equal(p0, p2)  # step 2: no update
+    exe.run(main, feed={"x": X, "label": L}, fetch_list=[loss])
+    p3 = np.asarray(scope.get(pname))
+    assert np.abs(p3 - p0).max() > 0  # step 3: applied
+
+
+def test_fp16_overflow_skips_update(fresh_programs):
+    """fp16 AMP: a step with inf grads must leave params AND moments
+    untouched (reference check_finite semantics), and halve the loss scale
+    after decr_every_n_nan_or_inf overflows."""
+    from paddle_tpu.fluid.contrib.mixed_precision import decorate
+
+    main, startup, scope = fresh_programs
+    x = fluid.data("x", [-1, 4], "float32")
+    x.stop_gradient = True
+    pred = fluid.layers.fc(x, 2, bias_attr=False)
+    loss = fluid.layers.reduce_mean(pred)
+    opt = decorate(fluid.optimizer.Adam(0.1), dtype="float16",
+                   init_loss_scaling=8.0, decr_every_n_nan_or_inf=1)
+    opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    pname = main.all_parameters()[0].name
+    p0 = np.asarray(scope.get(pname)).copy()
+    X = np.full((2, 4), np.inf, "float32")  # forces inf grads
+    exe.run(main, feed={"x": X}, fetch_list=[loss])
+    p1 = np.asarray(scope.get(pname))
+    np.testing.assert_array_equal(p0, p1)  # update skipped
+    scale = np.asarray(scope.get(opt.get_loss_scaling().name))
+    np.testing.assert_allclose(scale, [4.0])  # halved
+    # a finite step does update
+    exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+            fetch_list=[loss])
+    p2 = np.asarray(scope.get(pname))
+    assert np.abs(p2 - p0).max() > 0
+
+
+def test_grad_scale_uses_runtime_axis_size(fresh_programs):
+    """divide_by_axis_size scales by the mesh data-axis size (8), not the
+    transpiler's static endpoint count."""
+    main, startup, scope = fresh_programs
+    x = fluid.data("x", [8, 2], "float32")
+    s = main.global_block().create_var(name="s_out", dtype="float32")
+    main.global_block().append_op(
+        "scale", inputs={"X": [x]}, outputs={"Out": [s]},
+        attrs={"scale": 1.0, "bias": 0.0, "bias_after_scale": True,
+               "divide_by_axis_size": "data"}, infer_shape=False)
+    # add a collective op so the shard_map path is taken
+    import paddle_tpu.distributed.collective as coll
+
+    y = coll.all_reduce(s)
+    compiled = fluid.CompiledProgram(main).with_data_parallel()
+    exe = fluid.Executor()
+    X = np.ones((8, 2), "float32")
+    (out,) = exe.run(compiled, feed={"x": X}, fetch_list=[y])
+    # each shard: 1/8; allreduce over 8 shards: sum = 1.0
+    np.testing.assert_allclose(out[:1], np.ones((1, 2)), rtol=1e-6)

@@ -1,0 +1,374 @@
+"""Op tests: math/elementwise/reduction/activation families
+(mirrors reference unittests test_activation_op.py, test_elementwise_*_op.py,
+test_reduce_op.py, test_matmul_op.py methodology)."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest, randf
+
+
+class TestRelu(OpTest):
+    op_type = "relu"
+
+    def setup(self):
+        x = randf(4, 5, seed=1)
+        x[np.abs(x) < 0.05] = 0.1  # keep away from the kink
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.maximum(x, 0)}
+
+    def test(self):
+        self.setup()
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestSigmoid(OpTest):
+    op_type = "sigmoid"
+
+    def test(self):
+        x = randf(4, 5, seed=2)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": 1 / (1 + np.exp(-x))}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestTanh(OpTest):
+    op_type = "tanh"
+
+    def test(self):
+        x = randf(4, 5, seed=3)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.tanh(x)}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestGelu(OpTest):
+    op_type = "gelu"
+
+    def test(self):
+        from scipy.special import erf  # scipy is available via jax deps
+
+        x = randf(4, 5, seed=4)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": 0.5 * x * (1 + erf(x / np.sqrt(2)))}
+        self.check_output(atol=1e-4)
+        self.check_grad(["X"], "Out", max_relative_error=1e-2)
+
+
+class TestExpLog(OpTest):
+    op_type = "exp"
+
+    def test(self):
+        x = randf(3, 4, seed=5)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.exp(x)}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestSqrtGrad(OpTest):
+    op_type = "sqrt"
+
+    def test(self):
+        x = randf(3, 4, low=0.5, high=2.0, seed=6)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.sqrt(x)}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestSoftplus(OpTest):
+    op_type = "softplus"
+
+    def test(self):
+        x = randf(3, 4, seed=7)
+        self.inputs = {"X": x}
+        self.attrs = {"beta": 1.0, "threshold": 20.0}
+        self.outputs = {"Out": np.log1p(np.exp(x))}
+        self.check_output(atol=1e-5)
+        self.check_grad(["X"], "Out")
+
+
+class TestLeakyRelu(OpTest):
+    op_type = "leaky_relu"
+
+    def test(self):
+        x = randf(3, 4, seed=8)
+        x[np.abs(x) < 0.05] = 0.1
+        self.inputs = {"X": x}
+        self.attrs = {"alpha": 0.1}
+        self.outputs = {"Out": np.where(x >= 0, x, 0.1 * x)}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestElementwiseAdd(OpTest):
+    op_type = "elementwise_add"
+
+    def test(self):
+        x, y = randf(3, 4, seed=10), randf(3, 4, seed=11)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x + y}
+        self.check_output()
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestElementwiseAddBroadcastAxis(OpTest):
+    op_type = "elementwise_add"
+
+    def test(self):
+        x, y = randf(2, 3, 4, seed=12), randf(3, seed=13)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": x + y.reshape(1, 3, 1)}
+        self.check_output()
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestElementwiseMulBroadcast(OpTest):
+    op_type = "elementwise_mul"
+
+    def test(self):
+        x, y = randf(2, 3, 4, seed=14), randf(4, seed=15)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x * y}
+        self.check_output()
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestElementwiseDiv(OpTest):
+    op_type = "elementwise_div"
+
+    def test(self):
+        x = randf(3, 4, seed=16)
+        y = randf(3, 4, low=0.5, high=2.0, seed=17)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x / y}
+        self.check_output()
+        self.check_grad(["X", "Y"], "Out", max_relative_error=1e-2)
+
+
+class TestElementwiseSubTrailingOnes(OpTest):
+    op_type = "elementwise_sub"
+
+    def test(self):
+        x = randf(2, 3, 4, 5, seed=18)
+        y = randf(3, 4, 1, 1, seed=19)  # paddle trailing-1 stripping
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": x - y.reshape(1, 3, 4, 1)}
+        self.check_output()
+
+
+class TestScale(OpTest):
+    op_type = "scale"
+
+    def test(self):
+        x = randf(3, 4, seed=20)
+        self.inputs = {"X": x}
+        self.attrs = {"scale": 2.5, "bias": 0.5, "bias_after_scale": True}
+        self.outputs = {"Out": 2.5 * x + 0.5}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestSumMulti(OpTest):
+    op_type = "sum"
+
+    def test(self):
+        xs = [randf(3, 4, seed=21 + i) for i in range(3)]
+        self.inputs = {"X": xs}
+        self.outputs = {"Out": xs[0] + xs[1] + xs[2]}
+        self.check_output()
+
+
+class TestMatmul(OpTest):
+    op_type = "matmul"
+
+    def test(self):
+        x, y = randf(3, 4, seed=30), randf(4, 5, seed=31)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"transpose_X": False, "transpose_Y": False,
+                      "alpha": 1.0}
+        self.outputs = {"Out": x @ y}
+        self.check_output()
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestMatmulTransposed(OpTest):
+    op_type = "matmul"
+
+    def test(self):
+        x, y = randf(4, 3, seed=32), randf(5, 4, seed=33)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"transpose_X": True, "transpose_Y": True, "alpha": 2.0}
+        self.outputs = {"Out": 2.0 * (x.T @ y.T)}
+        self.check_output(atol=1e-4)
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestMatmulV2Batched(OpTest):
+    op_type = "matmul_v2"
+
+    def test(self):
+        x, y = randf(2, 3, 4, seed=34), randf(2, 4, 5, seed=35)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": np.matmul(x, y)}
+        self.check_output(atol=1e-4)
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestMul(OpTest):
+    op_type = "mul"
+
+    def test(self):
+        x, y = randf(3, 2, 2, seed=36), randf(4, 5, seed=37)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"x_num_col_dims": 1, "y_num_col_dims": 1}
+        self.outputs = {"Out": (x.reshape(3, 4) @ y).reshape(3, 5)}
+        self.check_output(atol=1e-4)
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestReduceSum(OpTest):
+    op_type = "reduce_sum"
+
+    def test(self):
+        x = randf(3, 4, 5, seed=40)
+        self.inputs = {"X": x}
+        self.attrs = {"dim": [1], "keep_dim": False, "reduce_all": False}
+        self.outputs = {"Out": x.sum(axis=1)}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestReduceMeanAll(OpTest):
+    op_type = "reduce_mean"
+
+    def test(self):
+        x = randf(3, 4, seed=41)
+        self.inputs = {"X": x}
+        self.attrs = {"dim": [0], "keep_dim": False, "reduce_all": True}
+        self.outputs = {"Out": np.array(x.mean(), "float32")}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestReduceMaxKeepdim(OpTest):
+    op_type = "reduce_max"
+
+    def test(self):
+        x = randf(3, 4, seed=42)
+        self.inputs = {"X": x}
+        self.attrs = {"dim": [-1], "keep_dim": True, "reduce_all": False}
+        self.outputs = {"Out": x.max(axis=-1, keepdims=True)}
+        self.check_output()
+
+
+class TestSoftmaxOp(OpTest):
+    op_type = "softmax"
+
+    def test(self):
+        x = randf(3, 7, seed=43)
+        e = np.exp(x - x.max(-1, keepdims=True))
+        self.inputs = {"X": x}
+        self.attrs = {"axis": -1}
+        self.outputs = {"Out": e / e.sum(-1, keepdims=True)}
+        self.check_output()
+        # d(sum softmax)/dx ≡ 0: both grads are float32 noise around zero,
+        # so the relative tolerance is necessarily loose here
+        self.check_grad(["X"], "Out", max_relative_error=5e-2)
+
+
+class TestCast(OpTest):
+    op_type = "cast"
+
+    def test(self):
+        x = randf(3, 4, seed=44)
+        self.inputs = {"X": x}
+        self.attrs = {"in_dtype": "float32", "out_dtype": "int32"}
+        self.outputs = {"Out": x.astype("int32")}
+        self.check_output()
+
+
+class TestClip(OpTest):
+    op_type = "clip"
+
+    def test(self):
+        x = randf(3, 4, seed=45)
+        self.inputs = {"X": x}
+        self.attrs = {"min": -0.3, "max": 0.3}
+        self.outputs = {"Out": np.clip(x, -0.3, 0.3)}
+        self.check_output()
+
+
+class TestCumsum(OpTest):
+    op_type = "cumsum"
+
+    def test(self):
+        x = randf(3, 4, seed=46)
+        self.inputs = {"X": x}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": np.cumsum(x, axis=1)}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestCumsumExclusiveReverse(OpTest):
+    op_type = "cumsum"
+
+    def test(self):
+        x = randf(3, 4, seed=47)
+        rev = x[:, ::-1]
+        want = np.cumsum(rev, 1) - rev
+        self.inputs = {"X": x}
+        self.attrs = {"axis": 1, "exclusive": True, "reverse": True}
+        self.outputs = {"Out": want[:, ::-1]}
+        self.check_output()
+
+
+class TestCompare(OpTest):
+    op_type = "less_than"
+
+    def test(self):
+        x, y = randf(3, 4, seed=48), randf(3, 4, seed=49)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x < y}
+        self.check_output()
+
+
+class TestLogicalAnd(OpTest):
+    op_type = "logical_and"
+
+    def test(self):
+        x = randf(3, 4, seed=50) > 0
+        y = randf(3, 4, seed=51) > 0
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x & y}
+        self.check_output()
+
+
+class TestSquaredL2Norm(OpTest):
+    op_type = "squared_l2_norm"
+
+    def test(self):
+        x = randf(3, 4, seed=52)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.array((x ** 2).sum(), "float32")}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestPowOp(OpTest):
+    op_type = "pow"
+
+    def test(self):
+        x = randf(3, 4, low=0.5, high=2.0, seed=53)
+        self.inputs = {"X": x}
+        self.attrs = {"factor": 3.0}
+        self.outputs = {"Out": x ** 3.0}
+        self.check_output(atol=1e-4)
+        self.check_grad(["X"], "Out", max_relative_error=1e-2)

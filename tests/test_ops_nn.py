@@ -1,0 +1,363 @@
+"""Op tests: conv/pool/norm/embedding/loss families (mirrors reference
+test_conv2d_op.py, test_pool2d_op.py, test_batch_norm_op.py,
+test_layer_norm_op.py, test_lookup_table_v2_op.py,
+test_softmax_with_cross_entropy_op.py methodology)."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest, randf
+
+
+def np_conv2d(x, w, stride, pad):
+    n, c, h, wd = x.shape
+    oc, ic, kh, kw = w.shape
+    xp = np.pad(x, [(0, 0), (0, 0), (pad[0], pad[0]), (pad[1], pad[1])])
+    oh = (h + 2 * pad[0] - kh) // stride[0] + 1
+    ow = (wd + 2 * pad[1] - kw) // stride[1] + 1
+    out = np.zeros((n, oc, oh, ow), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, :, i * stride[0]:i * stride[0] + kh,
+                       j * stride[1]:j * stride[1] + kw]
+            out[:, :, i, j] = np.einsum("nchw,ochw->no", patch, w)
+    return out
+
+
+class TestConv2d(OpTest):
+    op_type = "conv2d"
+
+    def test(self):
+        x = randf(2, 3, 7, 7, seed=60)
+        w = randf(4, 3, 3, 3, seed=61)
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [2, 2], "paddings": [1, 1],
+                      "dilations": [1, 1], "groups": 1,
+                      "padding_algorithm": "EXPLICIT",
+                      "data_format": "NCHW"}
+        self.outputs = {"Output": np_conv2d(x, w, [2, 2], [1, 1])}
+        self.check_output(atol=1e-4)
+        self.check_grad(["Input", "Filter"], "Output",
+                        max_relative_error=1e-2)
+
+
+class TestDepthwiseConv2d(OpTest):
+    op_type = "depthwise_conv2d"
+
+    def test(self):
+        x = randf(2, 3, 6, 6, seed=62)
+        w = randf(3, 1, 3, 3, seed=63)
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1],
+                      "dilations": [1, 1], "groups": 3,
+                      "padding_algorithm": "EXPLICIT",
+                      "data_format": "NCHW"}
+        want = np.concatenate(
+            [np_conv2d(x[:, i:i + 1], w[i:i + 1], [1, 1], [1, 1])
+             for i in range(3)], axis=1)
+        self.outputs = {"Output": want}
+        self.check_output(atol=1e-4)
+
+
+class TestPool2dMax(OpTest):
+    op_type = "pool2d"
+
+    def test(self):
+        x = randf(2, 3, 6, 6, seed=64)
+        want = x.reshape(2, 3, 3, 2, 3, 2).max(axis=(3, 5))
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "max", "ksize": [2, 2],
+                      "strides": [2, 2], "paddings": [0, 0],
+                      "global_pooling": False, "adaptive": False,
+                      "exclusive": True, "ceil_mode": False,
+                      "padding_algorithm": "EXPLICIT",
+                      "data_format": "NCHW"}
+        self.outputs = {"Out": want}
+        self.check_output()
+        self.check_grad(["X"], "Out", max_relative_error=1e-2)
+
+
+class TestPool2dAvgExclusive(OpTest):
+    op_type = "pool2d"
+
+    def test(self):
+        x = randf(1, 2, 4, 4, seed=65)
+        # padding 1, exclusive avg: corner windows count fewer elems
+        xp = np.pad(x, [(0, 0), (0, 0), (1, 1), (1, 1)])
+        cnt = np.pad(np.ones_like(x), [(0, 0), (0, 0), (1, 1), (1, 1)])
+        want = np.zeros((1, 2, 2, 2), np.float32)
+        for i in range(2):
+            for j in range(2):
+                sl = np.s_[:, :, i * 2:i * 2 + 3, j * 2:j * 2 + 3]
+                want[:, :, i, j] = xp[sl].sum((2, 3)) / cnt[sl].sum((2, 3))
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "avg", "ksize": [3, 3],
+                      "strides": [2, 2], "paddings": [1, 1],
+                      "global_pooling": False, "adaptive": False,
+                      "exclusive": True, "ceil_mode": False,
+                      "padding_algorithm": "EXPLICIT",
+                      "data_format": "NCHW"}
+        self.outputs = {"Out": want}
+        self.check_output(atol=1e-5)
+
+
+class TestGlobalPool(OpTest):
+    op_type = "pool2d"
+
+    def test(self):
+        x = randf(2, 3, 5, 5, seed=66)
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "avg", "ksize": [1, 1],
+                      "strides": [1, 1], "paddings": [0, 0],
+                      "global_pooling": True, "adaptive": False,
+                      "exclusive": True, "ceil_mode": False,
+                      "padding_algorithm": "EXPLICIT",
+                      "data_format": "NCHW"}
+        self.outputs = {"Out": x.mean((2, 3), keepdims=True)}
+        self.check_output()
+
+
+class TestBatchNormTrain(OpTest):
+    op_type = "batch_norm"
+
+    def test(self):
+        x = randf(4, 3, 5, 5, seed=67)
+        scale = randf(3, low=0.5, high=1.5, seed=68)
+        bias = randf(3, seed=69)
+        mean = np.zeros(3, np.float32)
+        var = np.ones(3, np.float32)
+        eps, mom = 1e-5, 0.9
+        bm = x.mean((0, 2, 3))
+        bv = x.var((0, 2, 3))
+        xn = (x - bm.reshape(1, 3, 1, 1)) / np.sqrt(
+            bv.reshape(1, 3, 1, 1) + eps)
+        y = xn * scale.reshape(1, 3, 1, 1) + bias.reshape(1, 3, 1, 1)
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias,
+                       "Mean": mean, "Variance": var}
+        self.attrs = {"momentum": mom, "epsilon": eps, "is_test": False,
+                      "data_layout": "NCHW", "use_global_stats": False}
+        self.outputs = {
+            "Y": y,
+            "MeanOut": mean * mom + bm * (1 - mom),
+            "VarianceOut": var * mom + bv * (1 - mom),
+            "SavedMean": bm,
+            "SavedVariance": 1.0 / np.sqrt(bv + eps),
+        }
+        self.check_output(atol=1e-4, no_check_set=("ReserveSpace",))
+
+
+class TestBatchNormInfer(OpTest):
+    op_type = "batch_norm"
+
+    def test(self):
+        x = randf(4, 3, 5, 5, seed=70)
+        scale = randf(3, low=0.5, high=1.5, seed=71)
+        bias = randf(3, seed=72)
+        mean = randf(3, seed=73)
+        var = randf(3, low=0.5, high=1.5, seed=74)
+        eps = 1e-5
+        y = (x - mean.reshape(1, 3, 1, 1)) / np.sqrt(
+            var.reshape(1, 3, 1, 1) + eps)
+        y = y * scale.reshape(1, 3, 1, 1) + bias.reshape(1, 3, 1, 1)
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias,
+                       "Mean": mean, "Variance": var}
+        self.attrs = {"momentum": 0.9, "epsilon": eps, "is_test": True,
+                      "data_layout": "NCHW", "use_global_stats": False}
+        self.outputs = {"Y": y, "MeanOut": mean, "VarianceOut": var,
+                        "SavedMean": np.zeros(3, np.float32),
+                        "SavedVariance": np.zeros(3, np.float32)}
+        self.check_output(atol=1e-4,
+                          no_check_set=("ReserveSpace", "SavedMean",
+                                        "SavedVariance"))
+
+
+class TestLayerNorm(OpTest):
+    op_type = "layer_norm"
+
+    def test(self):
+        x = randf(4, 10, seed=75)
+        scale = randf(10, low=0.5, high=1.5, seed=76)
+        bias = randf(10, seed=77)
+        eps = 1e-5
+        mu = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        y = (x - mu) / np.sqrt(var + eps) * scale + bias
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias}
+        self.attrs = {"epsilon": eps, "begin_norm_axis": 1}
+        self.outputs = {"Y": y, "Mean": mu.reshape(4),
+                        "Variance": var.reshape(4)}
+        self.check_output(atol=1e-4)
+        self.check_grad(["X", "Scale", "Bias"], "Y",
+                        max_relative_error=2e-2)
+
+
+class TestGroupNorm(OpTest):
+    op_type = "group_norm"
+
+    def test(self):
+        x = randf(2, 4, 3, 3, seed=78)
+        scale = randf(4, low=0.5, high=1.5, seed=79)
+        bias = randf(4, seed=80)
+        eps = 1e-5
+        xg = x.reshape(2, 2, 2, 3, 3)
+        mu = xg.mean((2, 3, 4), keepdims=True)
+        var = xg.var((2, 3, 4), keepdims=True)
+        y = ((xg - mu) / np.sqrt(var + eps)).reshape(x.shape)
+        y = y * scale.reshape(1, 4, 1, 1) + bias.reshape(1, 4, 1, 1)
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias}
+        self.attrs = {"epsilon": eps, "groups": 2}
+        self.outputs = {"Y": y, "Mean": mu.reshape(2, 2),
+                        "Variance": var.reshape(2, 2)}
+        self.check_output(atol=1e-4)
+
+
+class TestLookupTableV2(OpTest):
+    op_type = "lookup_table_v2"
+
+    def test(self):
+        w = randf(10, 4, seed=81)
+        ids = np.array([[1, 3], [7, 0]], np.int32)
+        self.inputs = {"W": w, "Ids": ids}
+        self.attrs = {"padding_idx": -1}
+        self.outputs = {"Out": w[ids]}
+        self.check_output()
+        self.check_grad(["W"], "Out")
+
+
+class TestLookupTablePadding(OpTest):
+    op_type = "lookup_table_v2"
+
+    def test(self):
+        w = randf(10, 4, seed=82)
+        ids = np.array([[1, 2], [2, 5]], np.int32)
+        want = w[ids].copy()
+        want[ids == 2] = 0.0
+        self.inputs = {"W": w, "Ids": ids}
+        self.attrs = {"padding_idx": 2}
+        self.outputs = {"Out": want}
+        self.check_output()
+
+
+class TestSoftmaxWithCE(OpTest):
+    op_type = "softmax_with_cross_entropy"
+
+    def test(self):
+        logits = randf(5, 7, seed=83)
+        labels = np.array([[0], [3], [6], [2], [1]], np.int32)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        sm = e / e.sum(-1, keepdims=True)
+        loss = -np.log(sm[np.arange(5), labels[:, 0]]).reshape(5, 1)
+        self.inputs = {"Logits": logits, "Label": labels}
+        self.attrs = {"soft_label": False, "ignore_index": -100, "axis": -1,
+                      "numeric_stable_mode": True}
+        self.outputs = {"Softmax": sm, "Loss": loss}
+        self.check_output(atol=1e-5)
+        self.check_grad(["Logits"], "Loss", max_relative_error=1e-2)
+
+
+class TestSoftmaxWithCESoftLabel(OpTest):
+    op_type = "softmax_with_cross_entropy"
+
+    def test(self):
+        logits = randf(4, 6, seed=84)
+        lab = np.abs(randf(4, 6, seed=85)) + 0.1
+        lab = (lab / lab.sum(-1, keepdims=True)).astype("float32")
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        sm = e / e.sum(-1, keepdims=True)
+        loss = -(lab * np.log(sm)).sum(-1, keepdims=True)
+        self.inputs = {"Logits": logits, "Label": lab}
+        self.attrs = {"soft_label": True, "ignore_index": -100, "axis": -1,
+                      "numeric_stable_mode": True}
+        self.outputs = {"Softmax": sm, "Loss": loss}
+        self.check_output(atol=1e-5)
+
+
+class TestSoftmaxWithCEIgnoreIndex(OpTest):
+    op_type = "softmax_with_cross_entropy"
+
+    def test(self):
+        logits = randf(4, 5, seed=86)
+        labels = np.array([[0], [-100], [3], [-100]], np.int32)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        sm = e / e.sum(-1, keepdims=True)
+        loss = np.zeros((4, 1), np.float32)
+        for i, l in enumerate(labels[:, 0]):
+            if l != -100:
+                loss[i, 0] = -np.log(sm[i, l])
+        self.inputs = {"Logits": logits, "Label": labels}
+        self.attrs = {"soft_label": False, "ignore_index": -100, "axis": -1,
+                      "numeric_stable_mode": True}
+        self.outputs = {"Softmax": sm, "Loss": loss}
+        self.check_output(atol=1e-5)
+
+
+class TestSigmoidCE(OpTest):
+    op_type = "sigmoid_cross_entropy_with_logits"
+
+    def test(self):
+        x = randf(4, 5, seed=87)
+        lab = (randf(4, 5, seed=88) > 0).astype("float32")
+        loss = np.maximum(x, 0) - x * lab + np.log1p(np.exp(-np.abs(x)))
+        self.inputs = {"X": x, "Label": lab}
+        self.attrs = {"ignore_index": -100, "normalize": False}
+        self.outputs = {"Out": loss}
+        self.check_output(atol=1e-5)
+        self.check_grad(["X"], "Out")
+
+
+class TestHuberLoss(OpTest):
+    op_type = "huber_loss"
+
+    def test(self):
+        x = randf(5, 1, seed=89)
+        y = randf(5, 1, seed=90)
+        d = 0.5
+        r = y - x
+        loss = np.where(np.abs(r) <= d, 0.5 * r ** 2, d * (np.abs(r) - 0.5 * d))
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"delta": d}
+        self.outputs = {"Out": loss.astype("float32"), "Residual": r}
+        self.check_output(atol=1e-5)
+
+
+class TestAccuracyOp(OpTest):
+    op_type = "accuracy"
+
+    def test(self):
+        pred = randf(6, 4, seed=91)
+        indices = np.argsort(-pred, axis=1)[:, :2].astype("int64")
+        label = np.array([[0], [1], [2], [3], [0], [1]], np.int64)
+        correct = sum(int(label[i, 0] in indices[i]) for i in range(6))
+        self.inputs = {"Out": pred, "Indices": indices, "Label": label}
+        self.outputs = {
+            "Accuracy": np.float32(correct / 6.0),
+            "Correct": np.int32(correct),
+            "Total": np.int32(6),
+        }
+        self.check_output()
+
+
+class TestDropoutStats(OpTest):
+    op_type = "dropout"
+
+    def test(self):
+        # statistical check (mask is random): mean ratio ~ keep prob
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.fluid import framework, unique_name
+        from paddle_tpu.fluid.executor import Scope, scope_guard
+
+        x = np.ones((100, 100), "float32")
+        self.inputs = {"X": x}
+        self.attrs = {"dropout_prob": 0.3, "is_test": False,
+                      "dropout_implementation": "upscale_in_train"}
+        self.outputs = {"Out": x, "Mask": np.ones_like(x).astype("uint8")}
+        main, startup, feed, fetch_names, _ = self._build()
+        scope = Scope()
+        with scope_guard(scope):
+            exe = fluid.Executor()
+            (out, mask) = exe.run(
+                main, feed=feed, fetch_list=[n for _, _, n in fetch_names])
+        keep_ratio = (out != 0).mean()
+        assert abs(keep_ratio - 0.7) < 0.05
+        kept = out[out != 0]
+        np.testing.assert_allclose(kept, 1 / 0.7, rtol=1e-5)

@@ -1,0 +1,430 @@
+"""Op tests: tensor creation/manipulation + RNG + optimizer-update ops
+(mirrors reference test_reshape_op.py, test_concat_op.py, test_slice_op.py,
+test_gather_op.py, test_top_k_v2_op.py, test_adam_op.py,
+test_momentum_op.py methodology)."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest, randf
+
+
+class TestFillConstant(OpTest):
+    op_type = "fill_constant"
+
+    def test(self):
+        self.inputs = {}
+        self.attrs = {"shape": [3, 4], "dtype": "float32", "value": 2.5}
+        self.outputs = {"Out": np.full((3, 4), 2.5, "float32")}
+        self.check_output()
+
+
+class TestReshape2(OpTest):
+    op_type = "reshape2"
+
+    def test(self):
+        x = randf(2, 3, 4, seed=100)
+        self.inputs = {"X": x}
+        self.attrs = {"shape": [-1, 12]}
+        self.outputs = {"Out": x.reshape(2, 12),
+                        "XShape": np.zeros((0, 2, 3, 4), "float32")}
+        self.check_output(no_check_set=("XShape",))
+        self.check_grad(["X"], "Out")
+
+
+class TestReshapeZeroCopyDim(OpTest):
+    op_type = "reshape2"
+
+    def test(self):
+        x = randf(2, 3, 4, seed=101)
+        self.inputs = {"X": x}
+        self.attrs = {"shape": [0, -1]}  # 0 copies dim0
+        self.outputs = {"Out": x.reshape(2, 12),
+                        "XShape": np.zeros((0,), "float32")}
+        self.check_output(no_check_set=("XShape",))
+
+
+class TestTranspose(OpTest):
+    op_type = "transpose2"
+
+    def test(self):
+        x = randf(2, 3, 4, seed=102)
+        self.inputs = {"X": x}
+        self.attrs = {"axis": [1, 2, 0]}
+        self.outputs = {"Out": x.transpose(1, 2, 0)}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestConcatAxis1(OpTest):
+    op_type = "concat"
+
+    def test(self):
+        xs = [randf(2, i + 2, seed=103 + i) for i in range(3)]
+        self.inputs = {"X": xs}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": np.concatenate(xs, axis=1)}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestSplitSections(OpTest):
+    op_type = "split"
+
+    def test(self):
+        x = randf(2, 9, seed=106)
+        self.inputs = {"X": x}
+        self.attrs = {"sections": [2, 3, -1], "num": 0, "axis": 1}
+        self.outputs = {"Out": [x[:, :2], x[:, 2:5], x[:, 5:]]}
+        self.check_output()
+
+
+class TestStack(OpTest):
+    op_type = "stack"
+
+    def test(self):
+        xs = [randf(3, 4, seed=107 + i) for i in range(3)]
+        self.inputs = {"X": xs}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Y": np.stack(xs, axis=1)}
+        self.check_output()
+
+
+class TestSliceDecrease(OpTest):
+    op_type = "slice"
+
+    def test(self):
+        x = randf(3, 4, 5, seed=110)
+        self.inputs = {"Input": x}
+        self.attrs = {"axes": [0, 2], "starts": [1, 2], "ends": [2, 4],
+                      "decrease_axis": [0]}
+        self.outputs = {"Out": x[1, :, 2:4]}
+        self.check_output()
+
+
+class TestSliceNegative(OpTest):
+    op_type = "slice"
+
+    def test(self):
+        x = randf(3, 6, seed=111)
+        self.inputs = {"Input": x}
+        self.attrs = {"axes": [1], "starts": [-3], "ends": [10000]}
+        self.outputs = {"Out": x[:, -3:]}
+        self.check_output()
+        self.check_grad(["Input"], "Out")
+
+
+class TestExpandV2(OpTest):
+    op_type = "expand_v2"
+
+    def test(self):
+        x = randf(1, 3, seed=112)
+        self.inputs = {"X": x}
+        self.attrs = {"shape": [4, -1]}
+        self.outputs = {"Out": np.broadcast_to(x, (4, 3))}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestTile(OpTest):
+    op_type = "tile"
+
+    def test(self):
+        x = randf(2, 3, seed=113)
+        self.inputs = {"X": x}
+        self.attrs = {"repeat_times": [2, 2]}
+        self.outputs = {"Out": np.tile(x, (2, 2))}
+        self.check_output()
+
+
+class TestGather(OpTest):
+    op_type = "gather"
+
+    def test(self):
+        x = randf(8, 4, seed=114)
+        idx = np.array([1, 5, 2], np.int32)
+        self.inputs = {"X": x, "Index": idx}
+        self.outputs = {"Out": x[idx]}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+class TestGatherNd(OpTest):
+    op_type = "gather_nd"
+
+    def test(self):
+        x = randf(3, 4, 5, seed=115)
+        idx = np.array([[0, 1], [2, 3]], np.int32)
+        self.inputs = {"X": x, "Index": idx}
+        self.outputs = {"Out": x[[0, 2], [1, 3]]}
+        self.check_output()
+
+
+class TestScatterOverwrite(OpTest):
+    op_type = "scatter"
+
+    def test(self):
+        x = randf(6, 3, seed=116)
+        ids = np.array([1, 4], np.int32)
+        upd = randf(2, 3, seed=117)
+        want = x.copy()
+        want[ids] = upd
+        self.inputs = {"X": x, "Ids": ids, "Updates": upd}
+        self.attrs = {"overwrite": True}
+        self.outputs = {"Out": want}
+        self.check_output()
+
+
+class TestWhere(OpTest):
+    op_type = "where"
+
+    def test(self):
+        c = randf(3, 4, seed=118) > 0
+        x, y = randf(3, 4, seed=119), randf(3, 4, seed=120)
+        self.inputs = {"Condition": c, "X": x, "Y": y}
+        self.outputs = {"Out": np.where(c, x, y)}
+        self.check_output()
+
+
+class TestOneHot(OpTest):
+    op_type = "one_hot_v2"
+
+    def test(self):
+        x = np.array([1, 0, 3], np.int32)
+        self.inputs = {"X": x}
+        self.attrs = {"depth": 4}
+        self.outputs = {"Out": np.eye(4, dtype="float32")[x]}
+        self.check_output()
+
+
+class TestArgMax(OpTest):
+    op_type = "arg_max"
+
+    def test(self):
+        x = randf(3, 5, seed=121)
+        self.inputs = {"X": x}
+        self.attrs = {"axis": 1, "keepdims": False, "dtype": "int64"}
+        self.outputs = {"Out": x.argmax(1).astype("int64")}
+        self.check_output()
+
+
+class TestTopKV2(OpTest):
+    op_type = "top_k_v2"
+
+    def test(self):
+        x = randf(3, 6, seed=122)
+        k = 2
+        idx = np.argsort(-x, axis=1)[:, :k]
+        vals = np.take_along_axis(x, idx, axis=1)
+        self.inputs = {"X": x}
+        self.attrs = {"k": k, "axis": -1, "largest": True, "sorted": True}
+        self.outputs = {"Out": vals, "Indices": idx.astype("int64")}
+        self.check_output()
+
+
+class TestArgsortDescending(OpTest):
+    op_type = "argsort"
+
+    def test(self):
+        x = randf(3, 5, seed=123)
+        idx = np.argsort(-x, axis=1)
+        self.inputs = {"X": x}
+        self.attrs = {"axis": 1, "descending": True}
+        self.outputs = {"Out": np.take_along_axis(x, idx, 1),
+                        "Indices": idx.astype("int64")}
+        self.check_output()
+
+
+class TestRange(OpTest):
+    op_type = "range"
+
+    def test(self):
+        self.inputs = {}
+        self.attrs = {"start": 2.0, "end": 10.0, "step": 2.0,
+                      "dtype": "int64"}
+        self.outputs = {"Out": np.arange(2, 10, 2).astype("int64")}
+        self.check_output()
+
+
+class TestTrilTriu(OpTest):
+    op_type = "tril_triu"
+
+    def test(self):
+        x = randf(4, 4, seed=124)
+        self.inputs = {"X": x}
+        self.attrs = {"diagonal": 0, "lower": True}
+        self.outputs = {"Out": np.tril(x)}
+        self.check_output()
+
+
+class TestPad(OpTest):
+    op_type = "pad"
+
+    def test(self):
+        x = randf(2, 3, seed=125)
+        self.inputs = {"X": x}
+        self.attrs = {"paddings": [0, 1, 2, 0], "pad_value": 9.0}
+        self.outputs = {"Out": np.pad(x, [(0, 1), (2, 0)],
+                                      constant_values=9.0)}
+        self.check_output()
+        self.check_grad(["X"], "Out")
+
+
+# -- RNG (statistical) ------------------------------------------------------
+
+class TestGaussianStats(OpTest):
+    op_type = "gaussian_random"
+
+    def test(self):
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.fluid.executor import Scope, scope_guard
+
+        self.inputs = {}
+        self.attrs = {"shape": [500, 200], "dtype": "float32",
+                      "mean": 1.0, "std": 2.0, "seed": 7}
+        self.outputs = {"Out": np.zeros((500, 200), "float32")}
+        main, startup, feed, fetch_names, _ = self._build()
+        with scope_guard(Scope()):
+            (out,) = fluid.Executor().run(
+                main, fetch_list=[n for _, _, n in fetch_names])
+        assert abs(out.mean() - 1.0) < 0.02
+        assert abs(out.std() - 2.0) < 0.02
+        # fixed seed => reproducible
+        with scope_guard(Scope()):
+            (out2,) = fluid.Executor().run(
+                main, fetch_list=[n for _, _, n in fetch_names])
+        np.testing.assert_array_equal(out, out2)
+
+
+class TestUniformStats(OpTest):
+    op_type = "uniform_random"
+
+    def test(self):
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.fluid.executor import Scope, scope_guard
+
+        self.inputs = {}
+        self.attrs = {"shape": [1000, 100], "dtype": "float32",
+                      "min": -2.0, "max": 4.0, "seed": 11}
+        self.outputs = {"Out": np.zeros((1000, 100), "float32")}
+        main, startup, feed, fetch_names, _ = self._build()
+        with scope_guard(Scope()):
+            (out,) = fluid.Executor().run(
+                main, fetch_list=[n for _, _, n in fetch_names])
+        assert out.min() >= -2.0 and out.max() < 4.0
+        assert abs(out.mean() - 1.0) < 0.02
+
+
+# -- optimizer update ops ---------------------------------------------------
+
+class TestSGDOp(OpTest):
+    op_type = "sgd"
+
+    def test(self):
+        p = randf(4, 3, seed=130)
+        g = randf(4, 3, seed=131)
+        lr = np.array([0.1], "float32")
+        self.inputs = {"Param": p, "Grad": g, "LearningRate": lr}
+        self.outputs = {"ParamOut": p - 0.1 * g}
+        self.check_output()
+
+
+class TestMomentumOp(OpTest):
+    op_type = "momentum"
+
+    def test(self):
+        p, g, v = randf(4, 3, seed=132), randf(4, 3, seed=133), randf(4, 3, seed=134)
+        lr = np.array([0.1], "float32")
+        mu = 0.9
+        v_out = mu * v + g
+        self.inputs = {"Param": p, "Grad": g, "Velocity": v,
+                       "LearningRate": lr}
+        self.attrs = {"mu": mu, "use_nesterov": False}
+        self.outputs = {"ParamOut": p - 0.1 * v_out, "VelocityOut": v_out}
+        self.check_output(atol=1e-5)
+
+
+class TestAdamOp(OpTest):
+    op_type = "adam"
+
+    def test(self):
+        p, g = randf(4, 3, seed=135), randf(4, 3, seed=136)
+        m1, m2 = randf(4, 3, seed=137), np.abs(randf(4, 3, seed=138))
+        lr = np.array([0.01], "float32")
+        b1, b2, eps = 0.9, 0.999, 1e-8
+        b1p = np.array([0.9 ** 3], "float32")
+        b2p = np.array([0.999 ** 3], "float32")
+        m1o = b1 * m1 + (1 - b1) * g
+        m2o = b2 * m2 + (1 - b2) * g * g
+        lr_t = lr * np.sqrt(1 - b2p) / (1 - b1p)
+        p_out = p - lr_t * m1o / (np.sqrt(m2o) + eps)
+        self.inputs = {"Param": p, "Grad": g, "LearningRate": lr,
+                       "Moment1": m1, "Moment2": m2,
+                       "Beta1Pow": b1p, "Beta2Pow": b2p}
+        self.attrs = {"beta1": b1, "beta2": b2, "epsilon": eps}
+        self.outputs = {"ParamOut": p_out, "Moment1Out": m1o,
+                        "Moment2Out": m2o,
+                        "Beta1PowOut": b1p * b1, "Beta2PowOut": b2p * b2}
+        self.check_output(atol=1e-5)
+
+
+class TestLambOp(OpTest):
+    op_type = "lamb"
+
+    def test(self):
+        p, g = randf(4, 3, seed=139), randf(4, 3, seed=140)
+        m1, m2 = randf(4, 3, seed=141), np.abs(randf(4, 3, seed=142))
+        lr = np.array([0.01], "float32")
+        b1, b2, eps, wd = 0.9, 0.999, 1e-6, 0.01
+        b1p = np.array([0.9], "float32")
+        b2p = np.array([0.999], "float32")
+        m1o = b1 * m1 + (1 - b1) * g
+        m2o = b2 * m2 + (1 - b2) * g * g
+        m1h = m1o / (1 - b1p)
+        m2h = m2o / (1 - b2p)
+        r = m1h / (np.sqrt(m2h) + eps) + wd * p
+        trust = np.linalg.norm(p) / np.linalg.norm(r)
+        p_out = p - lr * trust * r
+        self.inputs = {"Param": p, "Grad": g, "LearningRate": lr,
+                       "Moment1": m1, "Moment2": m2,
+                       "Beta1Pow": b1p, "Beta2Pow": b2p}
+        self.attrs = {"beta1": b1, "beta2": b2, "epsilon": eps,
+                      "weight_decay": wd}
+        self.outputs = {"ParamOut": p_out, "Moment1Out": m1o,
+                        "Moment2Out": m2o,
+                        "Beta1PowOut": b1p * b1, "Beta2PowOut": b2p * b2}
+        self.check_output(atol=1e-4)
+
+
+class TestCheckFiniteAndUnscale(OpTest):
+    op_type = "check_finite_and_unscale"
+
+    def test(self):
+        xs = [randf(3, 3, seed=143), randf(2, 2, seed=144)]
+        xs[1][0, 0] = np.inf
+        scale = np.array([2.0], "float32")
+        self.inputs = {"X": xs, "Scale": scale}
+        self.outputs = {"Out": [x / 2.0 for x in xs],
+                        "FoundInfinite": np.array([True])}
+        self.check_output()
+
+
+class TestUpdateLossScaling(OpTest):
+    op_type = "update_loss_scaling"
+
+    def test(self):
+        xs = [randf(3, 3, seed=145)]
+        found = np.array([False])
+        prev = np.array([1024.0], "float32")
+        good = np.array([999], "int32")
+        bad = np.array([0], "int32")
+        self.inputs = {"X": xs, "FoundInfinite": found,
+                       "PrevLossScaling": prev, "InGoodSteps": good,
+                       "InBadSteps": bad}
+        self.attrs = {"incr_every_n_steps": 1000,
+                      "decr_every_n_nan_or_inf": 2,
+                      "incr_ratio": 2.0, "decr_ratio": 0.5}
+        self.outputs = {"Out": xs, "LossScaling": prev * 2,
+                        "OutGoodSteps": np.array([0], "int32"),
+                        "OutBadSteps": np.array([0], "int32")}
+        self.check_output()
