@@ -1,0 +1,19 @@
+"""Dygraph (eager) engine — TPU-native re-design of the reference's
+`paddle/fluid/imperative/` (C++ tracer + grad engine) and
+`python/paddle/fluid/dygraph/`:
+
+  varbase.py        eager Tensor over jax.Array       (imperative/layer.h:65)
+  tracer.py         eager op tape via jax.vjp         (imperative/tracer.cc:50)
+  engine.py         reverse-topological grad walk     (imperative/basic_engine.cc:171)
+  math_op_patch.py  Tensor operator overloads         (varbase_patch_methods.py)
+  base.py           guard / enable / to_variable      (dygraph/base.py)
+"""
+
+from .base import (enable_dygraph, disable_dygraph, enabled, guard,
+                   to_variable)
+from .engine import grad, run_backward
+from .tracer import (Tracer, enable_grad, manual_seed, no_grad,
+                     no_grad_decorator, trace_fn, trace_op)
+from .varbase import Tensor, VarBase
+
+from . import math_op_patch  # installs Tensor operator overloads

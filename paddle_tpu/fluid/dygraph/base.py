@@ -1,0 +1,45 @@
+"""Dygraph mode switches (the reference's fluid/dygraph/base.py:
+`guard`/`enable_dygraph`/`to_variable`)."""
+
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+from .. import framework
+from .tracer import Tracer
+from .varbase import Tensor
+
+_global_tracer = None
+
+
+def enabled() -> bool:
+    return framework.in_dygraph_mode()
+
+
+def enable_dygraph(place=None):
+    global _global_tracer
+    if _global_tracer is None:
+        _global_tracer = Tracer()
+    framework._set_dygraph_tracer(_global_tracer)
+
+
+def disable_dygraph():
+    framework._set_dygraph_tracer(None)
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """Context manager enabling eager mode (dygraph/base.py guard)."""
+    tracer = Tracer()
+    with framework._dygraph_guard(tracer):
+        yield
+
+
+def to_variable(value, name=None, zero_copy=None, dtype=None):
+    """numpy/list/Tensor -> eager Tensor (dygraph/base.py to_variable)."""
+    if isinstance(value, Tensor):
+        return value.astype(dtype) if dtype is not None else value
+    return Tensor(np.asarray(value), name=name, dtype=dtype,
+                  stop_gradient=True)

@@ -1,0 +1,291 @@
+"""Reverse-mode autograd engine over the eager tape.
+
+The reference walks grad-op nodes reverse-topologically with dependency
+counting and a GradientAccumulator per multi-consumer variable
+(/root/reference/paddle/fluid/imperative/basic_engine.cc:171).  Here nodes
+hold `jax.vjp` closures (tracer.py); the walk is the same shape:
+
+  1. discover the active subgraph from the output tensors,
+  2. count, per node, how many downstream active nodes consume its outputs,
+  3. pop ready nodes, call their vjp closure with accumulated cotangents,
+  4. scatter input-cotangents: leaves accumulate into `.grad`, interior
+     tensors feed their producer node's pending buffer.
+
+Grad hooks (Tensor.register_hook) fire ONCE on the fully-accumulated
+gradient of a tensor — at its producer node for interior tensors (the
+pending buffer is final when the node becomes ready), at walk end for
+leaves — matching the reference's accumulator-then-hook ordering.
+
+`create_graph=True` (the reference's PartialGradEngine double-grad,
+imperative/partial_grad_engine.cc) re-enters the tracer: each node keeps its
+raw forward function, which is re-vjp'd symbolically via `trace_fn` so the
+produced grads carry tape nodes themselves — higher-order AD for free.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict, deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .varbase import Tensor
+
+
+def _zero_ct(aval):
+    """Zero cotangent for one flat output; float0 for non-inexact dtypes
+    (jax's convention for integer-valued primals)."""
+    import jax
+    import jax.numpy as jnp
+
+    if aval is None:
+        return None
+    shape, dtype = aval
+    if jnp.issubdtype(dtype, jnp.inexact):
+        return jnp.zeros(shape, dtype)
+    return np.zeros(shape, jax.dtypes.float0)
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _add(a, b, tensor_mode):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    if tensor_mode:
+        from .tracer import trace_fn
+
+        ta = a if isinstance(a, Tensor) else Tensor(a, stop_gradient=True)
+        tb = b if isinstance(b, Tensor) else Tensor(b, stop_gradient=True)
+        return trace_fn(lambda x, y: x + y, {"x": ta, "y": tb})
+    import jax.numpy as jnp
+
+    return jnp.add(_val(a), _val(b))
+
+
+def _apply_hooks(t: Tensor, g):
+    for hook in t._hooks:
+        res = hook(g if isinstance(g, Tensor)
+                   else Tensor(g, stop_gradient=True))
+        if res is not None:
+            g = res
+    return g
+
+
+def run_backward(tensors: List[Tensor], grad_tensors=None,
+                 retain_graph=False, create_graph=False,
+                 inputs: Optional[List[Tensor]] = None,
+                 accumulate_leaf=True):
+    """Core engine.  With `inputs`, returns a list of their grads (paddle.grad
+    semantics); with accumulate_leaf=False leaf `.grad` stays untouched."""
+    import jax.numpy as jnp
+
+    from .tracer import trace_fn
+
+    requested: Dict[int, Tensor] = {id(t): t for t in (inputs or [])}
+    results: Dict[int, object] = {}
+    # interior requested tensors: (id(node), out_index) -> tensor
+    interior_req: Dict[tuple, Tensor] = {}
+    for t in (inputs or []):
+        if t._grad_node is not None:
+            interior_req[(id(t._grad_node), t._out_index)] = t
+
+    # grads arriving at tensors with no active producer node, accumulated
+    # across the whole walk; hooks + .grad attachment happen at the end
+    leaf_store: Dict[int, list] = {}  # id(t) -> [tensor, value]
+
+    def deposit(t: Tensor, g):
+        ent = leaf_store.setdefault(id(t), [t, None])
+        ent[1] = _add(ent[1], g, create_graph)
+
+    # ---- seed cotangents --------------------------------------------------
+    pending: Dict[int, list] = {}   # id(node) -> [ct per flat output]
+    roots = []
+    root_ids = set()
+    for i, t in enumerate(tensors):
+        if grad_tensors is not None and i < len(grad_tensors) \
+                and grad_tensors[i] is not None:
+            g = grad_tensors[i]
+            ct = g if isinstance(g, Tensor) else Tensor(g, stop_gradient=True)
+        else:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    f"got shape {t.shape}")
+            ct = Tensor(jnp.ones_like(t._value), stop_gradient=True)
+        node = t._grad_node
+        if node is None:
+            if not t.stop_gradient or id(t) in requested:
+                deposit(t, ct)
+            continue
+        if id(node) not in root_ids:
+            root_ids.add(id(node))
+            roots.append(node)
+        buf = pending.setdefault(id(node), [None] * node.n_outs)
+        buf[t._out_index] = _add(buf[t._out_index], ct, create_graph)
+
+    if roots:
+        # ---- discover active subgraph + consumer counts -------------------
+        seen = set(root_ids)
+        nodes = list(roots)
+        consumer_count = defaultdict(int)
+        stack = list(roots)
+        while stack:
+            node = stack.pop()
+            for t in node.in_tensors:
+                p = t._grad_node
+                if p is None:
+                    continue
+                consumer_count[id(p)] += 1
+                if id(p) not in seen:
+                    seen.add(id(p))
+                    nodes.append(p)
+                    stack.append(p)
+        active = seen
+
+        # ---- walk ---------------------------------------------------------
+        ready = deque(n for n in nodes if consumer_count[id(n)] == 0)
+        processed = set()
+        while ready:
+            node = ready.popleft()
+            if id(node) in processed:
+                continue
+            processed.add(id(node))
+            cts = pending.pop(id(node), [None] * node.n_outs)
+
+            # Cotangents are final here (all consumers done): fire output
+            # hooks once, record interior requested grads.
+            for i, ct in enumerate(cts):
+                if ct is None:
+                    continue
+                ref = node.out_refs[i]
+                out_t = ref() if ref is not None else None
+                if out_t is not None and out_t._hooks:
+                    cts[i] = ct = _apply_hooks(out_t, ct)
+                t = interior_req.get((id(node), i))
+                if t is not None:
+                    results[id(t)] = _add(results.get(id(t)), ct,
+                                          create_graph)
+
+            any_live = any(ct is not None for ct in cts)
+            ct_vals = [
+                (_zero_ct(node.out_avals[i]) if ct is None
+                 else (ct if create_graph else _val(ct)))
+                for i, ct in enumerate(cts)
+            ]
+
+            if not any_live:
+                in_grads = [None] * len(node.in_tensors)
+            elif create_graph:
+                # Re-trace the grad computation symbolically: grad-of-grad
+                # flows through the PRIMAL inputs (captured constants in the
+                # cached vjp closure), so rebuild vjp from the node's raw
+                # forward fn with the primal input tensors as traced args.
+                import jax
+
+                raw_fn = node.raw_fn
+                live = {i for i, ct in enumerate(cts) if ct is not None}
+                zeros = {i: v for i, v in enumerate(ct_vals) if i not in live}
+                n_cts = len(ct_vals)
+                n_in = len(node.in_tensors)
+
+                def grad_compute(**kw):
+                    primals = [kw[f"p{i}"] for i in range(n_in)]
+                    vals = tuple(kw[f"ct{i}"] if i in live else zeros[i]
+                                 for i in range(n_cts))
+                    _, inner_vjp = jax.vjp(raw_fn, primals)
+                    (d_ins,) = inner_vjp(vals)
+                    return tuple(d_ins)
+
+                grad_compute.__name__ = f"{node.op_type}_grad"
+                in_map = {f"p{i}": t for i, t in enumerate(node.in_tensors)}
+                in_map.update({f"ct{i}": ct_vals[i] for i in live})
+                out = trace_fn(grad_compute, in_map, multi_out=True)
+                in_grads = list(out) if isinstance(out, tuple) else [out]
+            else:
+                (in_grads,) = node.vjp_fn(tuple(ct_vals))
+
+            for t, g in zip(node.in_tensors, in_grads):
+                if g is None:
+                    continue
+                p = t._grad_node
+                if p is None or id(p) not in active:
+                    if not t.stop_gradient or id(t) in requested:
+                        deposit(t, g)
+                else:
+                    buf = pending.setdefault(id(p), [None] * p.n_outs)
+                    buf[t._out_index] = _add(buf[t._out_index], g,
+                                             create_graph)
+                if p is not None and id(p) in active:
+                    consumer_count[id(p)] -= 1
+                    if consumer_count[id(p)] == 0:
+                        ready.append(p)
+
+            if not retain_graph and not create_graph:
+                # consume BOTH paths so a later create_graph backward can't
+                # silently reuse a freed graph
+                node.vjp_fn = _used_up
+                node.raw_fn = _used_up
+
+    # ---- finalize leaves: hooks once on the accumulated grad --------------
+    for t, g in leaf_store.values():
+        if t._hooks and t._grad_node is None:
+            g = _apply_hooks(t, g)
+        if id(t) in requested:
+            results[id(t)] = _add(results.get(id(t)), g, create_graph)
+        if accumulate_leaf and not t.stop_gradient:
+            gv = _val(g)
+            t._grad = gv if t._grad is None else t._grad + gv
+
+    if inputs is not None:
+        return _collect(inputs, results)
+    return None
+
+
+def _used_up(*_a, **_k):
+    raise RuntimeError(
+        "trying to run backward through the same graph a second time; "
+        "pass retain_graph=True to backward() if you need to")
+
+
+def _collect(inputs, results):
+    outs = []
+    for t in inputs:
+        g = results.get(id(t))
+        if g is None:
+            outs.append(None)
+        else:
+            outs.append(g if isinstance(g, Tensor)
+                        else Tensor(g, stop_gradient=True))
+    return outs
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """paddle.grad: grads of `outputs` w.r.t. `inputs` without touching
+    `.grad` (the reference's imperative::PartialGradEngine entry,
+    dygraph/base.py grad())."""
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is not None and not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+    if retain_graph is None:
+        retain_graph = create_graph
+    res = run_backward(list(outputs), grad_outputs,
+                       retain_graph=retain_graph, create_graph=create_graph,
+                       inputs=list(inputs), accumulate_leaf=False)
+    if not allow_unused:
+        for t, g in zip(inputs, res):
+            if g is None:
+                raise RuntimeError(
+                    "one of the inputs has no gradient path to outputs; "
+                    "set allow_unused=True to return None for it")
+    if create_graph:
+        for g in res:
+            if g is not None:
+                g.stop_gradient = g._grad_node is None
+    return res

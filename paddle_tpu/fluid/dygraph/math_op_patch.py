@@ -1,0 +1,192 @@
+"""Operator overloads for eager Tensors.
+
+Mirrors the reference's varbase_patch_methods.py / dygraph math_op_patch
+(which route through generated `core.ops.*` bindings,
+op_function_generator.cc:227) — here they route through `trace_op` into the
+same lowering rules the static graph uses."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .tracer import trace_fn, trace_op
+from .varbase import Tensor
+
+
+def _coerce(self, other):
+    from .. import core
+
+    if isinstance(other, Tensor):
+        return other
+    arr = np.asarray(other)
+    # Python scalars adopt the tensor's dtype (paddle's promotion rule for
+    # scalar operands, math_op_patch.py in the reference).
+    if arr.dtype in (np.float64, np.int64, np.int32) and arr.ndim == 0 \
+            and core.is_float_dtype(self.dtype):
+        arr = arr.astype(core.np_dtype(self.dtype))
+    return Tensor(arr, stop_gradient=True)
+
+
+def _binary(op_type, reverse=False):
+    def impl(self, other):
+        other = _coerce(self, other)
+        a, b = (other, self) if reverse else (self, other)
+        return trace_op(op_type, {"X": a, "Y": b}, {"axis": -1})
+
+    return impl
+
+
+def _compare(op_type, reverse=False):
+    def impl(self, other):
+        other = _coerce(self, other)
+        a, b = (other, self) if reverse else (self, other)
+        return trace_op(op_type, {"X": a, "Y": b}, {})
+
+    return impl
+
+
+def _op_out(op_type, ins, attrs):
+    """trace_op, returning the "Out" slot (ops like reshape2/transpose2 also
+    emit an XShape bookkeeping output)."""
+    out = trace_op(op_type, ins, attrs, multi_out=True)
+    if isinstance(out, dict):
+        return out["Out"][0]
+    return out
+
+
+def _neg(self):
+    return trace_op("scale", {"X": self}, {"scale": -1.0, "bias": 0.0})
+
+
+def _abs(self):
+    return trace_op("abs", {"X": self}, {})
+
+
+def _matmul(self, other):
+    return trace_op("matmul_v2", {"X": self, "Y": other},
+                    {"trans_x": False, "trans_y": False})
+
+
+def _install():
+    patches = {
+        "__add__": _binary("elementwise_add"),
+        "__radd__": _binary("elementwise_add", reverse=True),
+        "__sub__": _binary("elementwise_sub"),
+        "__rsub__": _binary("elementwise_sub", reverse=True),
+        "__mul__": _binary("elementwise_mul"),
+        "__rmul__": _binary("elementwise_mul", reverse=True),
+        "__truediv__": _binary("elementwise_div"),
+        "__rtruediv__": _binary("elementwise_div", reverse=True),
+        "__floordiv__": _binary("elementwise_floordiv"),
+        "__mod__": _binary("elementwise_mod"),
+        "__pow__": _binary("elementwise_pow"),
+        "__rpow__": _binary("elementwise_pow", reverse=True),
+        "__matmul__": _matmul,
+        "__neg__": _neg,
+        "__abs__": _abs,
+        "__eq__": _compare("equal"),
+        "__ne__": _compare("not_equal"),
+        "__lt__": _compare("less_than"),
+        "__le__": _compare("less_equal"),
+        "__gt__": _compare("greater_than"),
+        "__ge__": _compare("greater_equal"),
+    }
+    for name, fn in patches.items():
+        setattr(Tensor, name, fn)
+
+    # Common tensor methods used throughout model code; the full 2.0 method
+    # surface is installed by paddle_tpu.tensor at package import.
+    def method(op_type, **fixed):
+        def impl(self, **kw):
+            attrs = dict(fixed)
+            attrs.update(kw)
+            return trace_op(op_type, {"X": self}, attrs)
+
+        return impl
+
+    Tensor.exp = method("exp")
+    Tensor.log = method("log")
+    Tensor.sqrt = method("sqrt")
+    Tensor.rsqrt = method("rsqrt")
+    Tensor.tanh = method("tanh")
+    Tensor.abs = method("abs")
+    Tensor.square = method("square")
+
+    def reshape(self, shape):
+        shape = [int(s) for s in shape]
+        return _op_out("reshape2", {"X": self}, {"shape": shape})
+
+    def transpose(self, perm):
+        return _op_out("transpose2", {"X": self}, {"axis": list(perm)})
+
+    def sum(self, axis=None, dtype=None, keepdim=False):
+        attrs = {"dim": [] if axis is None else
+                 (list(axis) if isinstance(axis, (list, tuple)) else [axis]),
+                 "keep_dim": keepdim,
+                 "reduce_all": axis is None}
+        out = trace_op("reduce_sum", {"X": self}, attrs)
+        return out.astype(dtype) if dtype is not None else out
+
+    def mean(self, axis=None, keepdim=False):
+        attrs = {"dim": [] if axis is None else
+                 (list(axis) if isinstance(axis, (list, tuple)) else [axis]),
+                 "keep_dim": keepdim,
+                 "reduce_all": axis is None}
+        return trace_op("reduce_mean", {"X": self}, attrs)
+
+    def max(self, axis=None, keepdim=False):
+        attrs = {"dim": [] if axis is None else
+                 (list(axis) if isinstance(axis, (list, tuple)) else [axis]),
+                 "keep_dim": keepdim, "reduce_all": axis is None}
+        return trace_op("reduce_max", {"X": self}, attrs)
+
+    def min(self, axis=None, keepdim=False):
+        attrs = {"dim": [] if axis is None else
+                 (list(axis) if isinstance(axis, (list, tuple)) else [axis]),
+                 "keep_dim": keepdim, "reduce_all": axis is None}
+        return trace_op("reduce_min", {"X": self}, attrs)
+
+    def argmax(self, axis=None, keepdim=False, dtype="int64"):
+        return trace_op("arg_max", {"X": self},
+                        {"axis": -1 if axis is None else axis,
+                         "keepdims": keepdim, "flatten": axis is None})
+
+    def unsqueeze(self, axis):
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        return _op_out("unsqueeze2", {"X": self}, {"axes": axes})
+
+    def squeeze(self, axis=None):
+        axes = [] if axis is None else (
+            [axis] if isinstance(axis, int) else list(axis))
+        return _op_out("squeeze2", {"X": self}, {"axes": axes})
+
+    def flatten(self, start_axis=0, stop_axis=-1):
+        return _op_out("flatten_contiguous_range", {"X": self},
+                        {"start_axis": start_axis, "stop_axis": stop_axis})
+
+    def matmul(self, y, transpose_x=False, transpose_y=False):
+        return trace_op("matmul_v2", {"X": self, "Y": y},
+                        {"trans_x": transpose_x, "trans_y": transpose_y})
+
+    def scale(self, scale=1.0, bias=0.0):
+        return trace_op("scale", {"X": self}, {"scale": scale, "bias": bias})
+
+    def pow(self, y):
+        return self.__pow__(y)
+
+    Tensor.reshape = reshape
+    Tensor.transpose = transpose
+    Tensor.sum = sum
+    Tensor.mean = mean
+    Tensor.max = max
+    Tensor.min = min
+    Tensor.argmax = argmax
+    Tensor.unsqueeze = unsqueeze
+    Tensor.squeeze = squeeze
+    Tensor.flatten = flatten
+    Tensor.matmul = matmul
+    Tensor.scale = scale
+    Tensor.pow = pow
+
+
+_install()

@@ -1,0 +1,301 @@
+"""Eager op tracer + autograd tape.
+
+The reference's `imperative::Tracer::TraceOp` (/root/reference/paddle/fluid/
+imperative/tracer.cc:50) runs a kernel eagerly and, when grad is required,
+synthesizes a grad-op node (tracer.cc:104) for `BasicEngine` to walk later.
+
+TPU-native re-design: an eager op is the SAME lowering rule the static-graph
+Executor uses (paddle_tpu/ops/registry.py), applied immediately to
+`jax.Array`s.  When autograd is on and any input requires grad, the rule is
+evaluated under `jax.vjp` and the resulting vjp closure is recorded on a
+TapeNode — there are no grad ops, no GradOpMaker per op (the reference needs
+~650 of them); reverse-mode AD comes from jax.  Because a vjp closure is
+itself a pure jax function, higher-order grad (`create_graph=True`) falls
+out naturally: the engine re-traces vjp closures through this same tape.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .. import framework
+from .varbase import Tensor, _as_jax
+
+_STATE = threading.local()
+
+
+def _is_diff_value(v) -> bool:
+    import jax.numpy as jnp
+
+    return jnp.issubdtype(jnp.result_type(v), jnp.inexact)
+
+
+class TapeNode:
+    """One recorded differentiable computation: vjp closure + wiring.
+
+    in_tensors: the Tensors whose values were vjp-differentiated (in order).
+    out_avals: flat (shape, dtype) of the op outputs so the engine can build
+    zero cotangents for outputs nobody differentiated.
+    raw_fn: the pure function `dvals -> tuple(flat outs)` that was vjp'd —
+    kept so `create_graph=True` can RE-trace grad computation symbolically
+    (gradient-of-gradient flows through the primal inputs, so the cached
+    opaque vjp closure is not enough)."""
+
+    __slots__ = ("vjp_fn", "raw_fn", "in_tensors", "out_avals", "op_type",
+                 "n_outs", "out_refs")
+
+    def __init__(self, vjp_fn, raw_fn, in_tensors, out_avals, op_type):
+        self.vjp_fn = vjp_fn
+        self.raw_fn = raw_fn
+        self.in_tensors = in_tensors
+        self.out_avals = out_avals  # list of (shape, dtype) per flat output
+        self.op_type = op_type
+        self.n_outs = len(out_avals)
+        # weakrefs to the output Tensors (for grad-hook lookup during the
+        # backward walk); filled by _wrap_outs
+        self.out_refs = [None] * len(out_avals)
+
+
+class Tracer:
+    """Eager-mode state: grad on/off + per-step RNG (mirrors Tracer's
+    has_grad flag, imperative/tracer.h:45)."""
+
+    def __init__(self):
+        self._has_grad = True
+        self._seed_counter = 0
+        self._train_mode = True
+
+    @property
+    def has_grad(self):
+        return self._has_grad
+
+    def next_rng_key(self):
+        import jax
+
+        self._seed_counter += 1
+        base = getattr(_STATE, "rng_seed", 2023)
+        return jax.random.fold_in(jax.random.PRNGKey(base), self._seed_counter)
+
+
+def _tracer() -> Optional[Tracer]:
+    return framework._dygraph_tracer()
+
+
+def grad_enabled() -> bool:
+    t = _tracer()
+    return bool(t and t._has_grad)
+
+
+@contextlib.contextmanager
+def no_grad():
+    t = _tracer()
+    if t is None:
+        yield
+        return
+    old = t._has_grad
+    t._has_grad = False
+    try:
+        yield
+    finally:
+        t._has_grad = old
+
+
+def no_grad_decorator(fn):
+    def wrapper(*a, **kw):
+        with no_grad():
+            return fn(*a, **kw)
+
+    return wrapper
+
+
+@contextlib.contextmanager
+def enable_grad():
+    t = _tracer()
+    if t is None:
+        yield
+        return
+    old = t._has_grad
+    t._has_grad = True
+    try:
+        yield
+    finally:
+        t._has_grad = old
+
+
+def manual_seed(seed):
+    _STATE.rng_seed = int(seed)
+
+
+# ---------------------------------------------------------------------------
+# Core tracing
+# ---------------------------------------------------------------------------
+
+def _wrap_outs(flat_vals, node, stop_gradient) -> List[Tensor]:
+    import weakref
+
+    outs = []
+    for i, v in enumerate(flat_vals):
+        if v is None:
+            outs.append(None)
+            continue
+        t = Tensor(v, stop_gradient=stop_gradient or not _is_diff_value(v))
+        if node is not None and _is_diff_value(v):
+            t._grad_node = node
+            t._out_index = i
+            node.out_refs[i] = weakref.ref(t)
+        outs.append(t)
+    return outs
+
+
+def _flatten_struct(outs_dict):
+    """Deterministic flattening of an InsOuts dict: sorted slots."""
+    flat, spec = [], []
+    for slot in sorted(outs_dict):
+        vals = outs_dict[slot]
+        spec.append((slot, len(vals)))
+        flat.extend(vals)
+    return flat, spec
+
+
+def trace_fn(fn, in_map: Dict[str, Any], multi_out: bool = False):
+    """Trace an arbitrary pure jax function over eager Tensors.
+
+    `fn(**values)` receives raw jnp values for each key of `in_map` and
+    returns one value or a tuple.  Records ONE TapeNode for the whole fn —
+    the eager analogue of a fused kernel."""
+    import jax
+
+    values = {}
+    diff_keys = []
+    for k, v in in_map.items():
+        if isinstance(v, Tensor):
+            values[k] = v._value
+            if grad_enabled() and not v.stop_gradient:
+                diff_keys.append(k)
+        else:
+            values[k] = _as_jax(v) if isinstance(
+                v, (int, float, bool, list, tuple, np.ndarray)) else v
+
+    want_grad = bool(diff_keys)
+    if want_grad:
+        diff_vals = [values[k] for k in diff_keys]
+
+        def f(dvals):
+            merged = dict(values)
+            merged.update(zip(diff_keys, dvals))
+            out = fn(**merged)
+            return out if isinstance(out, tuple) else (out,)
+
+        out_vals, vjp_fn = jax.vjp(f, diff_vals)
+        node = TapeNode(
+            vjp_fn, f,
+            [in_map[k] for k in diff_keys],
+            [((v.shape, v.dtype) if v is not None else None) for v in out_vals],
+            getattr(fn, "__name__", "fn"),
+        )
+    else:
+        out = fn(**values)
+        out_vals = out if isinstance(out, tuple) else (out,)
+        node = None
+
+    outs = _wrap_outs(list(out_vals), node, stop_gradient=not want_grad)
+    if multi_out or len(outs) > 1:
+        return tuple(outs)
+    return outs[0]
+
+
+def trace_op(op_type: str, inputs: Dict[str, Any], attrs: Dict[str, Any] = None,
+             multi_out: bool = False):
+    """Run one registered op eagerly (the reference's `core.ops.<op>` fast
+    path, pybind/op_function_generator.cc:227).
+
+    `inputs`: slot -> Tensor | list[Tensor] | raw value.  Returns the single
+    output Tensor when the op has exactly one, else a dict slot->list.
+    """
+    import jax
+
+    from ...ops import registry
+
+    attrs = dict(attrs or {})
+    fn = registry._FORWARD.get(op_type)
+    if fn is None:
+        raise NotImplementedError(f"no lowering registered for op {op_type!r}")
+
+    tracer = _tracer()
+
+    # Normalize inputs to slot -> list, gather raw values + diff paths.
+    ins_tensors: Dict[str, List[Optional[Tensor]]] = {}
+    for slot, v in inputs.items():
+        if v is None:
+            ins_tensors[slot] = []
+        elif isinstance(v, (list, tuple)):
+            ins_tensors[slot] = [
+                x if isinstance(x, Tensor) or x is None else Tensor(x)
+                for x in v]
+        elif isinstance(v, Tensor):
+            ins_tensors[slot] = [v]
+        else:
+            ins_tensors[slot] = [Tensor(v)]
+
+    ins_vals = {s: [t._value if t is not None else None for t in ts]
+                for s, ts in ins_tensors.items()}
+
+    diff_paths, diff_tensors = [], []
+    if grad_enabled():
+        for slot, ts in ins_tensors.items():
+            for i, t in enumerate(ts):
+                if (t is not None and not t.stop_gradient
+                        and _is_diff_value(t._value)):
+                    diff_paths.append((slot, i))
+                    diff_tensors.append(t)
+
+    # Per-op context; the RNG key is a thunk so the (device-op) PRNGKey
+    # construction only happens for ops that actually consume randomness.
+    base_key = (tracer.next_rng_key if tracer is not None
+                else (lambda: jax.random.PRNGKey(0)))
+    op = framework.Operator(None, 0, op_type, {}, {}, attrs)
+    ctx = registry.LowerCtx(base_key, block=None)
+
+    if diff_paths:
+        spec_box = {}
+
+        def f2(dvals):
+            merged = {s: list(vs) for s, vs in ins_vals.items()}
+            for (slot, i), v in zip(diff_paths, dvals):
+                merged[slot][i] = v
+            out = fn(ctx, op, merged)
+            flat, spec = _flatten_struct(out)
+            spec_box["spec"] = spec
+            return tuple(flat)
+
+        flat_vals, vjp_fn = jax.vjp(f2, [t._value for t in diff_tensors])
+        spec = spec_box["spec"]
+        node = TapeNode(
+            vjp_fn, f2, diff_tensors,
+            [((v.shape, v.dtype) if v is not None else None)
+             for v in flat_vals],
+            op_type)
+        out_tensors = _wrap_outs(list(flat_vals), node, stop_gradient=False)
+    else:
+        out = fn(ctx, op, ins_vals)
+        flat_vals, spec = _flatten_struct(out)
+        out_tensors = _wrap_outs(list(flat_vals), None, stop_gradient=True)
+
+    # Re-assemble slot structure.
+    outs: Dict[str, List[Optional[Tensor]]] = {}
+    k = 0
+    for slot, n in spec:
+        outs[slot] = out_tensors[k:k + n]
+        k += n
+
+    if not multi_out:
+        non_empty = {s: v for s, v in outs.items() if v}
+        if len(non_empty) == 1:
+            vals = next(iter(non_empty.values()))
+            if len(vals) == 1:
+                return vals[0]
+    return outs

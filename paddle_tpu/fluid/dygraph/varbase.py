@@ -1,0 +1,248 @@
+"""Eager Tensor (the reference's `imperative::VarBase`,
+/root/reference/paddle/fluid/imperative/layer.h:65).
+
+TPU-native re-design: instead of a C++ tensor + grad-var pair managed by a
+C++ tracer, an eager Tensor is a thin Python wrapper over an immutable
+`jax.Array` plus autograd metadata (`_grad_node`, `_out_index`) recorded by
+the tape tracer (tracer.py).  Mutation APIs (`set_value`, optimizer updates)
+rebind the wrapped array — matching the reference's in-place semantics at
+the API level while staying functional underneath (SURVEY.md §7 "In-place &
+aliasing semantics").
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import core
+
+
+def _as_jax(value, dtype=None):
+    import jax.numpy as jnp
+
+    if isinstance(value, Tensor):
+        value = value._value
+    if dtype is not None:
+        dtype = core.np_dtype(dtype)
+    if isinstance(value, (int, float, bool, list, tuple, np.ndarray, np.generic)):
+        arr = np.asarray(value)
+        if dtype is None and arr.dtype == np.float64:
+            dtype = np.float32  # paddle default: fp32, not numpy's fp64
+        return jnp.asarray(arr, dtype=dtype)
+    return jnp.asarray(value, dtype=dtype) if dtype is not None else value
+
+
+class Tensor:
+    """Eager tensor: `jax.Array` + autograd metadata.
+
+    `stop_gradient` defaults to True (as in the reference's VarBase for
+    non-parameters, layer.h:65); layers create parameters with
+    stop_gradient=False."""
+
+    def __init__(self, value, name=None, stop_gradient=True, persistable=False,
+                 dtype=None):
+        from .. import unique_name
+
+        self._value = _as_jax(value, dtype)
+        self.name = name or unique_name.generate("eager_tmp")
+        self.stop_gradient = stop_gradient
+        self.persistable = persistable
+        self._grad = None          # jnp value (accumulated by the engine)
+        self._grad_node = None     # TapeNode that produced this tensor
+        self._out_index = None     # flat output index within that node
+        self._hooks = []           # grad hooks (register_hook)
+        self.is_leaf_param = False
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def dtype(self):
+        return core.convert_dtype(self._value.dtype)
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def place(self):
+        try:
+            dev = list(self._value.devices())[0]
+            return f"{dev.platform}:{dev.id}"
+        except Exception:
+            return "cpu:0"
+
+    def numel(self):
+        return self.size
+
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def item(self, *args):
+        return self.numpy().item(*args)
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __len__(self):
+        if not self._value.shape:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __bool__(self):
+        return bool(self.numpy())
+
+    def __float__(self):
+        return float(self.numpy())
+
+    def __int__(self):
+        return int(self.numpy())
+
+    def __index__(self):
+        return int(self.numpy())
+
+    def __hash__(self):
+        return id(self)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __repr__(self):
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype}, "
+                f"stop_gradient={self.stop_gradient},\n{self.numpy()})")
+
+    __str__ = __repr__
+
+    # -- autograd -----------------------------------------------------------
+    @property
+    def grad(self):
+        if self._grad is None:
+            return None
+        t = Tensor(self._grad, stop_gradient=True)
+        return t
+
+    @grad.setter
+    def grad(self, value):
+        self._grad = None if value is None else _as_jax(value)
+
+    @property
+    def is_leaf(self):
+        return self._grad_node is None
+
+    def backward(self, grad_tensor=None, retain_graph=False):
+        from .engine import run_backward
+
+        run_backward([self], [grad_tensor] if grad_tensor is not None else None,
+                     retain_graph=retain_graph)
+
+    def clear_gradient(self, set_to_zero=False):
+        if set_to_zero and self._grad is not None:
+            import jax.numpy as jnp
+
+            self._grad = jnp.zeros_like(self._grad)
+        else:
+            self._grad = None
+
+    clear_grad = clear_gradient
+
+    def register_hook(self, hook):
+        """Register a grad hook: hook(grad_tensor) -> new grad or None."""
+        self._hooks.append(hook)
+
+        class _Handle:
+            def remove(handle_self):
+                if hook in self._hooks:
+                    self._hooks.remove(hook)
+
+        return _Handle()
+
+    def detach(self):
+        t = Tensor(self._value, stop_gradient=True,
+                   persistable=self.persistable)
+        return t
+
+    def detach_(self):
+        self._grad_node = None
+        self._out_index = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self):
+        from .tracer import trace_op
+
+        out = trace_op("assign", {"X": self}, {})
+        return out
+
+    # -- mutation (rebinds the wrapped array) -------------------------------
+    def set_value(self, value):
+        new = _as_jax(value, self.dtype)
+        if tuple(new.shape) != tuple(self._value.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {list(new.shape)} vs {self.shape}")
+        self._value = new
+
+    def copy_(self, other, blocking=True):
+        self.set_value(other)
+        return self
+
+    def fill_(self, value):
+        import jax.numpy as jnp
+
+        self._value = jnp.full_like(self._value, value)
+        return self
+
+    def zero_(self):
+        return self.fill_(0)
+
+    # -- conversion sugar ---------------------------------------------------
+    def astype(self, dtype):
+        from .tracer import trace_op
+
+        return trace_op("cast", {"X": self},
+                        {"out_dtype": core.convert_dtype(dtype)})
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    def _to(self, *args, **kwargs):
+        return self
+
+    cuda = cpu = pin_memory = _to
+
+    @property
+    def T(self):
+        perm = list(range(self.ndim))[::-1]
+        return self.transpose(perm)  # installed by math_op_patch
+
+    def __getitem__(self, idx):
+        import jax.numpy as jnp
+
+        from .tracer import trace_fn
+
+        def f(x):
+            return x[idx]
+
+        return trace_fn(f, {"x": self})
+
+    def __setitem__(self, idx, value):
+        val = _as_jax(value, self.dtype)
+        self._value = self._value.at[idx].set(val)
+
+    def __array__(self, dtype=None):
+        arr = self.numpy()
+        return arr.astype(dtype) if dtype is not None else arr
+
+    # __add__ and friends are installed by
+    # paddle_tpu.fluid.dygraph.math_op_patch at import time (mirrors the
+    # reference's varbase_patch_methods.py / math_op_patch.py).
+
+
+# The reference's `core.VarBase` alias.
+VarBase = Tensor

@@ -554,6 +554,23 @@ def _current_tracer():
     return _dygraph_tracer_
 
 
+_dygraph_tracer = _current_tracer
+
+
+def _set_dygraph_tracer(tracer):
+    global _dygraph_tracer_
+    _dygraph_tracer_ = tracer
+
+
+@contextlib.contextmanager
+def _dygraph_guard(tracer):
+    old = _switch_tracer(tracer)
+    try:
+        yield
+    finally:
+        _switch_tracer(old)
+
+
 # op_role constants (op_proto_maker.h OpRole in the reference) — used to tag
 # forward (0) / backward (1) / optimize (2) ops for clone(for_test) pruning
 # and pipeline scheduling.

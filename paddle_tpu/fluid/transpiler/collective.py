@@ -127,7 +127,12 @@ class LocalSGD(Collective):
                 [1], "float32", 0.5)), "float32")
             for p in params:
                 pvar = block.var(p)
-                avg = nn.scale(pvar, 1.0 / self.nranks)
+                # divide by the RUNTIME data-axis size (the psum below spans
+                # every mesh shard), exactly as GradAllReduce does — the
+                # static endpoint count under-divides when one process holds
+                # several chips
+                avg = nn.scale(pvar, 1.0)
+                block.ops[-1]._set_attr("divide_by_axis_size", "data")
                 block.append_op("c_allreduce_sum", inputs={"X": [avg]},
                                 outputs={"Out": [avg]},
                                 attrs={"ring_id": 0}, infer_shape=False)

@@ -96,11 +96,13 @@ class LowerCtx:
 
     def rng_key(self, op: Operator):
         """Deterministic per-op key: seed attr wins (OpTest reproducibility),
-        else fold the op id into the per-step base key."""
+        else fold the op id into the per-step base key.  `base_key` may be a
+        thunk (eager tracer) so key construction is lazy."""
         seed = op.attr("seed", 0)
         if seed:
             return jax.random.PRNGKey(seed)
-        return jax.random.fold_in(self.base_key, op.id & 0x7FFFFFFF)
+        base = self.base_key() if callable(self.base_key) else self.base_key
+        return jax.random.fold_in(base, op.id & 0x7FFFFFFF)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,238 @@
+"""Dygraph engine tests: eager ops, tape autograd, hooks, double grad.
+
+Methodology mirrors the reference's test_imperative_basic.py /
+test_imperative_double_grad.py (loss.backward() vs hand-derived grads)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import dygraph
+from paddle_tpu.fluid.dygraph import Tensor, to_variable
+
+
+@pytest.fixture(autouse=True)
+def _dygraph_mode():
+    with dygraph.guard():
+        yield
+
+
+def test_eager_basic_math():
+    x = to_variable(np.array([1.0, 2.0, 3.0], np.float32))
+    y = to_variable(np.array([4.0, 5.0, 6.0], np.float32))
+    z = x * y + 2.0
+    np.testing.assert_allclose(z.numpy(), [6.0, 12.0, 20.0])
+    assert z.stop_gradient  # no grad-requiring inputs
+
+
+def test_backward_simple():
+    x = Tensor(np.array([2.0, 3.0], np.float32), stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_backward_chain_and_accumulation():
+    x = Tensor(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32),
+               stop_gradient=False)
+    # x used twice: grads must accumulate
+    y = (x * x + x * 3.0).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 2 * x.numpy() + 3.0)
+
+
+def test_grad_accumulates_across_backwards():
+    x = Tensor(np.array([1.0], np.float32), stop_gradient=False)
+    (x * 2.0).sum().backward()
+    (x * 3.0).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_no_grad():
+    x = Tensor(np.array([1.0], np.float32), stop_gradient=False)
+    with dygraph.no_grad():
+        y = x * 2.0
+    assert y.stop_gradient
+    assert y._grad_node is None
+
+
+def test_detach_breaks_graph():
+    x = Tensor(np.array([2.0], np.float32), stop_gradient=False)
+    y = x * 3.0
+    z = y.detach() * 2.0
+    assert z._grad_node is None
+
+
+def test_second_backward_raises():
+    x = Tensor(np.array([1.0], np.float32), stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_retain_graph():
+    x = Tensor(np.array([1.0], np.float32), stop_gradient=False)
+    y = (x * x).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+
+def test_matmul_grad():
+    a = Tensor(np.random.rand(3, 4).astype(np.float32), stop_gradient=False)
+    b = Tensor(np.random.rand(4, 5).astype(np.float32), stop_gradient=False)
+    out = (a @ b).sum()
+    out.backward()
+    np.testing.assert_allclose(a.grad.numpy(),
+                               np.ones((3, 5)) @ b.numpy().T, rtol=1e-5)
+    np.testing.assert_allclose(b.grad.numpy(),
+                               a.numpy().T @ np.ones((3, 5)), rtol=1e-5)
+
+
+def test_trace_op_softmax_ce():
+    logits = Tensor(np.random.rand(4, 10).astype(np.float32),
+                    stop_gradient=False)
+    labels = Tensor(np.random.randint(0, 10, (4, 1)).astype(np.int64))
+    outs = dygraph.trace_op(
+        "softmax_with_cross_entropy",
+        {"Logits": logits, "Label": labels},
+        {"soft_label": False, "axis": -1}, multi_out=True)
+    loss = outs["Loss"][0].mean()
+    loss.backward()
+    assert logits.grad is not None
+    assert logits.grad.shape == [4, 10]
+
+
+def test_paddle_grad_api():
+    x = Tensor(np.array([3.0], np.float32), stop_gradient=False)
+    y = x * x
+    (gx,) = dygraph.grad(y, x)
+    np.testing.assert_allclose(gx.numpy(), [6.0])
+    assert x.grad is None  # paddle.grad must not touch .grad
+
+
+def test_double_grad():
+    x = Tensor(np.array([3.0], np.float32), stop_gradient=False)
+    y = x * x * x  # y = x^3, dy/dx = 3x^2, d2y/dx2 = 6x
+    (gx,) = dygraph.grad(y, x, create_graph=True)
+    np.testing.assert_allclose(gx.numpy(), [27.0])
+    assert not gx.stop_gradient
+    (ggx,) = dygraph.grad(gx, x)
+    np.testing.assert_allclose(ggx.numpy(), [18.0])
+
+
+def test_grad_interior_tensor():
+    x = Tensor(np.array([2.0], np.float32), stop_gradient=False)
+    y = x * 3.0
+    z = y * y
+    (gy,) = dygraph.grad(z, y)
+    np.testing.assert_allclose(gy.numpy(), [12.0])
+
+
+def test_register_hook():
+    x = Tensor(np.array([1.0], np.float32), stop_gradient=False)
+    seen = []
+
+    def hook(g):
+        seen.append(g.numpy().copy())
+        return g * 2.0
+
+    x.register_hook(hook)
+    (x * 5.0).sum().backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(seen[0], [5.0])
+    np.testing.assert_allclose(x.grad.numpy(), [10.0])
+
+
+def test_grad_tensor_seed():
+    x = Tensor(np.array([1.0, 2.0], np.float32), stop_gradient=False)
+    y = x * 2.0
+    y.backward(grad_tensor=Tensor(np.array([1.0, 10.0], np.float32)))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 20.0])
+
+
+def test_reshape_transpose_grad():
+    x = Tensor(np.arange(6, dtype=np.float32).reshape(2, 3),
+               stop_gradient=False)
+    y = x.reshape([3, 2]).transpose([1, 0])
+    (y * y).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), 2 * x.numpy())
+
+
+def test_indexing_grad():
+    x = Tensor(np.arange(6, dtype=np.float32).reshape(2, 3),
+               stop_gradient=False)
+    y = x[0]
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(),
+                               [[1, 1, 1], [0, 0, 0]])
+
+
+def test_setitem_and_mutation():
+    x = Tensor(np.zeros((2, 2), np.float32))
+    x[0, 0] = 5.0
+    assert x.numpy()[0, 0] == 5.0
+    x.fill_(1.0)
+    np.testing.assert_allclose(x.numpy(), np.ones((2, 2)))
+
+
+def test_comparison_and_cast():
+    x = to_variable(np.array([1.0, 2.0], np.float32))
+    y = to_variable(np.array([2.0, 2.0], np.float32))
+    assert (x < y).numpy().tolist() == [True, False]
+    z = x.astype("int64")
+    # jax_enable_x64 is off (TPU-native default): int64 narrows to int32
+    assert z.dtype in ("int64", "int32")
+
+
+def test_multi_root_same_node():
+    # Two outputs of the SAME tape node given as backward roots must not
+    # double-count consumers (regression: discovery stalled upstream nodes).
+    x = Tensor(np.array([2.0], np.float32), stop_gradient=False)
+    y = x * 3.0
+    outs = dygraph.trace_fn(lambda v: (v * 2.0, v * 5.0), {"v": y},
+                            multi_out=True)
+    a, b = outs
+    gx = dygraph.grad([a, b], [x], grad_outputs=[
+        Tensor(np.ones(1, np.float32)), Tensor(np.ones(1, np.float32))])
+    np.testing.assert_allclose(gx[0].numpy(), [21.0])  # 3*(2+5)
+
+
+def test_hook_fires_once_on_accumulated_grad():
+    # Hook semantics: fires ONCE on the fully-accumulated gradient, not per
+    # contribution (regression).
+    x = Tensor(np.array([1.0], np.float32), stop_gradient=False)
+    calls = []
+
+    def hook(g):
+        calls.append(g.numpy().copy())
+        return g * 10.0
+
+    x.register_hook(hook)
+    # x consumed twice -> two partial grads 2.0 and 3.0 accumulate to 5.0
+    y = (x * 2.0 + x * 3.0).sum()
+    y.backward()
+    assert len(calls) == 1
+    np.testing.assert_allclose(calls[0], [5.0])
+    np.testing.assert_allclose(x.grad.numpy(), [50.0])
+
+
+def test_create_graph_after_consumed_graph_raises():
+    x = Tensor(np.array([1.0], np.float32), stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()  # consumes graph
+    with pytest.raises(RuntimeError):
+        dygraph.grad(y, x, create_graph=True)
+
+
+def test_branching_graph():
+    # Diamond: x -> a, b -> c; dependency counting must wait for both paths.
+    x = Tensor(np.array([2.0], np.float32), stop_gradient=False)
+    a = x * 2.0
+    b = x * 3.0
+    c = (a * b).sum()  # c = 6x^2, dc/dx = 12x = 24
+    c.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [24.0])
