@@ -38,7 +38,16 @@ def _binary(op_type, reverse=False):
 
 def _compare(op_type, reverse=False):
     def impl(self, other):
-        other = _coerce(self, other)
+        try:
+            other = _coerce(self, other)
+        except (TypeError, ValueError):
+            # foreign operand (None, objects): follow the equality protocol
+            # instead of raising from inside np/jnp coercion
+            if op_type == "equal":
+                return False
+            if op_type == "not_equal":
+                return True
+            return NotImplemented
         a, b = (other, self) if reverse else (self, other)
         return trace_op(op_type, {"X": a, "Y": b}, {})
 
@@ -119,37 +128,26 @@ def _install():
     def transpose(self, perm):
         return _op_out("transpose2", {"X": self}, {"axis": list(perm)})
 
-    def sum(self, axis=None, dtype=None, keepdim=False):
-        attrs = {"dim": [] if axis is None else
-                 (list(axis) if isinstance(axis, (list, tuple)) else [axis]),
-                 "keep_dim": keepdim,
-                 "reduce_all": axis is None}
-        out = trace_op("reduce_sum", {"X": self}, attrs)
-        return out.astype(dtype) if dtype is not None else out
+    def _reduce(op_type):
+        def impl(self, axis=None, dtype=None, keepdim=False):
+            attrs = {"dim": [] if axis is None else
+                     (list(axis) if isinstance(axis, (list, tuple))
+                      else [axis]),
+                     "keep_dim": keepdim, "reduce_all": axis is None}
+            out = trace_op(op_type, {"X": self}, attrs)
+            return out.astype(dtype) if dtype is not None else out
+        return impl
 
-    def mean(self, axis=None, keepdim=False):
-        attrs = {"dim": [] if axis is None else
-                 (list(axis) if isinstance(axis, (list, tuple)) else [axis]),
-                 "keep_dim": keepdim,
-                 "reduce_all": axis is None}
-        return trace_op("reduce_mean", {"X": self}, attrs)
-
-    def max(self, axis=None, keepdim=False):
-        attrs = {"dim": [] if axis is None else
-                 (list(axis) if isinstance(axis, (list, tuple)) else [axis]),
-                 "keep_dim": keepdim, "reduce_all": axis is None}
-        return trace_op("reduce_max", {"X": self}, attrs)
-
-    def min(self, axis=None, keepdim=False):
-        attrs = {"dim": [] if axis is None else
-                 (list(axis) if isinstance(axis, (list, tuple)) else [axis]),
-                 "keep_dim": keepdim, "reduce_all": axis is None}
-        return trace_op("reduce_min", {"X": self}, attrs)
+    sum = _reduce("reduce_sum")
+    mean = _reduce("reduce_mean")
+    max = _reduce("reduce_max")
+    min = _reduce("reduce_min")
 
     def argmax(self, axis=None, keepdim=False, dtype="int64"):
         return trace_op("arg_max", {"X": self},
                         {"axis": -1 if axis is None else axis,
-                         "keepdims": keepdim, "flatten": axis is None})
+                         "keepdims": keepdim, "flatten": axis is None,
+                         "dtype": dtype})
 
     def unsqueeze(self, axis):
         axes = [axis] if isinstance(axis, int) else list(axis)

@@ -76,9 +76,11 @@ class Tracer:
     def next_rng_key(self):
         import jax
 
-        self._seed_counter += 1
+        # seed and counter live together in thread-local state so
+        # manual_seed() restarts the stream for the calling thread
+        _STATE.rng_counter = getattr(_STATE, "rng_counter", 0) + 1
         base = getattr(_STATE, "rng_seed", 2023)
-        return jax.random.fold_in(jax.random.PRNGKey(base), self._seed_counter)
+        return jax.random.fold_in(jax.random.PRNGKey(base), _STATE.rng_counter)
 
 
 def _tracer() -> Optional[Tracer]:
@@ -128,6 +130,7 @@ def enable_grad():
 
 def manual_seed(seed):
     _STATE.rng_seed = int(seed)
+    _STATE.rng_counter = 0
 
 
 # ---------------------------------------------------------------------------
@@ -255,8 +258,16 @@ def trace_op(op_type: str, inputs: Dict[str, Any], attrs: Dict[str, Any] = None,
 
     # Per-op context; the RNG key is a thunk so the (device-op) PRNGKey
     # construction only happens for ops that actually consume randomness.
-    base_key = (tracer.next_rng_key if tracer is not None
-                else (lambda: jax.random.PRNGKey(0)))
+    # Memoized: create_graph=True re-executes the lowering through raw_fn,
+    # and the re-trace must see the SAME key the forward pass sampled with
+    # (e.g. the dropout mask in double-grad).
+    _key_box: Dict[str, Any] = {}
+
+    def base_key():
+        if "k" not in _key_box:
+            _key_box["k"] = (tracer.next_rng_key() if tracer is not None
+                             else jax.random.PRNGKey(0))
+        return _key_box["k"]
     op = framework.Operator(None, 0, op_type, {}, {}, attrs)
     ctx = registry.LowerCtx(base_key, block=None)
 

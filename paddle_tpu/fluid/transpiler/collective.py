@@ -131,8 +131,13 @@ class LocalSGD(Collective):
                 # every mesh shard), exactly as GradAllReduce does — the
                 # static endpoint count under-divides when one process holds
                 # several chips
-                avg = nn.scale(pvar, 1.0)
-                block.ops[-1]._set_attr("divide_by_axis_size", "data")
+                avg = block.create_var(dtype=pvar.dtype, shape=pvar.shape)
+                block.append_op("scale", inputs={"X": [pvar]},
+                                outputs={"Out": [avg]},
+                                attrs={"scale": 1.0, "bias": 0.0,
+                                       "bias_after_scale": True,
+                                       "divide_by_axis_size": "data"},
+                                infer_shape=False)
                 block.append_op("c_allreduce_sum", inputs={"X": [avg]},
                                 outputs={"Out": [avg]},
                                 attrs={"ring_id": 0}, infer_shape=False)
