@@ -2,10 +2,16 @@
 """Benchmark entry point (driver contract): prints ONE JSON line
 {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
 
-Current benchmark: MNIST ConvNet (BASELINE.json configs[0]) train-step
-throughput on the available accelerator.  The reference publishes no
-numbers (BASELINE.md), so vs_baseline is reported relative to a recorded
-first-round figure once one exists (1.0 until then).
+Benchmark: BERT-base pretraining MFU on the available accelerator
+(BASELINE.json north_star: >=45% MFU).  One fused XLA train step
+(fwd+bwd+AdamW, bf16 activations, fp32 master weights, Pallas flash
+attention) — seq 512, per-chip batch sized for one v5e chip.
+
+vs_baseline = achieved MFU / 45 (the north-star target).
+
+Fallback: if the accelerator is CPU (no TPU attached), runs a reduced
+config and reports MFU against a rough CPU peak — still one JSON line
+so the driver contract holds.
 """
 
 import json
@@ -14,42 +20,77 @@ import time
 
 import numpy as np
 
+# v5e (TPU v5 lite) peak bf16 throughput per chip
+TPU_V5E_PEAK_FLOPS = 197e12
+CPU_PEAK_FLOPS = 2e11  # rough; only used for the CPU fallback line
+
+
+def bert_step_flops(cfg, batch, seq, n_masked):
+    """Model FLOPs for one train step (fwd + bwd ~= 3x fwd cost)."""
+    h, l, inter, v = (cfg.hidden_size, cfg.num_hidden_layers,
+                      cfg.intermediate_size, cfg.vocab_size)
+    per_layer = 4 * h * h + 2 * h * inter          # qkvo + ffn weights
+    matmul_params = l * per_layer
+    fwd_tok = 2 * matmul_params + l * 4 * seq * h  # + attention scores/pv
+    fwd = batch * seq * fwd_tok
+    fwd += 2 * batch * n_masked * h * v            # MLM head matmul
+    return 3 * fwd
+
 
 def main():
-    import paddle_tpu.fluid as fluid
-    from paddle_tpu.fluid.executor import Scope, scope_guard
-    from paddle_tpu.models import mnist
+    import os
 
-    batch = 512
-    main_prog, startup, feeds, fetches = mnist.build_train_program(
-        optimizer=fluid.optimizer.Adam(learning_rate=0.001),
-        batch_size=batch)
+    import jax
 
-    rng = np.random.RandomState(0)
-    imgs = rng.rand(batch, 1, 28, 28).astype("float32")
-    labels = rng.randint(0, 10, size=(batch, 1)).astype("int64")
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # the axon TPU plugin otherwise wins over the env var
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
 
-    scope = Scope()
-    with scope_guard(scope):
-        exe = fluid.Executor()
-        exe.run(startup)
-        feed = {"img": imgs, "label": labels}
-        # warmup + compile
-        for _ in range(3):
-            exe.run(main_prog, feed=feed, fetch_list=fetches)
-        n_steps = 30
-        t0 = time.perf_counter()
-        for _ in range(n_steps):
-            out = exe.run(main_prog, feed=feed, fetch_list=fetches)
-        _ = [np.asarray(o) for o in out]  # sync
-        dt = time.perf_counter() - t0
+    from paddle_tpu.models import bert
 
-    ips = batch * n_steps / dt
+    backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+    # attention dropout 0 keeps attention on the Pallas flash kernel
+    # (dropout-in-kernel not implemented yet); hidden dropout stays on
+    if on_tpu:
+        cfg = bert.BertConfig.base(attention_probs_dropout_prob=0.0)
+        batch, seq, n_masked = 16, 512, 76
+        steps, peak = 20, TPU_V5E_PEAK_FLOPS
+    else:
+        cfg = bert.BertConfig.tiny(attention_probs_dropout_prob=0.0)
+        batch, seq, n_masked = 8, 128, 20
+        steps, peak = 3, CPU_PEAK_FLOPS
+
+    model = bert.BertForPretraining(cfg)
+    step, state = bert.build_pretrain_step(model, bf16=True)
+    b = bert.fake_batch(cfg, batch, seq, num_masked=n_masked)
+    lr = jnp.float32(1e-4)
+
+    # warmup / compile
+    state, loss = step(state, b, lr)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, loss = step(state, b, lr)
+    jax.block_until_ready(loss)
+    dt = (time.perf_counter() - t0) / steps
+
+    flops = bert_step_flops(cfg, batch, seq, n_masked)
+    mfu = flops / dt / peak * 100.0
+    tokens_per_sec = batch * seq / dt
+
     print(json.dumps({
-        "metric": "mnist_convnet_images_per_sec",
-        "value": round(ips, 2),
-        "unit": "images/sec",
-        "vs_baseline": 1.0,
+        "metric": ("bert_base_pretrain_mfu" if on_tpu
+                   else "bert_tiny_pretrain_mfu_cpu"),
+        "value": round(mfu, 2),
+        "unit": "%",
+        "vs_baseline": round(mfu / 45.0, 4),
+        "detail": {"backend": backend, "batch": batch, "seq": seq,
+                   "step_ms": round(dt * 1e3, 2),
+                   "tokens_per_sec": round(tokens_per_sec, 1),
+                   "loss": float(loss)},
     }))
 
 

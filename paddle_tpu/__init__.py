@@ -22,6 +22,35 @@ __version__ = "0.1.0"
 
 from . import fluid
 from . import ops
+from . import nn
+from . import optimizer
+from . import tensor
+from . import jit
+from . import models
+from .nn.layer.layers import Layer  # 2.0 alias: paddle.nn.Layer
+from .tensor import (to_tensor, zeros, ones, full, zeros_like, ones_like,
+                     full_like, arange, linspace, eye, rand, randn, randint,
+                     randperm, uniform, normal, bernoulli, multinomial,
+                     seed, concat, stack, split, squeeze, unsqueeze,
+                     reshape, transpose, flatten, cast, matmul, bmm, dot,
+                     mv, t, kron, addmm, tril, triu, diag, meshgrid, where,
+                     nonzero, unique, flip, roll, tile, expand, expand_as,
+                     broadcast_to, gather, gather_nd, scatter,
+                     scatter_nd_add, index_select, index_sample,
+                     masked_select, argmax, argmin, argsort, sort, topk,
+                     add, subtract, multiply, divide, pow, clip, scale,
+                     isnan, isinf, isfinite, norm, dist, equal, not_equal,
+                     greater_than, greater_equal, less_than, less_equal,
+                     logical_and, logical_or, logical_not, logical_xor,
+                     equal_all, allclose, cumsum, cumprod, assign, clone,
+                     numel, std, var, median, logsumexp, sum, mean, prod,
+                     exp, log, sqrt, rsqrt, abs, ceil, floor, round, sin,
+                     cos, tan, tanh, reciprocal, square, sign, erf,
+                     maximum, minimum)
+from .tensor import max, min  # noqa: A004 (paddle API shadows builtins)
+from .fluid.dygraph.base import enable_dygraph as disable_static_mode
+from .fluid.dygraph import to_variable, no_grad, grad
+from .fluid.dygraph.varbase import Tensor
 from .fluid import (CPUPlace, CUDAPlace, TPUPlace, Executor, ParamAttr,
                     Program, Variable, append_backward, cpu_places,
                     cuda_places, default_main_program,
@@ -29,8 +58,13 @@ from .fluid import (CPUPlace, CUDAPlace, TPUPlace, Executor, ParamAttr,
                     scope_guard, tpu_places, in_dygraph_mode)
 from .fluid.layers.tensor import data
 
-enable_static = lambda: None  # static mode is the default, as in 1.x
+def enable_static():
+    from .fluid.dygraph import disable_dygraph
+
+    disable_dygraph()
 
 
-def disable_static():
-    raise NotImplementedError("dygraph mode: see paddle_tpu.fluid.dygraph")
+def disable_static(place=None):
+    from .fluid.dygraph import enable_dygraph
+
+    enable_dygraph(place)

@@ -128,20 +128,26 @@ def _install():
     def transpose(self, perm):
         return _op_out("transpose2", {"X": self}, {"axis": list(perm)})
 
-    def _reduce(op_type):
-        def impl(self, axis=None, dtype=None, keepdim=False):
+    def _reduce(op_type, with_dtype):
+        # paddle 2.x positional signatures: sum(axis, dtype, keepdim) but
+        # mean/max/min(axis, keepdim) — dtype must NOT shift keepdim
+        def impl_dtype(self, axis=None, dtype=None, keepdim=False):
             attrs = {"dim": [] if axis is None else
                      (list(axis) if isinstance(axis, (list, tuple))
                       else [axis]),
                      "keep_dim": keepdim, "reduce_all": axis is None}
             out = trace_op(op_type, {"X": self}, attrs)
             return out.astype(dtype) if dtype is not None else out
-        return impl
 
-    sum = _reduce("reduce_sum")
-    mean = _reduce("reduce_mean")
-    max = _reduce("reduce_max")
-    min = _reduce("reduce_min")
+        def impl(self, axis=None, keepdim=False):
+            return impl_dtype(self, axis, None, keepdim)
+
+        return impl_dtype if with_dtype else impl
+
+    sum = _reduce("reduce_sum", True)
+    mean = _reduce("reduce_mean", False)
+    max = _reduce("reduce_max", False)
+    min = _reduce("reduce_min", False)
 
     def argmax(self, axis=None, keepdim=False, dtype="int64"):
         return trace_op("arg_max", {"X": self},

@@ -87,6 +87,43 @@ def _tracer() -> Optional[Tracer]:
     return framework._dygraph_tracer()
 
 
+@contextlib.contextmanager
+def rng_key_scope(key):
+    """Provide a (possibly traced) PRNG key for random ops executed
+    OUTSIDE a dygraph guard — the functionalization path
+    (paddle_tpu.jit.functional_call under jax.jit), where randomness must
+    come from an explicit key argument to stay pure."""
+    old_key = getattr(_STATE, "func_key", None)
+    old_n = getattr(_STATE, "func_n", 0)
+    _STATE.func_key = key
+    _STATE.func_n = 0
+    try:
+        yield
+    finally:
+        _STATE.func_key = old_key
+        _STATE.func_n = old_n
+
+
+def _next_func_key():
+    """Next key from an active rng_key_scope, else None."""
+    import jax
+
+    key = getattr(_STATE, "func_key", None)
+    if key is None:
+        return None
+    _STATE.func_n = getattr(_STATE, "func_n", 0) + 1
+    return jax.random.fold_in(key, _STATE.func_n)
+
+
+def default_rng_key():
+    """Key for random lowerings when no tracer is active: scope key if
+    provided, else a fixed key (deterministic eager fallback)."""
+    import jax
+
+    k = _next_func_key()
+    return k if k is not None else jax.random.PRNGKey(0)
+
+
 def grad_enabled() -> bool:
     t = _tracer()
     return bool(t and t._has_grad)
@@ -265,8 +302,14 @@ def trace_op(op_type: str, inputs: Dict[str, Any], attrs: Dict[str, Any] = None,
 
     def base_key():
         if "k" not in _key_box:
-            _key_box["k"] = (tracer.next_rng_key() if tracer is not None
-                             else jax.random.PRNGKey(0))
+            # an active rng_key_scope (jit functionalization) outranks
+            # the eager tracer's concrete key stream — a concrete key
+            # would be constant-folded into the compiled step
+            k = _next_func_key()
+            if k is None:
+                k = (tracer.next_rng_key() if tracer is not None
+                     else jax.random.PRNGKey(0))
+            _key_box["k"] = k
         return _key_box["k"]
     op = framework.Operator(None, 0, op_type, {}, {}, attrs)
     ctx = registry.LowerCtx(base_key, block=None)

@@ -15,8 +15,30 @@ import math
 import numpy as np
 
 
+_eager_seed = [2023, 0]  # [base seed, counter] for eager-mode param init
+
+
+def _seed_eager(seed):
+    _eager_seed[0] = int(seed)
+    _eager_seed[1] = 0
+
+
+def _eager_rng(seed_attr=0):
+    if seed_attr:
+        return np.random.RandomState(seed_attr)
+    _eager_seed[1] += 1
+    return np.random.RandomState((_eager_seed[0] * 1000003 + _eager_seed[1])
+                                 % (2**31 - 1))
+
+
 class Initializer:
     def __call__(self, var, block):
+        raise NotImplementedError
+
+    def eager_value(self, shape, dtype="float32"):
+        """Compute the initial value eagerly (dygraph-mode parameter
+        creation; the reference initializes dygraph params by running the
+        same init ops eagerly through the tracer)."""
         raise NotImplementedError
 
 
@@ -31,6 +53,9 @@ class ConstantInitializer(Initializer):
                    "value": float(self.value)},
             infer_shape=False)
 
+    def eager_value(self, shape, dtype="float32"):
+        return np.full(shape, self.value, dtype=dtype)
+
 
 class UniformInitializer(Initializer):
     def __init__(self, low=-1.0, high=1.0, seed=0):
@@ -43,6 +68,10 @@ class UniformInitializer(Initializer):
                    "min": float(self.low), "max": float(self.high),
                    "seed": self.seed},
             infer_shape=False)
+
+    def eager_value(self, shape, dtype="float32"):
+        rng = _eager_rng(self.seed)
+        return rng.uniform(self.low, self.high, size=shape).astype(dtype)
 
 
 class NormalInitializer(Initializer):
@@ -57,6 +86,10 @@ class NormalInitializer(Initializer):
                    "seed": self.seed},
             infer_shape=False)
 
+    def eager_value(self, shape, dtype="float32"):
+        rng = _eager_rng(self.seed)
+        return rng.normal(self.loc, self.scale, size=shape).astype(dtype)
+
 
 class TruncatedNormalInitializer(Initializer):
     def __init__(self, loc=0.0, scale=1.0, seed=0):
@@ -69,6 +102,23 @@ class TruncatedNormalInitializer(Initializer):
                    "mean": float(self.loc), "std": float(self.scale),
                    "seed": self.seed},
             infer_shape=False)
+
+    def eager_value(self, shape, dtype="float32"):
+        rng = _eager_rng(self.seed)
+        a = rng.normal(self.loc, self.scale, size=shape)
+        lo, hi = self.loc - 2 * self.scale, self.loc + 2 * self.scale
+        bad = (a < lo) | (a > hi)
+        while bad.any():
+            a[bad] = rng.normal(self.loc, self.scale, size=int(bad.sum()))
+            bad = (a < lo) | (a > hi)
+        return a.astype(dtype)
+
+
+class _ShapeVar:
+    """Adapter so shape-driven initializers work without a block Variable."""
+
+    def __init__(self, shape):
+        self.shape = list(shape)
 
 
 def _fan_in_out(var):
@@ -102,6 +152,17 @@ class XavierInitializer(Initializer):
             std = math.sqrt(2.0 / (fi + fo))
             NormalInitializer(0.0, std, self.seed)(var, block)
 
+    def eager_value(self, shape, dtype="float32"):
+        fi, fo = _fan_in_out(_ShapeVar(shape))
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        if self.uniform:
+            limit = math.sqrt(6.0 / (fi + fo))
+            return UniformInitializer(-limit, limit,
+                                      self.seed).eager_value(shape, dtype)
+        std = math.sqrt(2.0 / (fi + fo))
+        return NormalInitializer(0.0, std, self.seed).eager_value(shape, dtype)
+
 
 class MSRAInitializer(Initializer):
     def __init__(self, uniform=True, fan_in=None, seed=0,
@@ -118,12 +179,22 @@ class MSRAInitializer(Initializer):
             std = math.sqrt(2.0 / fi)
             NormalInitializer(0.0, std, self.seed)(var, block)
 
+    def eager_value(self, shape, dtype="float32"):
+        fi, _ = _fan_in_out(_ShapeVar(shape))
+        fi = self.fan_in if self.fan_in is not None else fi
+        if self.uniform:
+            limit = math.sqrt(6.0 / fi)
+            return UniformInitializer(-limit, limit,
+                                      self.seed).eager_value(shape, dtype)
+        std = math.sqrt(2.0 / fi)
+        return NormalInitializer(0.0, std, self.seed).eager_value(shape, dtype)
+
 
 class BilinearInitializer(Initializer):
     """For upsample deconv kernels (initializer.py:741 in the reference)."""
 
-    def __call__(self, var, block):
-        shape = var.shape
+    @staticmethod
+    def _weight(shape):
         f = math.ceil(shape[3] / 2.0)
         c = (2 * f - 1 - f % 2) / (2.0 * f)
         weight = np.zeros(shape, dtype="float32")
@@ -132,7 +203,13 @@ class BilinearInitializer(Initializer):
             x = i % size
             y = (i // size) % size
             weight.flat[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
-        NumpyArrayInitializer(weight)(var, block)
+        return weight
+
+    def __call__(self, var, block):
+        NumpyArrayInitializer(self._weight(var.shape))(var, block)
+
+    def eager_value(self, shape, dtype="float32"):
+        return self._weight(shape).astype(dtype)
 
 
 class NumpyArrayInitializer(Initializer):
@@ -145,6 +222,9 @@ class NumpyArrayInitializer(Initializer):
             attrs={"shape": list(self.value.shape), "dtype": var.dtype,
                    "values": self.value},
             infer_shape=False)
+
+    def eager_value(self, shape, dtype="float32"):
+        return self.value.astype(dtype)
 
 
 # Public aliases matching fluid.initializer

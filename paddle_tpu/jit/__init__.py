@@ -1,0 +1,195 @@
+"""`paddle.jit` — dygraph-to-compiled bridge.
+
+Reference: python/paddle/fluid/dygraph/jit.py (`@declarative`,
+`TracedLayer`, jit.py:159) + the dy2static AST transpiler
+(fluid/dygraph/dygraph_to_static/, ProgramTranslator
+program_translator.py:711), whose converted programs execute via the
+`run_program` op (operators/run_program_op.cc:22).
+
+TPU-native re-design: no AST rewriting at all.  The eager engine records
+pure jax calls, so `jax.jit` IS the translator (SURVEY.md §7 step 8
+"dy2static equivalent is mostly free").  The machinery here is
+*functionalization* of stateful Layers:
+
+  functional_state(layer)           -> {name: jnp value} pytree
+  functional_call(layer, state, xs) -> (outputs, new_buffer_state)
+
+`functional_call` temporarily rebinds every Parameter/buffer to the
+(possibly traced) values in `state`, runs forward, and captures buffer
+mutations (e.g. BN running stats) as explicit outputs — converting the
+reference's in-place Scope semantics to XLA's pure-functional contract
+(SURVEY.md §7 "In-place & aliasing semantics").
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+from ..fluid.dygraph.tracer import no_grad
+from ..fluid.dygraph.varbase import Tensor
+
+
+def _named_state_tensors(layer):
+    """(name, Tensor) for every parameter and persistable buffer."""
+    out = []
+    seen = set()
+    for name, p in layer.named_parameters():
+        if id(p) not in seen:
+            seen.add(id(p))
+            out.append((name, p))
+    for name, b in layer.named_buffers():
+        if b is not None and id(b) not in seen:
+            seen.add(id(b))
+            out.append((name, b))
+    return out
+
+
+def functional_state(layer) -> Dict[str, Any]:
+    """Snapshot the layer's parameters+buffers as a jnp-value pytree."""
+    return {name: t._value for name, t in _named_state_tensors(layer)}
+
+
+@contextlib.contextmanager
+def _bound_state(layer, state: Dict[str, Any]):
+    entries = _named_state_tensors(layer)
+    saved = [(t, t._value) for _, t in entries]
+    try:
+        for name, t in entries:
+            if name in state:
+                t._value = state[name]
+        yield entries
+    finally:
+        for t, v in saved:
+            t._value = v
+
+
+def functional_call(layer, state: Dict[str, Any], *args,
+                    **kwargs) -> Tuple[Any, Dict[str, Any]]:
+    """Run `layer(*args)` with parameters/buffers taken from `state`.
+
+    Returns (outputs, new_state) where new_state reflects any buffer
+    mutations (BN running stats).  Pure w.r.t. `state`: safe to call
+    under jax.jit / jax.grad / shard_map with traced state values.
+    Positional args may be jnp values or Tensors.
+    """
+    wrapped = [a if isinstance(a, Tensor) or not _is_arraylike(a)
+               else Tensor(a) for a in args]
+    with no_grad():
+        with _bound_state(layer, state) as entries:
+            out = layer(*wrapped, **kwargs)
+            new_state = {name: t._value for name, t in entries}
+    return _unwrap(out), new_state
+
+
+def _is_arraylike(a):
+    return hasattr(a, "shape") or isinstance(a, (np.ndarray, list))
+
+
+def _unwrap(out):
+    if isinstance(out, Tensor):
+        return out._value
+    if isinstance(out, (list, tuple)):
+        return type(out)(_unwrap(o) for o in out)
+    if isinstance(out, dict):
+        return {k: _unwrap(v) for k, v in out.items()}
+    return out
+
+
+class TracedLayer:
+    """Compiled wrapper produced by `trace` / `to_static`
+    (reference: dygraph/jit.py TracedLayer).
+
+    Calls are jit-compiled once per input-shape signature; parameters are
+    captured from the live layer at call time so `opt.step()` updates are
+    visible without retracing.
+    """
+
+    def __init__(self, layer, training=False):
+        import jax
+
+        self._layer = layer
+        self._training = training
+        self._names = [n for n, _ in _named_state_tensors(layer)]
+
+        def fwd(state, *args):
+            was = layer.training
+            layer.training = training
+            for sub in layer.sublayers():
+                sub.training = training
+            try:
+                out, _ = functional_call(layer, state, *args)
+            finally:
+                layer.training = was
+                for sub in layer.sublayers():
+                    sub.training = was
+            return out
+
+        self._jitted = jax.jit(fwd)
+
+    def __call__(self, *args):
+        state = functional_state(self._layer)
+        vals = [a._value if isinstance(a, Tensor) else a for a in args]
+        out = self._jitted(state, *vals)
+        return _rewrap(out)
+
+    @property
+    def layer(self):
+        return self._layer
+
+
+def _rewrap(out):
+    import jax
+
+    if isinstance(out, jax.Array):
+        return Tensor(out)
+    if isinstance(out, (list, tuple)):
+        return type(out)(_rewrap(o) for o in out)
+    return out
+
+
+def to_static(layer_or_fn=None, input_spec=None, **kwargs):
+    """`@paddle.jit.to_static` (reference: the `@declarative` decorator,
+    dygraph/jit.py:159).  For a Layer returns a TracedLayer; for a
+    function returns a jit-compiled wrapper over eager Tensors."""
+    from ..nn.layer.layers import Layer
+
+    def wrap(target):
+        if isinstance(target, Layer):
+            return TracedLayer(target, training=target.training)
+
+        import jax
+
+        jitted_box = {}
+
+        @functools.wraps(target)
+        def fn(*args):
+            if "f" not in jitted_box:
+                def pure(*vals):
+                    wrapped = [Tensor(v) for v in vals]
+                    return _unwrap(target(*wrapped))
+
+                jitted_box["f"] = jax.jit(pure)
+            vals = [a._value if isinstance(a, Tensor) else np.asarray(a)
+                    for a in args]
+            return _rewrap(jitted_box["f"](*vals))
+
+        return fn
+
+    if layer_or_fn is None:
+        return wrap
+    return wrap(layer_or_fn)
+
+
+declarative = to_static
+
+
+def trace(layer, inputs):
+    """TracedLayer factory (reference: TracedLayer.trace, jit.py)."""
+    traced = TracedLayer(layer, training=False)
+    outs = traced(*inputs) if isinstance(inputs, (list, tuple)) \
+        else traced(inputs)
+    return outs, traced

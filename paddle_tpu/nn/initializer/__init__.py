@@ -1,0 +1,33 @@
+"""`paddle.nn.initializer` — 2.0-style initializer names over the fluid
+initializer implementations (reference:
+python/paddle/nn/initializer/__init__.py)."""
+
+from ...fluid.initializer import (BilinearInitializer as Bilinear,
+                                  ConstantInitializer as Constant,
+                                  MSRAInitializer,
+                                  NormalInitializer as Normal,
+                                  NumpyArrayInitializer as Assign,
+                                  TruncatedNormalInitializer as
+                                  TruncatedNormal,
+                                  UniformInitializer as Uniform,
+                                  XavierInitializer)
+
+
+class KaimingNormal(MSRAInitializer):
+    def __init__(self, fan_in=None, name=None):
+        super().__init__(uniform=False, fan_in=fan_in)
+
+
+class KaimingUniform(MSRAInitializer):
+    def __init__(self, fan_in=None, name=None):
+        super().__init__(uniform=True, fan_in=fan_in)
+
+
+class XavierNormal(XavierInitializer):
+    def __init__(self, fan_in=None, fan_out=None, name=None):
+        super().__init__(uniform=False, fan_in=fan_in, fan_out=fan_out)
+
+
+class XavierUniform(XavierInitializer):
+    def __init__(self, fan_in=None, fan_out=None, name=None):
+        super().__init__(uniform=True, fan_in=fan_in, fan_out=fan_out)

@@ -1,0 +1,118 @@
+"""Convolution layers (reference: python/paddle/nn/layer/conv.py; ops
+conv2d/conv3d/conv2d_transpose, operators/conv_op.cc)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...fluid.initializer import MSRAInitializer
+from .. import functional as F
+from .layers import Layer
+
+
+def _ntuple(v, n):
+    if isinstance(v, (list, tuple)):
+        return list(v)
+    return [v] * n
+
+
+class _ConvNd(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride,
+                 padding, dilation, groups, padding_mode, weight_attr,
+                 bias_attr, data_format, dims, transposed=False,
+                 output_padding=0):
+        super().__init__()
+        assert in_channels % groups == 0
+        self._in_channels = in_channels
+        self._out_channels = out_channels
+        self._kernel_size = _ntuple(kernel_size, dims)
+        self._stride = _ntuple(stride, dims)
+        self._padding = padding
+        self._dilation = _ntuple(dilation, dims)
+        self._groups = groups
+        self._data_format = data_format
+        self._output_padding = output_padding
+        if transposed:
+            filter_shape = [in_channels, out_channels // groups] \
+                + self._kernel_size
+        else:
+            filter_shape = [out_channels, in_channels // groups] \
+                + self._kernel_size
+        fan_in = (in_channels // groups) * int(np.prod(self._kernel_size))
+        self.weight = self.create_parameter(
+            shape=filter_shape, attr=weight_attr,
+            default_initializer=MSRAInitializer(uniform=True, fan_in=fan_in))
+        self.bias = self.create_parameter(
+            shape=[out_channels], attr=bias_attr, is_bias=True)
+
+    def extra_repr(self):
+        return (f"{self._in_channels}, {self._out_channels}, "
+                f"kernel_size={self._kernel_size}, stride={self._stride}")
+
+
+class Conv2D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NCHW"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, padding_mode,
+                         weight_attr, bias_attr, data_format, dims=2)
+
+    def forward(self, x):
+        return F.conv2d(x, self.weight, self.bias, self._stride,
+                        self._padding, self._dilation, self._groups,
+                        self._data_format)
+
+
+class Conv2DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None, data_format="NCHW"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, "zeros", weight_attr,
+                         bias_attr, data_format, dims=2, transposed=True,
+                         output_padding=output_padding)
+
+    def forward(self, x, output_size=None):
+        return F.conv2d_transpose(
+            x, self.weight, self.bias, self._stride, self._padding,
+            self._output_padding, self._dilation, self._groups,
+            output_size, self._data_format)
+
+
+class Conv3D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, padding_mode,
+                         weight_attr, bias_attr, data_format, dims=3)
+
+    def forward(self, x):
+        return F.conv3d(x, self.weight, self.bias, self._stride,
+                        self._padding, self._dilation, self._groups,
+                        self._data_format)
+
+
+class Conv1D(_ConvNd):
+    """Conv1D via a squeeze/expand around conv2d (the reference lowers
+    conv1d the same way, nn/layer/conv.py Conv1D)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NCL"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, padding_mode,
+                         weight_attr, bias_attr, data_format, dims=1)
+
+    def forward(self, x):
+        from ...fluid.dygraph.tracer import trace_fn
+        import jax.numpy as jnp
+
+        w2 = trace_fn(lambda w: jnp.expand_dims(w, 2), {"w": self.weight})
+        x2 = trace_fn(lambda x: jnp.expand_dims(x, 2), {"x": x})
+        pad = self._padding
+        pad2 = [0, pad] if isinstance(pad, int) else [0] + list(pad)
+        out = F.conv2d(x2, w2, self.bias, [1] + self._stride, pad2,
+                       [1] + self._dilation, self._groups)
+        return trace_fn(lambda x: jnp.squeeze(x, 2), {"x": out})

@@ -1,0 +1,401 @@
+"""Recurrent layers (reference: python/paddle/nn/layer/rnn.py —
+RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, SimpleRNN, LSTM, GRU;
+C++ ops operators/lstm_op.cc, gru_op.cc, recurrent_op.cc).
+
+TPU-native re-design: the reference runs RNNs either as monolithic
+CPU/cuDNN kernels or as a `recurrent` sub-block interpreted step-by-step.
+Here the whole sequence loop is ONE `jax.lax.scan` inside a single traced
+function, so XLA compiles the time loop with static shapes — the
+compiler-friendly control-flow idiom (SURVEY.md §7 "Control flow
+lowering").
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ...fluid.dygraph.tracer import trace_fn
+from ...fluid.initializer import UniformInitializer
+from .layers import Layer
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32",
+                           init_value=0.0):
+        from ...fluid.dygraph.varbase import Tensor
+
+        batch = batch_ref.shape[0]
+        shape = shape or self.state_shape
+        if isinstance(shape, (list, tuple)) and isinstance(
+                shape[0], (list, tuple)):
+            return tuple(
+                Tensor(np.full([batch] + list(s), init_value, np.float32))
+                for s in shape)
+        return Tensor(np.full([batch] + list(shape), init_value, np.float32))
+
+
+def _std_uniform(hidden_size):
+    std = 1.0 / math.sqrt(hidden_size)
+    return UniformInitializer(-std, std)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        init = _std_uniform(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [hidden_size, input_size], weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=init)
+        self.hidden_size = hidden_size
+        self.activation = activation
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        import jax.numpy as jnp
+
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else lambda x: jnp.maximum(x, 0)
+
+        def f(x, h, wi, wh, bi, bh):
+            return act(x @ wi.T + bi + h @ wh.T + bh)
+
+        h = trace_fn(f, {"x": inputs, "h": states, "wi": self.weight_ih,
+                         "wh": self.weight_hh, "bi": self.bias_ih,
+                         "bh": self.bias_hh})
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        init = _std_uniform(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [4 * hidden_size, input_size], weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [4 * hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [4 * hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [4 * hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=init)
+        self.hidden_size = hidden_size
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h, c = states
+        h_new, c_new = trace_fn(
+            _lstm_step, {"x": inputs, "h": h, "c": c,
+                         "wi": self.weight_ih, "wh": self.weight_hh,
+                         "bi": self.bias_ih, "bh": self.bias_hh})
+        return h_new, (h_new, c_new)
+
+
+def _lstm_step(x, h, c, wi, wh, bi, bh):
+    import jax
+    import jax.numpy as jnp
+
+    gates = x @ wi.T + bi + h @ wh.T + bh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i = jax.nn.sigmoid(i)
+    f = jax.nn.sigmoid(f)
+    g = jnp.tanh(g)
+    o = jax.nn.sigmoid(o)
+    c_new = f * c + i * g
+    h_new = o * jnp.tanh(c_new)
+    return h_new, c_new
+
+
+def _gru_step(x, h, wi, wh, bi, bh):
+    import jax
+    import jax.numpy as jnp
+
+    gi = x @ wi.T + bi
+    gh = h @ wh.T + bh
+    ir, iz, ic = jnp.split(gi, 3, axis=-1)
+    hr, hz, hc = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(ir + hr)
+    z = jax.nn.sigmoid(iz + hz)
+    n = jnp.tanh(ic + r * hc)
+    return (1 - z) * n + z * h
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        init = _std_uniform(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [3 * hidden_size, input_size], weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [3 * hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [3 * hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [3 * hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=init)
+        self.hidden_size = hidden_size
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h = trace_fn(_gru_step, {"x": inputs, "h": states,
+                                 "wi": self.weight_ih, "wh": self.weight_hh,
+                                 "bi": self.bias_ih, "bh": self.bias_hh})
+        return h, h
+
+
+class _ScanRNNBase(Layer):
+    """Multi-layer (optionally bidirectional) scan-based recurrence.
+
+    mode in {"LSTM", "GRU", "RNN_TANH", "RNN_RELU"}; weights per
+    (layer, direction) follow the cell layout above."""
+
+    GATES = {"LSTM": 4, "GRU": 3, "RNN_TANH": 1, "RNN_RELU": 1}
+
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        ndir = 2 if self.bidirect else 1
+        g = self.GATES[mode]
+        init = _std_uniform(hidden_size)
+        self._all_weights = []
+        for layer in range(num_layers):
+            for d in range(ndir):
+                in_sz = input_size if layer == 0 else hidden_size * ndir
+                wi = self.create_parameter([g * hidden_size, in_sz],
+                                           weight_ih_attr,
+                                           default_initializer=init)
+                wh = self.create_parameter([g * hidden_size, hidden_size],
+                                           weight_hh_attr,
+                                           default_initializer=init)
+                bi = self.create_parameter([g * hidden_size], bias_ih_attr,
+                                           is_bias=True,
+                                           default_initializer=init)
+                bh = self.create_parameter([g * hidden_size], bias_hh_attr,
+                                           is_bias=True,
+                                           default_initializer=init)
+                suffix = f"{layer}" + ("_reverse" if d else "")
+                self.add_parameter(f"weight_ih_l{suffix}", wi)
+                self.add_parameter(f"weight_hh_l{suffix}", wh)
+                self.add_parameter(f"bias_ih_l{suffix}", bi)
+                self.add_parameter(f"bias_hh_l{suffix}", bh)
+                self._all_weights.append((wi, wh, bi, bh))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        import jax
+        import jax.numpy as jnp
+
+        mode = self.mode
+        nl, ndir = self.num_layers, 2 if self.bidirect else 1
+        hs = self.hidden_size
+        time_major = self.time_major
+        is_lstm = mode == "LSTM"
+        dropout = self.dropout if self.training else 0.0
+
+        ins = {"x": inputs}
+        for i, (wi, wh, bi, bh) in enumerate(self._all_weights):
+            ins[f"wi{i}"] = wi
+            ins[f"wh{i}"] = wh
+            ins[f"bi{i}"] = bi
+            ins[f"bh{i}"] = bh
+        if initial_states is not None:
+            if is_lstm:
+                ins["h0"], ins["c0"] = initial_states
+            else:
+                ins["h0"] = initial_states
+
+        def run(x, h0=None, c0=None, **w):
+            if not time_major:
+                x = jnp.swapaxes(x, 0, 1)  # (T, B, C)
+            batch = x.shape[1]
+            if h0 is None:
+                h0 = jnp.zeros((nl * ndir, batch, hs), x.dtype)
+                c0 = jnp.zeros((nl * ndir, batch, hs), x.dtype)
+            hs_out, cs_out = [], []
+            for layer in range(nl):
+                outs = []
+                for d in range(ndir):
+                    idx = layer * ndir + d
+                    wi, wh, bi, bh = (w[f"wi{idx}"], w[f"wh{idx}"],
+                                      w[f"bi{idx}"], w[f"bh{idx}"])
+                    xs = jnp.flip(x, 0) if d else x
+
+                    if is_lstm:
+                        def step(carry, xt, wi=wi, wh=wh, bi=bi, bh=bh):
+                            h, c = carry
+                            h2, c2 = _lstm_step(xt, h, c, wi, wh, bi, bh)
+                            return (h2, c2), h2
+
+                        (hT, cT), ys = jax.lax.scan(
+                            step, (h0[idx], c0[idx] if c0 is not None
+                                   else jnp.zeros_like(h0[idx])), xs)
+                        cs_out.append(cT)
+                    elif mode == "GRU":
+                        def step(h, xt, wi=wi, wh=wh, bi=bi, bh=bh):
+                            h2 = _gru_step(xt, h, wi, wh, bi, bh)
+                            return h2, h2
+
+                        hT, ys = jax.lax.scan(step, h0[idx], xs)
+                    else:
+                        act = (jnp.tanh if mode == "RNN_TANH"
+                               else jax.nn.relu)
+
+                        def step(h, xt, wi=wi, wh=wh, bi=bi, bh=bh, act=act):
+                            h2 = act(xt @ wi.T + bi + h @ wh.T + bh)
+                            return h2, h2
+
+                        hT, ys = jax.lax.scan(step, h0[idx], xs)
+                    hs_out.append(hT)
+                    outs.append(jnp.flip(ys, 0) if d else ys)
+                x = jnp.concatenate(outs, axis=-1) if ndir == 2 else outs[0]
+                if dropout and layer < nl - 1:
+                    # a fixed-key dropout between layers (training only)
+                    key = jax.random.PRNGKey(layer)
+                    keep = 1.0 - dropout
+                    x = jnp.where(jax.random.bernoulli(key, keep, x.shape),
+                                  x / keep, 0.0)
+            y = x if time_major else jnp.swapaxes(x, 0, 1)
+            h_all = jnp.stack(hs_out, 0)
+            if is_lstm:
+                return y, h_all, jnp.stack(cs_out, 0)
+            return y, h_all
+
+        out = trace_fn(run, ins, multi_out=True)
+        return out
+
+    def __call__(self, inputs, initial_states=None, sequence_length=None):
+        # bypass Layer.__call__'s single-output assumption cleanly
+        for hook in self._forward_pre_hooks.values():
+            hook(self, (inputs,))
+        outs = self.forward(inputs, initial_states, sequence_length)
+        if isinstance(outs, (list, tuple)) and len(outs) == 3:
+            y, h, c = outs
+            return y, (h, c)
+        if isinstance(outs, (list, tuple)) and len(outs) == 2:
+            return outs[0], outs[1]
+        return outs
+
+
+class LSTM(_ScanRNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 **kw):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)
+
+
+class GRU(_ScanRNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 **kw):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)
+
+
+class SimpleRNN(_ScanRNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kw):
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(mode, input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)
+
+
+class RNN(Layer):
+    """Wraps a cell into a scan over time
+    (reference: nn/layer/rnn.py RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        import jax.numpy as jnp
+
+        steps = inputs.shape[0 if self.time_major else 1]
+        outputs = []
+        states = initial_states
+        idxs = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        for t in idxs:
+            xt = inputs[:, t] if not self.time_major else inputs[t]
+            out, states = self.cell(xt, states)
+            outputs.append(out)
+        if self.is_reverse:
+            outputs = outputs[::-1]
+        from ...fluid.dygraph.tracer import trace_fn
+
+        axis = 0 if self.time_major else 1
+        n = len(outputs)
+
+        def stack(**kw):
+            return jnp.stack([kw[f"x{i}"] for i in range(n)], axis=axis)
+
+        y = trace_fn(stack, {f"x{i}": o for i, o in enumerate(outputs)})
+        return y, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        import jax.numpy as jnp
+
+        from ...fluid.dygraph.tracer import trace_fn
+
+        sf = sb = None
+        if initial_states is not None:
+            sf, sb = initial_states
+        yf, stf = self.rnn_fw(inputs, sf)
+        yb, stb = self.rnn_bw(inputs, sb)
+        y = trace_fn(lambda a, b: jnp.concatenate([a, b], -1),
+                     {"a": yf, "b": yb})
+        return y, (stf, stb)

@@ -1,0 +1,11 @@
+"""Pallas TPU kernels for the hot ops where XLA fusion isn't enough
+(SURVEY.md §7 "op → lowering rule registry ... Pallas kernels for the hot
+few").  Each module exposes a jax-level function with an XLA fallback so
+the same API works on CPU test meshes.
+
+The reference implements these as hand-written CUDA in
+paddle/fluid/operators/fused/ (multihead_matmul_op.cu, fused layernorm,
+optimizer kernels); here they are Mosaic/Pallas kernels tiled for the MXU.
+"""
+
+from . import attention  # noqa: F401

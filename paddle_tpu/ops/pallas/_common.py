@@ -1,0 +1,24 @@
+"""Shared helpers for pallas kernels."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+@functools.cache
+def default_backend() -> str:
+    return jax.default_backend()
+
+
+def on_tpu() -> bool:
+    return default_backend() == "tpu"
+
+
+def cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def round_up(a: int, b: int) -> int:
+    return cdiv(a, b) * b

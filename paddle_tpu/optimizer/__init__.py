@@ -1,0 +1,463 @@
+"""`paddle.optimizer` — dygraph optimizers over eager Parameters
+(reference: python/paddle/optimizer/ — optimizer.py Optimizer base,
+adam.py, adamw.py, momentum.py, lamb.py, rmsprop.py, adagrad.py...;
+C++ kernels operators/optimizers/*.cc).
+
+TPU-native re-design: instead of one optimizer *op* per parameter
+appended to a program, each step gathers (params, grads, state) pytrees
+and applies ONE jitted pure update function — a single fused XLA
+computation per step (donated buffers, no per-op dispatch), the analogue
+of the reference's fuse_optimizer_ops pass
+(framework/ir/fuse_optimizer_ops_pass/) being always-on.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..fluid.dygraph.varbase import Tensor
+from . import lr as lr_module
+from .lr import LRScheduler
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax",
+           "Adagrad", "Adadelta", "RMSProp", "Lamb", "lr"]
+
+lr = lr_module
+
+
+def _global_norm_clip(grads, clip_norm):
+    import jax.numpy as jnp
+
+    gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                         for g in grads))
+    scale = jnp.minimum(1.0, clip_norm / jnp.maximum(gnorm, 1e-6))
+    return [g * scale.astype(g.dtype) for g in grads]
+
+
+class ClipGradByGlobalNorm:
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+
+    def _apply(self, grads):
+        return _global_norm_clip(grads, self.clip_norm)
+
+
+class ClipGradByNorm:
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _apply(self, grads):
+        import jax.numpy as jnp
+
+        out = []
+        for g in grads:
+            n = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(n, 1e-6))
+            out.append(g * scale.astype(g.dtype))
+        return out
+
+
+class ClipGradByValue:
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def _apply(self, grads):
+        import jax.numpy as jnp
+
+        return [jnp.clip(g, self.min, self.max) for g in grads]
+
+
+class Optimizer:
+    """Base optimizer (reference: python/paddle/optimizer/optimizer.py).
+
+    Subclasses define `_init_state(param) -> dict[str, array]` and
+    `_update(p, g, state, lr, t) -> (new_p, new_state)` as pure jnp
+    functions; `step()` jit-compiles the whole multi-parameter update
+    once per (structure, dtype) signature.
+    """
+
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 multi_precision=False):
+        self._parameter_list = list(parameters) if parameters is not None \
+            else None
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        if isinstance(weight_decay, float):
+            self._l2_coef = weight_decay
+            self._coupled_decay = True
+        else:
+            self._l2_coef = 0.0
+            self._coupled_decay = False
+        self._state: Dict[int, dict] = {}
+        self._step_count = 0
+        self._jit_update = None
+
+    # -- lr ------------------------------------------------------------------
+    def get_lr(self):
+        if isinstance(self._learning_rate, LRScheduler):
+            return self._learning_rate()
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    # -- state ---------------------------------------------------------------
+    def _init_state(self, param) -> dict:
+        return {}
+
+    def _update(self, p, g, state, lr, t, wd=0.0):
+        raise NotImplementedError
+
+    def _param_state(self, param):
+        key = id(param)
+        if key not in self._state:
+            self._state[key] = self._init_state(param)
+        return self._state[key]
+
+    def _decay_coef(self, param) -> float:
+        """Per-parameter weight-decay coefficient (host-side; passed into
+        the jitted update as a scalar).  Base class: the coupled-L2
+        `weight_decay` float applied uniformly."""
+        return self._l2_coef
+
+    # -- step ----------------------------------------------------------------
+    def step(self):
+        import jax
+        import jax.numpy as jnp
+
+        params = [p for p in self._parameter_list
+                  if p.trainable and p._grad is not None]
+        if not params:
+            return
+        grads = [p._grad for p in params]
+        if self._grad_clip is not None:
+            grads = self._grad_clip._apply(grads)
+
+        states = [self._param_state(p) for p in params]
+        lr_val = jnp.float32(self.get_lr())
+        self._step_count += 1
+        t = jnp.int32(self._step_count)
+
+        if self._jit_update is None:
+            coupled = self._coupled_decay
+            update = self._update
+
+            def apply_all(params_v, grads_v, states_v, lr_s, t_s, lrm, wd):
+                new_p, new_s = [], []
+                for p, g, s, m, w in zip(params_v, grads_v, states_v, lrm,
+                                         wd):
+                    g = g.astype(jnp.float32)
+                    if coupled:
+                        g = g + w * p.astype(jnp.float32)
+                    p2, s2 = update(p, g, s, lr_s * m, t_s, wd=w)
+                    new_p.append(p2.astype(p.dtype))
+                    new_s.append(s2)
+                return new_p, new_s
+
+            self._jit_update = jax.jit(apply_all, donate_argnums=(0, 2))
+
+        params_v = [p._value for p in params]
+        # per-param lr multipliers (ParamAttr.learning_rate) scale the
+        # STEP, not the gradient — scaling g would be a no-op under
+        # adaptive optimizers
+        lrm = [jnp.float32(p.optimize_attr.get("learning_rate", 1.0))
+               for p in params]
+        wd = [jnp.float32(self._decay_coef(p)) for p in params]
+        new_params, new_states = self._jit_update(params_v, grads, states,
+                                                  lr_val, t, lrm, wd)
+        for p, np_, s_new in zip(params, new_params, new_states):
+            p._value = np_
+        for p, s_new in zip(params, new_states):
+            self._state[id(p)] = s_new
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list or []:
+            p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        if loss._grad_node is not None and all(
+                p._grad is None for p in self._parameter_list):
+            loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in self._parameter_list]
+
+    # -- checkpointing -------------------------------------------------------
+    def state_dict(self):
+        sd = {}
+        for p in self._parameter_list or []:
+            st = self._state.get(id(p))
+            if st:
+                for k, v in st.items():
+                    sd[f"{p.name}_{k}"] = Tensor(v)
+        sd["global_step"] = self._step_count
+        if isinstance(self._learning_rate, LRScheduler):
+            sd["LR_Scheduler"] = self._learning_rate.state_dict()
+        return sd
+
+    def set_state_dict(self, state_dict):
+        import jax.numpy as jnp
+
+        self._step_count = int(state_dict.get("global_step", 0))
+        if "LR_Scheduler" in state_dict and isinstance(
+                self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        for p in self._parameter_list or []:
+            st = self._param_state(p)
+            for k in list(st):
+                key = f"{p.name}_{k}"
+                if key in state_dict:
+                    v = state_dict[key]
+                    st[k] = jnp.asarray(
+                        v.numpy() if isinstance(v, Tensor) else v)
+
+    set_dict = set_state_dict
+
+
+class SGD(Optimizer):
+    def _update(self, p, g, state, lr, t, wd=0.0):
+        import jax.numpy as jnp
+
+        return p.astype(jnp.float32) - lr * g, state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_state(self, param):
+        import jax.numpy as jnp
+
+        return {"velocity": jnp.zeros(param._value.shape, jnp.float32)}
+
+    def _update(self, p, g, state, lr, t, wd=0.0):
+        import jax.numpy as jnp
+
+        v = self._momentum * state["velocity"] + g
+        if self._nesterov:
+            new_p = p.astype(jnp.float32) - lr * (g + self._momentum * v)
+        else:
+            new_p = p.astype(jnp.float32) - lr * v
+        return new_p, {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, name=None,
+                 multi_precision=False, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _init_state(self, param):
+        import jax.numpy as jnp
+
+        shape = param._value.shape
+        return {"moment1": jnp.zeros(shape, jnp.float32),
+                "moment2": jnp.zeros(shape, jnp.float32)}
+
+    def _update(self, p, g, state, lr, t, wd=0.0):
+        import jax.numpy as jnp
+
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        m = b1 * state["moment1"] + (1 - b1) * g
+        v = b2 * state["moment2"] + (1 - b2) * jnp.square(g)
+        tf = t.astype(jnp.float32)
+        mhat = m / (1 - jnp.power(b1, tf))
+        vhat = v / (1 - jnp.power(b2, tf))
+        new_p = p.astype(jnp.float32) - lr * mhat / (jnp.sqrt(vhat) + eps)
+        return new_p, {"moment1": m, "moment2": v}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 grad_clip=None, lazy_mode=False, apply_decay_param_fun=None,
+                 name=None, **kw):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, name)
+        self._wd = weight_decay if isinstance(weight_decay, float) else 0.01
+        self._decay_fn = apply_decay_param_fun
+
+    def _decay_coef(self, param):
+        if self._decay_fn is not None and not self._decay_fn(param.name):
+            return 0.0
+        return self._wd
+
+    def _update(self, p, g, state, lr, t, wd=0.0):
+        import jax.numpy as jnp
+
+        new_p, new_s = super()._update(p, g, state, lr, t)
+        new_p = new_p - lr * wd * p.astype(jnp.float32)
+        return new_p, new_s
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _init_state(self, param):
+        import jax.numpy as jnp
+
+        shape = param._value.shape
+        return {"moment": jnp.zeros(shape, jnp.float32),
+                "inf_norm": jnp.zeros(shape, jnp.float32)}
+
+    def _update(self, p, g, state, lr, t, wd=0.0):
+        import jax.numpy as jnp
+
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        m = b1 * state["moment"] + (1 - b1) * g
+        u = jnp.maximum(b2 * state["inf_norm"], jnp.abs(g))
+        tf = t.astype(jnp.float32)
+        new_p = p.astype(jnp.float32) - (
+            lr / (1 - jnp.power(b1, tf))) * m / (u + eps)
+        return new_p, {"moment": m, "inf_norm": u}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 initial_accumulator_value=0.0, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_state(self, param):
+        import jax.numpy as jnp
+
+        return {"moment": jnp.full(param._value.shape, self._init_acc,
+                                   jnp.float32)}
+
+    def _update(self, p, g, state, lr, t, wd=0.0):
+        import jax.numpy as jnp
+
+        acc = state["moment"] + jnp.square(g)
+        new_p = p.astype(jnp.float32) - lr * g / (jnp.sqrt(acc) + self._eps)
+        return new_p, {"moment": acc}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._eps, self._rho = epsilon, rho
+
+    def _init_state(self, param):
+        import jax.numpy as jnp
+
+        shape = param._value.shape
+        return {"avg_squared_grad": jnp.zeros(shape, jnp.float32),
+                "avg_squared_update": jnp.zeros(shape, jnp.float32)}
+
+    def _update(self, p, g, state, lr, t, wd=0.0):
+        import jax.numpy as jnp
+
+        rho, eps = self._rho, self._eps
+        ag = rho * state["avg_squared_grad"] + (1 - rho) * jnp.square(g)
+        upd = g * jnp.sqrt(state["avg_squared_update"] + eps) / \
+            jnp.sqrt(ag + eps)
+        au = rho * state["avg_squared_update"] + (1 - rho) * jnp.square(upd)
+        new_p = p.astype(jnp.float32) - lr * upd
+        return new_p, {"avg_squared_grad": ag, "avg_squared_update": au}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._rho, self._eps = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _init_state(self, param):
+        import jax.numpy as jnp
+
+        shape = param._value.shape
+        return {"mean_square": jnp.zeros(shape, jnp.float32),
+                "mean_grad": jnp.zeros(shape, jnp.float32),
+                "momentum": jnp.zeros(shape, jnp.float32)}
+
+    def _update(self, p, g, state, lr, t, wd=0.0):
+        import jax.numpy as jnp
+
+        rho, eps = self._rho, self._eps
+        ms = rho * state["mean_square"] + (1 - rho) * jnp.square(g)
+        mg = state["mean_grad"]
+        if self._centered:
+            mg = rho * mg + (1 - rho) * g
+            denom = jnp.sqrt(ms - jnp.square(mg) + eps)
+        else:
+            denom = jnp.sqrt(ms + eps)
+        mom = self._momentum * state["momentum"] + lr * g / denom
+        new_p = p.astype(jnp.float32) - mom
+        return new_p, {"mean_square": ms, "mean_grad": mg, "momentum": mom}
+
+
+class Lamb(Optimizer):
+    """Layer-adaptive large-batch optimizer
+    (reference: optimizer/lamb.py; operators/optimizers/lamb_op.cc)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _decay_coef(self, param):
+        if self._exclude_fn is not None and self._exclude_fn(param.name):
+            return 0.0
+        return self._lamb_wd
+
+    def _init_state(self, param):
+        import jax.numpy as jnp
+
+        shape = param._value.shape
+        return {"moment1": jnp.zeros(shape, jnp.float32),
+                "moment2": jnp.zeros(shape, jnp.float32)}
+
+    def _update(self, p, g, state, lr, t, wd=0.0):
+        import jax.numpy as jnp
+
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        pf = p.astype(jnp.float32)
+        m = b1 * state["moment1"] + (1 - b1) * g
+        v = b2 * state["moment2"] + (1 - b2) * jnp.square(g)
+        tf = t.astype(jnp.float32)
+        mhat = m / (1 - jnp.power(b1, tf))
+        vhat = v / (1 - jnp.power(b2, tf))
+        r = mhat / (jnp.sqrt(vhat) + eps) + wd * pf
+        w_norm = jnp.linalg.norm(pf)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        new_p = pf - lr * trust * r
+        return new_p, {"moment1": m, "moment2": v}

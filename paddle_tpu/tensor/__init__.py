@@ -1,0 +1,665 @@
+"""`paddle.tensor` — tensor creation / math / manipulation / search API
+on eager Tensors (reference: python/paddle/tensor/{creation,math,
+manipulation,search,logic,linalg,random,stat}.py, each dispatching to
+`core.ops.*` in dygraph mode).
+
+Every function here is a thin wrapper over one registered op lowering
+(trace_op) or one fused jax function (trace_fn) — the eager fast path;
+under `jax.jit` these trace to pure XLA.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..fluid import core
+from ..fluid.dygraph.tracer import trace_fn, trace_op
+from ..fluid.dygraph.varbase import Tensor
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+# -- creation -----------------------------------------------------------------
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    return Tensor(data, dtype=dtype, stop_gradient=stop_gradient)
+
+
+def zeros(shape, dtype="float32", name=None):
+    return trace_op("fill_constant", {},
+                    {"shape": list(shape), "dtype": dtype, "value": 0.0})
+
+
+def ones(shape, dtype="float32", name=None):
+    return trace_op("fill_constant", {},
+                    {"shape": list(shape), "dtype": dtype, "value": 1.0})
+
+
+def full(shape, fill_value, dtype="float32", name=None):
+    return trace_op("fill_constant", {},
+                    {"shape": list(shape), "dtype": dtype,
+                     "value": float(fill_value)})
+
+
+def zeros_like(x, dtype=None, name=None):
+    return trace_op("fill_any_like", {"X": x},
+                    {"value": 0.0, "dtype": dtype})
+
+
+def ones_like(x, dtype=None, name=None):
+    return trace_op("fill_any_like", {"X": x},
+                    {"value": 1.0, "dtype": dtype})
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return trace_op("fill_any_like", {"X": x},
+                    {"value": float(fill_value), "dtype": dtype})
+
+
+def arange(start=0, end=None, step=1, dtype="int64", name=None):
+    if end is None:
+        start, end = 0, start
+    return trace_op("range", {"Start": Tensor(start, dtype=dtype),
+                              "End": Tensor(end, dtype=dtype),
+                              "Step": Tensor(step, dtype=dtype)}, {})
+
+
+def linspace(start, stop, num, dtype="float32", name=None):
+    return trace_op("linspace", {"Start": Tensor(start, dtype=dtype),
+                                 "Stop": Tensor(stop, dtype=dtype),
+                                 "Num": Tensor(num, dtype="int32")},
+                    {"dtype": dtype})
+
+
+def eye(num_rows, num_columns=None, dtype="float32", name=None):
+    return trace_op("eye", {}, {"num_rows": num_rows,
+                                "num_columns": num_columns or num_rows,
+                                "dtype": dtype})
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    return trace_op("diag_v2", {"X": x},
+                    {"offset": offset, "padding_value": padding_value})
+
+
+def empty(shape, dtype="float32", name=None):
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def assign(x, output=None):
+    out = trace_op("assign", {"X": x}, {})
+    if output is not None:
+        output.set_value(out.numpy())
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return assign(x)
+
+
+def numel(x, name=None):
+    return Tensor(np.int64(int(np.prod(x.shape))))
+
+
+def tril(x, diagonal=0, name=None):
+    return trace_op("tril_triu", {"X": x},
+                    {"diagonal": diagonal, "lower": True})
+
+
+def triu(x, diagonal=0, name=None):
+    return trace_op("tril_triu", {"X": x},
+                    {"diagonal": diagonal, "lower": False})
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    return trace_op("meshgrid", {"X": list(args)}, {}, multi_out=True)["Out"]
+
+
+# -- random -------------------------------------------------------------------
+
+def rand(shape, dtype="float32", name=None):
+    return trace_op("uniform_random", {},
+                    {"shape": list(shape), "dtype": dtype, "min": 0.0,
+                     "max": 1.0})
+
+
+def randn(shape, dtype="float32", name=None):
+    return trace_op("gaussian_random", {},
+                    {"shape": list(shape), "dtype": dtype, "mean": 0.0,
+                     "std": 1.0})
+
+
+def uniform(shape, dtype="float32", min=-1.0, max=1.0, seed=0, name=None):
+    return trace_op("uniform_random", {},
+                    {"shape": list(shape), "dtype": dtype,
+                     "min": float(min), "max": float(max), "seed": seed})
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    return trace_op("gaussian_random", {},
+                    {"shape": list(shape), "dtype": "float32",
+                     "mean": float(mean), "std": float(std)})
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return trace_op("randint", {}, {"shape": list(shape), "dtype": dtype,
+                                    "low": low, "high": high})
+
+
+def randperm(n, dtype="int64", name=None):
+    return trace_op("randperm", {}, {"n": n, "dtype": dtype})
+
+
+def bernoulli(x, name=None):
+    return trace_op("bernoulli", {"X": x}, {})
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    return trace_op("multinomial", {"X": x},
+                    {"num_samples": num_samples, "replacement": replacement})
+
+
+def seed(value):
+    from ..fluid.dygraph.tracer import manual_seed
+    from ..fluid.initializer import _seed_eager
+
+    manual_seed(value)
+    _seed_eager(value)
+
+
+# -- math ---------------------------------------------------------------------
+
+def _binop(op_type):
+    def fn(x, y, name=None):
+        return trace_op(op_type, {"X": x, "Y": y}, {})
+
+    return fn
+
+
+add = _binop("elementwise_add")
+subtract = _binop("elementwise_sub")
+multiply = _binop("elementwise_mul")
+divide = _binop("elementwise_div")
+remainder = mod = _binop("elementwise_mod")
+floor_divide = _binop("elementwise_floordiv")
+minimum = _binop("elementwise_min")
+maximum = _binop("elementwise_max")
+pow_ = _binop("elementwise_pow")
+
+
+def pow(x, y, name=None):
+    if isinstance(y, (int, float)):
+        return trace_op("pow", {"X": x}, {"factor": float(y)})
+    return pow_(x, y)
+
+
+def _unop(op_type):
+    def fn(x, name=None):
+        return trace_op(op_type, {"X": x}, {})
+
+    return fn
+
+
+for _name in ["exp", "log", "log2", "log10", "log1p", "sqrt", "rsqrt",
+              "abs", "ceil", "floor", "round", "sin", "cos", "tan", "asin",
+              "acos", "atan", "sinh", "cosh", "tanh", "reciprocal", "square",
+              "sign", "erf", "expm1"]:
+    globals()[_name] = _unop(_name)
+
+
+def _make_reduce(op_type):
+    def fn(x, axis=None, keepdim=False, name=None):
+        if axis is None:
+            dim, reduce_all = [], True
+        else:
+            dim = [axis] if isinstance(axis, int) else list(axis)
+            reduce_all = False
+        return trace_op(op_type, {"X": x},
+                        {"dim": dim, "keep_dim": keepdim,
+                         "reduce_all": reduce_all})
+
+    return fn
+
+
+sum = _make_reduce("reduce_sum")
+mean = _make_reduce("reduce_mean")
+max = _make_reduce("reduce_max")
+min = _make_reduce("reduce_min")
+prod = _make_reduce("reduce_prod")
+any = _make_reduce("reduce_any")
+all = _make_reduce("reduce_all")
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    jnp = _jnp()
+
+    def f(x):
+        return jnp.std(x, axis=axis, ddof=1 if unbiased else 0,
+                       keepdims=keepdim)
+
+    return trace_fn(f, {"x": x})
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    jnp = _jnp()
+
+    def f(x):
+        return jnp.var(x, axis=axis, ddof=1 if unbiased else 0,
+                       keepdims=keepdim)
+
+    return trace_fn(f, {"x": x})
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    jnp = _jnp()
+    return trace_fn(lambda x: jnp.median(x, axis=axis, keepdims=keepdim),
+                    {"x": x})
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return trace_op("logsumexp", {"X": x},
+                    {"axis": [] if axis is None else (
+                        [axis] if isinstance(axis, int) else list(axis)),
+                     "keepdim": keepdim,
+                     "reduce_all": axis is None})
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = -3.4e38 if min is None else float(min)
+    hi = 3.4e38 if max is None else float(max)
+    return trace_op("clip", {"X": x}, {"min": lo, "max": hi})
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    return trace_op("matmul_v2", {"X": x, "Y": y},
+                    {"trans_x": transpose_x, "trans_y": transpose_y})
+
+
+def bmm(x, y, name=None):
+    return trace_op("bmm", {"X": x, "Y": y}, {})
+
+
+def dot(x, y, name=None):
+    return trace_op("dot", {"X": x, "Y": y}, {})
+
+
+def mv(x, vec, name=None):
+    return trace_op("mv", {"X": x, "Vec": vec}, {})
+
+
+def t(x, name=None):
+    perm = list(range(len(x.shape)))[::-1]
+    return transpose(x, perm)
+
+
+def kron(x, y, name=None):
+    return trace_op("kron", {"X": x, "Y": y}, {})
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return trace_op("addmm", {"Input": input, "X": x, "Y": y},
+                    {"Beta": beta, "Alpha": alpha})
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return trace_op("trace", {"Input": x},
+                    {"offset": offset, "axis1": axis1, "axis2": axis2})
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    return trace_op("cumsum", {"X": x},
+                    {"axis": -1 if axis is None else axis,
+                     "flatten": axis is None})
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    return trace_op("cumprod", {"X": x}, {"dim": dim if dim is not None else 0})
+
+
+def cross(x, y, axis=None, name=None):
+    jnp = _jnp()
+    ax = axis if axis is not None else -1
+    return trace_fn(lambda x, y: jnp.cross(x, y, axis=ax),
+                    {"x": x, "y": y})
+
+
+def multiply_no_nan(x, y):
+    jnp = _jnp()
+    return trace_fn(lambda x, y: jnp.where(y == 0, 0.0, x * y),
+                    {"x": x, "y": y})
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None,
+          name=None):
+    return trace_op("scale", {"X": x},
+                    {"scale": float(scale), "bias": float(bias),
+                     "bias_after_scale": bias_after_scale})
+
+
+def increment(x, value=1.0, name=None):
+    return trace_op("increment", {"X": x}, {"step": float(value)})
+
+
+def isnan(x, name=None):
+    return trace_op("isnan_v2", {"X": x}, {})
+
+
+def isinf(x, name=None):
+    return trace_op("isinf_v2", {"X": x}, {})
+
+
+def isfinite(x, name=None):
+    return trace_op("isfinite_v2", {"X": x}, {})
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    if p == "fro" and axis is None:
+        return trace_op("frobenius_norm", {"X": x},
+                        {"dim": [], "keep_dim": keepdim, "reduce_all": True})
+    jnp = _jnp()
+    return trace_fn(
+        lambda x: jnp.linalg.norm(x, ord=p if p != "fro" else None,
+                                  axis=axis, keepdims=keepdim), {"x": x})
+
+
+def dist(x, y, p=2, name=None):
+    jnp = _jnp()
+    # paddle.dist: p-norm of the FLATTENED difference (not a matrix norm)
+    return trace_fn(
+        lambda x, y: jnp.linalg.norm((x - y).ravel(), ord=p),
+        {"x": x, "y": y})
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return trace_op("stanh", {"X": x},
+                    {"scale_a": scale_a, "scale_b": scale_b})
+
+
+# -- logic --------------------------------------------------------------------
+
+def _cmp(jnp_name):
+    def fn(x, y, name=None):
+        jnp = _jnp()
+        return trace_fn(lambda x, y: getattr(jnp, jnp_name)(x, y),
+                        {"x": x, "y": y})
+
+    return fn
+
+
+equal = _cmp("equal")
+not_equal = _cmp("not_equal")
+greater_than = _cmp("greater")
+greater_equal = _cmp("greater_equal")
+less_than = _cmp("less")
+less_equal = _cmp("less_equal")
+logical_and = _cmp("logical_and")
+logical_or = _cmp("logical_or")
+logical_xor = _cmp("logical_xor")
+
+
+def logical_not(x, name=None):
+    return trace_op("logical_not", {"X": x}, {})
+
+
+def equal_all(x, y, name=None):
+    jnp = _jnp()
+    return trace_fn(lambda x, y: jnp.array_equal(x, y), {"x": x, "y": y})
+
+
+def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False, name=None):
+    jnp = _jnp()
+    return trace_fn(
+        lambda x, y: jnp.allclose(x, y, rtol=rtol, atol=atol,
+                                  equal_nan=equal_nan), {"x": x, "y": y})
+
+
+def is_empty(x, name=None):
+    return Tensor(np.bool_(int(np.prod(x.shape)) == 0))
+
+
+# -- manipulation -------------------------------------------------------------
+
+def reshape(x, shape, name=None):
+    outs = trace_op("reshape2", {"X": x},
+                    {"shape": [int(s) for s in shape]}, multi_out=True)
+    return outs["Out"][0]
+
+
+def transpose(x, perm, name=None):
+    outs = trace_op("transpose2", {"X": x}, {"axis": list(perm)},
+                    multi_out=True)
+    return outs["Out"][0]
+
+
+def concat(x, axis=0, name=None):
+    return trace_op("concat", {"X": list(x)}, {"axis": axis})
+
+
+def stack(x, axis=0, name=None):
+    return trace_op("stack", {"X": list(x)}, {"axis": axis})
+
+
+def unstack(x, axis=0, num=None, name=None):
+    outs = trace_op("unstack", {"X": x}, {"axis": axis,
+                                          "num": num or x.shape[axis]},
+                    multi_out=True)
+    return outs["Y"]
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    attrs = {"axis": axis}
+    if isinstance(num_or_sections, int):
+        attrs["num"] = num_or_sections
+    else:
+        attrs["sections"] = list(num_or_sections)
+    outs = trace_op("split", {"X": x}, attrs, multi_out=True)
+    return outs["Out"]
+
+
+def squeeze(x, axis=None, name=None):
+    axes = [] if axis is None else (
+        [axis] if isinstance(axis, int) else list(axis))
+    outs = trace_op("squeeze2", {"X": x}, {"axes": axes}, multi_out=True)
+    return outs["Out"][0]
+
+
+def unsqueeze(x, axis, name=None):
+    axes = [axis] if isinstance(axis, int) else list(axis)
+    outs = trace_op("unsqueeze2", {"X": x}, {"axes": axes}, multi_out=True)
+    return outs["Out"][0]
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    return trace_op("flatten_contiguous_range", {"X": x},
+                    {"start_axis": start_axis, "stop_axis": stop_axis})
+
+
+def gather(x, index, axis=None, name=None):
+    return trace_op("gather", {"X": x, "Index": index},
+                    {"axis": axis if axis is not None else 0})
+
+
+def gather_nd(x, index, name=None):
+    return trace_op("gather_nd", {"X": x, "Index": index}, {})
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    return trace_op("scatter", {"X": x, "Ids": index, "Updates": updates},
+                    {"overwrite": overwrite})
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    return trace_op("scatter_nd_add",
+                    {"X": x, "Index": index, "Updates": updates}, {})
+
+
+def index_select(x, index, axis=0, name=None):
+    return trace_op("index_select", {"X": x, "Index": index}, {"dim": axis})
+
+
+def index_sample(x, index):
+    return trace_op("index_sample", {"X": x, "Index": index}, {})
+
+
+def masked_select(x, mask, name=None):
+    jnp = _jnp()
+    return trace_fn(lambda x, mask: x[mask], {"x": x, "mask": mask})
+
+
+def where(condition, x=None, y=None, name=None):
+    return trace_op("where", {"Condition": condition, "X": x, "Y": y}, {})
+
+
+def nonzero(x, as_tuple=False):
+    jnp = _jnp()
+    out = trace_fn(lambda x: jnp.stack(jnp.nonzero(x), axis=1), {"x": x})
+    if as_tuple:
+        n = len(x.shape)
+        return tuple(out[:, i] for i in range(n))
+    return out
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    if not (return_index or return_inverse or return_counts):
+        return trace_op("unique", {"X": x},
+                        {"axis": [] if axis is None else [axis]})
+    # numpy-backed eager path for the optional outputs (dynamic shapes
+    # are fine outside jit; inside jit use the static-shape op above)
+    vals, idx, inv, cnt = np.unique(
+        x.numpy() if isinstance(x, Tensor) else np.asarray(x),
+        return_index=True, return_inverse=True, return_counts=True,
+        axis=axis)
+    result = [Tensor(vals)]
+    if return_index:
+        result.append(Tensor(idx.astype(dtype)))
+    if return_inverse:
+        result.append(Tensor(inv.astype(dtype)))
+    if return_counts:
+        result.append(Tensor(cnt.astype(dtype)))
+    return tuple(result)
+
+
+def flip(x, axis, name=None):
+    axes = [axis] if isinstance(axis, int) else list(axis)
+    return trace_op("flip", {"X": x}, {"axis": axes})
+
+
+def roll(x, shifts, axis=None, name=None):
+    sh = [shifts] if isinstance(shifts, int) else list(shifts)
+    ax = [] if axis is None else (
+        [axis] if isinstance(axis, int) else list(axis))
+    return trace_op("roll", {"X": x}, {"shifts": sh, "axis": ax})
+
+
+def tile(x, repeat_times, name=None):
+    return trace_op("tile", {"X": x}, {"repeat_times": list(repeat_times)})
+
+
+def expand(x, shape, name=None):
+    return trace_op("expand_v2", {"X": x}, {"shape": list(shape)})
+
+
+def expand_as(x, y, name=None):
+    return trace_op("expand_as_v2", {"X": x},
+                    {"target_shape": list(y.shape)})
+
+
+def broadcast_to(x, shape, name=None):
+    return trace_op("expand_v2", {"X": x}, {"shape": list(shape)})
+
+
+def cast(x, dtype):
+    return trace_op("cast", {"X": x}, {"out_dtype": core.convert_dtype(dtype)})
+
+
+def slice(input, axes, starts, ends):
+    return trace_op("slice", {"Input": input},
+                    {"axes": list(axes), "starts": list(starts),
+                     "ends": list(ends)})
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    return trace_op("strided_slice", {"Input": x},
+                    {"axes": list(axes), "starts": list(starts),
+                     "ends": list(ends), "strides": list(strides)})
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    jnp = _jnp()
+    size = (index_num + nshards - 1) // nshards
+
+    def f(x):
+        shard = x // size
+        return jnp.where(shard == shard_id, x % size, ignore_value)
+
+    return trace_fn(f, {"x": input})
+
+
+# -- search -------------------------------------------------------------------
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return trace_op("arg_max", {"X": x},
+                    {"axis": axis if axis is not None else -1,
+                     "keepdims": keepdim, "flatten": axis is None,
+                     "dtype": dtype})
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return trace_op("arg_min", {"X": x},
+                    {"axis": axis if axis is not None else -1,
+                     "keepdims": keepdim, "flatten": axis is None,
+                     "dtype": dtype})
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    outs = trace_op("argsort", {"X": x},
+                    {"axis": axis, "descending": descending},
+                    multi_out=True)
+    return outs["Indices"][0]
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    outs = trace_op("argsort", {"X": x},
+                    {"axis": axis, "descending": descending},
+                    multi_out=True)
+    return outs["Out"][0]
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    outs = trace_op("top_k_v2", {"X": x},
+                    {"k": k, "axis": axis if axis is not None else -1,
+                     "largest": largest, "sorted": sorted},
+                    multi_out=True)
+    return outs["Out"][0], outs["Indices"][0]
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    jnp = _jnp()
+
+    def f(x):
+        import jax
+
+        srt = jnp.sort(x, axis=axis)
+        # simple mode via run-lengths on the sorted axis
+        vals, counts = jnp.unique(x, return_counts=True, size=x.size)
+        return vals[jnp.argmax(counts)]
+
+    return trace_fn(f, {"x": x})

@@ -1,0 +1,237 @@
+"""Tests for the paddle.nn-equivalent Layer library (the dygraph module
+system).  Mirrors the reference's test strategy (SURVEY.md §4): numeric
+oracles are numpy; dygraph-vs-oracle equivalence per layer."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.fluid.dygraph import guard, to_variable
+
+
+@pytest.fixture(autouse=True)
+def dygraph():
+    with guard():
+        yield
+
+
+def _t(a):
+    return to_variable(np.asarray(a, dtype="float32"))
+
+
+class TestLayerBase:
+    def test_parameter_registration(self):
+        lin = nn.Linear(4, 3)
+        names = [n for n, _ in lin.named_parameters()]
+        assert set(names) == {"weight", "bias"}
+        assert lin.weight.shape == [4, 3]
+        assert lin.bias.shape == [3]
+
+    def test_sublayer_traversal(self):
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        assert len(net.sublayers()) == 3
+        assert len(net.parameters()) == 4
+
+    def test_state_dict_roundtrip(self):
+        net = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8))
+        sd = net.state_dict()
+        # params + BN buffers
+        assert len(sd) == 4 + 2
+        net2 = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8))
+        missing, unexpected = net2.set_state_dict(sd)
+        assert not missing and not unexpected
+        for (k1, v1), (k2, v2) in zip(sorted(net.state_dict().items()),
+                                      sorted(net2.state_dict().items())):
+            np.testing.assert_allclose(v1.numpy(), v2.numpy())
+
+    def test_train_eval_mode(self):
+        net = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        assert net.training
+        net.eval()
+        assert not net[1].training
+        x = _t(np.ones((4, 2)))
+        y1, y2 = net(x), net(x)
+        np.testing.assert_allclose(y1.numpy(), y2.numpy())  # no dropout
+
+    def test_forward_hooks(self):
+        lin = nn.Linear(2, 2)
+        calls = []
+        h = lin.register_forward_post_hook(
+            lambda layer, ins, out: calls.append(1))
+        lin(_t(np.ones((1, 2))))
+        assert calls == [1]
+        h.remove()
+        lin(_t(np.ones((1, 2))))
+        assert calls == [1]
+
+    def test_apply_and_astype(self):
+        net = nn.Linear(2, 2)
+        net.astype("bfloat16")
+        assert net.weight.dtype == "bfloat16"
+
+
+class TestLayers:
+    def test_linear_oracle(self):
+        lin = nn.Linear(5, 3)
+        x = np.random.rand(2, 5).astype("float32")
+        ref = x @ lin.weight.numpy() + lin.bias.numpy()
+        np.testing.assert_allclose(lin(_t(x)).numpy(), ref, rtol=1e-5)
+
+    def test_conv2d_shapes(self):
+        conv = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+        y = conv(_t(np.random.rand(2, 3, 16, 16)))
+        assert y.shape == [2, 8, 8, 8]
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        ids = to_variable(np.array([[1, 0, 3]], dtype="int64"))
+        out = emb(ids)
+        assert out.shape == [1, 3, 4]
+        np.testing.assert_allclose(out.numpy()[0, 1], np.zeros(4))
+
+    def test_layernorm_oracle(self):
+        ln = nn.LayerNorm(6)
+        x = np.random.rand(3, 6).astype("float32")
+        mu = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        ref = (x - mu) / np.sqrt(var + 1e-5)
+        np.testing.assert_allclose(ln(_t(x)).numpy(), ref, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_batchnorm_running_stats(self):
+        bn = nn.BatchNorm2D(4, momentum=0.9)
+        x = np.random.rand(8, 4, 5, 5).astype("float32") * 3 + 1
+        bn(_t(x))
+        # running mean moved toward batch mean
+        assert not np.allclose(bn._mean.numpy(), 0.0)
+        bn.eval()
+        y = bn(_t(x))
+        assert y.shape == [8, 4, 5, 5]
+
+    def test_losses(self):
+        logits = np.random.rand(4, 10).astype("float32")
+        labels = np.random.randint(0, 10, (4,)).astype("int64")
+        loss = nn.CrossEntropyLoss()(_t(logits), to_variable(labels))
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        ref = -np.log(p[np.arange(4), labels]).mean()
+        np.testing.assert_allclose(float(loss.numpy()), ref, rtol=1e-4)
+
+        a, b = np.random.rand(3, 2), np.random.rand(3, 2)
+        np.testing.assert_allclose(
+            float(nn.MSELoss()(_t(a), _t(b)).numpy()),
+            ((a - b) ** 2).mean(), rtol=1e-5)
+        np.testing.assert_allclose(
+            float(nn.L1Loss()(_t(a), _t(b)).numpy()),
+            np.abs(a - b).mean(), rtol=1e-5)
+
+    def test_activations(self):
+        x = np.linspace(-3, 3, 13).astype("float32")
+        np.testing.assert_allclose(nn.ReLU()(_t(x)).numpy(),
+                                   np.maximum(x, 0))
+        np.testing.assert_allclose(
+            nn.Sigmoid()(_t(x)).numpy(), 1 / (1 + np.exp(-x)), rtol=1e-5)
+        sm = nn.Softmax()(_t(x)).numpy()
+        np.testing.assert_allclose(sm.sum(), 1.0, rtol=1e-5)
+
+    def test_backward_through_stack(self):
+        net = nn.Sequential(nn.Linear(4, 8), nn.GELU(), nn.Linear(8, 1))
+        loss = net(_t(np.random.rand(4, 4))).mean()
+        loss.backward()
+        for p in net.parameters():
+            assert p.grad is not None
+
+
+class TestRNN:
+    def test_lstm_shapes_and_grad(self):
+        lstm = nn.LSTM(8, 16, num_layers=2)
+        x = _t(np.random.rand(4, 6, 8))
+        y, (h, c) = lstm(x)
+        assert y.shape == [4, 6, 16]
+        assert h.shape == [2, 4, 16] and c.shape == [2, 4, 16]
+
+    def test_bidirectional(self):
+        gru = nn.GRU(8, 16, direction="bidirect")
+        y, h = gru(_t(np.random.rand(2, 5, 8)))
+        assert y.shape == [2, 5, 32]
+
+    def test_gru_cell_oracle(self):
+        cell = nn.GRUCell(4, 6)
+        x = np.random.rand(3, 4).astype("float32")
+        h0 = np.zeros((3, 6), "float32")
+        out, h = cell(_t(x), _t(h0))
+        # oracle
+        wi, wh = cell.weight_ih.numpy(), cell.weight_hh.numpy()
+        bi, bh = cell.bias_ih.numpy(), cell.bias_hh.numpy()
+        gi, gh = x @ wi.T + bi, h0 @ wh.T + bh
+        ir, iz, ic = np.split(gi, 3, -1)
+        hr, hz, hc = np.split(gh, 3, -1)
+        s = lambda v: 1 / (1 + np.exp(-v))
+        r, z = s(ir + hr), s(iz + hz)
+        n = np.tanh(ic + r * hc)
+        ref = (1 - z) * n + z * h0
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+class TestTransformer:
+    def test_encoder_forward_backward(self):
+        enc = nn.TransformerEncoder(
+            nn.TransformerEncoderLayer(32, 4, 64, dropout=0.0), 2)
+        x = _t(np.random.rand(2, 10, 32))
+        y = enc(x)
+        assert y.shape == [2, 10, 32]
+        y.mean().backward()
+        assert enc.parameters()[0].grad is not None
+
+    def test_mha_self_attention_oracle(self):
+        mha = nn.MultiHeadAttention(16, 2, dropout=0.0)
+        x = np.random.rand(1, 4, 16).astype("float32")
+        out = mha(_t(x))
+        assert out.shape == [1, 4, 16]
+        # oracle: project, attend, project back
+        q = x @ mha.q_proj.weight.numpy() + mha.q_proj.bias.numpy()
+        k = x @ mha.k_proj.weight.numpy() + mha.k_proj.bias.numpy()
+        v = x @ mha.v_proj.weight.numpy() + mha.v_proj.bias.numpy()
+        q = q.reshape(1, 4, 2, 8).transpose(0, 2, 1, 3)
+        k = k.reshape(1, 4, 2, 8).transpose(0, 2, 1, 3)
+        v = v.reshape(1, 4, 2, 8).transpose(0, 2, 1, 3)
+        s = (q @ k.transpose(0, 1, 3, 2)) / np.sqrt(8)
+        e = np.exp(s - s.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        o = (p @ v).transpose(0, 2, 1, 3).reshape(1, 4, 16)
+        ref = o @ mha.out_proj.weight.numpy() + mha.out_proj.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-3, atol=1e-4)
+
+    def test_decoder_cache(self):
+        dec_layer = nn.TransformerDecoderLayer(16, 2, 32, dropout=0.0)
+        dec = nn.TransformerDecoder(dec_layer, 1)
+        memory = _t(np.random.rand(1, 6, 16))
+        cache = dec.gen_cache(memory)
+        tgt = _t(np.random.rand(1, 1, 16))
+        out, new_cache = dec(tgt, memory, cache=cache)
+        assert out.shape == [1, 1, 16]
+
+
+class TestFunctional:
+    def test_flash_attention_oracle(self):
+        """Pallas flash-attention kernel (interpret mode) vs XLA oracle."""
+        from paddle_tpu.ops.pallas import attention as A
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(0)
+        q = jnp.asarray(rng.randn(2, 128, 2, 64), jnp.float32)
+        k = jnp.asarray(rng.randn(2, 128, 2, 64), jnp.float32)
+        v = jnp.asarray(rng.randn(2, 128, 2, 64), jnp.float32)
+        ref = A._xla_attention(q, k, v, is_causal=True)
+        out = A.flash_attention(q, k, v, is_causal=True, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_pad_and_interpolate(self):
+        x = _t(np.random.rand(1, 2, 4, 4))
+        y = F.pad(x, [1, 1, 1, 1])
+        assert y.shape == [1, 2, 6, 6]
+        z = F.interpolate(x, scale_factor=2, mode="nearest")
+        assert z.shape == [1, 2, 8, 8]

@@ -1,0 +1,205 @@
+"""Tests for the paddle.tensor-equivalent API (creation / math /
+manipulation / search) against numpy oracles."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.fluid.dygraph import guard, to_variable
+
+
+@pytest.fixture(autouse=True)
+def dygraph():
+    with guard():
+        yield
+
+
+def _t(a, dtype="float32"):
+    return to_variable(np.asarray(a, dtype=dtype))
+
+
+class TestCreation:
+    def test_basics(self):
+        assert paddle.zeros([2, 3]).numpy().sum() == 0
+        assert paddle.ones([2, 3]).numpy().sum() == 6
+        np.testing.assert_allclose(paddle.full([2], 7.0).numpy(), [7, 7])
+        np.testing.assert_allclose(paddle.arange(5).numpy(), np.arange(5))
+        np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(),
+                                   np.linspace(0, 1, 5), rtol=1e-6)
+        np.testing.assert_allclose(paddle.eye(3).numpy(), np.eye(3))
+
+    def test_like_family(self):
+        x = _t(np.random.rand(3, 4))
+        assert paddle.zeros_like(x).shape == [3, 4]
+        np.testing.assert_allclose(paddle.full_like(x, 2.0).numpy(),
+                                   np.full((3, 4), 2.0))
+
+    def test_random_shapes_and_ranges(self):
+        paddle.seed(7)
+        u = paddle.uniform([100], min=-2, max=2).numpy()
+        assert u.min() >= -2 and u.max() <= 2
+        r = paddle.randint(0, 5, [50]).numpy()
+        assert r.min() >= 0 and r.max() < 5
+        p = paddle.randperm(10).numpy()
+        assert sorted(p.tolist()) == list(range(10))
+
+    def test_tril_triu_diag(self):
+        x = np.random.rand(4, 4).astype("float32")
+        np.testing.assert_allclose(paddle.tril(_t(x)).numpy(), np.tril(x))
+        np.testing.assert_allclose(paddle.triu(_t(x)).numpy(), np.triu(x))
+        v = np.array([1.0, 2.0, 3.0], dtype="float32")
+        np.testing.assert_allclose(paddle.diag(_t(v)).numpy(), np.diag(v))
+
+
+class TestMath:
+    def test_elementwise(self):
+        a, b = np.random.rand(3, 4), np.random.rand(3, 4)
+        np.testing.assert_allclose(
+            paddle.add(_t(a), _t(b)).numpy(), a + b, rtol=1e-6)
+        np.testing.assert_allclose(
+            paddle.multiply(_t(a), _t(b)).numpy(), a * b, rtol=1e-6)
+        np.testing.assert_allclose(
+            paddle.maximum(_t(a), _t(b)).numpy(), np.maximum(a, b))
+
+    def test_unary(self):
+        x = np.random.rand(5).astype("float32") + 0.5
+        np.testing.assert_allclose(paddle.log(_t(x)).numpy(), np.log(x),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(paddle.sqrt(_t(x)).numpy(), np.sqrt(x),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(paddle.rsqrt(_t(x)).numpy(),
+                                   1 / np.sqrt(x), rtol=1e-5)
+
+    def test_reductions(self):
+        x = np.random.rand(3, 4).astype("float32")
+        np.testing.assert_allclose(float(paddle.sum(_t(x)).numpy()),
+                                   x.sum(), rtol=1e-5)
+        np.testing.assert_allclose(paddle.mean(_t(x), axis=1).numpy(),
+                                   x.mean(1), rtol=1e-5)
+        np.testing.assert_allclose(paddle.max(_t(x), axis=0).numpy(),
+                                   x.max(0))
+        np.testing.assert_allclose(float(paddle.std(_t(x)).numpy()),
+                                   x.std(ddof=1), rtol=1e-4)
+
+    def test_matmul_family(self):
+        a = np.random.rand(3, 4).astype("float32")
+        b = np.random.rand(4, 5).astype("float32")
+        np.testing.assert_allclose(paddle.matmul(_t(a), _t(b)).numpy(),
+                                   a @ b, rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.matmul(_t(a), _t(b.T), transpose_y=True).numpy(),
+            a @ b, rtol=1e-5)
+        c = np.random.rand(2, 3, 4).astype("float32")
+        d = np.random.rand(2, 4, 5).astype("float32")
+        np.testing.assert_allclose(paddle.bmm(_t(c), _t(d)).numpy(), c @ d,
+                                   rtol=1e-5)
+        v = np.random.rand(4).astype("float32")
+        np.testing.assert_allclose(paddle.mv(_t(a), _t(v)).numpy(), a @ v,
+                                   rtol=1e-5)
+
+    def test_cumsum_clip(self):
+        x = np.random.rand(3, 4).astype("float32")
+        np.testing.assert_allclose(paddle.cumsum(_t(x), axis=1).numpy(),
+                                   np.cumsum(x, 1), rtol=1e-5)
+        np.testing.assert_allclose(paddle.clip(_t(x), 0.2, 0.8).numpy(),
+                                   np.clip(x, 0.2, 0.8))
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        x = np.arange(24).reshape(2, 3, 4).astype("float32")
+        np.testing.assert_allclose(
+            paddle.reshape(_t(x), [6, 4]).numpy(), x.reshape(6, 4))
+        np.testing.assert_allclose(
+            paddle.transpose(_t(x), [2, 0, 1]).numpy(),
+            x.transpose(2, 0, 1))
+        np.testing.assert_allclose(paddle.t(_t(x[0])).numpy(), x[0].T)
+
+    def test_concat_split_stack(self):
+        a = np.random.rand(2, 3).astype("float32")
+        b = np.random.rand(2, 3).astype("float32")
+        np.testing.assert_allclose(
+            paddle.concat([_t(a), _t(b)], axis=0).numpy(),
+            np.concatenate([a, b], 0))
+        parts = paddle.split(_t(a), 3, axis=1)
+        assert len(parts) == 3 and parts[0].shape == [2, 1]
+        np.testing.assert_allclose(
+            paddle.stack([_t(a), _t(b)], axis=0).numpy(),
+            np.stack([a, b], 0))
+
+    def test_gather_scatter(self):
+        x = np.random.rand(5, 3).astype("float32")
+        idx = np.array([0, 2, 4], dtype="int64")
+        np.testing.assert_allclose(
+            paddle.gather(_t(x), to_variable(idx)).numpy(), x[idx])
+        np.testing.assert_allclose(
+            paddle.index_select(_t(x), to_variable(idx), axis=0).numpy(),
+            x[idx])
+
+    def test_where_masked(self):
+        x = np.array([1.0, -2.0, 3.0], dtype="float32")
+        cond = to_variable(x > 0)
+        y = paddle.where(cond, _t(x), _t(np.zeros(3)))
+        np.testing.assert_allclose(y.numpy(), [1, 0, 3])
+        m = paddle.masked_select(_t(x), cond)
+        np.testing.assert_allclose(m.numpy(), [1, 3])
+
+    def test_tile_expand_flip_roll(self):
+        x = np.arange(6).reshape(2, 3).astype("float32")
+        np.testing.assert_allclose(paddle.tile(_t(x), [2, 1]).numpy(),
+                                   np.tile(x, (2, 1)))
+        np.testing.assert_allclose(
+            paddle.expand(_t(x[:1]), [4, 3]).numpy(),
+            np.broadcast_to(x[:1], (4, 3)))
+        np.testing.assert_allclose(paddle.flip(_t(x), 1).numpy(),
+                                   x[:, ::-1])
+        np.testing.assert_allclose(paddle.roll(_t(x), 1, axis=1).numpy(),
+                                   np.roll(x, 1, 1))
+
+    def test_unique(self):
+        x = np.array([3, 1, 2, 1, 3], dtype="int64")
+        vals, counts = paddle.unique(to_variable(x), return_counts=True)
+        np.testing.assert_allclose(vals.numpy(), [1, 2, 3])
+        np.testing.assert_allclose(counts.numpy(), [2, 1, 2])
+
+
+class TestSearchLogic:
+    def test_argmax_topk_sort(self):
+        x = np.random.rand(3, 5).astype("float32")
+        np.testing.assert_allclose(
+            paddle.argmax(_t(x), axis=1).numpy(), x.argmax(1))
+        vals, idx = paddle.topk(_t(x), 2, axis=1)
+        np.testing.assert_allclose(vals.numpy(),
+                                   np.sort(x, 1)[:, ::-1][:, :2], rtol=1e-6)
+        np.testing.assert_allclose(paddle.sort(_t(x), axis=1).numpy(),
+                                   np.sort(x, 1))
+
+    def test_comparisons(self):
+        a = np.array([1.0, 2.0, 3.0], "float32")
+        b = np.array([2.0, 2.0, 2.0], "float32")
+        assert paddle.equal(_t(a), _t(b)).numpy().tolist() == \
+            [False, True, False]
+        assert paddle.greater_than(_t(a), _t(b)).numpy().tolist() == \
+            [False, False, True]
+        assert bool(paddle.allclose(_t(a), _t(a)).numpy())
+
+    def test_nan_inf(self):
+        x = np.array([1.0, np.nan, np.inf], "float32")
+        assert paddle.isnan(_t(x)).numpy().tolist() == [False, True, False]
+        assert paddle.isinf(_t(x)).numpy().tolist() == [False, False, True]
+        assert paddle.isfinite(_t(x)).numpy().tolist() == \
+            [True, False, False]
+
+    def test_nonzero(self):
+        x = np.array([0.0, 1.0, 0.0, 2.0], "float32")
+        nz = paddle.nonzero(_t(x)).numpy()
+        np.testing.assert_allclose(nz[:, 0], [1, 3])
+
+
+class TestAutogradIntegration:
+    def test_grad_through_tensor_api(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], "float32"),
+                             stop_gradient=False)
+        y = paddle.sum(paddle.multiply(x, x))
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0])
