@@ -15,7 +15,8 @@ from typing import Optional
 from ..meta_optimizers import (AMPOptimizer, GradientMergeOptimizer,
                                GraphExecutionOptimizer, LambOptimizer,
                                LarsOptimizer, LocalSGDOptimizer,
-                               RecomputeOptimizer, ShardingOptimizer)
+                               PipelineOptimizer, RecomputeOptimizer,
+                               ShardingOptimizer)
 from .distributed_strategy import DistributedStrategy
 from .role_maker import PaddleCloudRoleMaker, RoleMakerBase
 from .strategy_compiler import StrategyCompiler
@@ -27,6 +28,7 @@ _META_OPTIMIZER_CLASSES = [
     RecomputeOptimizer,
     LarsOptimizer,
     LambOptimizer,
+    PipelineOptimizer,
     ShardingOptimizer,
     LocalSGDOptimizer,
     GradientMergeOptimizer,

@@ -244,7 +244,7 @@ def bert_param_spec(name, shape, mp_axis="mp"):
 def build_pretrain_step(model: BertForPretraining,
                         weight_decay=0.01, bf16=True, remat=False,
                         mesh=None, dp_axis="dp", mp_axis=None,
-                        sp_axis=None):
+                        sp_axis=None, use_ring_attention=False):
     """One fully-fused XLA train step: fwd + bwd + AdamW.
 
     Returns (step_fn, state) where
@@ -260,6 +260,10 @@ def build_pretrain_step(model: BertForPretraining,
 
     from ..jit import functional_call, functional_state
 
+    if use_ring_attention and model.bert.config.attention_probs_dropout_prob:
+        raise ValueError(
+            "use_ring_attention requires attention_probs_dropout_prob=0 "
+            "(attention dropout is not supported by the ring path yet)")
     criterion = BertPretrainingCriterion(model.bert.config.vocab_size)
     # copy: the jitted step donates state buffers; the model's live
     # weights must not alias them
@@ -277,7 +281,14 @@ def build_pretrain_step(model: BertForPretraining,
             cast = params
 
         def fwd(p, b):
-            with rng_key_scope(key):
+            import contextlib
+
+            from ..ops.pallas.attention import ring_attention_scope
+
+            ring = (ring_attention_scope(mesh, sp_axis)
+                    if use_ring_attention and mesh is not None
+                    and sp_axis is not None else contextlib.nullcontext())
+            with rng_key_scope(key), ring:
                 return functional_call(
                     model, p, b["input_ids"], b["token_type_ids"],
                     masked_positions=b["masked_positions"])[0]

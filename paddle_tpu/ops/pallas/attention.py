@@ -224,12 +224,48 @@ def _flash_ok(q, k, v, mask, dropout_p):
     return d % 64 == 0 and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0
 
 
+import contextlib
+import threading
+
+_RING_CTX = threading.local()  # per-thread, like the tracer's rng scope
+
+
+@contextlib.contextmanager
+def ring_attention_scope(mesh, axis="sp"):
+    """Route subsequent attention calls through ring attention
+    (sequence-parallel over `axis`; paddle_tpu/parallel/ring_attention.py).
+    Model code stays unchanged — MultiHeadAttention picks it up via the
+    dispatcher below."""
+    old = (getattr(_RING_CTX, "mesh", None), getattr(_RING_CTX, "axis", None))
+    _RING_CTX.mesh, _RING_CTX.axis = mesh, axis
+    try:
+        yield
+    finally:
+        _RING_CTX.mesh, _RING_CTX.axis = old
+
+
 def scaled_dot_product_attention(q, k, v, mask=None, is_causal=False,
                                  scale=None, dropout_p=0.0,
                                  dropout_key=None):
-    """Dispatcher: Pallas flash kernel when on TPU with supported shapes,
-    XLA path otherwise (always for masked or dropout attention).
+    """Dispatcher: ring attention inside ring_attention_scope (sequence
+    parallel), Pallas flash kernel on TPU with supported shapes, XLA
+    path otherwise (always for masked or dropout attention).
     q/k/v: (batch, seq, heads, head_dim)."""
+    ring_mesh = getattr(_RING_CTX, "mesh", None)
+    if ring_mesh is not None:
+        if mask is not None or dropout_p != 0.0:
+            # loud failure beats silently dropping sequence parallelism
+            # (the whole point of the scope is bounded per-chip memory)
+            raise ValueError(
+                "ring_attention_scope is active but this attention call "
+                "cannot be ring-routed: attention masks and attention "
+                "dropout are not supported by the ring path yet. Set "
+                "attention dropout to 0 (and drop the mask) or exit the "
+                "scope.")
+        from ...parallel.ring_attention import ring_attention
+
+        return ring_attention(ring_mesh, _RING_CTX.axis)(
+            q, k, v, is_causal=is_causal, scale=scale)
     if _flash_ok(q, k, v, mask, dropout_p):
         return flash_attention(q, k, v, is_causal=is_causal, scale=scale)
     return _xla_attention(q, k, v, mask=mask, is_causal=is_causal,

@@ -3,3 +3,6 @@ Fleet facade.  TPU-native replacement for the reference's ParallelExecutor +
 NCCL stack (SURVEY.md §2.9)."""
 
 from .compiler import CompiledProgram  # noqa: F401
+from .pipeline import gpipe, stack_stage_params  # noqa: F401
+from .ring_attention import (ring_attention,  # noqa: F401
+                             ring_attention_local)

@@ -1,0 +1,93 @@
+"""Pipeline parallelism over a mesh axis — GPipe schedule as SPMD.
+
+The reference implements PP as a program rewrite + a dedicated C++
+runtime: `PipelineOptimizer` splits the program into device_guard
+sections (fluid/optimizer.py:3695), `PipelineTrainer` builds per-
+microbatch scopes and `SectionWorker` runs fwd-all-microbatches →
+bwd-all-microbatches → update with send_v2/recv_v2 between stages
+(framework/pipeline_trainer.cc:25, section_worker.cc:44).
+
+TPU-native re-design: the whole pipeline is ONE SPMD computation under
+`shard_map` over the `pp` mesh axis.  Stage weights are stacked with a
+leading stage dimension sharded over `pp`; the GPipe schedule is a
+`lax.scan` over M + n - 1 ticks where each tick computes one microbatch
+per stage and passes activations to the next stage with
+`jax.lax.ppermute` (one ICI hop — the send_v2/recv_v2 equivalent).
+Backward is jax AD through the scan: XLA emits the reversed schedule
+automatically, replacing SectionWorker's explicit bwd phase.  1F1B falls
+out of XLA's liveness scheduling rather than manual orchestration.
+"""
+
+from __future__ import annotations
+
+
+def stack_stage_params(per_stage_params):
+    """[{name: arr}, ...] per stage -> {name: arr stacked on axis 0}.
+    All stages must share one parameter structure (uniform stages)."""
+    import jax.numpy as jnp
+
+    keys = per_stage_params[0].keys()
+    return {k: jnp.stack([p[k] for p in per_stage_params], axis=0)
+            for k in keys}
+
+
+def gpipe(mesh, stage_fn, num_microbatches, axis="pp",
+          batch_in_specs=None):
+    """Build a pipelined forward: run(stacked_params, x) -> y.
+
+    stage_fn(params, x) -> y with x/y the SAME shape family (uniform
+    stages); stacked_params leaves have leading dim n_stages (sharded
+    over `axis`); x is the full batch (microbatched internally).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    m_count = num_microbatches
+
+    def local(params, xs):
+        # params leaves: (1, ...) local stage slice -> squeeze
+        params = jax.tree_util.tree_map(lambda a: a[0], params)
+        n = jax.lax.psum(1, axis)
+        s = jax.lax.axis_index(axis)
+
+        def tick(carry, t):
+            inbuf, outs = carry
+            mb = t - s  # microbatch index this stage works on at tick t
+            x0 = xs[jnp.clip(t, 0, m_count - 1)]
+            x = jnp.where(s == 0, x0, inbuf)
+            y = stage_fn(params, x)
+            active = jnp.logical_and(mb >= 0, mb < m_count)
+            is_last = s == n - 1
+            idx = jnp.clip(mb, 0, m_count - 1)
+            outs = outs.at[idx].set(
+                jnp.where(jnp.logical_and(active, is_last), y, outs[idx]))
+            # hand activations to the next stage (no wraparound)
+            inbuf_next = jax.lax.ppermute(
+                y, axis, [(i, i + 1) for i in range(n - 1)])
+            return (inbuf_next, outs), None
+
+        mb_shape = xs.shape[1:]
+        inbuf0 = jnp.zeros(mb_shape, xs.dtype)
+        outs0 = jnp.zeros((m_count,) + mb_shape, xs.dtype)
+        n_static = mesh.shape[axis]
+        (_, outs), _ = jax.lax.scan(
+            tick, (inbuf0, outs0), jnp.arange(m_count + n_static - 1))
+        # outputs live on the last stage only; psum replicates them
+        outs = jnp.where(s == n - 1, outs, 0.0)
+        return jax.lax.psum(outs, axis)
+
+    def run(stacked_params, x):
+        batch = x.shape[0]
+        assert batch % m_count == 0, (batch, m_count)
+        xs = x.reshape((m_count, batch // m_count) + x.shape[1:])
+        in_params_spec = jax.tree_util.tree_map(
+            lambda _: P(axis), stacked_params)
+        out = shard_map(
+            local, mesh=mesh,
+            in_specs=(in_params_spec, P()),
+            out_specs=P(), check_rep=False)(stacked_params, xs)
+        return out.reshape((batch,) + out.shape[2:])
+
+    return run

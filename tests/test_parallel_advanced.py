@@ -1,0 +1,223 @@
+"""Sequence parallelism (ring attention) + pipeline parallelism tests on
+the 8-device virtual CPU mesh (SURVEY.md §4 implication (b): single-
+process multi-device mesh replaces the reference's multi-process
+TestDistBase harness)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+
+@pytest.fixture
+def mesh8():
+    return Mesh(np.array(jax.devices()[:8]).reshape(8,), ("sp",))
+
+
+@pytest.fixture
+def mesh42():
+    return Mesh(np.array(jax.devices()[:8]).reshape(4, 2), ("pp", "dp"))
+
+
+class TestRingAttention:
+    def _qkv(self, B=2, S=64, H=4, D=32, seed=0):
+        rng = np.random.RandomState(seed)
+        mk = lambda: jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+        return mk(), mk(), mk()
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_full_attention(self, mesh8, causal):
+        from paddle_tpu.ops.pallas.attention import _xla_attention
+        from paddle_tpu.parallel import ring_attention
+
+        q, k, v = self._qkv()
+        out = ring_attention(mesh8, "sp")(q, k, v, is_causal=causal)
+        ref = _xla_attention(q, k, v, is_causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_gradients_match(self, mesh8):
+        from paddle_tpu.ops.pallas.attention import _xla_attention
+        from paddle_tpu.parallel import ring_attention
+
+        q, k, v = self._qkv()
+        attn = ring_attention(mesh8, "sp")
+        g1 = jax.grad(lambda k: attn(q, k, v, is_causal=True).sum())(k)
+        g2 = jax.grad(
+            lambda k: _xla_attention(q, k, v, is_causal=True).sum())(k)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_jit_with_sharded_inputs(self, mesh8):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from paddle_tpu.parallel import ring_attention
+
+        q, k, v = self._qkv()
+        shard = NamedSharding(mesh8, P(None, "sp"))
+        qs, ks, vs = (jax.device_put(x, shard) for x in (q, k, v))
+        attn = jax.jit(
+            lambda q, k, v: ring_attention(mesh8, "sp")(q, k, v,
+                                                        is_causal=True))
+        out = attn(qs, ks, vs)
+        assert out.shape == q.shape and np.isfinite(np.asarray(out)).all()
+
+    def test_scope_routes_mha(self, mesh8, monkeypatch):
+        """MultiHeadAttention transparently uses ring attention inside
+        ring_attention_scope — with a positive signal that the ring path
+        actually executed."""
+        import paddle_tpu as paddle
+        from paddle_tpu.fluid.dygraph import guard, to_variable
+        from paddle_tpu.ops.pallas.attention import ring_attention_scope
+        from paddle_tpu.parallel import ring_attention as real_ring
+
+        calls = []
+
+        def counting_ring(mesh, axis):
+            calls.append(axis)
+            return real_ring(mesh, axis)
+
+        import importlib
+
+        ra_mod = importlib.import_module(
+            "paddle_tpu.parallel.ring_attention")
+        monkeypatch.setattr(ra_mod, "ring_attention", counting_ring)
+
+        with guard():
+            mha = paddle.nn.MultiHeadAttention(32, 4, dropout=0.0)
+            mha.eval()
+            x = to_variable(np.random.rand(2, 64, 32).astype("float32"))
+            ref = mha(x).numpy()
+            assert calls == []
+            with ring_attention_scope(mesh8, "sp"):
+                out = mha(x).numpy()
+            assert calls == ["sp"], "ring path did not execute"
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_scope_raises_on_unroutable_call(self, mesh8):
+        """Dropout/mask inside the scope must fail loudly, not silently
+        skip sequence parallelism."""
+        import paddle_tpu as paddle
+        from paddle_tpu.fluid.dygraph import guard, to_variable
+        from paddle_tpu.ops.pallas.attention import ring_attention_scope
+
+        with guard():
+            mha = paddle.nn.MultiHeadAttention(32, 4, dropout=0.5)
+            mha.train()
+            x = to_variable(np.random.rand(2, 64, 32).astype("float32"))
+            with ring_attention_scope(mesh8, "sp"):
+                with pytest.raises(ValueError, match="ring"):
+                    mha(x)
+
+    def test_bert_build_rejects_attn_dropout_with_ring(self, mesh8):
+        from paddle_tpu.models import bert
+
+        cfg = bert.BertConfig.tiny()  # attention dropout 0.1
+        model = bert.BertForPretraining(cfg)
+        with pytest.raises(ValueError, match="attention_probs_dropout"):
+            bert.build_pretrain_step(model, mesh=mesh8, sp_axis="sp",
+                                     use_ring_attention=True)
+
+
+class TestPipeline:
+    def test_forward_matches_sequential(self, mesh42):
+        from paddle_tpu.parallel import gpipe, stack_stage_params
+
+        rng = np.random.RandomState(0)
+        H = 16
+        stages = [{"w": jnp.asarray(rng.randn(H, H) * 0.3, jnp.float32),
+                   "b": jnp.zeros(H, jnp.float32)} for _ in range(4)]
+
+        def stage_fn(p, x):
+            return jnp.tanh(x @ p["w"] + p["b"])
+
+        run = gpipe(mesh42, stage_fn, num_microbatches=8, axis="pp")
+        x = jnp.asarray(rng.randn(16, H), jnp.float32)
+        y = run(stack_stage_params(stages), x)
+        ref = x
+        for p in stages:
+            ref = jnp.tanh(ref @ p["w"] + p["b"])
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_gradients_match_sequential(self, mesh42):
+        from paddle_tpu.parallel import gpipe, stack_stage_params
+
+        rng = np.random.RandomState(1)
+        H = 8
+        stacked = stack_stage_params(
+            [{"w": jnp.asarray(rng.randn(H, H) * 0.3, jnp.float32)}
+             for _ in range(4)])
+
+        def stage_fn(p, x):
+            return jnp.tanh(x @ p["w"])
+
+        run = gpipe(mesh42, stage_fn, num_microbatches=4, axis="pp")
+        x = jnp.asarray(rng.randn(8, H), jnp.float32)
+        g1 = jax.grad(lambda sp: run(sp, x).sum())(stacked)
+
+        def seq(sp):
+            h = x
+            for i in range(4):
+                h = jnp.tanh(h @ sp["w"][i])
+            return h.sum()
+
+        g2 = jax.grad(seq)(stacked)
+        np.testing.assert_allclose(np.asarray(g1["w"]),
+                                   np.asarray(g2["w"]),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_train_convergence_through_pipeline(self, mesh42):
+        """A pipelined 4-stage MLP trains to fit a fixed batch — the
+        SectionWorker fwd/bwd/update cycle in one SPMD step."""
+        from paddle_tpu.parallel import gpipe, stack_stage_params
+
+        rng = np.random.RandomState(2)
+        H = 8
+        stacked = stack_stage_params(
+            [{"w": jnp.asarray(rng.randn(H, H) * 0.5, jnp.float32)}
+             for _ in range(4)])
+        x = jnp.asarray(rng.randn(16, H), jnp.float32)
+        target = jnp.asarray(rng.randn(16, H) * 0.1, jnp.float32)
+
+        def stage_fn(p, h):
+            return jnp.tanh(h @ p["w"])
+
+        run = gpipe(mesh42, stage_fn, num_microbatches=4, axis="pp")
+
+        @jax.jit
+        def step(params):
+            def loss(p):
+                return jnp.mean((run(p, x) - target) ** 2)
+
+            l, g = jax.value_and_grad(loss)(params)
+            return {k: params[k] - 0.5 * g[k] for k in params}, l
+
+        losses = []
+        for _ in range(10):
+            stacked, l = step(stacked)
+            losses.append(float(l))
+        assert losses[-1] < losses[0] * 0.7, losses
+
+
+class TestPipelineMetaOptimizer:
+    def test_strategy_selects_pipeline(self):
+        """Graph-level assertion in the reference style
+        (fleet_meta_optimizer_base.py): strategy flag -> meta-opt chain."""
+        from paddle_tpu.distributed.fleet.base.distributed_strategy import \
+            DistributedStrategy
+        from paddle_tpu.distributed.fleet.meta_optimizers import \
+            PipelineOptimizer
+
+        class _Inner:
+            pass
+
+        strat = DistributedStrategy()
+        strat.pipeline = True
+        strat.pipeline_configs = {"micro_batch": 4}
+        opt = PipelineOptimizer(_Inner())
+        opt._set_basic_info(None, None, _Inner(), strat)
+        assert opt._can_apply()
+        assert opt.micro_batch == 4
