@@ -27,6 +27,15 @@ from . import optimizer
 from . import tensor
 from . import jit
 from . import models
+from . import amp
+from . import io
+from . import metric
+from . import hapi
+from .hapi import Model
+from .framework_io import load, save
+from . import inference
+from . import profiler
+from .fluid.flags import get_flags, set_flags
 from .nn.layer.layers import Layer  # 2.0 alias: paddle.nn.Layer
 from .tensor import (to_tensor, zeros, ones, full, zeros_like, ones_like,
                      full_like, arange, linspace, eye, rand, randn, randint,

@@ -1,0 +1,204 @@
+"""`paddle.amp` — automatic mixed precision for dygraph.
+
+Reference: python/paddle/amp (auto_cast.py:20, GradScaler
+grad_scaler.py:20) over fluid/dygraph/amp (AmpAutoCast amp_auto_cast.cc,
+AmpScaler loss_scaler.py:27) and the AMP ops
+operators/amp/{check_finite_and_unscale,update_loss_scaling}_op.
+
+TPU-native re-design: the cast policy targets bfloat16 (the MXU's native
+low precision) instead of float16, so the O1 white/black-list machinery
+is kept for API parity but loss scaling is OPTIONAL — bf16 has fp32's
+exponent range, the reference's overflow-driven scale adjustment
+normally never triggers.  `auto_cast` installs a thread-local policy the
+eager tracer consults per op (the AmpAutoCast hook done in Python);
+GradScaler implements the full dynamic-loss-scaling state machine for
+fp16 parity and tests.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import numpy as np
+
+_AMP = threading.local()
+
+# mirrors the reference's fp16 white list (matmul/conv ride the MXU) and
+# black list (numerically sensitive reductions stay fp32)
+WHITE_LIST = {
+    "matmul", "matmul_v2", "mul", "bmm", "mv", "addmm",
+    "conv2d", "conv3d", "conv2d_transpose", "depthwise_conv2d",
+}
+BLACK_LIST = {
+    "exp", "log", "square", "reduce_sum", "reduce_mean", "mean", "sum",
+    "softmax", "log_softmax", "softmax_with_cross_entropy",
+    "cross_entropy", "cross_entropy2", "layer_norm", "batch_norm",
+    "p_norm", "frobenius_norm", "cumsum", "logsumexp",
+}
+
+
+def amp_state():
+    return getattr(_AMP, "state", None)
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    """(reference: paddle/amp/auto_cast.py:20).  level O1: white-list ops
+    compute in `dtype`; O2: every float op except the black list."""
+    if not enable:
+        yield
+        return
+    white = set(WHITE_LIST)
+    black = set(BLACK_LIST)
+    if custom_white_list:
+        white |= set(custom_white_list)
+        black -= set(custom_white_list)
+    if custom_black_list:
+        black |= set(custom_black_list)
+        white -= set(custom_black_list)
+    old = amp_state()
+    _AMP.state = {"level": level, "dtype": dtype, "white": white,
+                  "black": black}
+    try:
+        yield
+    finally:
+        _AMP.state = old
+
+
+amp_guard = auto_cast  # fluid.dygraph.amp alias
+
+
+def cast_inputs_if_amp(op_type, ins_vals):
+    """Called by the eager tracer: cast float32 leaf values per the
+    active policy.  Returns (ins_vals, did_cast)."""
+    state = amp_state()
+    if state is None:
+        return ins_vals, False
+    import jax.numpy as jnp
+
+    target = jnp.bfloat16 if state["dtype"] == "bfloat16" else jnp.float16
+    if state["level"] == "O2":
+        do = op_type not in state["black"]
+    else:
+        do = op_type in state["white"]
+    if not do:
+        return ins_vals, False
+
+    def cast(v):
+        if v is not None and hasattr(v, "dtype") and v.dtype == jnp.float32:
+            return v.astype(target)
+        return v
+
+    return {s: [cast(v) for v in vs] for s, vs in ins_vals.items()}, True
+
+
+class GradScaler:
+    """Dynamic loss scaling (reference: paddle/amp/grad_scaler.py:20 /
+    AmpScaler loss_scaler.py:27; C++ check_finite_and_unscale_op,
+    update_loss_scaling_op)."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        from ..fluid.dygraph.tracer import trace_op
+
+        return trace_op("scale", {"X": loss},
+                        {"scale": self._scale, "bias": 0.0})
+
+    def unscale_(self, optimizer):
+        """check_finite_and_unscale: divide grads by scale, flag inf."""
+        if not self._enable:
+            return
+        import jax.numpy as jnp
+
+        found = False
+        for p in optimizer._parameter_list or []:
+            if p._grad is None:
+                continue
+            g = p._grad / self._scale
+            if not bool(jnp.isfinite(g).all()):
+                found = True
+            p._grad = g
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        self.step(optimizer)
+        return None, []
+
+    def update(self):
+        """update_loss_scaling_op state machine."""
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "incr_every_n_steps": self._incr_every,
+                "decr_every_n_nan_or_inf": self._decr_every,
+                "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def set_state_dict(self, sd):
+        self._scale = sd.get("scale", self._scale)
+        self._good_steps = sd.get("good_steps", 0)
+        self._bad_steps = sd.get("bad_steps", 0)
+
+
+AmpScaler = GradScaler  # fluid alias
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2 decoration: cast model params to `dtype`
+    (reference: paddle/amp/auto_cast.py decorate)."""
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    for m in model_list:
+        m.astype(dtype)
+    if optimizers is None:
+        return models if single else model_list
+    return (models if single else model_list), optimizers

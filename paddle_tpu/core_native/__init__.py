@@ -1,0 +1,120 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference builds its feeding runtime in C++
+(operators/reader/lod_tensor_blocking_queue.h, buffered_reader.cc); this
+package holds the TPU-native equivalents, compiled on first use with the
+system toolchain (g++ -O2 -shared) and cached next to the sources.
+
+Components:
+  BlockingQueue — bounded MPMC byte-slab queue with GIL-free blocking
+  (ctypes releases the GIL during push/pop waits), used by
+  paddle_tpu.io.DataLoader's worker->reader channel.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB = None
+_LIB_LOCK = threading.Lock()
+
+
+def _build_and_load():
+    src = os.path.join(_DIR, "blocking_queue.cc")
+    so = os.path.join(_DIR, "_native.so")
+    if (not os.path.exists(so)
+            or os.path.getmtime(so) < os.path.getmtime(src)):
+        tmp = so + f".tmp.{os.getpid()}"
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
+             src, "-o", tmp],
+            check=True, capture_output=True)
+        os.replace(tmp, so)
+    lib = ctypes.CDLL(so)
+    lib.ptq_create.restype = ctypes.c_void_p
+    lib.ptq_create.argtypes = [ctypes.c_int]
+    lib.ptq_push.restype = ctypes.c_int
+    lib.ptq_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                             ctypes.c_long]
+    lib.ptq_pop.restype = ctypes.c_long
+    lib.ptq_pop.argtypes = [ctypes.c_void_p,
+                            ctypes.POINTER(ctypes.POINTER(ctypes.c_char))]
+    lib.ptq_free_buf.argtypes = [ctypes.POINTER(ctypes.c_char)]
+    lib.ptq_close.argtypes = [ctypes.c_void_p]
+    lib.ptq_size.restype = ctypes.c_int
+    lib.ptq_size.argtypes = [ctypes.c_void_p]
+    lib.ptq_capacity.restype = ctypes.c_int
+    lib.ptq_capacity.argtypes = [ctypes.c_void_p]
+    lib.ptq_destroy.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def _lib():
+    global _LIB
+    if _LIB is None:
+        with _LIB_LOCK:
+            if _LIB is None:
+                _LIB = _build_and_load()
+    return _LIB
+
+
+def native_available() -> bool:
+    try:
+        _lib()
+        return True
+    except Exception:
+        return False
+
+
+class BlockingQueue:
+    """Bounded blocking queue of python objects over the native byte
+    queue (the reference's LoDTensorBlockingQueue role).  Producers may
+    be threads or processes-via-thread-pumps; waits happen in C++ with
+    the GIL released."""
+
+    def __init__(self, capacity: int):
+        self._l = _lib()
+        self._q = ctypes.c_void_p(self._l.ptq_create(int(capacity)))
+        self._closed = False
+
+    def push(self, obj) -> bool:
+        payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        rc = self._l.ptq_push(self._q, payload, len(payload))
+        return rc == 0
+
+    def pop(self):
+        """Blocks; returns the object or raises StopIteration when the
+        queue is closed and drained."""
+        out = ctypes.POINTER(ctypes.c_char)()
+        size = self._l.ptq_pop(self._q, ctypes.byref(out))
+        if size < 0:
+            raise StopIteration
+        try:
+            data = ctypes.string_at(out, size)
+        finally:
+            self._l.ptq_free_buf(out)
+        return pickle.loads(data)
+
+    def close(self):
+        if not self._closed:
+            self._closed = True
+            self._l.ptq_close(self._q)
+
+    def size(self) -> int:
+        return self._l.ptq_size(self._q)
+
+    @property
+    def capacity(self) -> int:
+        return self._l.ptq_capacity(self._q)
+
+    def __del__(self):
+        try:
+            self.close()
+            self._l.ptq_destroy(self._q)
+        except Exception:
+            pass

@@ -1,0 +1,127 @@
+// Native bounded blocking queue for data-loader pipelines.
+//
+// TPU-native equivalent of the reference's C++ feeding runtime:
+//   * LoDTensorBlockingQueue (operators/reader/lod_tensor_blocking_queue.h)
+//     — the bounded producer/consumer channel between Python feeders and
+//     the device reader;
+//   * BufferedReader (operators/reader/buffered_reader.cc) — double-
+//     buffered prefetch ahead of the device.
+//
+// Re-designed rather than ported: one generic byte-buffer MPMC queue with
+// condition-variable blocking and GIL-free waits (callers drop the GIL via
+// ctypes), carrying opaque (malloc'd) slabs that Python maps to numpy
+// batches.  Device staging (host->HBM) is jax's job; this queue only has
+// to keep the host side ahead of the accelerator.
+//
+// C ABI (ctypes-friendly):
+//   void* ptq_create(int capacity)
+//   int   ptq_push(void* q, const char* data, long n)   // blocks; 0 ok,
+//                                                       // -1 closed
+//   long  ptq_pop(void* q, char** out)                  // blocks; size or
+//                                                       // -1 closed+empty
+//   void  ptq_free_buf(char* buf)
+//   void  ptq_close(void* q)       // wake all; pops drain, pushes fail
+//   int   ptq_size(void* q)
+//   int   ptq_capacity(void* q)
+//   void  ptq_destroy(void* q)
+
+#include <condition_variable>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <mutex>
+
+namespace {
+
+struct Buf {
+  char* data;
+  long size;
+};
+
+struct Queue {
+  std::mutex mu;
+  std::condition_variable not_full;
+  std::condition_variable not_empty;
+  std::deque<Buf> items;
+  int capacity;
+  bool closed = false;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ptq_create(int capacity) {
+  auto* q = new Queue();
+  q->capacity = capacity > 0 ? capacity : 1;
+  return q;
+}
+
+int ptq_push(void* handle, const char* data, long n) {
+  auto* q = static_cast<Queue*>(handle);
+  char* copy = static_cast<char*>(std::malloc(n > 0 ? n : 1));
+  if (copy == nullptr) return -2;
+  std::memcpy(copy, data, n);
+  std::unique_lock<std::mutex> lock(q->mu);
+  q->not_full.wait(lock, [q] {
+    return q->closed || static_cast<int>(q->items.size()) < q->capacity;
+  });
+  if (q->closed) {
+    std::free(copy);
+    return -1;
+  }
+  q->items.push_back({copy, n});
+  lock.unlock();
+  q->not_empty.notify_one();
+  return 0;
+}
+
+long ptq_pop(void* handle, char** out) {
+  auto* q = static_cast<Queue*>(handle);
+  std::unique_lock<std::mutex> lock(q->mu);
+  q->not_empty.wait(lock, [q] { return q->closed || !q->items.empty(); });
+  if (q->items.empty()) {
+    *out = nullptr;
+    return -1;  // closed and drained
+  }
+  Buf b = q->items.front();
+  q->items.pop_front();
+  lock.unlock();
+  q->not_full.notify_one();
+  *out = b.data;
+  return b.size;
+}
+
+void ptq_free_buf(char* buf) { std::free(buf); }
+
+void ptq_close(void* handle) {
+  auto* q = static_cast<Queue*>(handle);
+  {
+    std::lock_guard<std::mutex> lock(q->mu);
+    q->closed = true;
+  }
+  q->not_full.notify_all();
+  q->not_empty.notify_all();
+}
+
+int ptq_size(void* handle) {
+  auto* q = static_cast<Queue*>(handle);
+  std::lock_guard<std::mutex> lock(q->mu);
+  return static_cast<int>(q->items.size());
+}
+
+int ptq_capacity(void* handle) {
+  return static_cast<Queue*>(handle)->capacity;
+}
+
+void ptq_destroy(void* handle) {
+  auto* q = static_cast<Queue*>(handle);
+  {
+    std::lock_guard<std::mutex> lock(q->mu);
+    for (auto& b : q->items) std::free(b.data);
+    q->items.clear();
+  }
+  delete q;
+}
+
+}  // extern "C"

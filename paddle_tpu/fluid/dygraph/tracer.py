@@ -265,6 +265,19 @@ def trace_op(op_type: str, inputs: Dict[str, Any], attrs: Dict[str, Any] = None,
     if fn is None:
         raise NotImplementedError(f"no lowering registered for op {op_type!r}")
 
+    # AMP: wrap the lowering so white-listed ops compute in bf16/fp16.
+    # The cast lives INSIDE the traced fn, so vjp returns f32 grads.
+    try:
+        from ...amp import amp_state, cast_inputs_if_amp
+    except ImportError:  # during partial package import
+        amp_state = lambda: None
+    if amp_state() is not None:
+        _inner_fn = fn
+
+        def fn(ctx, op, ins_vals, _f=_inner_fn):
+            cast_vals, _ = cast_inputs_if_amp(op_type, ins_vals)
+            return _f(ctx, op, cast_vals)
+
     tracer = _tracer()
 
     # Normalize inputs to slot -> list, gather raw values + diff paths.
@@ -345,6 +358,22 @@ def trace_op(op_type: str, inputs: Dict[str, Any], attrs: Dict[str, Any] = None,
     for slot, n in spec:
         outs[slot] = out_tensors[k:k + n]
         k += n
+
+    from ..flags import flag as _flag
+
+    if _flag("check_nan_inf"):
+        # eager-mode post-op scan (CheckVarHasNanOrInf; only outside jit
+        # tracing — traced values have no concrete data)
+        import jax
+
+        for t in out_tensors:
+            if (t is not None and not isinstance(
+                    t._value, jax.core.Tracer)
+                    and _is_diff_value(t._value)
+                    and not bool(jax.numpy.isfinite(t._value).all())):
+                raise RuntimeError(
+                    f"NaN/Inf detected in output of op {op_type!r} "
+                    f"(FLAGS_check_nan_inf is set)")
 
     if not multi_out:
         non_empty = {s: v for s, v in outs.items() if v}

@@ -202,6 +202,19 @@ class Executor:
                                       feed_arrays, seed)
         for name, val in new_state.items():
             scope.set(name, val)
+        from .flags import flag
+
+        if flag("check_nan_inf"):
+            # post-run tensor scan (the reference's CheckVarHasNanOrInf,
+            # details/nan_inf_utils — FLAGS_check_nan_inf, flags.cc:44)
+            for name, val in list(new_state.items()) + list(
+                    zip(fetch_names, fetches)):
+                arr = np.asarray(val)
+                if np.issubdtype(arr.dtype, np.floating) \
+                        and not np.isfinite(arr).all():
+                    raise RuntimeError(
+                        f"NaN/Inf detected in variable {name!r} after "
+                        f"Executor.run (FLAGS_check_nan_inf is set)")
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return list(fetches)

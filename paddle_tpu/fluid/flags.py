@@ -1,0 +1,107 @@
+"""Global runtime flags.
+
+Reference: the 32 gflags in paddle/fluid/platform/flags.cc exposed to
+Python through global_value_getter_setter.cc and `fluid.set_flags` /
+`FLAGS_*` environment variables (SURVEY.md §5.9).
+
+TPU-native: a Python registry seeded from the environment; flags that
+map onto jax/XLA knobs forward to them on set (e.g. check_nan_inf ->
+jax_debug_nans).  Unknown FLAGS_* names raise, like the reference.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict
+
+_REGISTRY: Dict[str, dict] = {}
+
+
+def _define(name, default, help_str="", on_set: Callable = None,
+            typ=None):
+    typ = typ or type(default)
+    env = os.environ.get(f"FLAGS_{name}")
+    value = default
+    if env is not None:
+        if typ is bool:
+            value = env.lower() in ("1", "true", "yes")
+        else:
+            value = typ(env)
+    _REGISTRY[name] = {"value": value, "default": default, "help": help_str,
+                       "type": typ, "on_set": on_set}
+    if on_set is not None and value != default:
+        on_set(value)
+
+
+def _set_debug_nans(v):
+    import jax
+
+    jax.config.update("jax_debug_nans", bool(v))
+
+
+def _set_deterministic(v):
+    # XLA is deterministic by construction on TPU; keep the knob for
+    # API parity (the reference's FLAGS_cudnn_deterministic)
+    pass
+
+
+# -- the flag set (mirrors flags.cc categories) ------------------------------
+_define("check_nan_inf", False,
+        "scan op outputs for NaN/Inf after each eager op / executor run "
+        "(flags.cc:44); also enables jax_debug_nans", _set_debug_nans)
+_define("cudnn_deterministic", False,
+        "deterministic kernels (flags.cc:98); TPU/XLA is deterministic",
+        _set_deterministic)
+_define("allocator_strategy", "auto_growth",
+        "host-staging allocator strategy (flags.cc:316); XLA owns device "
+        "memory on TPU")
+_define("eager_delete_tensor_gb", 0.0,
+        "GC threshold (flags.cc:257); XLA buffer liveness replaces it")
+_define("fraction_of_gpu_memory_to_use", 0.92,
+        "device memory fraction; TPU: XLA preallocation policy")
+_define("paddle_num_threads", 1, "intra-op host threads")
+_define("sync_nccl_allreduce", True,
+        "collective sync mode; XLA schedules collectives")
+_define("benchmark", False, "per-op benchmark mode")
+_define("max_inplace_grad_add", 0, "grad accumulation inplace threshold")
+_define("sort_sum_gradient", False,
+        "deterministic gradient sum order (flags.cc:521)")
+_define("use_pinned_memory", True, "host staging uses pinned buffers")
+_define("init_allocated_mem", False, "poison fresh allocations")
+_define("free_idle_chunk", False, "release idle allocator chunks")
+_define("tracer_profile_fname", "", "imperative tracer profile output")
+_define("check_numerics", False,
+        "per-op numeric check, softer than check_nan_inf")
+
+
+def get_flags(flags):
+    """get_flags(['FLAGS_x', ...]) -> {name: value}
+    (reference: fluid get_flags)."""
+    single = isinstance(flags, str)
+    names = [flags] if single else list(flags)
+    out = {}
+    for n in names:
+        key = n[6:] if n.startswith("FLAGS_") else n
+        if key not in _REGISTRY:
+            raise ValueError(f"unknown flag {n!r}")
+        out[n] = _REGISTRY[key]["value"]
+    return out[names[0]] if single else out
+
+
+def set_flags(flags: Dict[str, Any]):
+    """set_flags({'FLAGS_x': v}) (reference: fluid.set_flags)."""
+    for n, v in flags.items():
+        key = n[6:] if n.startswith("FLAGS_") else n
+        if key not in _REGISTRY:
+            raise ValueError(f"unknown flag {n!r}")
+        entry = _REGISTRY[key]
+        entry["value"] = entry["type"](v) if entry["type"] is not bool \
+            else bool(v)
+        if entry["on_set"] is not None:
+            entry["on_set"](entry["value"])
+
+
+def flag(name, default=None):
+    """Internal fast read."""
+    e = _REGISTRY.get(name)
+    return e["value"] if e is not None else default

@@ -1,0 +1,46 @@
+"""`paddle.save` / `paddle.load` — state-dict serialization.
+
+Reference: python/paddle/framework/io.py (paddle.save/load of nested
+state_dicts) over fluid/dygraph/checkpoint.py; the static path is
+save_op/load_op programs (fluid/io.py — see paddle_tpu/fluid/io.py).
+
+Format: numpy .npz-style pickle of a flattened {key: ndarray | scalar}
+tree — portable, no framework objects inside.  Dygraph Tensors and jax
+Arrays are converted to numpy on save and restored as numpy (consumers
+call set_state_dict, which casts onto the live parameter dtypes).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+
+def _to_storable(obj):
+    from .fluid.dygraph.varbase import Tensor
+
+    if isinstance(obj, Tensor):
+        return np.asarray(obj.numpy())
+    if type(obj).__module__.startswith("jax"):
+        return np.asarray(obj)
+    if isinstance(obj, dict):
+        return {k: _to_storable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_storable(v) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    """Serialize a (nested) state dict / object to `path`."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_storable(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    with open(path, "rb") as f:
+        return pickle.load(f)

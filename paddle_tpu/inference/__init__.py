@@ -1,0 +1,152 @@
+"""Inference stack — export, load, and serve compiled models.
+
+Reference: paddle/fluid/inference (~36k LoC C++, SURVEY.md §1 L7):
+`AnalysisPredictor` (load model -> PrepareProgram -> IR pass pipeline ->
+NaiveExecutor -> ZeroCopyRun, api/analysis_predictor.cc:129,532,762)
+plus `paddle.jit.save/load` (dygraph/jit.py -> TranslatedLayer) and
+`save_inference_model` (fluid/io.py) with ProgramDesc protobuf as the
+serialized graph format.
+
+TPU-native re-design: the serialized artifact is **StableHLO** (via
+jax.export) — the XLA-native exchange format replacing ProgramDesc.
+`save_inference_model(path, layer, input_spec)` functionalizes an
+nn.Layer forward, folds the weights in as constants (the reference's
+params.pdparams fusion), lowers to StableHLO bytes + a small JSON
+manifest.  `Predictor` deserializes and compiles once, then `run()` is
+ZeroCopyRun: jitted execution with no Python graph interpretation.  The
+reference's 45-pass IR optimization pipeline is XLA's optimization
+pipeline — applied at deserialize/compile time, not export time.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+
+def save_inference_model(path_prefix, layer, input_spec, fold_params=True):
+    """Export `layer.forward` over `input_spec` to StableHLO.
+
+    input_spec: list of (shape, dtype) or arrays providing example
+    shapes.  Writes <prefix>.stablehlo + <prefix>.json manifest (+
+    <prefix>.pdiparams when fold_params=False).
+    """
+    import jax
+    from jax import export as jexport
+
+    from ..jit import functional_call, functional_state
+
+    layer.eval()
+    state = functional_state(layer)
+
+    specs = []
+    for s in input_spec:
+        if isinstance(s, tuple) and len(s) == 2 and isinstance(s[0],
+                                                               (list, tuple)):
+            shape, dtype = s
+        else:
+            arr = np.asarray(s.numpy() if hasattr(s, "numpy") else s)
+            shape, dtype = arr.shape, arr.dtype
+        specs.append(jax.ShapeDtypeStruct(tuple(shape), np.dtype(dtype)))
+
+    if fold_params:
+        def fn(*xs):
+            out, _ = functional_call(layer, state, *xs)
+            return out
+
+        exp = jexport.export(jax.jit(fn))(*specs)
+        params_path = None
+    else:
+        def fn(state, *xs):
+            out, _ = functional_call(layer, state, *xs)
+            return out
+
+        state_spec = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                      for k, v in state.items()}
+        exp = jexport.export(jax.jit(fn))(state_spec, *specs)
+        params_path = path_prefix + ".pdiparams"
+        from ..framework_io import save as psave
+
+        psave(state, params_path)
+
+    d = os.path.dirname(path_prefix)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path_prefix + ".stablehlo", "wb") as f:
+        f.write(exp.serialize())
+    manifest = {
+        "format": "stablehlo",
+        "fold_params": fold_params,
+        "inputs": [{"shape": list(s.shape), "dtype": np.dtype(s.dtype).name}
+                   for s in specs],
+        "params_file": os.path.basename(params_path) if params_path
+        else None,
+    }
+    with open(path_prefix + ".json", "w") as f:
+        json.dump(manifest, f, indent=2)
+    return path_prefix
+
+
+def load_inference_model(path_prefix):
+    """-> Predictor (the AnalysisPredictor role)."""
+    return Predictor(Config(path_prefix))
+
+
+class Config:
+    """Predictor config (reference: inference/api paddle_analysis_config
+    AnalysisConfig) — the TPU build keeps the knob surface minimal since
+    XLA owns optimization/memory."""
+
+    def __init__(self, model_path_prefix=None):
+        self.model_prefix = model_path_prefix
+        self.device = None  # default jax device
+
+    def set_model(self, prefix):
+        self.model_prefix = prefix
+
+    def enable_memory_optim(self):
+        pass  # XLA buffer assignment
+
+    def switch_ir_optim(self, flag=True):
+        pass  # XLA pipeline
+
+
+class Predictor:
+    """ZeroCopyRun-style predictor (analysis_predictor.cc:762): compile
+    once, feed/fetch device arrays with no per-call graph work."""
+
+    def __init__(self, config):
+        from jax import export as jexport
+
+        prefix = config.model_prefix
+        with open(prefix + ".stablehlo", "rb") as f:
+            self._exported = jexport.deserialize(f.read())
+        with open(prefix + ".json") as f:
+            self.manifest = json.load(f)
+        self._params = None
+        if self.manifest.get("params_file"):
+            from ..framework_io import load as pload
+
+            self._params = pload(os.path.join(
+                os.path.dirname(prefix), self.manifest["params_file"]))
+
+    def get_input_names(self):
+        return [f"x{i}" for i in range(len(self.manifest["inputs"]))]
+
+    def run(self, inputs):
+        """inputs: list of arrays in manifest order -> list of outputs."""
+        vals = [np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+                for x in inputs]
+        if self._params is not None:
+            out = self._exported.call(self._params, *vals)
+        else:
+            out = self._exported.call(*vals)
+        if isinstance(out, (list, tuple)):
+            return [np.asarray(o) for o in out]
+        return [np.asarray(out)]
+
+
+def create_predictor(config):
+    return Predictor(config)

@@ -1,0 +1,399 @@
+"""`paddle.io` — Dataset / Sampler / DataLoader.
+
+Reference: python/paddle/io (Dataset, IterableDataset, TensorDataset,
+BatchSampler, DistributedBatchSampler, DataLoader) over the fluid reader
+machinery (fluid/reader.py:147,421,792,1067 — GeneratorLoader feeding a
+C++ LoDTensorBlockingQueue read by py_reader/buffered_reader with
+double-buffer prefetch, fluid/dataloader/* multiprocess workers).
+
+TPU-native re-design: worker threads/processes produce numpy batches into
+the native C++ BlockingQueue (paddle_tpu/core_native) — GIL-free blocking
+and bounded memory like LoDTensorBlockingQueue — and the loader
+double-buffers ahead of the accelerator with async `jax.device_put`
+(BufferedReader's prefetch, with XLA's async dispatch replacing the CUDA
+stream juggling).
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import threading
+import warnings
+
+import numpy as np
+
+
+# -- datasets -----------------------------------------------------------------
+
+class Dataset:
+    """Map-style dataset (reference: paddle/io/dataset.py Dataset)."""
+
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+
+class IterableDataset(Dataset):
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __getitem__(self, idx):
+        raise RuntimeError("IterableDataset has no __getitem__")
+
+    def __len__(self):
+        raise RuntimeError("IterableDataset has no __len__")
+
+
+class TensorDataset(Dataset):
+    def __init__(self, tensors):
+        arrays = [np.asarray(t.numpy() if hasattr(t, "numpy") else t)
+                  for t in tensors]
+        n = arrays[0].shape[0]
+        assert all(a.shape[0] == n for a in arrays)
+        self.tensors = arrays
+
+    def __getitem__(self, idx):
+        return tuple(a[idx] for a in self.tensors)
+
+    def __len__(self):
+        return self.tensors[0].shape[0]
+
+
+class ComposeDataset(Dataset):
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        assert all(len(d) == len(self.datasets[0]) for d in self.datasets)
+
+    def __len__(self):
+        return len(self.datasets[0])
+
+    def __getitem__(self, idx):
+        out = []
+        for d in self.datasets:
+            sample = d[idx]
+            out.extend(sample if isinstance(sample, tuple) else (sample,))
+        return tuple(out)
+
+
+class ChainDataset(IterableDataset):
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+
+    def __iter__(self):
+        return itertools.chain(*self.datasets)
+
+
+class Subset(Dataset):
+    def __init__(self, dataset, indices):
+        self.dataset = dataset
+        self.indices = list(indices)
+
+    def __getitem__(self, idx):
+        return self.dataset[self.indices[idx]]
+
+    def __len__(self):
+        return len(self.indices)
+
+
+def random_split(dataset, lengths, generator=None):
+    assert sum(lengths) == len(dataset)
+    perm = np.random.RandomState().permutation(len(dataset))
+    out, ofs = [], 0
+    for n in lengths:
+        out.append(Subset(dataset, perm[ofs:ofs + n].tolist()))
+        ofs += n
+    return out
+
+
+# -- samplers -----------------------------------------------------------------
+
+class Sampler:
+    def __init__(self, data_source=None):
+        self.data_source = data_source
+
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self.data_source)
+
+
+class SequenceSampler(Sampler):
+    def __iter__(self):
+        return iter(range(len(self.data_source)))
+
+
+class RandomSampler(Sampler):
+    def __init__(self, data_source, replacement=False, num_samples=None,
+                 generator=None):
+        super().__init__(data_source)
+        self.replacement = replacement
+        self._num_samples = num_samples
+
+    @property
+    def num_samples(self):
+        return self._num_samples or len(self.data_source)
+
+    def __iter__(self):
+        n = len(self.data_source)
+        if self.replacement:
+            return iter(np.random.randint(0, n, self.num_samples).tolist())
+        return iter(np.random.permutation(n)[:self.num_samples].tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class WeightedRandomSampler(Sampler):
+    def __init__(self, weights, num_samples, replacement=True):
+        self.weights = np.asarray(weights, dtype="float64")
+        self.num_samples = num_samples
+        self.replacement = replacement
+
+    def __iter__(self):
+        p = self.weights / self.weights.sum()
+        idx = np.random.choice(len(p), size=self.num_samples,
+                               replace=self.replacement, p=p)
+        return iter(idx.tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class BatchSampler(Sampler):
+    """(reference: paddle/io BatchSampler)."""
+
+    def __init__(self, dataset=None, sampler=None, shuffle=False,
+                 batch_size=1, drop_last=False):
+        self.batch_size = int(batch_size)
+        self.drop_last = drop_last
+        if sampler is not None:
+            self.sampler = sampler
+        elif shuffle:
+            self.sampler = RandomSampler(dataset)
+        else:
+            self.sampler = SequenceSampler(dataset)
+
+    def __iter__(self):
+        batch = []
+        for idx in self.sampler:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = len(self.sampler)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+
+class DistributedBatchSampler(BatchSampler):
+    """Rank-sharded batches (reference: paddle/io
+    DistributedBatchSampler); on TPU the 'ranks' are jax processes."""
+
+    def __init__(self, dataset, batch_size, num_replicas=None, rank=None,
+                 shuffle=False, drop_last=False):
+        self.dataset = dataset
+        self.batch_size = int(batch_size)
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        if num_replicas is None or rank is None:
+            try:
+                import jax
+
+                num_replicas = num_replicas or jax.process_count()
+                rank = rank if rank is not None else jax.process_index()
+            except Exception:
+                num_replicas, rank = 1, 0
+        self.nranks = num_replicas
+        self.local_rank = rank
+        self.epoch = 0
+        self.num_samples = int(
+            math.ceil(len(dataset) / self.nranks)) if not drop_last \
+            else len(dataset) // self.nranks
+        self.total_size = self.num_samples * self.nranks
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+    def __iter__(self):
+        n = len(self.dataset)
+        indices = np.arange(n)
+        if self.shuffle:
+            rng = np.random.RandomState(self.epoch)
+            indices = rng.permutation(n)
+        # pad to make divisible, then take this rank's strided slice
+        if not self.drop_last and self.total_size > n:
+            indices = np.concatenate(
+                [indices, indices[:self.total_size - n]])
+        indices = indices[:self.total_size]
+        local = indices[self.local_rank::self.nranks]
+        batch = []
+        for idx in local.tolist():
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        if self.drop_last:
+            return self.num_samples // self.batch_size
+        return (self.num_samples + self.batch_size - 1) // self.batch_size
+
+
+# -- collate ------------------------------------------------------------------
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, (tuple, list)):
+        return tuple(default_collate_fn([b[i] for b in batch])
+                     for i in range(len(sample)))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch])
+                for k in sample}
+    arr = np.stack([np.asarray(s) for s in batch])
+    return arr
+
+
+# -- DataLoader ---------------------------------------------------------------
+
+class DataLoader:
+    """(reference: paddle/io/dataloader + fluid/reader.py DataLoader).
+
+    num_workers=0: synchronous iteration.
+    num_workers>0: worker threads index the dataset and push collated
+    numpy batches into the native C++ BlockingQueue; the consumer pops
+    with the GIL released.  use_buffer_reader double-buffers one batch
+    onto the device with async jax.device_put.
+    """
+
+    def __init__(self, dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=False, timeout=0, worker_init_fn=None):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = int(num_workers)
+        self.use_buffer_reader = use_buffer_reader
+        self.prefetch_factor = max(2, int(prefetch_factor))
+        self.worker_init_fn = worker_init_fn
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        elif not self._iterable_mode:
+            self.batch_sampler = BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size,
+                drop_last=drop_last)
+        else:
+            self.batch_sampler = None
+            self.batch_size = int(batch_size)
+            self.drop_last = drop_last
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset has no length")
+        return len(self.batch_sampler)
+
+    # -- iteration paths ---------------------------------------------------
+    def _batches_sync(self):
+        if self._iterable_mode:
+            batch = []
+            for sample in self.dataset:
+                batch.append(sample)
+                if len(batch) == self.batch_size:
+                    yield self.collate_fn(batch)
+                    batch = []
+            if batch and not self.drop_last:
+                yield self.collate_fn(batch)
+        else:
+            for idxs in self.batch_sampler:
+                yield self.collate_fn([self.dataset[i] for i in idxs])
+
+    def _batches_workers(self):
+        from ..core_native import BlockingQueue
+
+        q = BlockingQueue(self.prefetch_factor * self.num_workers)
+        idx_iter = iter(self.batch_sampler) if not self._iterable_mode \
+            else None
+        lock = threading.Lock()
+        n_live = [self.num_workers]
+
+        def worker(wid):
+            if self.worker_init_fn is not None:
+                self.worker_init_fn(wid)
+            try:
+                if self._iterable_mode:
+                    batch = []
+                    for i, sample in enumerate(self.dataset):
+                        if i % self.num_workers != wid:
+                            continue
+                        batch.append(sample)
+                        if len(batch) == self.batch_size:
+                            q.push(self.collate_fn(batch))
+                            batch = []
+                    if batch and not self.drop_last:
+                        q.push(self.collate_fn(batch))
+                else:
+                    while True:
+                        with lock:
+                            idxs = next(idx_iter, None)
+                        if idxs is None:
+                            break
+                        q.push(self.collate_fn(
+                            [self.dataset[i] for i in idxs]))
+            finally:
+                with lock:
+                    n_live[0] -= 1
+                    if n_live[0] == 0:
+                        q.close()
+
+        threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+                   for w in range(self.num_workers)]
+        for t in threads:
+            t.start()
+        while True:
+            try:
+                yield q.pop()
+            except StopIteration:
+                break
+        for t in threads:
+            t.join()
+
+    def __iter__(self):
+        gen = (self._batches_workers() if self.num_workers > 0
+               else self._batches_sync())
+        if not self.use_buffer_reader:
+            yield from gen
+            return
+        # double-buffer: issue async device_put one batch ahead
+        # (BufferedReader's prefetch, buffered_reader.cc)
+        import jax
+
+        def put(b):
+            try:
+                return jax.tree_util.tree_map(jax.device_put, b)
+            except Exception:
+                return b
+
+        prev = None
+        for batch in gen:
+            nxt = put(batch)
+            if prev is not None:
+                yield prev
+            prev = nxt
+        if prev is not None:
+            yield prev
+
+
+def get_worker_info():
+    return None  # thread workers share the dataset object

@@ -193,3 +193,40 @@ def trace(layer, inputs):
     outs = traced(*inputs) if isinstance(inputs, (list, tuple)) \
         else traced(inputs)
     return outs, traced
+
+
+def save(layer, path, input_spec=None, **configs):
+    """`paddle.jit.save` (reference: dygraph/jit.py jit.save ->
+    TranslatedLayer format).  Exports to StableHLO + params via
+    paddle_tpu.inference."""
+    from ..inference import save_inference_model
+
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec (shapes/dtypes or "
+                         "example arrays)")
+    target = layer._layer if isinstance(layer, TracedLayer) else layer
+    return save_inference_model(path, target, input_spec,
+                                fold_params=configs.get("fold_params",
+                                                        True))
+
+
+def load(path, **configs):
+    """`paddle.jit.load` -> a callable predictor wrapper (the
+    TranslatedLayer role)."""
+    from ..inference import load_inference_model
+
+    pred = load_inference_model(path)
+
+    class _Loaded:
+        def __init__(self, predictor):
+            self._predictor = predictor
+
+        def __call__(self, *inputs):
+            outs = self._predictor.run(list(inputs))
+            outs = [_rewrap(o) for o in outs]
+            return outs[0] if len(outs) == 1 else outs
+
+        def eval(self):
+            return self
+
+    return _Loaded(pred)

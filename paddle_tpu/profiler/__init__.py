@@ -1,0 +1,130 @@
+"""Profiler — host event recording + device trace.
+
+Reference: paddle/fluid/platform/profiler.{h,cc} (`RecordEvent` RAII
+markers, EnableProfiler/DisableProfiler aggregation tables,
+profiler.proto) + DeviceTracer over CUPTI (device_tracer.h:43) +
+tools/timeline.py chrome://tracing conversion, and the Python surface
+fluid/profiler.py:131,198,255 (SURVEY.md §5.1).
+
+TPU-native re-design: device-side tracing is jax.profiler (XLA's
+profiler; TensorBoard/perfetto format replaces chrome://tracing), so
+this module provides (a) the RecordEvent host-marker API bridged onto
+jax.profiler.TraceAnnotation so host phases appear inside the XLA trace,
+(b) a host-side event table with the reference's summary-report shape,
+and (c) start/stop entry points that drive jax.profiler.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import defaultdict
+
+_STATE = threading.local()
+_ENABLED = [False]
+_EVENTS = defaultdict(lambda: {"calls": 0, "total": 0.0, "min": None,
+                               "max": 0.0})
+_EVENTS_LOCK = threading.Lock()
+_TRACE_DIR = [None]
+
+
+class RecordEvent:
+    """RAII host event marker (reference: profiler.h:127).  Usable as a
+    context manager or start()/end() pair; nests into the XLA trace via
+    jax.profiler.TraceAnnotation when device tracing is on."""
+
+    def __init__(self, name, event_type="UserDefined"):
+        self.name = name
+        self._t0 = None
+        self._ann = None
+
+    def begin(self):
+        self._t0 = time.perf_counter()
+        if _TRACE_DIR[0] is not None:
+            import jax
+
+            self._ann = jax.profiler.TraceAnnotation(self.name)
+            self._ann.__enter__()
+
+    def end(self):
+        if self._t0 is None:
+            return
+        dt = time.perf_counter() - self._t0
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+        if _ENABLED[0]:
+            with _EVENTS_LOCK:
+                e = _EVENTS[self.name]
+                e["calls"] += 1
+                e["total"] += dt
+                e["min"] = dt if e["min"] is None else min(e["min"], dt)
+                e["max"] = max(e["max"], dt)
+        self._t0 = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+
+
+def start_profiler(state="All", tracer_option="Default", trace_dir=None):
+    """(reference: fluid/profiler.py:198 start_profiler).  state 'All'
+    also starts the XLA device trace when trace_dir is given."""
+    _ENABLED[0] = True
+    with _EVENTS_LOCK:
+        _EVENTS.clear()
+    if trace_dir is not None:
+        import jax
+
+        jax.profiler.start_trace(trace_dir)
+        _TRACE_DIR[0] = trace_dir
+
+
+def stop_profiler(sorted_key="total", profile_path=None):
+    """(reference: fluid/profiler.py:255).  Prints the event table and
+    stops the XLA trace; returns the table rows."""
+    _ENABLED[0] = False
+    if _TRACE_DIR[0] is not None:
+        import jax
+
+        jax.profiler.stop_trace()
+        _TRACE_DIR[0] = None
+    with _EVENTS_LOCK:
+        rows = [{"name": k, **v, "avg": v["total"] / max(v["calls"], 1)}
+                for k, v in _EVENTS.items()]
+    key = {"total": "total", "calls": "calls", "max": "max", "min": "min",
+           "ave": "avg"}.get(sorted_key, "total")
+    rows.sort(key=lambda r: r[key] or 0, reverse=True)
+    if rows:
+        print(f"{'Event':<40}{'Calls':>8}{'Total(s)':>12}{'Avg(s)':>12}"
+              f"{'Min(s)':>12}{'Max(s)':>12}")
+        for r in rows:
+            print(f"{r['name']:<40}{r['calls']:>8}{r['total']:>12.6f}"
+                  f"{r['avg']:>12.6f}{(r['min'] or 0):>12.6f}"
+                  f"{r['max']:>12.6f}")
+    if profile_path:
+        import json
+
+        with open(profile_path, "w") as f:
+            json.dump(rows, f)
+    return rows
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key="total", profile_path=None,
+             trace_dir=None):
+    """(reference: fluid/profiler.py:131)."""
+    start_profiler(state, trace_dir=trace_dir)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+def reset_profiler():
+    with _EVENTS_LOCK:
+        _EVENTS.clear()

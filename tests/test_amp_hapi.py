@@ -1,0 +1,232 @@
+"""Tests for paddle.amp (auto_cast + GradScaler), paddle.save/load,
+paddle.metric, and the hapi Model trainer."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.fluid.dygraph import guard, to_variable
+
+
+@pytest.fixture(autouse=True)
+def dygraph():
+    with guard():
+        yield
+
+
+class TestAutoCast:
+    def test_white_list_casts(self):
+        x = to_variable(np.random.rand(4, 8).astype("float32"))
+        w = to_variable(np.random.rand(8, 4).astype("float32"))
+        with paddle.amp.auto_cast():
+            y = paddle.matmul(x, w)
+            z = paddle.exp(x)  # black list: stays f32
+        assert y.dtype == "bfloat16"
+        assert z.dtype == "float32"
+        assert paddle.matmul(x, w).dtype == "float32"
+
+    def test_custom_lists(self):
+        x = to_variable(np.random.rand(4, 4).astype("float32"))
+        with paddle.amp.auto_cast(custom_white_list={"exp"},
+                                  custom_black_list={"matmul_v2"}):
+            assert paddle.exp(x).dtype == "bfloat16"
+            assert paddle.matmul(x, x).dtype == "float32"
+
+    def test_o2_casts_everything_but_blacklist(self):
+        x = to_variable(np.random.rand(4, 4).astype("float32"))
+        with paddle.amp.auto_cast(level="O2"):
+            assert (x + x).dtype == "bfloat16"
+            assert paddle.nn.functional.softmax(x).dtype == "float32"
+
+    def test_grad_flows_back_f32(self):
+        lin = paddle.nn.Linear(8, 4)
+        x = to_variable(np.random.rand(2, 8).astype("float32"))
+        with paddle.amp.auto_cast():
+            y = lin(x)
+        y.astype("float32").mean().backward()
+        g = lin.weight.grad
+        assert g is not None and g.dtype == "float32"
+
+
+class TestGradScaler:
+    def test_scale_and_good_step(self):
+        sc = paddle.amp.GradScaler(init_loss_scaling=256.0)
+        lin = paddle.nn.Linear(4, 1)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=lin.parameters())
+        x = to_variable(np.random.rand(4, 4).astype("float32"))
+        w0 = lin.weight.numpy().copy()
+        loss = lin(x).mean()
+        sc.scale(loss).backward()
+        sc.step(opt)
+        assert not np.allclose(lin.weight.numpy(), w0)  # applied
+
+    def test_inf_skips_step_and_decays_scale(self):
+        import jax.numpy as jnp
+
+        sc = paddle.amp.GradScaler(init_loss_scaling=1024.0,
+                                   decr_every_n_nan_or_inf=1)
+        lin = paddle.nn.Linear(4, 1)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=lin.parameters())
+        w0 = lin.weight.numpy().copy()
+        lin.weight._grad = jnp.full((4, 1), np.inf, dtype=jnp.float32)
+        lin.bias._grad = jnp.zeros((1,), jnp.float32)
+        sc.step(opt)
+        np.testing.assert_allclose(lin.weight.numpy(), w0)  # skipped
+        assert sc.get_loss_scaling() == 512.0
+
+    def test_scale_growth(self):
+        sc = paddle.amp.GradScaler(init_loss_scaling=2.0,
+                                   incr_every_n_steps=2)
+        lin = paddle.nn.Linear(2, 1)
+        opt = paddle.optimizer.SGD(learning_rate=0.0,
+                                   parameters=lin.parameters())
+        import jax.numpy as jnp
+
+        for _ in range(2):
+            lin.weight._grad = jnp.ones((2, 1), jnp.float32)
+            sc.step(opt)
+        assert sc.get_loss_scaling() == 4.0
+
+    def test_state_dict(self):
+        sc = paddle.amp.GradScaler(init_loss_scaling=128.0)
+        sd = sc.state_dict()
+        sc2 = paddle.amp.GradScaler()
+        sc2.set_state_dict(sd)
+        assert sc2.get_loss_scaling() == 128.0
+
+
+class TestSaveLoad:
+    def test_state_dict_roundtrip(self, tmp_path):
+        net = paddle.nn.Sequential(paddle.nn.Linear(4, 8),
+                                   paddle.nn.BatchNorm1D(8))
+        p = str(tmp_path / "m.pdparams")
+        paddle.save(net.state_dict(), p)
+        loaded = paddle.load(p)
+        net2 = paddle.nn.Sequential(paddle.nn.Linear(4, 8),
+                                    paddle.nn.BatchNorm1D(8))
+        missing, unexpected = net2.set_state_dict(loaded)
+        assert not missing and not unexpected
+        np.testing.assert_allclose(net2[0].weight.numpy(),
+                                   net[0].weight.numpy())
+
+    def test_nested_object(self, tmp_path):
+        p = str(tmp_path / "obj.pd")
+        obj = {"step": 7, "arrs": [np.arange(3), {"w": np.eye(2)}]}
+        paddle.save(obj, p)
+        back = paddle.load(p)
+        assert back["step"] == 7
+        np.testing.assert_allclose(back["arrs"][1]["w"], np.eye(2))
+
+
+class TestMetrics:
+    def test_accuracy_topk(self):
+        m = paddle.metric.Accuracy(topk=(1, 2))
+        pred = np.array([[0.1, 0.9, 0.0], [0.5, 0.1, 0.4],
+                         [0.2, 0.3, 0.5]])
+        label = np.array([[1], [2], [2]])
+        m.update(m.compute(pred, label))
+        top1, top2 = m.accumulate()
+        assert abs(top1 - 2 / 3) < 1e-6
+        assert abs(top2 - 3 / 3) < 1e-6
+
+    def test_precision_recall(self):
+        p = paddle.metric.Precision()
+        r = paddle.metric.Recall()
+        preds = np.array([0.9, 0.8, 0.2, 0.7])
+        labels = np.array([1, 0, 1, 1])
+        p.update(preds, labels)
+        r.update(preds, labels)
+        assert abs(p.accumulate() - 2 / 3) < 1e-6
+        assert abs(r.accumulate() - 2 / 3) < 1e-6
+
+    def test_auc_perfect_and_random(self):
+        auc = paddle.metric.Auc()
+        auc.update(np.array([0.9, 0.8, 0.1, 0.2]), np.array([1, 1, 0, 0]))
+        assert auc.accumulate() > 0.99
+        auc.reset()
+        auc.update(np.array([0.5, 0.5, 0.5, 0.5]), np.array([1, 0, 1, 0]))
+        assert abs(auc.accumulate() - 0.5) < 0.01
+
+
+class _RegData(paddle.io.Dataset):
+    def __init__(self, n=64):
+        rng = np.random.RandomState(0)
+        self.x = rng.rand(n, 8).astype("float32")
+        self.y = (self.x @ rng.rand(8, 1)).astype("float32")
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+class TestHapiModel:
+    def _model(self):
+        net = paddle.nn.Sequential(paddle.nn.Linear(8, 16),
+                                   paddle.nn.ReLU(),
+                                   paddle.nn.Linear(16, 1))
+        m = paddle.Model(net)
+        m.prepare(optimizer=paddle.optimizer.Adam(
+            learning_rate=0.01, parameters=net.parameters()),
+            loss=paddle.nn.MSELoss())
+        return m
+
+    def test_fit_reduces_loss(self):
+        m = self._model()
+        hist = m.fit(_RegData(), batch_size=16, epochs=4, verbose=0)
+        assert hist[-1]["loss"] < hist[0]["loss"]
+
+    def test_evaluate_and_predict(self):
+        m = self._model()
+        m.fit(_RegData(), batch_size=16, epochs=2, verbose=0)
+        logs = m.evaluate(_RegData(), batch_size=32, verbose=0)
+        assert "loss" in logs
+        preds = m.predict(_RegData(), batch_size=32, stack_outputs=True)
+        assert preds[0].shape == (64, 1)
+
+    def test_save_load(self, tmp_path):
+        m = self._model()
+        m.fit(_RegData(), batch_size=32, epochs=1, verbose=0)
+        path = str(tmp_path / "ckpt")
+        m.save(path)
+        m2 = self._model()
+        m2.load(path)
+        np.testing.assert_allclose(
+            m2.network[0].weight.numpy(), m.network[0].weight.numpy())
+
+    def test_early_stopping(self):
+        from paddle_tpu.hapi.callbacks import EarlyStopping
+
+        m = self._model()
+        es = EarlyStopping(monitor="loss", patience=0, mode="min",
+                           baseline=0.0)  # nothing beats 0 -> stop asap
+        hist = m.fit(_RegData(), eval_data=_RegData(), batch_size=32,
+                     epochs=5, verbose=0, callbacks=[es])
+        assert len(hist) < 5
+
+    def test_classification_with_metric(self):
+        class Cls(paddle.io.Dataset):
+            def __init__(self):
+                rng = np.random.RandomState(0)
+                self.x = rng.rand(64, 4).astype("float32")
+                self.y = (self.x.sum(-1) > 2).astype("int64")[:, None]
+
+            def __len__(self):
+                return 64
+
+            def __getitem__(self, i):
+                return self.x[i], self.y[i]
+
+        net = paddle.nn.Linear(4, 2)
+        m = paddle.Model(net)
+        m.prepare(optimizer=paddle.optimizer.Adam(
+            learning_rate=0.05, parameters=net.parameters()),
+            loss=paddle.nn.CrossEntropyLoss(),
+            metrics=paddle.metric.Accuracy())
+        hist = m.fit(Cls(), batch_size=16, epochs=5, verbose=0)
+        assert hist[-1]["acc"] > 0.6
