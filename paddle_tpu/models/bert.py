@@ -205,9 +205,14 @@ class BertPretrainingCriterion(nn.Layer):
 
 def fake_batch(cfg, batch_size, seq_len, num_masked=20, seed=0):
     rng = np.random.RandomState(seed)
+    # realistic variable-length padding mask: real pretraining batches
+    # carry one, and the Pallas kernel handles it in-kernel (key bias)
+    lens = rng.randint(max(1, seq_len // 2), seq_len + 1, (batch_size,))
     return {
         "input_ids": rng.randint(0, cfg.vocab_size,
                                  (batch_size, seq_len)).astype("int64"),
+        "attention_mask": (np.arange(seq_len)[None, :]
+                           < lens[:, None]).astype("int64"),
         "token_type_ids": rng.randint(0, cfg.type_vocab_size,
                                       (batch_size, seq_len)).astype("int64"),
         "masked_positions": np.sort(
@@ -285,12 +290,21 @@ def build_pretrain_step(model: BertForPretraining,
 
             from ..ops.pallas.attention import ring_attention_scope
 
-            ring = (ring_attention_scope(mesh, sp_axis)
-                    if use_ring_attention and mesh is not None
-                    and sp_axis is not None else contextlib.nullcontext())
+            ring_active = (use_ring_attention and mesh is not None
+                           and sp_axis is not None)
+            ring = (ring_attention_scope(mesh, sp_axis) if ring_active
+                    else contextlib.nullcontext())
+            am = b.get("attention_mask")
+            if am is not None and not ring_active:
+                # (B, S) int -> (B, 1, 1, S) bool; the flash kernel
+                # runs this key-padding form in-kernel as a key bias
+                am = (am != 0)[:, None, None, :]
+            else:
+                am = None  # ring path has no mask support yet
             with rng_key_scope(key), ring:
                 return functional_call(
                     model, p, b["input_ids"], b["token_type_ids"],
+                    attention_mask=am,
                     masked_positions=b["masked_positions"])[0]
 
         if remat:
@@ -347,6 +361,7 @@ def build_pretrain_step(model: BertForPretraining,
         seq2 = P(dp_axis, sp_axis) if sp_axis else P(dp_axis)
         batch_shard = {
             "input_ids": NamedSharding(mesh, seq2),
+            "attention_mask": NamedSharding(mesh, seq2),
             "token_type_ids": NamedSharding(mesh, seq2),
             "masked_positions": NamedSharding(mesh, P(dp_axis)),
             "masked_labels": NamedSharding(mesh, P(dp_axis)),

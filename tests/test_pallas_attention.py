@@ -1,0 +1,182 @@
+"""Flash-attention kernel tests (interpret mode on the CPU mesh).
+
+Covers the round-2 kernel upgrades: in-kernel key-padding bias,
+in-kernel counter-based dropout (bit-exact fwd/bwd agreement), the
+padding shim for non-block-multiple shapes, and the Pallas backward
+kernels vs autodiff-through-XLA oracle gradients.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.pallas import attention as A
+
+
+def _rand_qkv(rng, b=2, sq=128, sk=128, h=2, d=64):
+    mk = lambda s: jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    return mk(sq), mk(sk), mk(sk)
+
+
+class TestFlashForward:
+    def test_causal_oracle(self):
+        rng = np.random.RandomState(0)
+        q, k, v = _rand_qkv(rng)
+        ref = A._xla_attention(q, k, v, is_causal=True)
+        out = A.flash_attention(q, k, v, is_causal=True, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_unaligned_lengths_padding_shim(self):
+        """ADVICE round-1 #1: non-block-multiple seq lens must not read
+        garbage K/V columns."""
+        rng = np.random.RandomState(1)
+        q, k, v = _rand_qkv(rng, sq=100, sk=75, d=48)
+        ref = A._xla_attention(q, k, v)
+        out = A.flash_attention(q, k, v, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_cross_attention_causal_offset(self):
+        rng = np.random.RandomState(2)
+        q, k, v = _rand_qkv(rng, sq=64, sk=160)
+        ref = A._xla_attention(q, k, v, is_causal=True)
+        out = A.flash_attention(q, k, v, is_causal=True, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_key_padding_bias_in_kernel(self):
+        rng = np.random.RandomState(3)
+        b, sk = 2, 128
+        q, k, v = _rand_qkv(rng, b=b, sk=sk)
+        lens = np.array([100, 57])
+        bool_mask = jnp.asarray(np.arange(sk)[None, :] < lens[:, None])
+        bias = jnp.where(bool_mask, 0.0, A.DEFAULT_MASK_VALUE)
+        ref = A._xla_attention(q, k, v,
+                               mask=bool_mask[:, None, None, :])
+        out = A.flash_attention(q, k, v, key_bias=bias, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_dispatcher_mask_reduction(self):
+        m4 = jnp.zeros((2, 1, 1, 128), jnp.float32)
+        assert A._mask_as_key_bias(m4, 2, 128) is not None
+        m_bool = jnp.ones((2, 128), jnp.bool_)
+        kb = A._mask_as_key_bias(m_bool, 2, 128)
+        assert kb is not None and kb.dtype == jnp.float32
+        # per-query masks are NOT expressible as key bias
+        dense = jnp.zeros((2, 1, 128, 128), jnp.float32)
+        assert A._mask_as_key_bias(dense, 2, 128) is None
+        per_head = jnp.zeros((2, 4, 1, 128), jnp.float32)
+        assert A._mask_as_key_bias(per_head, 2, 128) is None
+
+
+class TestFlashBackward:
+    def _grads(self, fn, q, k, v):
+        def loss(q, k, v):
+            out = fn(q, k, v)
+            # non-uniform cotangent exercises all grad paths
+            w = jnp.arange(out.size, dtype=jnp.float32).reshape(out.shape)
+            return jnp.sum(out * w) / out.size
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grads_vs_oracle(self, causal):
+        rng = np.random.RandomState(4)
+        q, k, v = _rand_qkv(rng, b=1, sq=128, sk=128, h=2, d=64)
+        g_ref = self._grads(
+            lambda q, k, v: A._xla_attention(q, k, v, is_causal=causal),
+            q, k, v)
+        g_out = self._grads(
+            lambda q, k, v: A.flash_attention(q, k, v, is_causal=causal,
+                                              interpret=True),
+            q, k, v)
+        for a, b in zip(g_out, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=2e-3)
+
+    def test_grads_unaligned_with_bias(self):
+        rng = np.random.RandomState(5)
+        b, sk = 2, 90
+        q, k, v = _rand_qkv(rng, b=b, sq=70, sk=sk, d=32)
+        lens = np.array([88, 41])
+        bool_mask = jnp.asarray(np.arange(sk)[None, :] < lens[:, None])
+        bias = jnp.where(bool_mask, 0.0, A.DEFAULT_MASK_VALUE)
+        g_ref = self._grads(
+            lambda q, k, v: A._xla_attention(
+                q, k, v, mask=bool_mask[:, None, None, :]), q, k, v)
+        g_out = self._grads(
+            lambda q, k, v: A.flash_attention(q, k, v, key_bias=bias,
+                                              interpret=True), q, k, v)
+        for a, b_ in zip(g_out, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=2e-3, atol=2e-3)
+
+
+class TestFlashDropout:
+    """The in-kernel RNG is a pure function of absolute coordinates, so
+    an XLA oracle applying the *same* keep mask must match bit-for-bit
+    in expectation AND gradient."""
+
+    def _oracle_with_keep(self, q, k, v, keep, p_drop):
+        d = q.shape[-1]
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / (d ** 0.5)
+        probs = jax.nn.softmax(logits, axis=-1)
+        probs = jnp.where(keep, probs / (1.0 - p_drop), 0.0)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+    def _keep_for(self, seed, b, h, sq, sk, p_drop):
+        """Reconstruct the kernel's keep mask with the same hash."""
+        seed_arr = jnp.asarray([seed], jnp.int32)
+        rows = []
+        for bh in range(b * h):
+            rows.append(A._keep_mask(seed_arr[0], bh, 0, 0, sq, sk, p_drop))
+        m = jnp.stack(rows).reshape(b, h, sq, sk)
+        return m
+
+    def test_dropout_matches_masked_oracle(self):
+        rng = np.random.RandomState(6)
+        p_drop = 0.3
+        b, sq, sk, h, d = 1, 128, 128, 2, 64
+        q, k, v = _rand_qkv(rng, b=b, sq=sq, sk=sk, h=h, d=d)
+        keep = self._keep_for(7, b, h, sq, sk, p_drop)
+
+        out = A.flash_attention(q, k, v, dropout_p=p_drop, dropout_seed=7,
+                                interpret=True)
+        ref = self._oracle_with_keep(q, k, v, keep, p_drop)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_dropout_grads_match_masked_oracle(self):
+        rng = np.random.RandomState(7)
+        p_drop = 0.25
+        b, sq, sk, h, d = 1, 128, 128, 1, 32
+        q, k, v = _rand_qkv(rng, b=b, sq=sq, sk=sk, h=h, d=d)
+        keep = self._keep_for(11, b, h, sq, sk, p_drop)
+
+        def l_kernel(q, k, v):
+            return jnp.sum(A.flash_attention(
+                q, k, v, dropout_p=p_drop, dropout_seed=11,
+                interpret=True) ** 2)
+
+        def l_oracle(q, k, v):
+            return jnp.sum(self._oracle_with_keep(q, k, v, keep,
+                                                  p_drop) ** 2)
+
+        g_k = jax.grad(l_kernel, argnums=(0, 1, 2))(q, k, v)
+        g_o = jax.grad(l_oracle, argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(g_k, g_o):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=2e-3, atol=2e-3)
+
+    def test_dropout_rate_and_determinism(self):
+        keep = np.asarray(A._keep_mask(jnp.int32(3), 0, 0, 0, 256, 256, 0.4))
+        assert abs(keep.mean() - 0.6) < 0.02
+        keep2 = np.asarray(A._keep_mask(jnp.int32(3), 0, 0, 0, 256, 256, 0.4))
+        np.testing.assert_array_equal(keep, keep2)
+        keep3 = np.asarray(A._keep_mask(jnp.int32(4), 0, 0, 0, 256, 256, 0.4))
+        assert (keep != keep3).any()
+        # block-layout independence: bits at offset == slice of full mask
+        sub = np.asarray(A._keep_mask(jnp.int32(3), 0, 128, 64, 128, 128, 0.4))
+        np.testing.assert_array_equal(sub, keep[128:, 64:192])
