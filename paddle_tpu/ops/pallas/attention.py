@@ -124,7 +124,7 @@ def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, kbias_ref,
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale  # (block_q, block_k)
-    s = s + kbias_ref[0][None, :]  # additive key bias (incl. pad mask)
+    s = s + kbias_ref[0]  # additive key bias (1, block_k) row broadcast
 
     if causal:
         # query i attends keys <= i + causal_offset (offset = sk - sq,
@@ -162,7 +162,7 @@ def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, kbias_ref,
     def _finalize():
         l = l_scr[:]
         o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
-        lse_ref[0] = (m_scr[:] + jnp.log(l))[:, 0]  # (block_q,)
+        lse_ref[0] = m_scr[:] + jnp.log(l)  # (block_q, 1)
 
 
 @functools.partial(jax.jit, static_argnames=(
@@ -171,9 +171,15 @@ def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, kbias_ref,
 def _flash_forward(q, k, v, kbias, seed, heads, is_causal=False, scale=None,
                    dropout_p=0.0, block_q=128, block_k=128, interpret=False,
                    causal_offset=None):
-    """q,k,v: (BH, S, D); kbias: (B, Sk) f32; seed: (1,) i32
-    -> (out (BH, Sq, D), lse (BH, Sq)).  Shapes must be pre-padded to
-    block multiples (flash_attention() handles that)."""
+    """q,k,v: (BH, S, D); kbias: (B, 1, Sk) f32; seed: (1,) i32
+    -> (out (BH, Sq, D), lse (BH, Sq, 1)).  Shapes must be pre-padded to
+    block multiples (flash_attention() handles that).
+
+    Row-vector operands are laid out with a unit SUBLANE dim ((B, 1, Sk)
+    bias blocks (1, 1, block_k); (BH, Sq, 1) lse blocks (1, block_q, 1))
+    because Mosaic requires each block's last two dims to be divisible by
+    (8, 128) or equal to the array dims — the round-2 rank-2 row blocks
+    (1, block_k) were illegal on real TPU (BENCH_r02 failure)."""
     bh, sq, d = q.shape
     sk = k.shape[1]
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
@@ -194,16 +200,16 @@ def _flash_forward(q, k, v, kbias, seed, heads, is_causal=False, scale=None,
             pl.BlockSpec((1, block_q, d), lambda b, iq, ik: (b, iq, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, iq, ik: (b, ik, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, iq, ik: (b, ik, 0)),
-            pl.BlockSpec((1, block_k),
-                         lambda b, iq, ik, h=heads: (b // h, ik)),
+            pl.BlockSpec((1, 1, block_k),
+                         lambda b, iq, ik, h=heads: (b // h, 0, ik)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, iq, ik: (b, iq, 0)),
-            pl.BlockSpec((1, block_q), lambda b, iq, ik: (b, iq)),
+            pl.BlockSpec((1, block_q, 1), lambda b, iq, ik: (b, iq, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -236,13 +242,13 @@ def _flash_bwd_dkv_kernel(seed_ref, q_ref, g_ref, lse_ref, delta_ref,
     g = g_ref[0]          # (block_q, d)
     k = k_ref[0]          # (block_k, d)
     v = v_ref[0]          # (block_k, d)
-    lse = lse_ref[0][:, None]      # (block_q, 1)
-    delta = delta_ref[0][:, None]  # (block_q, 1)
+    lse = lse_ref[0]      # (block_q, 1)
+    delta = delta_ref[0]  # (block_q, 1)
 
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale
-    s = s + kbias_ref[0][None, :]
+    s = s + kbias_ref[0]
     if causal:
         q_idx = iq * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
@@ -301,13 +307,13 @@ def _flash_bwd_dq_kernel(seed_ref, q_ref, g_ref, lse_ref, delta_ref,
     g = g_ref[0]
     k = k_ref[0]
     v = v_ref[0]
-    lse = lse_ref[0][:, None]
-    delta = delta_ref[0][:, None]
+    lse = lse_ref[0]      # (block_q, 1)
+    delta = delta_ref[0]  # (block_q, 1)
 
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale
-    s = s + kbias_ref[0][None, :]
+    s = s + kbias_ref[0]
     if causal:
         q_idx = iq * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
@@ -347,7 +353,7 @@ def _flash_backward(q, k, v, kbias, seed, out, lse, g, heads,
     sk = k.shape[1]
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)  # (BH, Sq)
+                    axis=-1, keepdims=True)  # (BH, Sq, 1)
     if causal_offset is None:
         causal_offset = sk - sq
     kw = dict(scale=scale, block_q=block_q, block_k=block_k,
@@ -355,15 +361,16 @@ def _flash_backward(q, k, v, kbias, seed, out, lse, g, heads,
               dropout_p=dropout_p)
 
     q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
-    row_spec = pl.BlockSpec((1, block_q), lambda b, i, j: (b, i))
+    row_spec = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
     # dkv grid iterates (bh, ik, iq): swap index maps for q-side inputs
     q_spec_t = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0))
-    row_spec_t = pl.BlockSpec((1, block_q), lambda b, i, j: (b, j))
+    row_spec_t = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0))
     k_spec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
     k_spec_t = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0))
-    kb_spec = pl.BlockSpec((1, block_k), lambda b, i, j, h=heads: (b // h, j))
-    kb_spec_t = pl.BlockSpec((1, block_k),
-                             lambda b, i, j, h=heads: (b // h, i))
+    kb_spec = pl.BlockSpec((1, 1, block_k),
+                           lambda b, i, j, h=heads: (b // h, 0, j))
+    kb_spec_t = pl.BlockSpec((1, 1, block_k),
+                             lambda b, i, j, h=heads: (b // h, 0, i))
     smem = pl.BlockSpec(memory_space=pltpu.SMEM)
 
     dk, dv = pl.pallas_call(
@@ -439,9 +446,33 @@ _flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 # -- public API ---------------------------------------------------------------
 
+def _pick_blocks(sq, sk, d, block_q=None, block_k=None,
+                 vmem_budget=8 * 1024 * 1024):
+    """Choose MXU-friendly block sizes.  Bigger tiles amortize the
+    per-grid-step overhead (measured on v5e: (512,512) blocks run the
+    S=512 BERT forward ~4x faster than (128,128)), capped so the
+    working set (q/k/v blocks + f32 scores + accumulators) stays well
+    inside VMEM."""
+    if block_q is None:
+        block_q = min(512, round_up(sq, 128))
+    if block_k is None:
+        block_k = min(512, round_up(sk, 128))
+    # working set ~= f32 scores + probs + q/k/v/acc tiles; shrink in
+    # 128-steps (Mosaic wants lane-dim blocks divisible by 128)
+    while block_q > 128 and (
+            block_q * block_k * 8 + (block_q + 2 * block_k) * d * 4
+            > vmem_budget):
+        block_q -= 128
+    while block_k > 128 and (
+            block_q * block_k * 8 + (block_q + 2 * block_k) * d * 4
+            > vmem_budget):
+        block_k -= 128
+    return block_q, block_k
+
+
 def flash_attention(q, k, v, key_bias=None, is_causal=False, scale=None,
-                    dropout_p=0.0, dropout_seed=None, block_q=128,
-                    block_k=128, interpret=False):
+                    dropout_p=0.0, dropout_seed=None, block_q=None,
+                    block_k=None, interpret=False):
     """(B, S, H, D) flash attention via the Pallas kernels.
 
     key_bias: optional (B, Sk) float32 additive bias applied to every
@@ -461,6 +492,7 @@ def flash_attention(q, k, v, key_bias=None, is_causal=False, scale=None,
     sk = k.shape[1]
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
 
+    block_q, block_k = _pick_blocks(sq, sk, d, block_q, block_k)
     sq_p = round_up(sq, block_q)
     sk_p = round_up(sk, block_k)
     d_p = round_up(d, 64)
@@ -480,6 +512,7 @@ def flash_attention(q, k, v, key_bias=None, is_causal=False, scale=None,
     if sk_p != sk:  # mask out padded keys
         valid = jnp.arange(sk_p) < sk
         bias = jnp.where(valid[None, :], bias, DEFAULT_MASK_VALUE)
+    bias = bias[:, None, :]  # (B, 1, Sk_p): unit sublane dim for Mosaic
 
     if dropout_p > 0.0:
         seed = (jnp.zeros((1,), jnp.int32) if dropout_seed is None
@@ -488,11 +521,67 @@ def flash_attention(q, k, v, key_bias=None, is_causal=False, scale=None,
         seed = jnp.zeros((1,), jnp.int32)
     seed_f = lax.bitcast_convert_type(seed, jnp.float32)
 
+    # Last line of defense (code-review r3): compile the EXACT fwd+bwd
+    # instances standalone before committing the traced graph to them.
+    # The generic probe covers the block/dtype tiling surface, but an
+    # unprobed real-shape Mosaic failure would otherwise surface at the
+    # caller's jit compile, where no try/except can catch it.
+    if not interpret and on_tpu() and not _probe_exact(
+            qm.shape, km.shape, h, is_causal, float(dropout_p),
+            qm.dtype, block_q, block_k, sk - sq):
+        mask = None if key_bias is None \
+            else lax.stop_gradient(key_bias)[:, None, None, :]
+        return _xla_attention(q, k, v, mask=mask, is_causal=is_causal,
+                              scale=scale, dropout_p=dropout_p,
+                              dropout_key=None)
+
     out = _flash_attention(qm, km, vm, bias, seed_f, h, is_causal, scale,
                            float(dropout_p), interpret, sk - sq,
                            block_q, block_k)
     out = out[:, :sq, :d]
     return jnp.transpose(out.reshape(b, h, sq, d), (0, 2, 1, 3))
+
+
+_EXACT_PROBE_CACHE = {}
+
+
+def _probe_exact(q_shape, k_shape, heads, is_causal, dropout_p, dtype,
+                 block_q, block_k, causal_offset):
+    """Compile (never run) the exact kernel instances flash_attention is
+    about to stage, once per configuration.  Returns False (with a loud
+    warning) if Mosaic rejects them, so the caller can fall back to XLA
+    instead of poisoning the surrounding jit compile."""
+    key = (q_shape, k_shape, heads, is_causal, dropout_p,
+           jnp.dtype(dtype).name, block_q, block_k, causal_offset)
+    if key not in _EXACT_PROBE_CACHE:
+        try:
+            sds = jax.ShapeDtypeStruct
+            bh, sq, d = q_shape
+            sk = k_shape[1]
+            x = sds(q_shape, dtype)
+            kv = sds(k_shape, dtype)
+            kb = sds((bh // heads, 1, sk), jnp.float32)
+            seed = sds((1,), jnp.int32)
+            kw = dict(is_causal=is_causal, dropout_p=dropout_p,
+                      block_q=block_q, block_k=block_k,
+                      causal_offset=causal_offset)
+            _flash_forward.lower(x, kv, kv, kb, seed, heads,
+                                 **kw).compile()
+            lse = sds((bh, sq, 1), jnp.float32)
+            _flash_backward.lower(x, kv, kv, kb, seed, x, lse, x, heads,
+                                  **kw).compile()
+            _EXACT_PROBE_CACHE[key] = True
+        except Exception as e:  # noqa: BLE001
+            import warnings
+
+            warnings.warn(
+                "paddle_tpu: flash-attention instance "
+                f"q{q_shape} k{k_shape} blocks=({block_q},{block_k}) "
+                f"failed to compile ({type(e).__name__}: {e}); using the "
+                "XLA attention path for this shape.", RuntimeWarning,
+                stacklevel=2)
+            _EXACT_PROBE_CACHE[key] = False
+    return _EXACT_PROBE_CACHE[key]
 
 
 def _mask_as_key_bias(mask, batch, sk):
@@ -519,13 +608,75 @@ def _mask_as_key_bias(mask, batch, sk):
     return m
 
 
+_PROBE_CACHE = {}
+_FLASH_DISABLED = None  # reason string when force-disabled
+
+
+def disable_flash(reason):
+    """Force all attention dispatch onto the XLA path (used by bench.py
+    when the preflight finds a numeric mismatch: a kernel that COMPILES
+    but is WRONG must not produce the bench number)."""
+    global _FLASH_DISABLED
+    _FLASH_DISABLED = reason
+
+
+def _probe_flash_kernel(block_q=128, block_k=128, d=128,
+                        dtype=jnp.bfloat16):
+    """Compile (never run) a tiny fwd+bwd kernel instance against the real
+    backend, once per block config.  If Mosaic rejects the kernel the
+    Pallas path is disabled with a loud warning and attention falls back
+    to plain XLA — a kernel bug must degrade to a slower-but-correct
+    train step, never to a dead bench (VERDICT r2 "do this" #2; round 2
+    shipped 0.0 MFU because the first compile error killed the step).
+
+    `.lower().compile()` happens at the Python level, so this is safe to
+    call while tracing an outer jit: nothing is staged into the caller's
+    graph."""
+    key = (block_q, block_k, d, jnp.dtype(dtype).name)
+    if key not in _PROBE_CACHE:
+        try:
+            s = 2 * max(block_q, block_k)
+            sds = jax.ShapeDtypeStruct
+            x = sds((2, s, d), dtype)
+            kb = sds((1, 1, s), jnp.float32)
+            seed = sds((1,), jnp.int32)
+            _flash_forward.lower(
+                x, x, x, kb, seed, 2, is_causal=True, dropout_p=0.1,
+                block_q=block_q, block_k=block_k,
+                causal_offset=0).compile()
+            lse = sds((2, s, 1), jnp.float32)
+            _flash_backward.lower(
+                x, x, x, kb, seed, x, lse, x, 2, is_causal=True,
+                dropout_p=0.1, block_q=block_q, block_k=block_k,
+                causal_offset=0).compile()
+            _PROBE_CACHE[key] = True
+        except Exception as e:  # Mosaic/lowering failure: fall back
+            import warnings
+
+            warnings.warn(
+                "paddle_tpu: Pallas flash-attention kernel failed to "
+                f"compile for this TPU ({type(e).__name__}: {e}); "
+                "falling back to the XLA attention path. Performance "
+                "will be lower but training proceeds.", RuntimeWarning,
+                stacklevel=2)
+            _PROBE_CACHE[key] = False
+    return _PROBE_CACHE[key]
+
+
 def _flash_ok(q, k):
-    """Kernel-dispatch heuristic: on TPU with Pallas available, and the
+    """Kernel-dispatch heuristic: on TPU with Pallas available, the
     sequences long enough that blockwise tiling wins over plain XLA
-    (the padding shim makes any shape *correct*; this is about perf)."""
+    (the padding shim makes any shape *correct*; this is about perf),
+    and the kernel actually compiles for this chip (probe above)."""
+    if _FLASH_DISABLED is not None:
+        return False
     if not (_HAS_PALLAS and on_tpu()):
         return False
-    return q.shape[1] >= 128 and k.shape[1] >= 128
+    if not (q.shape[1] >= 128 and k.shape[1] >= 128):
+        return False
+    bq, bk = _pick_blocks(q.shape[1], k.shape[1], q.shape[-1])
+    return _probe_flash_kernel(bq, bk, round_up(q.shape[-1], 64),
+                               q.dtype)
 
 
 import contextlib
