@@ -15,6 +15,13 @@ from . import collective  # noqa: F401
 from .collective import (all_gather, all_reduce, barrier, broadcast,  # noqa: F401
                          get_rank, get_world_size, scatter)
 from .parallel import init_parallel_env, ParallelEnv  # noqa: F401
+from .spawn import spawn  # noqa: F401
+from . import launch_utils  # noqa: F401
+
+# NOTE: `launch` is deliberately NOT imported here: `python -m
+# paddle_tpu.distributed.launch` imports this package first, and an
+# eager submodule import would make runpy warn about (and re-execute) a
+# second copy of the module.  Import it explicitly where needed.
 
 
 def get_world_size() -> int:  # noqa: F811 — canonical definition

@@ -1,0 +1,70 @@
+"""Multi-process / multi-host job launcher.
+
+    python -m paddle_tpu.distributed.launch [--ips ip1,ip2] \
+        [--nproc_per_node N] [--started_port P] [--log_dir dir] \
+        train.py [script args...]
+
+TPU-native equivalent of the reference collective launcher
+(/root/reference/python/paddle/distributed/fleet/launch.py:183
+`launch_collective`): builds the Cluster/Pod topology (from the TPU pod
+env when present, else --ips/localhost), exports the PADDLE_* +
+coordinator env to each local worker, spawns them, and propagates the
+first failure.  There is no PS mode: parameter-server strategies are out
+of TPU scope (SURVEY.md §2.9 #13-15); collective is the only mode.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .launch_utils import (find_free_ports, get_cluster,
+                           get_cluster_from_tpu_env, start_local_trainers,
+                           watch_local_trainers)
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        description="paddle_tpu collective launcher")
+    p.add_argument("--ips", type=str, default="127.0.0.1",
+                   help="comma-separated host ips (rank order)")
+    p.add_argument("--node_ip", type=str, default=None,
+                   help="this node's ip (default: first of --ips)")
+    p.add_argument("--nproc_per_node", type=int, default=None,
+                   help="worker processes per node (default: 1 — a JAX "
+                        "process owns all local chips)")
+    p.add_argument("--started_port", type=int, default=None)
+    p.add_argument("--log_dir", type=str, default=None)
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def launch_collective(args):
+    nproc = args.nproc_per_node or 1
+    topo = get_cluster_from_tpu_env(nproc)
+    if topo is not None:
+        cluster, pod = topo
+    else:
+        ips = [s.strip() for s in args.ips.split(",") if s.strip()]
+        node_ip = args.node_ip or ips[0]
+        port = args.started_port or (
+            find_free_ports(1)[0] if len(ips) == 1 and nproc == 1
+            else 8476)
+        cluster, pod = get_cluster(ips, node_ip, port, nproc)
+
+    cmd = [sys.executable, "-u", args.training_script] \
+        + args.training_script_args
+    procs = start_local_trainers(cluster, pod, cmd, log_dir=args.log_dir)
+    rc = watch_local_trainers(procs)
+    if rc != 0:
+        sys.exit(rc)
+
+
+def main(argv=None):
+    launch_collective(_parse_args(argv))
+
+
+if __name__ == "__main__":
+    main()

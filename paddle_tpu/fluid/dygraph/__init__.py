@@ -7,6 +7,7 @@
   engine.py         reverse-topological grad walk     (imperative/basic_engine.cc:171)
   math_op_patch.py  Tensor operator overloads         (varbase_patch_methods.py)
   base.py           guard / enable / to_variable      (dygraph/base.py)
+  parallel.py       DataParallel via sharded arrays   (dygraph/parallel.py:335)
 """
 
 from .base import (enable_dygraph, disable_dygraph, enabled, guard,
@@ -15,5 +16,6 @@ from .engine import grad, run_backward
 from .tracer import (Tracer, enable_grad, manual_seed, no_grad,
                      no_grad_decorator, trace_fn, trace_op)
 from .varbase import Tensor, VarBase
+from .parallel import DataParallel, ParallelEnv
 
 from . import math_op_patch  # installs Tensor operator overloads

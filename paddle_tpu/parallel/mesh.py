@@ -64,3 +64,27 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 def batch_sharded(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
     return NamedSharding(mesh, P(axis))
+
+
+def global_mesh(axes: Optional[Dict[str, int]] = None) -> Mesh:
+    """Mesh over ALL global devices (multi-host aware: after
+    `jax.distributed.initialize`, jax.devices() spans every process).
+    The multi-host analogue of the reference's cross-node NCCLContextMap
+    rings (nccl_helper.h:185) — XLA routes collectives over ICI/DCN from
+    the mesh, no ring construction needed."""
+    return make_mesh(axes, devices=jax.devices())
+
+
+def shard_host_batch(mesh: Mesh, tree, axis: str = DATA_AXIS):
+    """Assemble global device arrays from per-process host shards: each
+    process contributes its local slice of the leading (batch) dim.
+    TPU-native replacement for the reference's per-rank feed split
+    (DataFeed per trainer, data_feed.cc) when driving a multi-host
+    pjit step."""
+    sharding = NamedSharding(mesh, P(axis))
+
+    def put(x):
+        x = np.asarray(x)
+        return jax.make_array_from_process_local_data(sharding, x)
+
+    return jax.tree_util.tree_map(put, tree)

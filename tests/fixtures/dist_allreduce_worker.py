@@ -1,0 +1,75 @@
+"""2-process collective training fixture (reference pattern:
+test_dist_base.py `_run_cluster` model files like dist_mnist.py).
+
+Each worker joins the global mesh, trains a tiny regression model on its
+batch shard with gradients combined by XLA sharding propagation (the
+allreduce), and writes its final loss.  The test compares against a
+single-process run — losses must match bit-for-bit-ish because the
+GLOBAL batch and seed are identical.
+"""
+
+import os
+import sys
+
+# own platform config: workers inherit the test env; force a clean
+# single-local-device CPU runtime regardless
+os.environ["XLA_FLAGS"] = ""
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np
+
+
+def make_data(steps=20, batch=16, dim=8, seed=0):
+    rng = np.random.RandomState(seed)
+    W = rng.randn(dim, 1).astype("float32")
+    xs = rng.randn(steps, batch, dim).astype("float32")
+    ys = xs @ W
+    return xs, ys
+
+
+def train(out_path):
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.parallel.mesh import (DATA_AXIS, global_mesh,
+                                          replicated, shard_host_batch)
+
+    env = dist.init_parallel_env()
+    rank, world = env.rank, env.world_size
+    mesh = global_mesh({DATA_AXIS: world})
+
+    xs, ys = make_data()
+    dim = xs.shape[-1]
+    params = {"w": jnp.zeros((dim, 1), jnp.float32),
+              "b": jnp.zeros((1,), jnp.float32)}
+    params = jax.device_put(params, replicated(mesh))
+
+    def loss_fn(p, x, y):
+        pred = x @ p["w"] + p["b"]
+        return jnp.mean((pred - y) ** 2)
+
+    @jax.jit
+    def step(p, x, y):
+        l, g = jax.value_and_grad(loss_fn)(p, x, y)
+        return jax.tree_util.tree_map(lambda p, g: p - 0.1 * g, p, g), l
+
+    loss = None
+    for i in range(xs.shape[0]):
+        # this process's shard of the global batch
+        per = xs.shape[1] // world
+        xl = xs[i, rank * per:(rank + 1) * per]
+        yl = ys[i, rank * per:(rank + 1) * per]
+        gx, gy = shard_host_batch(mesh, (xl, yl))
+        params, loss = step(params, gx, gy)
+    with open(out_path % rank, "w") as f:
+        f.write(repr(float(loss)))
+
+
+def spawn_entry(out_path):
+    """Entry for the spawn() API test (must be module-level importable)."""
+    train(out_path)
+
+
+if __name__ == "__main__":
+    train(sys.argv[1])

@@ -1,0 +1,201 @@
+"""Loss-trajectory equivalence for every parallel mode (VERDICT r3 task
+5): same seed, N-way sharded vs 1-device, losses must match — the
+reference's `check_with_place` standard
+(/root/reference/python/paddle/fluid/tests/unittests/test_dist_base.py:1119
+asserts dist losses ~= local losses for each strategy's model fixture).
+
+Modes covered on the 8-device virtual CPU mesh:
+  dp        batch sharding (BERT-tiny full train step)
+  mp        tensor/model parallel param sharding (BERT-tiny)
+  dp x mp   combined 4x2 mesh (BERT-tiny)
+  sp        ring-attention sequence parallelism (BERT-tiny, dropout=0)
+  sharding  ZeRO-style param+optimizer-state sharding (BERT-tiny)
+  pp        GPipe pipeline (MLP stages; BERT pipeline lands with the
+            non-uniform-stage generalization)
+  dygraph   eager DataParallel
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models import bert
+from paddle_tpu.parallel.mesh import make_mesh
+
+STEPS = 4
+TOL = dict(rtol=2e-3, atol=2e-4)
+
+
+def _bert_losses(mesh=None, steps=STEPS, dropout=True, **mesh_kw):
+    import paddle_tpu as paddle
+
+    cfg = bert.BertConfig.tiny()
+    if not dropout:
+        cfg.hidden_dropout_prob = 0.0
+        cfg.attention_probs_dropout_prob = 0.0
+    paddle.seed(0)  # init draws from the global generator
+    model = bert.BertForPretraining(cfg)
+    step, state = bert.build_pretrain_step(model, bf16=False, mesh=mesh,
+                                           **mesh_kw)
+    b = bert.fake_batch(cfg, 8, 128, num_masked=10, seed=7)
+    losses = []
+    for _ in range(steps):
+        state, loss = step(state, b, jnp.float32(1e-3))
+        losses.append(float(loss))
+    return losses
+
+
+@pytest.fixture(scope="module")
+def single_device_losses():
+    return _bert_losses(mesh=None)
+
+
+@pytest.fixture(scope="module")
+def single_device_losses_nodrop():
+    return _bert_losses(mesh=None, dropout=False)
+
+
+class TestShardedEqualsSingle:
+    def test_dp(self, single_device_losses):
+        mesh = make_mesh({"dp": 8})
+        got = _bert_losses(mesh=mesh, dp_axis="dp")
+        np.testing.assert_allclose(got, single_device_losses, **TOL)
+
+    def test_mp(self, single_device_losses):
+        mesh = make_mesh({"dp": 1, "mp": 8})
+        got = _bert_losses(mesh=mesh, dp_axis="dp", mp_axis="mp")
+        np.testing.assert_allclose(got, single_device_losses, **TOL)
+
+    def test_dp_x_mp(self, single_device_losses):
+        mesh = make_mesh({"dp": 4, "mp": 2})
+        got = _bert_losses(mesh=mesh, dp_axis="dp", mp_axis="mp")
+        np.testing.assert_allclose(got, single_device_losses, **TOL)
+
+    def test_sp_ring_attention(self, single_device_losses_nodrop):
+        mesh = make_mesh({"dp": 2, "sp": 4})
+        got = _bert_losses(mesh=mesh, dp_axis="dp", sp_axis="sp",
+                           use_ring_attention=True, dropout=False)
+        np.testing.assert_allclose(got, single_device_losses_nodrop,
+                                   **TOL)
+
+    def test_zero_sharding(self, single_device_losses):
+        """ZeRO: params + adam moments sharded over the data axis.
+        Numerics must be identical — sharding only changes layout."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        import paddle_tpu as paddle
+
+        mesh = make_mesh({"dp": 8})
+        cfg = bert.BertConfig.tiny()
+        paddle.seed(0)
+        model = bert.BertForPretraining(cfg)
+        step, state = bert.build_pretrain_step(model, bf16=False)
+        # re-place the state ZeRO-style: shard each tensor's first
+        # axis that divides the mesh (stage-3 partitioning)
+        def zero_spec(v):
+            for i, d in enumerate(v.shape):
+                if d % 8 == 0:
+                    return P(*([None] * i + ["dp"]))
+            return P()
+
+        shardings = {
+            grp: {k: NamedSharding(mesh, zero_spec(v))
+                  for k, v in state[grp].items()}
+            for grp in ("params", "m", "v")}
+        shardings["t"] = NamedSharding(mesh, P())
+        state = jax.device_put(state, shardings)
+        b = bert.fake_batch(cfg, 8, 128, num_masked=10, seed=7)
+        losses = []
+        for _ in range(STEPS):
+            state, loss = step(state, b, jnp.float32(1e-3))
+            losses.append(float(loss))
+        np.testing.assert_allclose(losses, single_device_losses, **TOL)
+
+    def test_pp_gpipe(self):
+        """4-stage GPipe MLP == non-pipelined (uniform stages; the
+        real-model pipeline test lives in test_pipeline_bert.py)."""
+        from paddle_tpu.parallel.pipeline import gpipe, stack_stage_params
+
+        mesh = make_mesh({"pp": 4}, devices=jax.devices()[:4])
+        rng = np.random.RandomState(0)
+        ws = [jnp.asarray(rng.randn(16, 16) * 0.3, jnp.float32)
+              for _ in range(4)]
+
+        def stage(p, x):
+            return jnp.tanh(x @ p["w"])
+
+        run = gpipe(mesh, stage, num_microbatches=4, axis="pp")
+        x = jnp.asarray(rng.randn(8, 16), jnp.float32)
+
+        def loss_pp(params, x):
+            return jnp.mean(run(params, x) ** 2)
+
+        def loss_ref(params_list, x):
+            h = x
+            for p in params_list:
+                h = stage({"w": p}, h)
+            return jnp.mean(h ** 2)
+
+        stacked = stack_stage_params([{"w": w} for w in ws])
+        lp, gp = jax.value_and_grad(loss_pp)(stacked, x)
+        lr, gr = jax.value_and_grad(
+            lambda ws, x: loss_ref(list(ws), x))(tuple(ws), x)
+        np.testing.assert_allclose(float(lp), float(lr), rtol=1e-5)
+        for i in range(4):
+            np.testing.assert_allclose(np.asarray(gp["w"][i]),
+                                       np.asarray(gr[i]), rtol=1e-4,
+                                       atol=1e-5)
+
+
+class TestDygraphDataParallel:
+    def test_dp_matches_single(self):
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.fluid.dygraph import (DataParallel, guard,
+                                              to_variable)
+        from paddle_tpu.optimizer import SGD
+
+        def run(parallel):
+            with guard():
+                paddle.seed(0)
+                net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                                    nn.Linear(32, 10))
+                model = DataParallel(net) if parallel else net
+                opt = SGD(learning_rate=0.1,
+                          parameters=net.parameters())
+                rng = np.random.RandomState(1)
+                losses = []
+                for _ in range(6):
+                    x = to_variable(rng.randn(32, 16).astype("float32"))
+                    y = to_variable(
+                        rng.randint(0, 10, (32,)).astype("int64"))
+                    loss = F.cross_entropy(model(x), y)
+                    loss = (model.scale_loss(loss) if parallel else loss)
+                    loss.backward()
+                    if parallel:
+                        model.apply_collective_grads()
+                    opt.minimize(loss)
+                    for p in net.parameters():
+                        p.clear_gradient()
+                    losses.append(float(loss.numpy()))
+                return losses
+
+        np.testing.assert_allclose(run(True), run(False), rtol=2e-4)
+
+    def test_params_replicated_and_inputs_sharded(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.fluid.dygraph import DataParallel, guard, to_variable
+
+        with guard():
+            net = nn.Linear(8, 4)
+            model = DataParallel(net)
+            assert model._nranks == 8
+            x = to_variable(np.ones((16, 8), "float32"))
+            out = model(x)
+            # input got the data sharding; params stayed replicated
+            assert len(set(x._value.sharding.device_set)) == 8
+            assert net.weight._value.sharding.is_fully_replicated
+            assert out.shape == [16, 4]
