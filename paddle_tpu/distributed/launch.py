@@ -49,9 +49,14 @@ def launch_collective(args):
     else:
         ips = [s.strip() for s in args.ips.split(",") if s.strip()]
         node_ip = args.node_ip or ips[0]
-        port = args.started_port or (
-            find_free_ports(1)[0] if len(ips) == 1 and nproc == 1
-            else 8476)
+        if args.started_port:
+            port = args.started_port
+        elif len(ips) == 1:
+            # single-node: reserve genuinely free ports so concurrent
+            # jobs on one host don't collide on a fixed base
+            port = find_free_ports(nproc)
+        else:
+            port = 8476  # multi-node needs a pre-agreed base port
         cluster, pod = get_cluster(ips, node_ip, port, nproc)
 
     cmd = [sys.executable, "-u", args.training_script] \

@@ -74,11 +74,16 @@ def find_free_ports(n: int) -> List[int]:
     return ports
 
 
-def get_cluster(node_ips: List[str], node_ip: str, started_port: int,
+def get_cluster(node_ips: List[str], node_ip: str, started_port,
                 nproc_per_node: int) -> (Cluster, Pod):
     """Static topology: every node runs `nproc_per_node` workers on
     consecutive ports from `started_port` (the reference's
-    get_cluster_from_args contract, so its launch scripts translate)."""
+    get_cluster_from_args contract, so its launch scripts translate).
+    `started_port` may also be an explicit port LIST (single-node
+    launches pass freshly reserved free ports to avoid collisions
+    between concurrent jobs)."""
+    ports = (list(started_port) if isinstance(started_port, (list, tuple))
+             else [started_port + i for i in range(nproc_per_node)])
     cluster = Cluster()
     rank = 0
     current = None
@@ -86,7 +91,7 @@ def get_cluster(node_ips: List[str], node_ip: str, started_port: int,
         pod = Pod(ip=ip)
         for i in range(nproc_per_node):
             pod.trainers.append(
-                Trainer(endpoint=f"{ip}:{started_port + i}", rank=rank))
+                Trainer(endpoint=f"{ip}:{ports[i]}", rank=rank))
             rank += 1
         cluster.pods.append(pod)
         if ip == node_ip:

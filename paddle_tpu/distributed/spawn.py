@@ -84,8 +84,9 @@ def spawn(func, args: Tuple = (), nprocs: int = -1, join: bool = True,
     with os.fdopen(fd, "wb") as f:
         pickle.dump((mod, qual, args), f)
 
-    port = started_port or find_free_ports(1)[0]
-    cluster, pod = get_cluster(["127.0.0.1"], "127.0.0.1", port, nprocs)
+    ports = ([started_port + i for i in range(nprocs)] if started_port
+             else find_free_ports(nprocs))
+    cluster, pod = get_cluster(["127.0.0.1"], "127.0.0.1", ports, nprocs)
     cmd = [sys.executable, "-u", "-c", _WORKER_SNIPPET, spec_path]
     procs = start_local_trainers(cluster, pod, cmd)
     ctx = SpawnContext(procs=procs, spec_path=spec_path)

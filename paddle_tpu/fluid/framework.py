@@ -313,6 +313,10 @@ class Block:
                 if name == EMPTY_VAR_NAME or i >= len(shapes1):
                     continue
                 s1, s2 = shapes1[i], shapes2[i]
+                if not hasattr(s1, "shape"):
+                    # composite values (TensorArrayVal) have no single
+                    # shape; leave the declared one
+                    continue
                 shape = tuple(
                     -1 if a != b else a for a, b in zip(s1.shape, s2.shape)
                 )

@@ -9,5 +9,6 @@ from .tensor import *  # noqa: F401,F403
 from .nn import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .control_flow import *  # noqa: F401,F403
+from .rnn import *  # noqa: F401,F403
 from .learning_rate_scheduler import *  # noqa: F401,F403
-from . import tensor, nn, loss, control_flow, learning_rate_scheduler  # noqa: F401
+from . import tensor, nn, loss, control_flow, rnn, learning_rate_scheduler  # noqa: F401

@@ -12,7 +12,7 @@ from ..framework import default_main_program
 from ..layer_helper import LayerHelper
 
 __all__ = ["While", "while_loop", "cond", "increment_", "array_write",
-           "array_read"]
+           "array_read", "array_length", "create_array"]
 
 
 class While:
@@ -114,9 +114,56 @@ def increment_(x, value=1.0):
     return increment(x, value)
 
 
+def create_array(dtype, capacity=None, element_shape=None):
+    """LoDTensorArray handle (reference fluid/layers/control_flow.py
+    create_array).  TPU-native re-design: the array is a STACKED buffer
+    + length (ops/control_flow_ops.py TensorArrayVal).  Pass `capacity`
+    + `element_shape` when the array will be written inside a While
+    block — XLA's static-shape contract needs the buffer preallocated
+    before it becomes loop-carried state; trace-time (outside-loop)
+    writes grow the buffer automatically and need neither."""
+    helper = LayerHelper("create_array")
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    if capacity is not None and element_shape is None:
+        raise ValueError("create_array(capacity=...) also needs "
+                         "element_shape")
+    # Always append the allocator so the handle is BOUND (an unproduced
+    # var would fail the executor's read-before-write analysis).
+    # capacity=0 allocates an empty sentinel that the first trace-time
+    # write replaces with a real buffer.
+    helper.append_op("allocate_array", inputs={}, outputs={"Out": [out]},
+                     attrs={"capacity": int(capacity or 0),
+                            "element_shape": list(element_shape or []),
+                            "dtype": dtype})
+    return out
+
+
 def array_write(x, i, array=None):
-    raise NotImplementedError("LoDTensorArray: pending lax.scan-based design")
+    """Write x at index i (reference array_write).  Returns the array
+    (a NEW version var: functional update, not mutation)."""
+    helper = LayerHelper("array_write")
+    inputs = {"X": [x], "I": [i]}
+    if array is None:
+        out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    else:
+        inputs["Array"] = [array]
+        out = array
+    helper.append_op("write_to_array", inputs=inputs,
+                     outputs={"Out": [out]})
+    return out
 
 
 def array_read(array, i):
-    raise NotImplementedError("LoDTensorArray: pending lax.scan-based design")
+    helper = LayerHelper("array_read")
+    out = helper.create_variable_for_type_inference(dtype=array.dtype)
+    helper.append_op("read_from_array", inputs={"X": [array], "I": [i]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def array_length(array):
+    helper = LayerHelper("array_length")
+    out = helper.create_variable_for_type_inference(dtype="int64")
+    helper.append_op("lod_array_length", inputs={"X": [array]},
+                     outputs={"Out": [out]})
+    return out

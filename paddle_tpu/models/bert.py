@@ -376,3 +376,121 @@ def build_pretrain_step(model: BertForPretraining,
     else:
         step_fn = jax.jit(step, donate_argnums=(0,))
     return step_fn, state
+
+
+def build_pipeline_pretrain_step(model: BertForPretraining, mesh,
+                                 num_microbatches=4, axis="pp",
+                                 learning_rate=1e-3):
+    """BERT pretraining over a NON-UNIFORM pipeline: embedding stage ->
+    n_stages of encoder blocks (params sharded over `axis`) -> pooler+
+    heads stage (VERDICT r3 task 9; reference behavior: PipelineTrainer/
+    SectionWorker ran sectioned BERT programs, pipeline_trainer.cc:25,
+    section_worker.cc:44).
+
+    Dropout must be 0 (the pipelined schedule cannot reproduce the
+    non-pipelined dropout mask stream, so parity is only defined
+    deterministically).  Returns (step_fn, state); step_fn(state, batch)
+    -> (state, loss).  SGD update; the tied word-embedding/MLM-decoder
+    table gets the SUM of its first-stage and last-stage gradients —
+    megatron-style tied-embedding handling.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..jit import functional_call, functional_state
+
+    cfg = model.bert.config
+    assert cfg.hidden_dropout_prob == 0.0 \
+        and cfg.attention_probs_dropout_prob == 0.0, \
+        "pipeline parity requires dropout=0"
+    n_stages = mesh.shape[axis]
+    L = cfg.num_hidden_layers
+    assert L % n_stages == 0, (L, n_stages)
+    k = L // n_stages
+
+    full = functional_state(model)
+
+    def sub(prefix):
+        pl = len(prefix)
+        return {kk[pl:]: jnp.array(v) for kk, v in full.items()
+                if kk.startswith(prefix)}
+
+    emb_p = sub("bert.embeddings.")
+    layer_states = [sub(f"bert.encoder.layers.{i}.") for i in range(L)]
+    # stack: leaf (n_stages, k, ...)
+    block_p = {
+        kk: jnp.stack([jnp.stack([layer_states[st * k + j][kk]
+                                  for j in range(k)])
+                       for st in range(n_stages)])
+        for kk in layer_states[0]}
+    last_p = {"pooler": sub("bert.pooler."), "cls": sub("cls.")}
+    # weight tie: cls.decoder_weight IS the embedding table; carry it in
+    # last_p explicitly so the head stage has it
+    last_p["cls"]["decoder_weight"] = emb_p["word_embeddings.weight"]
+
+    embeddings, enc_layer0 = model.bert.embeddings, \
+        model.bert.encoder.layers[0]
+    pooler, cls_head = model.bert.pooler, model.cls
+
+    def first_fn(p, aux):
+        out, _ = functional_call(embeddings, p, aux["input_ids"],
+                                 aux["token_type_ids"])
+        return out
+
+    def block_fn(p, h, aux):
+        am = (aux["attention_mask"] != 0)[:, None, None, :]
+
+        def one(h, sl):
+            out, _ = functional_call(enc_layer0, sl, h, am)
+            return out, None
+
+        h, _ = jax.lax.scan(one, h, p)
+        return h
+
+    def last_fn(p, h, aux):
+        pooled, _ = functional_call(pooler, p["pooler"], h)
+        (mlm, nsp), _ = functional_call(
+            cls_head, p["cls"], h, pooled,
+            masked_positions=aux["masked_positions"])
+        return {"mlm": mlm, "nsp": nsp}
+
+    from ..parallel.pipeline import gpipe_model
+
+    run = gpipe_model(mesh, first_fn, block_fn, last_fn,
+                      num_microbatches, axis=axis)
+    criterion = BertPretrainingCriterion(cfg.vocab_size)
+
+    def loss_fn(params, batch):
+        emb_p, block_p, last_p = params
+        aux = {kk: batch[kk] for kk in
+               ("input_ids", "token_type_ids", "attention_mask",
+                "masked_positions")}
+        outs = run(emb_p, block_p, last_p, aux)
+        from ..nn.layer.layers import Tensor as _T
+
+        return criterion(_T(outs["mlm"]), _T(outs["nsp"]),
+                         _T(batch["masked_labels"]),
+                         _T(batch["nsp_labels"]))._value
+
+    lr = learning_rate
+
+    @jax.jit
+    def step(state, batch):
+        params = state["params"]
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        g_emb, g_block, g_last = grads
+        # tied table: sum embedding-stage and decoder-head gradients
+        tied = g_emb["word_embeddings.weight"] \
+            + g_last["cls"]["decoder_weight"]
+        g_emb = dict(g_emb, **{"word_embeddings.weight": tied})
+        e_p, b_p, l_p = params
+        new_e = {kk: v - lr * g_emb[kk] for kk, v in e_p.items()}
+        new_b = {kk: v - lr * g_block[kk] for kk, v in b_p.items()}
+        new_l = {
+            grp: {kk: v - lr * g_last[grp][kk]
+                  for kk, v in l_p[grp].items()}
+            for grp in l_p}
+        new_l["cls"]["decoder_weight"] = new_e["word_embeddings.weight"]
+        return {"params": (new_e, new_b, new_l)}, loss
+
+    return step, {"params": (emb_p, block_p, last_p)}

@@ -167,6 +167,93 @@ class WMTTransformer(nn.Layer):
                 [kw[f"x{i}"] for i in range(len(outs))], axis=1),
             {f"x{i}": o for i, o in enumerate(outs)})
 
+    @staticmethod
+    def _tree_reorder(cache, parent):
+        """Reorder the batch rows of every Tensor leaf in a (possibly
+        nested list/tuple/namedtuple) KV-cache by beam parent indices."""
+        from ..fluid.dygraph.tracer import trace_fn
+        from ..nn.layer.layers import Tensor as _T
+
+        def walk(node):
+            if isinstance(node, _T):
+                return trace_fn(lambda c, p: c[p],
+                                {"c": node, "p": parent})
+            if isinstance(node, (list, tuple)):
+                mapped = [walk(x) for x in node]
+                if hasattr(node, "_fields"):  # namedtuple (Cache)
+                    return type(node)(*mapped)
+                return type(node)(mapped)
+            return node
+
+        return walk(cache)
+
+    def beam_decode(self, src_ids, beam_size=4, max_len=32):
+        """Beam-search decode (the machine_translation book config —
+        reference beam_search_op.cc + beam_search_decode_op.cc — in the
+        dense TPU form): beams ride the batch dim (B*W rows), each step
+        is one top-k over (W*V) per source via ops.rnn_ops.
+        dense_beam_step, KV caches reordered by parent pointers, and the
+        token trail is backtracked with dense_beam_backtrack.  Returns
+        (sequences (B, W, T) best-first, scores (B, W))."""
+        import jax.numpy as jnp
+
+        from ..fluid.dygraph.tracer import trace_fn
+        from ..ops.rnn_ops import dense_beam_backtrack, dense_beam_step
+
+        cfg = self.config
+        w = beam_size
+        batch = src_ids.shape[0]
+        memory = self.transformer.encoder(
+            self.src_pos(self.src_emb(src_ids)))
+        # tile memory per beam: (B, S, H) -> (B*W, S, H)
+        memory = trace_fn(
+            lambda m: jnp.repeat(m, w, axis=0), {"m": memory})
+        cache = self.transformer.decoder.gen_cache(memory)
+
+        ids = nn.layer.layers.Tensor(
+            np.full((batch * w, 1), cfg.bos_id, "int64"))
+        # only beam 0 of each source is live at step 0 (all beams hold
+        # the same BOS, so without this every source would pick one
+        # token W times)
+        init_scores = np.full((batch * w, 1), -1e9, "float32")
+        init_scores[::w] = 0.0
+        scores = nn.layer.layers.Tensor(init_scores)
+
+        step_ids, step_parents = [], []
+        for step in range(max_len):
+            tgt_in = self.tgt_pos(self.tgt_emb(ids), offset=step)
+            dec, new_cache = self.transformer.decoder(
+                tgt_in, memory, None, None, cache)
+            logits = self.out_proj(dec)
+
+            import jax
+
+            def select(l, pid, psc):
+                lp = jax.nn.log_softmax(l[:, -1].astype(jnp.float32),
+                                        axis=-1)
+                return dense_beam_step(pid, psc, None, lp, w, cfg.eos_id)
+
+            ids, scores, parent = trace_fn(
+                select, {"l": logits, "pid": ids, "psc": scores})
+            # reorder every cache leaf's batch rows by parent
+            cache = self._tree_reorder(new_cache, parent)
+            step_ids.append(ids)
+            step_parents.append(parent)
+
+        def finish(**kw):
+            t = len(step_ids)
+            sid = jnp.stack([kw[f"i{k}"][:, 0] for k in range(t)])
+            par = jnp.stack([kw[f"p{k}"] for k in range(t)])
+            seqs = dense_beam_backtrack(sid, par)          # (B*W, T)
+            return (seqs.reshape(batch, w, t),
+                    kw["sc"][:, 0].reshape(batch, w))
+
+        kw = {"sc": scores}
+        for k, (i_t, p_t) in enumerate(zip(step_ids, step_parents)):
+            kw[f"i{k}"] = i_t
+            kw[f"p{k}"] = p_t
+        return trace_fn(finish, kw)
+
 
 def build_train_step(model: WMTTransformer, lr_d_model=None,
                      warmup_steps=4000, bf16=True, mesh=None,

@@ -11,4 +11,5 @@ from . import random_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import collective_ops  # noqa: F401
 from . import control_flow_ops  # noqa: F401
+from . import rnn_ops  # noqa: F401
 from .registry import register_op, register_grad, registered_ops, has_op  # noqa: F401

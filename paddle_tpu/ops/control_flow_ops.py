@@ -199,3 +199,136 @@ def _recompute_segment_grad(ctx, op, ins):
     for i, g in zip(diff_idx, dvals):
         grads[i] = g
     return {"InGrads": grads}
+
+
+# -- LoDTensorArray (dense re-design) -----------------------------------------
+#
+# The reference's LoDTensorArray is a C++ vector<LoDTensor> grown by
+# write_to_array ops and read inside while blocks
+# (/root/reference/paddle/fluid/operators/controlflow/
+# lod_tensor_array_ops via lod_array_length_op.cc, array_read/write in
+# fluid/layers/control_flow.py).  XLA needs static shapes, so an array
+# is a STACKED buffer + length scalar (the scan-carried form):
+#
+#   TensorArrayVal(buffer (C, *elem), length ())
+#
+# Outside control flow, writes grow the buffer at trace time (indices
+# are concrete).  Inside a `while` sub-block the array is loop-carried
+# state: preallocate capacity via layers.create_array(...,
+# capacity=..., element_shape=...) and writes become
+# dynamic_update_slice.
+
+from typing import NamedTuple
+
+
+class TensorArrayVal(NamedTuple):
+    buffer: object  # (C, *elem)
+    length: object  # () int32
+
+
+def _concrete_index(i):
+    try:
+        return int(jax.device_get(i).reshape(()))
+    except Exception:  # traced (the whole block compiles under one jit)
+        return None
+
+
+def _ir_const(ctx, op, slot):
+    """Trace-time constant folding over the program IR: if `slot`'s
+    input var is produced (only) by a fill_constant in this block, its
+    value is statically known even though the jit trace shows a tracer."""
+    names = op.input(slot)
+    if not names or ctx.block is None:
+        return None
+    name = names[0]
+    val = None
+    for prev in ctx.block.ops:
+        if prev is op:
+            break
+        if name in prev.output_arg_names():
+            val = (int(prev.attr("value"))
+                   if prev.type == "fill_constant" else None)
+    return val
+
+
+@register_op("write_to_array")
+def _write_to_array(ctx, op, ins):
+    x = first(ins, "X")
+    i = first(ins, "I").reshape(()).astype(jnp.int32)
+    arr = first(ins, "Array")
+    ci = _concrete_index(i)
+    if ci is None:
+        ci = _ir_const(ctx, op, "I")
+    if isinstance(arr, TensorArrayVal) and arr.buffer.shape[0] == 0:
+        arr = None  # capacity-0 sentinel from create_array()
+    if arr is None or not isinstance(arr, TensorArrayVal):
+        if ci is None:
+            if ctx.abstract:
+                ci = 0  # InferShape placeholder: element shape is what
+                # matters; the real trace sees the concrete index
+            else:
+                raise ValueError(
+                    "write_to_array with a traced index needs a "
+                    "preallocated array: create_array(dtype, "
+                    "capacity=..., element_shape=...) before the loop "
+                    "(XLA static-shape contract; see "
+                    "control_flow_ops.py)")
+        buf = jnp.zeros((ci + 1,) + x.shape, x.dtype).at[ci].set(x)
+        return {"Out": [TensorArrayVal(buf, jnp.int32(ci + 1))]}
+    buf, length = arr.buffer, arr.length
+    cap = buf.shape[0]
+    if ci is not None and ci >= cap:
+        buf = jnp.concatenate(
+            [buf, jnp.zeros((ci + 1 - cap,) + buf.shape[1:], buf.dtype)])
+    buf = lax.dynamic_update_slice_in_dim(buf, x[None], i, axis=0)
+    new_len = jnp.maximum(length.astype(jnp.int32), i + 1)
+    return {"Out": [TensorArrayVal(buf, new_len)]}
+
+
+@register_op("read_from_array")
+def _read_from_array(ctx, op, ins):
+    arr = first(ins, "X")
+    i = first(ins, "I").reshape(()).astype(jnp.int32)
+    out = lax.dynamic_index_in_dim(arr.buffer, i, axis=0,
+                                   keepdims=False)
+    return {"Out": [out]}
+
+
+@register_op("lod_array_length")
+def _lod_array_length(ctx, op, ins):
+    arr = first(ins, "X")
+    return {"Out": [arr.length.reshape((1,)).astype(jnp.int64)]}
+
+
+@register_op("allocate_array")
+def _allocate_array(ctx, op, ins):
+    shape = tuple(op.attr("element_shape"))
+    cap = int(op.attr("capacity"))
+    dtype = op.attr("dtype") or "float32"
+    from ..fluid import core
+
+    return {"Out": [TensorArrayVal(
+        jnp.zeros((cap,) + shape, core.np_dtype(dtype)),
+        jnp.int32(0))]}
+
+
+@register_op("tensor_array_to_tensor")
+def _tensor_array_to_tensor(ctx, op, ins):
+    arr = first(ins, "X")
+    axis = int(op.attr("axis") or 0)
+    buf, length = arr.buffer, arr.length
+    ci = _concrete_index(length)
+    if ci is not None:
+        buf = buf[:ci]
+    if op.attr("use_stack"):
+        out = buf  # (C, *elem)
+    elif buf.shape[0] == 0:
+        out = buf.reshape(buf.shape[1:])
+    else:
+        # concat the C elements along ELEMENT axis `axis` (reference
+        # tensor_array_to_tensor_op semantics: axis indexes the element
+        # dims, axis=0 -> (C*e0, e1, ...), axis=1 -> (e0, C*e1, ...))
+        out = jnp.concatenate(list(buf), axis=axis)
+    return {"Out": [out],
+            "OutIndex": [jnp.full((buf.shape[0],), buf.shape[1]
+                                  if buf.ndim > 1 else 1, jnp.int64)]}

@@ -1,0 +1,219 @@
+"""Static RNN + sequence-decode op lowerings.
+
+Reference ops re-designed LoD-free (SURVEY.md §7 "LoD (ragged) tensors":
+pad+mask, batch-major dense):
+
+  lstm               /root/reference/paddle/fluid/operators/lstm_op.cc
+  gru                /root/reference/paddle/fluid/operators/gru_op.cc
+  beam_search        /root/reference/paddle/fluid/operators/beam_search_op.cc
+  beam_search_decode /root/reference/paddle/fluid/operators/beam_search_decode_op.cc
+
+The reference's recurrences are per-timestep CPU/CUDA kernels over
+LoD-packed batches (math/sequence2batch.h re-orders by length); here one
+`lax.scan` carries (h, c) over the time axis of a dense (B, T, ·) input —
+the whole recurrence lowers into the surrounding XLA computation.  Beam
+search drops the LoD machinery entirely: beams live in a dense
+(batch*beam, ·) layout, selection is one top-k over the flattened
+(beam*K) candidate matrix per source, and decode is a reverse scan over
+stored parent pointers.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import first, register_op
+
+_ACT = {
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "identity": lambda x: x,
+}
+
+
+@register_op("lstm")
+def _lstm(ctx, op, ins):
+    """Dense LSTM: Input (B, T, 4H) = x@Wx precomputed (matching the
+    reference contract where dynamic_lstm consumes an fc output), Weight
+    (H, 4H) recurrent, Bias (1, 4H).  Gate order i, f, c~, o (the
+    reference kernel order, lstm_op.cc).  Outputs Hidden/Cell (B, T, H).
+    Optional H0/C0 (B, H)."""
+    x = first(ins, "Input")
+    w = first(ins, "Weight")
+    bias = first(ins, "Bias")
+    h = x.shape[-1] // 4
+    b = x.shape[0]
+    gate_act = _ACT[op.attr("gate_activation") or "sigmoid"]
+    cell_act = _ACT[op.attr("cell_activation") or "tanh"]
+    cand_act = _ACT[op.attr("candidate_activation") or "tanh"]
+    reverse = bool(op.attr("is_reverse"))
+
+    h0 = first(ins, "H0")
+    c0 = first(ins, "C0")
+    if h0 is None:
+        h0 = jnp.zeros((b, h), x.dtype)
+    if c0 is None:
+        c0 = jnp.zeros((b, h), x.dtype)
+
+    xs = jnp.swapaxes(x, 0, 1)  # (T, B, 4H)
+    if reverse:
+        xs = xs[::-1]
+
+    def step(carry, xt):
+        hp, cp = carry
+        g = xt + hp @ w + bias.reshape(1, -1)
+        i = gate_act(g[:, :h])
+        f = gate_act(g[:, h:2 * h])
+        cand = cand_act(g[:, 2 * h:3 * h])
+        o = gate_act(g[:, 3 * h:])
+        c = f * cp + i * cand
+        hh = o * cell_act(c)
+        return (hh, c), (hh, c)
+
+    _, (hs, cs) = lax.scan(step, (h0, c0), xs)
+    if reverse:
+        hs, cs = hs[::-1], cs[::-1]
+    return {"Hidden": [jnp.swapaxes(hs, 0, 1)],
+            "Cell": [jnp.swapaxes(cs, 0, 1)],
+            "BatchGate": [jnp.zeros_like(x)],
+            "BatchCellPreAct": [jnp.zeros((b, xs.shape[0], h), x.dtype)]}
+
+
+@register_op("gru")
+def _gru(ctx, op, ins):
+    """Dense GRU: Input (B, T, 3H) = x@Wx, Weight (H, 3H) laid out as
+    [W_update | W_reset | W_candidate] (gru_op.cc layout: the first 2H
+    columns drive the gates, the last H the candidate), Bias (1, 3H).
+    origin_mode selects between h = u*h_prev + (1-u)*c~ (True, the
+    original paper) and h = (1-u)*h_prev + u*c~ (False, the default)."""
+    x = first(ins, "Input")
+    w = first(ins, "Weight")
+    bias = first(ins, "Bias")
+    h = x.shape[-1] // 3
+    b = x.shape[0]
+    gate_act = _ACT[op.attr("gate_activation") or "sigmoid"]
+    cand_act = _ACT[op.attr("activation") or "tanh"]
+    origin = bool(op.attr("origin_mode"))
+    reverse = bool(op.attr("is_reverse"))
+
+    h0 = first(ins, "H0")
+    if h0 is None:
+        h0 = jnp.zeros((b, h), x.dtype)
+
+    w_gates = w[:, :2 * h]   # (H, 2H)
+    w_cand = w[:, 2 * h:]    # (H, H)
+    xs = jnp.swapaxes(x, 0, 1)
+    if reverse:
+        xs = xs[::-1]
+    bg = bias.reshape(1, -1)
+
+    def step(hp, xt):
+        g = xt[:, :2 * h] + hp @ w_gates + bg[:, :2 * h]
+        u = gate_act(g[:, :h])
+        r = gate_act(g[:, h:])
+        cand = cand_act(xt[:, 2 * h:] + (r * hp) @ w_cand + bg[:, 2 * h:])
+        hh = u * hp + (1 - u) * cand if origin \
+            else (1 - u) * hp + u * cand
+        return hh, hh
+
+    _, hs = lax.scan(step, h0, xs)
+    if reverse:
+        hs = hs[::-1]
+    out = jnp.swapaxes(hs, 0, 1)
+    return {"Hidden": [out],
+            "BatchGate": [jnp.zeros_like(x)],
+            "BatchResetHiddenPrev": [jnp.zeros((b, xs.shape[0], h),
+                                               x.dtype)],
+            "BatchHidden": [out]}
+
+
+def dense_beam_step(pre_ids, pre_scores, cand_ids, scores, w, end_id,
+                    is_accumulated=False):
+    """Pure dense beam-search step shared by the `beam_search` op
+    lowering and model-level decoders (models/transformer_wmt.py).
+    Shapes: pre_ids/pre_scores (B*W, 1), scores (B*W, K), cand_ids
+    (B*W, K) or None (implicit arange).  is_accumulated=True means
+    `scores` already include the prefix total (the reference op's
+    default contract, beam_search_op.cc) — pre_scores are then used
+    only to freeze finished beams.  Returns (sel_ids (B*W, 1),
+    sel_scores (B*W, 1), parent (B*W,) int32 row indices)."""
+    bw, k = scores.shape
+    b = bw // w
+    if cand_ids is None:
+        cand_ids = jnp.broadcast_to(jnp.arange(k, dtype=jnp.int64),
+                                    (bw, k))
+    finished = (pre_ids.reshape(bw) == end_id)
+    neg = jnp.full_like(scores, -1e9)
+    frozen_scores = neg.at[:, 0].set(pre_scores.reshape(bw))
+    frozen_ids = jnp.full_like(cand_ids, end_id)
+    live = scores if is_accumulated \
+        else pre_scores.reshape(bw, 1) + scores
+    total = jnp.where(finished[:, None], frozen_scores, live)
+    cand_ids = jnp.where(finished[:, None], frozen_ids, cand_ids)
+
+    flat = total.reshape(b, w * k)
+    top_scores, top_pos = lax.top_k(flat, w)
+    src_beam = top_pos // k
+    parent = (jnp.arange(b, dtype=jnp.int32)[:, None] * w
+              + src_beam.astype(jnp.int32))
+    sel_ids = jnp.take_along_axis(cand_ids.reshape(b, w * k), top_pos,
+                                  axis=1)
+    return (sel_ids.reshape(bw, 1), top_scores.reshape(bw, 1),
+            parent.reshape(bw))
+
+
+def dense_beam_backtrack(ids, parents):
+    """(T, B*W) selected ids + parent pointers -> (B*W, T) sequences,
+    shared by `beam_search_decode` and model decoders."""
+    bw = ids.shape[1]
+
+    def back(ptr, step):
+        step_ids, step_par = step
+        return step_par[ptr], step_ids[ptr]
+
+    _, toks = lax.scan(back, jnp.arange(bw, dtype=jnp.int32),
+                       (ids, parents.astype(jnp.int32)), reverse=True)
+    return jnp.swapaxes(toks, 0, 1)
+
+
+@register_op("beam_search")
+def _beam_search(ctx, op, ins):
+    """One beam-search step, dense layout.
+
+    Inputs: pre_ids (B*W, 1), pre_scores (B*W, 1), scores (B*W, K)
+    log-probs for each candidate, ids (B*W, K) candidate token ids (or
+    absent -> implicit arange over vocab).  Attrs: beam_size W, end_id.
+    Outputs: selected_ids/selected_scores (B*W, 1), parent_idx (B*W,)
+    — indices into the B*W input rows.
+
+    Finished beams (pre_id == end_id) are frozen: their only candidate
+    is end_id carrying the unchanged cumulative score (the reference
+    implements this by pruning; dense form keeps shapes static)."""
+    acc = op.attr("is_accumulated")
+    sel_ids, sel_scores, parent = dense_beam_step(
+        first(ins, "pre_ids"), first(ins, "pre_scores"),
+        first(ins, "ids"), first(ins, "scores"),
+        int(op.attr("beam_size")), int(op.attr("end_id")),
+        is_accumulated=True if acc is None else bool(acc))
+    return {"selected_ids": [sel_ids],
+            "selected_scores": [sel_scores],
+            "parent_idx": [parent]}
+
+
+@register_op("beam_search_decode")
+def _beam_search_decode(ctx, op, ins):
+    """Backtrack stored per-step selections into full sequences.
+
+    Inputs: Ids (T, B*W) selected token ids per step, ParentIdx
+    (T, B*W) parent row pointers per step, Scores (T, B*W) cumulative
+    scores.  Outputs: SentenceIds (B*W, T) backtracked sequences,
+    SentenceScores (B*W,) final scores.  (The reference emits
+    LoD-encoded ragged sentences; dense form pads with end_id.)"""
+    ids = first(ins, "Ids")
+    parents = first(ins, "ParentIdx")
+    scores = first(ins, "Scores")
+    return {"SentenceIds": [dense_beam_backtrack(ids, parents)],
+            "SentenceScores": [scores[-1]]}

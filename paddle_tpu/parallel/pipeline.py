@@ -91,3 +91,93 @@ def gpipe(mesh, stage_fn, num_microbatches, axis="pp",
         return out.reshape((batch,) + out.shape[2:])
 
     return run
+
+
+def gpipe_model(mesh, first_fn, block_fn, last_fn, num_microbatches,
+                axis="pp"):
+    """Non-uniform GPipe: embedding-style first stage, uniform middle
+    blocks, head-style last stage (VERDICT r3 task 9 — the reference ran
+    real BERT pipelines through SectionWorker, section_worker.cc:44,
+    with per-section programs; here each role is a function and the
+    schedule is a shard_map scan with ppermute hand-offs).
+
+      first_fn(first_params, aux)            -> carrier  (stage 0)
+      block_fn(stage_block_params, carrier, aux) -> carrier  (every stage)
+      last_fn(last_params, carrier, aux)     -> out pytree (last stage)
+
+    * `aux` is the per-microbatch raw-batch pytree (ids, masks, labels)
+      — replicated, so any stage can read its microbatch's metadata.
+    * first/last params are replicated over the pipeline axis (in BERT
+      the word-embedding table is weight-tied to the MLM decoder, so
+      first and last stages SHARE it — replication is the natural
+      layout, matching megatron-style embedding handling).
+    * block params: stacked leaves (n_stages, ...) sharded over `axis`;
+      a stage entry may itself stack several model layers.
+    * SPMD note: every device evaluates first_fn/last_fn each tick and
+      masks the result (same-program semantics); the pipeline's memory
+      win — block params sharded N-ways — is preserved.
+
+    Returns run(first_p, stacked_block_p, last_p, batch_tree) -> outs
+    pytree with leading dim = global batch.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    m_count = num_microbatches
+    tmap = jax.tree_util.tree_map
+
+    def local(first_p, block_p, last_p, aux_mbs):
+        block_local = tmap(lambda a: a[0], block_p)
+        n = jax.lax.psum(1, axis)
+        s = jax.lax.axis_index(axis)
+
+        aux0 = tmap(lambda a: a[0], aux_mbs)
+        carrier_shape = jax.eval_shape(first_fn, first_p, aux0)
+        out_shape = jax.eval_shape(last_fn, last_p, carrier_shape, aux0)
+
+        def tick(carry, t):
+            inbuf, outs = carry
+            mb = t - s                       # microbatch at stage s, tick t
+            idx = jnp.clip(mb, 0, m_count - 1)
+            aux = tmap(lambda a: a[idx], aux_mbs)
+            x0 = first_fn(first_p, aux)
+            x = jnp.where(s == 0, x0, inbuf)
+            y = block_fn(block_local, x, aux)
+            out_mb = last_fn(last_p, y, aux)
+            active = jnp.logical_and(mb >= 0, mb < m_count)
+            write = jnp.logical_and(active, s == n - 1)
+            outs = tmap(
+                lambda buf, o: buf.at[idx].set(
+                    jnp.where(write, o, buf[idx])), outs, out_mb)
+            inbuf_next = jax.lax.ppermute(
+                y, axis, [(i, i + 1) for i in range(n - 1)])
+            return (inbuf_next, outs), None
+
+        inbuf0 = jnp.zeros(carrier_shape.shape, carrier_shape.dtype)
+        outs0 = tmap(lambda sh: jnp.zeros((m_count,) + sh.shape,
+                                          sh.dtype), out_shape)
+        n_static = mesh.shape[axis]
+        (_, outs), _ = jax.lax.scan(
+            tick, (inbuf0, outs0), jnp.arange(m_count + n_static - 1))
+        outs = tmap(lambda o: jax.lax.psum(
+            jnp.where(s == n - 1, o, jnp.zeros_like(o)), axis), outs)
+        return outs
+
+    def run(first_p, block_p, last_p, batch_tree):
+        lead = jax.tree_util.tree_leaves(batch_tree)[0].shape[0]
+        assert lead % m_count == 0, (lead, m_count)
+        mb = lead // m_count
+        aux_mbs = tmap(
+            lambda a: a.reshape((m_count, mb) + a.shape[1:]), batch_tree)
+        block_spec = tmap(lambda _: P(axis), block_p)
+        outs = shard_map(
+            local, mesh=mesh,
+            in_specs=(P(), block_spec, P(), P()),
+            out_specs=P(), check_rep=False)(
+                first_p, block_p, last_p, aux_mbs)
+        return tmap(
+            lambda o: o.reshape((lead,) + o.shape[2:]), outs)
+
+    return run

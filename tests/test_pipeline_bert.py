@@ -1,0 +1,94 @@
+"""Real-model pipeline parallelism (VERDICT r3 task 9): BERT-tiny
+through a 4-stage NON-UNIFORM pipeline — embedding stage, sharded
+encoder-block stages, pooler+heads stage — must match the non-pipelined
+model's loss trajectory (reference behavior: PipelineTrainer/
+SectionWorker ran sectioned BERT programs,
+/root/reference/paddle/fluid/framework/section_worker.cc:44)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.jit import functional_call, functional_state
+from paddle_tpu.models import bert
+from paddle_tpu.parallel.mesh import make_mesh
+
+
+def _nodrop_cfg(layers=4):
+    cfg = bert.BertConfig.tiny(num_hidden_layers=layers)
+    cfg.hidden_dropout_prob = 0.0
+    cfg.attention_probs_dropout_prob = 0.0
+    return cfg
+
+
+def test_bert_pipeline_matches_nonpipelined():
+    cfg = _nodrop_cfg()
+    paddle.seed(0)
+    model = bert.BertForPretraining(cfg)
+    b = bert.fake_batch(cfg, 8, 128, num_masked=10, seed=7)
+
+    params0 = functional_state(model)
+    crit = bert.BertPretrainingCriterion(cfg.vocab_size)
+
+    def ref_loss(params, batch):
+        am = (batch["attention_mask"] != 0)[:, None, None, :]
+        (mlm, nsp), _ = functional_call(
+            model, params, batch["input_ids"], batch["token_type_ids"],
+            attention_mask=am,
+            masked_positions=batch["masked_positions"])
+        from paddle_tpu.nn.layer.layers import Tensor as T
+
+        return crit(T(mlm), T(nsp), T(batch["masked_labels"]),
+                    T(batch["nsp_labels"]))._value
+
+    @jax.jit
+    def ref_step(params, batch):
+        loss, g = jax.value_and_grad(ref_loss)(params, batch)
+        return {k: v - 1e-3 * g[k] for k, v in params.items()}, loss
+
+    mesh = make_mesh({"pp": 4}, devices=jax.devices()[:4])
+    step, state = bert.build_pipeline_pretrain_step(
+        model, mesh, num_microbatches=4)
+
+    rp = {k: jnp.array(v) for k, v in params0.items()}
+    ref_losses, pp_losses = [], []
+    for _ in range(4):
+        rp, rl = ref_step(rp, b)
+        state, pl = step(state, b)
+        ref_losses.append(float(rl))
+        pp_losses.append(float(pl))
+    np.testing.assert_allclose(pp_losses, ref_losses, rtol=2e-4)
+
+
+def test_block_params_are_stage_sharded():
+    """The pipeline's memory win: encoder block params live sharded over
+    the pp axis (each stage holds 1/n of the blocks), not replicated."""
+    cfg = _nodrop_cfg()
+    paddle.seed(0)
+    model = bert.BertForPretraining(cfg)
+    mesh = make_mesh({"pp": 4}, devices=jax.devices()[:4])
+    step, state = bert.build_pipeline_pretrain_step(
+        model, mesh, num_microbatches=4)
+    b = bert.fake_batch(cfg, 8, 128, num_masked=10, seed=7)
+    state, _ = step(state, b)
+    _, block_p, _ = state["params"]
+    w = block_p["self_attn.q_proj.weight"]  # (n_stages, k, H, H)
+    assert w.shape[0] == 4
+    # after a jitted step with shard_map in_specs P(axis), the updated
+    # stacked leaves come back partitioned across the 4 stage devices
+    assert len(w.sharding.device_set) == 4
+
+
+def test_microbatch_count_must_divide_batch():
+    cfg = _nodrop_cfg()
+    paddle.seed(0)
+    model = bert.BertForPretraining(cfg)
+    mesh = make_mesh({"pp": 4}, devices=jax.devices()[:4])
+    step, state = bert.build_pipeline_pretrain_step(
+        model, mesh, num_microbatches=3)
+    b = bert.fake_batch(cfg, 8, 128, num_masked=10, seed=7)
+    with pytest.raises(AssertionError):
+        step(state, b)
