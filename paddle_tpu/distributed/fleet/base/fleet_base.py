@@ -12,7 +12,9 @@ from __future__ import annotations
 
 from typing import Optional
 
-from ..meta_optimizers import (AMPOptimizer, GradientMergeOptimizer,
+from ..meta_optimizers import (AMPOptimizer, DGCOptimizer,
+                               FP16AllReduceOptimizer,
+                               GradientMergeOptimizer,
                                GraphExecutionOptimizer, LambOptimizer,
                                LarsOptimizer, LocalSGDOptimizer,
                                PipelineOptimizer, RecomputeOptimizer,
@@ -31,6 +33,8 @@ _META_OPTIMIZER_CLASSES = [
     PipelineOptimizer,
     ShardingOptimizer,
     LocalSGDOptimizer,
+    DGCOptimizer,
+    FP16AllReduceOptimizer,
     GradientMergeOptimizer,
     GraphExecutionOptimizer,
 ]

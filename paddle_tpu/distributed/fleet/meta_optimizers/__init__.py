@@ -17,4 +17,6 @@ from .lamb_optimizer import LambOptimizer  # noqa: F401
 from .lars_optimizer import LarsOptimizer  # noqa: F401
 from .graph_execution_optimizer import GraphExecutionOptimizer  # noqa: F401
 from .localsgd_optimizer import LocalSGDOptimizer  # noqa: F401
+from .dgc_optimizer import DGCOptimizer  # noqa: F401
+from .fp16_allreduce_optimizer import FP16AllReduceOptimizer  # noqa: F401
 from .pipeline_optimizer import PipelineOptimizer  # noqa: F401

@@ -1,0 +1,48 @@
+"""FP16/bf16-allreduce meta-optimizer (reference
+fleet/meta_optimizers/fp16_allreduce_optimizer.py, SURVEY §2.9 #11):
+gradients cross the interconnect in half precision.  On TPU the wire
+dtype defaults to bf16 (native; fp16 is emulated) — halves the ICI
+bytes per allreduce with bf16's safe exponent range, so no loss
+scaling is needed on the comm path."""
+
+from __future__ import annotations
+
+from ....fluid.transpiler.collective import FP16AllReduce
+from .meta_optimizer_base import MetaOptimizerBase
+
+
+class FP16AllReduceOptimizer(MetaOptimizerBase):
+    def __init__(self, optimizer):
+        super().__init__(optimizer)
+        # replaces the plain GradAllReduce transpile: a non-empty
+        # whitelist WITHOUT GraphExecutionOptimizer keeps it out of the
+        # chain (strategy_compiler honors whitelists, not blacklists)
+        self.meta_optimizers_white_list = ["GradientMergeOptimizer",
+                                           "RecomputeOptimizer"]
+
+    def _can_apply(self):
+        try:
+            return (self.user_defined_strategy.fp16_allreduce
+                    and self.role_maker.worker_num() > 1)
+        except Exception:
+            return False
+
+    def _disable_strategy(self, dist_strategy):
+        dist_strategy.fp16_allreduce = False
+
+    def _enable_strategy(self, dist_strategy, context=None):
+        dist_strategy.fp16_allreduce = True
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        from ....fluid.framework import default_startup_program
+
+        ret = self.inner_opt.minimize(loss, startup_program,
+                                      parameter_list, no_grad_set)
+        nranks = self.role_maker.worker_num()
+        t = FP16AllReduce(nrings=1)
+        t.transpile(startup_program or default_startup_program(),
+                    loss.block.program, self.role_maker.worker_index(),
+                    self.role_maker.get_trainer_endpoints() or
+                    ["127.0.0.1:0"] * nranks, "127.0.0.1:0")
+        return ret

@@ -29,14 +29,18 @@ class LocalSGDOptimizer(MetaOptimizerBase):
                                       parameter_list, no_grad_set)
         cfg = self.user_defined_strategy.localsgd_configs
         if int(cfg.get("k_steps", 1)) > 1:
-            # k>1 keeps params DIVERGENT per shard between syncs, which the
-            # single-program shard_map state model (replicated scope arrays)
-            # cannot represent yet; needs per-shard state with a leading
-            # device dim. Tracked for a later round.
+            # k>1 keeps params DIVERGENT per shard between syncs; the
+            # static scope stores ONE replicated copy per param, so the
+            # program form cannot express it.  The working k>1
+            # implementation is the mesh-level API
+            # (paddle_tpu.parallel.localsgd.build_localsgd_step):
+            # per-shard stacked parameter state sharded over the data
+            # axis, periodic psum-average inside the jitted step.
             raise NotImplementedError(
-                "localsgd with k_steps>1 requires per-shard parameter "
-                "state; only k_steps=1 (every-step averaging) is supported "
-                "in single-program mode")
+                "localsgd k_steps>1 in static-program mode: use "
+                "paddle_tpu.parallel.localsgd.build_localsgd_step "
+                "(per-shard parameter copies over the mesh; tested in "
+                "tests/test_dist_strategies.py)")
         t = LocalSGD(k_steps=int(cfg.get("k_steps", 1)))
         nranks = self.role_maker.worker_num()
         t.transpile(startup_program or default_startup_program(),

@@ -4,6 +4,8 @@ re-designed TPU-native (see SURVEY.md §7 and per-module docstrings)."""
 from __future__ import annotations
 
 from . import core, unique_name
+from . import dataset
+from .dataset import DatasetFactory, InMemoryDataset, QueueDataset
 from .framework import (Program, Variable, Parameter, OpRole,
                         default_main_program, default_startup_program,
                         program_guard, in_dygraph_mode)

@@ -1,0 +1,258 @@
+"""Dataset factory: the industrial file-driven ingestion path.
+
+Reference: /root/reference/python/paddle/fluid/dataset.py
+(DatasetFactory, InMemoryDataset :329, QueueDataset :923) over the C++
+DataFeed/Dataset engine (framework/data_feed.cc ~1.6k LoC slot parsing,
+framework/data_set.cc in-memory store + global shuffle), consumed by
+`exe.train_from_dataset` through MultiTrainer/HogwildWorker threads
+(framework/trainer.h:51, device_worker.h:148).
+
+TPU-native re-design:
+* The wire format stays the reference's MultiSlot text lines
+  ("<n> v1 .. vn" per slot, slots ordered as set_use_var) so existing
+  data files work.
+* Parsing runs in background threads feeding the GIL-free native
+  BlockingQueue (core_native/blocking_queue.cc) — the role
+  data_feed.cc's channels play.
+* There is no per-thread DeviceWorker: batches feed ONE whole-block XLA
+  computation (the Executor), because on TPU the parallelism lives
+  inside the compiled program, not in host worker threads.  `thread`
+  settings are accepted and drive the PARSER pool size instead.
+* InMemoryDataset materializes samples host-side and global-shuffles
+  with a seeded RNG (data_set.cc's global_shuffle minus the cross-node
+  RPC: multi-host jobs shard files per worker via set_filelist, the
+  fleet convention).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+
+class DatasetFactory:
+    """reference dataset.py DatasetFactory.create_dataset"""
+
+    def create_dataset(self, datafeed_class="QueueDataset"):
+        if datafeed_class == "InMemoryDataset":
+            return InMemoryDataset()
+        if datafeed_class == "QueueDataset":
+            return QueueDataset()
+        raise ValueError(f"unknown dataset class {datafeed_class!r}")
+
+
+class DatasetBase:
+    def __init__(self):
+        self._batch_size = 1
+        self._use_vars = []
+        self._filelist: List[str] = []
+        self._thread = 1
+        self._parse_fn = None
+        self._drop_last = False
+
+    # -- reference config surface -------------------------------------------
+    def set_batch_size(self, batch_size):
+        self._batch_size = int(batch_size)
+
+    def set_thread(self, thread_num):
+        self._thread = max(1, int(thread_num))
+
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+
+    def set_use_var(self, var_list):
+        self._use_vars = list(var_list)
+
+    def set_parse_fn(self, fn):
+        """TPU extension replacing set_pipe_command's shell
+        preprocessors: fn(line) -> list of numpy arrays (one per
+        use_var).  Default: MultiSlot text parsing."""
+        self._parse_fn = fn
+
+    def set_pipe_command(self, cmd):
+        raise NotImplementedError(
+            "set_pipe_command (shell preprocessors) is not supported on "
+            "the TPU build; use set_parse_fn(python_fn) instead")
+
+    # -- parsing -------------------------------------------------------------
+    def _parse_line(self, line):
+        if self._parse_fn is not None:
+            return self._parse_fn(line)
+        toks = line.split()
+        out = []
+        pos = 0
+        for v in self._use_vars:
+            n = int(toks[pos])
+            pos += 1
+            vals = toks[pos:pos + n]
+            pos += n
+            dt = np.dtype(_np_dtype(v))
+            out.append(np.asarray(vals, dtype=dt))
+        return out
+
+    def _iter_samples(self, files):
+        for path in files:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        yield self._parse_line(line)
+
+    def _iter_samples_keyed(self, files, file_base):
+        """(sort_key, sample) pairs so threaded loads can restore the
+        deterministic file/line order afterwards."""
+        for fi, path in enumerate(files):
+            with open(path) as f:
+                li = 0
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        yield (file_base[fi], li), self._parse_line(line)
+                        li += 1
+
+    def _batch(self, samples):
+        """Stack per-var sample arrays into a feed dict."""
+        feed = {}
+        for i, v in enumerate(self._use_vars):
+            arrs = [s[i] for s in samples]
+            a = np.stack(arrs)
+            want = [d for d in v.shape if d not in (-1, None)]
+            if want and list(a.shape[1:]) != want:
+                a = a.reshape([len(arrs)] + want)
+            feed[v.name] = a
+        return feed
+
+    def batch_iter(self):
+        raise NotImplementedError
+
+
+class InMemoryDataset(DatasetBase):
+    """reference dataset.py:329 — load, global-shuffle, iterate."""
+
+    def __init__(self):
+        super().__init__()
+        self._samples = None
+        self._seed = 0
+
+    def load_into_memory(self):
+        if not self._filelist:
+            raise ValueError("set_filelist() before load_into_memory()")
+        samples = []
+        if self._thread <= 1 or len(self._filelist) <= 1:
+            samples = list(self._iter_samples(self._filelist))
+        else:
+            from ..core_native import BlockingQueue
+
+            q = BlockingQueue(capacity=4096)
+            chunks = [(self._filelist[i::self._thread],
+                       list(range(i, len(self._filelist), self._thread)))
+                      for i in range(self._thread)]
+            chunks = [c for c in chunks if c[0]]
+
+            def worker(files, base):
+                for item in self._iter_samples_keyed(files, base):
+                    q.push(item)
+                q.push(None)  # done marker
+
+            threads = [threading.Thread(target=worker, args=c,
+                                        daemon=True) for c in chunks]
+            for t in threads:
+                t.start()
+            done, keyed = 0, []
+            while done < len(threads):
+                item = q.pop()
+                if item is None:
+                    done += 1
+                else:
+                    keyed.append(item)
+            for t in threads:
+                t.join()
+            # restore deterministic (file, line) order: thread arrival
+            # order depends on the OS scheduler, and set_shuffle_seed's
+            # reproducibility promise needs a stable pre-shuffle order
+            keyed.sort(key=lambda kv: kv[0])
+            samples = [s for _, s in keyed]
+        self._samples = samples
+
+    def set_shuffle_seed(self, seed):
+        self._seed = int(seed)
+
+    def global_shuffle(self, fleet=None, thread_num=None):
+        """data_set.cc global_shuffle: one permutation over EVERY loaded
+        sample (vs local per-file shuffle)."""
+        if self._samples is None:
+            raise ValueError("load_into_memory() before global_shuffle()")
+        random.Random(self._seed).shuffle(self._samples)
+
+    def release_memory(self):
+        self._samples = None
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._samples or [])
+
+    def batch_iter(self):
+        if self._samples is None:
+            raise ValueError("load_into_memory() first")
+        n = len(self._samples)
+        for i in range(0, n, self._batch_size):
+            chunk = self._samples[i:i + self._batch_size]
+            if self._drop_last and len(chunk) < self._batch_size:
+                break
+            yield self._batch(chunk)
+
+
+class QueueDataset(DatasetBase):
+    """reference dataset.py:923 — streaming: parse while training.  A
+    background parser pool feeds the native BlockingQueue; batch_iter
+    pops without holding the dataset in memory."""
+
+    def batch_iter(self):
+        if not self._filelist:
+            raise ValueError("set_filelist() before iterating")
+        from ..core_native import BlockingQueue
+
+        q = BlockingQueue(capacity=1024)
+        chunks = [self._filelist[i::self._thread]
+                  for i in range(self._thread)]
+        chunks = [c for c in chunks if c]
+
+        def worker(files):
+            for s in self._iter_samples(files):
+                if not q.push(s):
+                    return  # queue closed: consumer abandoned the epoch
+            q.push(None)
+
+        threads = [threading.Thread(target=worker, args=(c,), daemon=True)
+                   for c in chunks]
+        for t in threads:
+            t.start()
+        try:
+            done, buf = 0, []
+            while done < len(threads) or buf:
+                if done < len(threads):
+                    s = q.pop()
+                    if s is None:
+                        done += 1
+                    else:
+                        buf.append(s)
+                if len(buf) == self._batch_size or (done == len(threads)
+                                                    and buf):
+                    if not (self._drop_last
+                            and len(buf) < self._batch_size):
+                        yield self._batch(buf)
+                    buf = []
+        finally:
+            # breaking out of the generator mid-epoch must not leave
+            # producers blocked forever in push() on a full queue
+            q.close()
+            for t in threads:
+                t.join(timeout=5)
+
+
+def _np_dtype(var):
+    from . import core
+
+    return core.np_dtype(var.dtype)

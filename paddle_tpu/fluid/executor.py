@@ -219,6 +219,49 @@ class Executor:
             return [np.asarray(f) for f in fetches]
         return list(fetches)
 
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Dataset-driven training loop (reference executor.py:1642 ->
+        C++ Executor::RunFromDataset -> MultiTrainer/HogwildWorker
+        threads over DataFeed channels, trainer.h:51).
+
+        TPU re-design: the dataset's parser pool (background threads +
+        native BlockingQueue) streams batches into the ONE compiled XLA
+        train step — host worker threads would only serialize against
+        the single device stream, so `thread` configures the parser
+        pool (dataset.set_thread) instead of device workers."""
+        if dataset is None:
+            raise ValueError("train_from_dataset needs a dataset")
+        if thread:
+            dataset.set_thread(thread)
+        fetch_list = fetch_list or []
+        fetch_info = fetch_info or [getattr(v, "name", str(v))
+                                    for v in fetch_list]
+        step = 0
+        last = None
+        for feed in dataset.batch_iter():
+            outs = self.run(program, feed=feed, fetch_list=fetch_list,
+                            scope=scope)
+            last = outs
+            step += 1
+            if debug and fetch_list and step % print_period == 0:
+                msg = ", ".join(
+                    f"{n}={np.asarray(o).ravel()[:1]}"
+                    for n, o in zip(fetch_info, outs))
+                print(f"[train_from_dataset] step {step}: {msg}")
+        return last
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Inference twin of train_from_dataset (reference
+        executor.py:1608): same streaming loop; the program simply has
+        no optimizer ops."""
+        return self.train_from_dataset(program, dataset, scope, thread,
+                                       debug, fetch_list, fetch_info,
+                                       print_period)
+
     # -- internals ---------------------------------------------------------
     def _next_seed(self, program) -> np.uint32:
         # With a fixed program.random_seed the stream is reproducible across

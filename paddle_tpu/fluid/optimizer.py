@@ -402,3 +402,87 @@ Adamax = AdamaxOptimizer
 Adadelta = AdadeltaOptimizer
 RMSProp = RMSPropOptimizer
 Lamb = LambOptimizer
+
+
+class DGCMomentumOptimizer(Optimizer):
+    """Deep Gradient Compression momentum (reference
+    fluid/optimizer.py:1185 DGCMomentumOptimizer over dgc_op.cc and the
+    SparseAllReduceOpHandle, details/sparse_all_reduce_op_handle.cc).
+
+    Per gradient: dgc op (momentum correction u, error feedback v,
+    top-(1-sparsity) selection) -> c_allreduce_sum of the selected
+    values -> SGD apply.  The collective lowers to a dense XLA psum
+    (see ops/optimizer_ops.py `dgc` note); before `rampup_begin_step`
+    the reference trains with plain momentum — pass rampup_begin_step=0
+    (the supported mode) to compress from step one."""
+
+    type = "dgc_momentum"
+
+    def __init__(self, learning_rate, momentum=0.9, rampup_begin_step=0,
+                 rampup_step=1, sparsity=None, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        if rampup_begin_step != 0:
+            raise NotImplementedError(
+                "DGCMomentumOptimizer: rampup_begin_step != 0 (delayed "
+                "compression) is not supported; compression starts at "
+                "step 0")
+        self._momentum = momentum
+        self._sparsity_list = [float(x) for x in (sparsity or [0.999])]
+        self._rampup_step = int(rampup_step)
+        self._step_var = None
+
+    def _dgc_step_counter(self, block):
+        """Shared persistable step counter feeding the warmup schedule
+        (incremented once per optimize pass)."""
+        if self._step_var is None:
+            from .layers import tensor as tl
+
+            self._step_var = tl.create_global_var(
+                [1], 0.0, "float32", persistable=True,
+                name=unique_name.generate("dgc_step"))
+            block.append_op(
+                "increment", inputs={"X": [self._step_var]},
+                outputs={"Out": [self._step_var]},
+                attrs=self._opt_attrs({"step": 1.0}),
+                infer_shape=False)
+        return self._step_var
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        u = self._add_accumulator("dgc_u", p, dtype="float32")
+        v = self._add_accumulator("dgc_v", p, dtype="float32")
+        encoded = block.create_var(dtype="float32", shape=p.shape)
+        step = self._dgc_step_counter(block)
+        block.append_op(
+            "dgc",
+            inputs={"U": [u], "V": [v], "Grad": [g],
+                    "CurrentStep": [step]},
+            outputs={"U_out": [u], "V_out": [v],
+                     "EncodeGrad": [encoded]},
+            attrs=self._opt_attrs({"m": self._momentum,
+                                   "ratio": self._sparsity_list[-1],
+                                   "ratio_list": self._sparsity_list,
+                                   "rampup_step": self._rampup_step}),
+            infer_shape=False)
+        block.append_op(
+            "scale", inputs={"X": [encoded]}, outputs={"Out": [encoded]},
+            attrs=self._opt_attrs({"scale": 1.0, "bias": 0.0,
+                                   "bias_after_scale": True,
+                                   "divide_by_axis_size": "data"}),
+            infer_shape=False)
+        block.append_op(
+            "c_allreduce_sum", inputs={"X": [encoded]},
+            outputs={"Out": [encoded]},
+            attrs=self._opt_attrs({"ring_id": 0,
+                                   "use_calc_stream": True}),
+            infer_shape=False)
+        return block.append_op(
+            "sgd",
+            inputs={"Param": [p], "Grad": [encoded],
+                    "LearningRate": [self._global_learning_rate()]},
+            outputs={"ParamOut": [p]},
+            attrs=self._opt_attrs({}),
+            infer_shape=False)
+
+
+DGCMomentum = DGCMomentumOptimizer

@@ -52,10 +52,22 @@ class GradAllReduce(Collective):
         super().__init__(nrings)
         self.scale_gradient = scale_gradient
 
+    def _mk_op(self, block, type_, ins, outs, attrs):
+        from ..framework import Operator
+
+        return Operator(block, self.main_program._next_op_id(), type_,
+                        ins, outs, dict(attrs, op_role=OpRole.Backward))
+
+    def _comm_ops_for_grad(self, block, g, ring):
+        """Build the comm ops for one gradient var (hook point:
+        FP16AllReduce wraps the allreduce in casts)."""
+        return [self._mk_op(
+            block, "c_allreduce_sum", {"X": [g]}, {"Out": [g]},
+            {"ring_id": ring % self.nrings, "use_calc_stream": True})]
+
     def _transpile_main_program(self):
         block = self.main_program.global_block()
         # find grad vars produced by backward ops that feed optimizer ops
-        opt_inputs = []
         grad_names = set()
         for op in block.ops:
             if op.attr("op_role", 0) == OpRole.Optimize:
@@ -68,29 +80,48 @@ class GradAllReduce(Collective):
                          if op.attr("op_role", 0) == OpRole.Optimize)
         new_ops = []
         ring = 0
-        from ..framework import Operator
-
         for g in sorted(grad_names):
-            attrs = {"op_role": OpRole.Backward}
             if self.scale_gradient:
                 # scale by the RUNTIME data-axis size (divide_by_axis_size),
                 # not the static endpoint count: with multi-device hosts the
                 # psum spans every mesh shard, so 1/len(endpoints) would
                 # under-scale (multi-chip-per-process case)
-                new_ops.append(Operator(
-                    block, self.main_program._next_op_id(), "scale",
-                    {"X": [g]}, {"Out": [g]},
+                new_ops.append(self._mk_op(
+                    block, "scale", {"X": [g]}, {"Out": [g]},
                     {"scale": 1.0, "bias": 0.0, "bias_after_scale": True,
-                     "divide_by_axis_size": "data",
-                     "op_role": OpRole.Backward}))
-            new_ops.append(Operator(
-                block, self.main_program._next_op_id(), "c_allreduce_sum",
-                {"X": [g]}, {"Out": [g]},
-                {"ring_id": ring % self.nrings, "use_calc_stream": True,
-                 "op_role": OpRole.Backward}))
+                     "divide_by_axis_size": "data"}))
+            new_ops.extend(self._comm_ops_for_grad(block, g, ring))
             ring += 1
         block.ops[first_opt:first_opt] = new_ops
         self.main_program._bump_version()
+
+
+class FP16AllReduce(GradAllReduce):
+    """Communicate gradients in half precision (reference
+    fleet/meta_optimizers/fp16_allreduce_optimizer.py §2.9 #11): cast
+    each grad to fp16/bf16 before c_allreduce_sum and back after.  On
+    TPU bf16 is the native half type (fp16 is emulated), so bf16 is the
+    default wire dtype."""
+
+    def __init__(self, nrings=1, scale_gradient=True, wire_dtype="bfloat16"):
+        super().__init__(nrings, scale_gradient)
+        self.wire_dtype = wire_dtype
+
+    def _comm_ops_for_grad(self, block, g, ring):
+        gv = block.var(g)
+        half = block.create_var(dtype=self.wire_dtype, shape=gv.shape)
+        return [
+            self._mk_op(block, "cast", {"X": [g]}, {"Out": [half.name]},
+                        {"in_dtype": gv.dtype,
+                         "out_dtype": self.wire_dtype}),
+            self._mk_op(block, "c_allreduce_sum", {"X": [half.name]},
+                        {"Out": [half.name]},
+                        {"ring_id": ring % self.nrings,
+                         "use_calc_stream": True}),
+            self._mk_op(block, "cast", {"X": [half.name]}, {"Out": [g]},
+                        {"in_dtype": self.wire_dtype,
+                         "out_dtype": gv.dtype}),
+        ]
 
 
 class LocalSGD(Collective):

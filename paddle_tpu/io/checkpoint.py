@@ -1,0 +1,108 @@
+"""Sharded / async array checkpointing (orbax-backed).
+
+The reference's checkpoint path is per-var save/load ops executed by a
+generated program (save_op.cc / load_op.cc via fluid/io.py) plus
+fleet sharded-state saves (dist_sharding_save.py).  TPU-native
+re-design (SURVEY.md §5.4: "pytree checkpoints + sharded array save"):
+orbax writes each jax.Array in its native layout — a ZeRO-sharded or
+mesh-sharded param saves WITHOUT gathering to one host, and multi-host
+jobs write cooperatively.  `async_save` overlaps the write with
+training (the reference has no async path).
+
+Plain numpy/python leaves round-trip too, so this serves as the one
+checkpoint engine for scopes, state_dicts, and train states.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Optional
+
+_async_mgr = None
+_async_lock = threading.Lock()
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def save_state(state: Dict[str, Any], path: str):
+    """Synchronous sharded-aware save of a flat {name: array} tree."""
+    import jax
+
+    path = os.path.abspath(path)
+    state = {k: v for k, v in state.items() if v is not None}
+    if not state:
+        raise ValueError(
+            "save_state: empty state — nothing to checkpoint (did you "
+            "pass the right program/scope? persistables resolve against "
+            "the DEFAULT program unless one is given)")
+    # orbax forbids keys with '/', which paddle var names may contain
+    enc = {k.replace("/", "%2F"): v for k, v in state.items()}
+    _checkpointer().save(path, enc)
+
+
+def load_state(path: str, target: Optional[Dict[str, Any]] = None
+               ) -> Dict[str, Any]:
+    """Restore a tree saved by save_state.  With `target` (name ->
+    abstract array or concrete example), arrays restore with the
+    target's sharding/dtype — the multi-host resume path."""
+    path = os.path.abspath(path)
+    enc_target = None
+    if target is not None:
+        enc_target = {k.replace("/", "%2F"): v for k, v in target.items()}
+    out = _checkpointer().restore(path, item=enc_target)
+    return {k.replace("%2F", "/"): v for k, v in out.items()}
+
+
+class AsyncSaver:
+    """Background-thread checkpoint writer: `save()` returns
+    immediately, `wait()` (or the next save) joins the in-flight write.
+    One outstanding write at a time — the overlap the reference lacks
+    and preemptible TPUs want."""
+
+    def __init__(self):
+        self._thread = None
+        self._err = None
+
+    def save(self, state: Dict[str, Any], path: str):
+        import jax
+
+        self.wait()
+        # snapshot device arrays to host BEFORE returning so training
+        # may donate/overwrite them while the writer runs
+        snap = {}
+        for k, v in state.items():
+            if v is None:
+                continue
+            snap[k] = (jax.device_get(v)
+                       if isinstance(v, jax.Array) else v)
+
+        def run():
+            try:
+                save_state(snap, path)
+            except BaseException as e:  # surfaced on wait()
+                self._err = e
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def wait(self):
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise err
+
+
+def async_save(state: Dict[str, Any], path: str) -> AsyncSaver:
+    global _async_mgr
+    with _async_lock:
+        if _async_mgr is None:
+            _async_mgr = AsyncSaver()
+    _async_mgr.save(state, path)
+    return _async_mgr

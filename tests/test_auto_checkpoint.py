@@ -1,0 +1,101 @@
+"""Auto-checkpoint / preemption recovery (VERDICT r3 task 7): kill
+training mid-job, restart, resume to the same final loss — the
+reference mechanism is TrainEpochRange
+(/root/reference/python/paddle/fluid/incubate/checkpoint/
+auto_checkpoint.py:265) hooking every epoch; ours checkpoints scope
+persistables through the orbax-backed sharded writer
+(paddle_tpu/io/checkpoint.py)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(REPO, "tests", "fixtures", "acp_worker.py")
+
+
+def _run(out, ckpt_dir, preempt_at=None):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["PADDLE_TPU_CHECKPOINT_DIR"] = str(ckpt_dir)
+    env["PADDLE_JOB_ID"] = "acp_test"
+    if preempt_at is not None:
+        env["PREEMPT_AT"] = str(preempt_at)
+    else:
+        env.pop("PREEMPT_AT", None)
+    return subprocess.run([sys.executable, FIXTURE, str(out)], env=env,
+                          cwd=REPO, capture_output=True, text=True,
+                          timeout=300)
+
+
+def _losses(path):
+    out = {}
+    with open(path) as f:
+        for line in f:
+            e, l = line.split()
+            out[int(e)] = float(l)  # resumed epochs overwrite
+    return out
+
+
+def test_preempt_resume_matches_uninterrupted(tmp_path):
+    # uninterrupted reference run
+    ref_out = tmp_path / "ref.txt"
+    rc = _run(ref_out, tmp_path / "ckpt_ref")
+    assert rc.returncode == 0, rc.stdout + rc.stderr
+    ref = _losses(ref_out)
+    assert sorted(ref) == list(range(6))
+
+    # preempted run: dies at end of epoch 2 (before that epoch's save)
+    out = tmp_path / "preempted.txt"
+    rc1 = _run(out, tmp_path / "ckpt", preempt_at=2)
+    assert rc1.returncode == 17  # simulated preemption
+
+    # restart: must resume after the last COMPLETE epoch and finish
+    rc2 = _run(out, tmp_path / "ckpt")
+    assert rc2.returncode == 0, rc2.stdout + rc2.stderr
+    assert "restored_epoch: 1" in rc2.stdout  # epoch 2's save never ran
+    got = _losses(out)
+    assert sorted(got) == list(range(6))
+    for e in range(6):
+        np.testing.assert_allclose(got[e], ref[e], rtol=1e-6,
+                                   err_msg=f"epoch {e} diverged")
+
+
+def test_no_checkpoint_dir_is_plain_range():
+    import paddle_tpu.fluid.incubate.checkpoint.auto_checkpoint as acp
+
+    r = acp.train_epoch_range(
+        4, checker=acp.AutoCheckpointChecker(ckpt_dir=None))
+    assert list(r) == [0, 1, 2, 3]
+
+
+def test_sharded_async_checkpoint_roundtrip(tmp_path):
+    """The orbax engine: sharded jax arrays round-trip; async_save
+    overlaps and wait() completes it."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.io.checkpoint import (async_save, load_state,
+                                          save_state)
+    from paddle_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh({"data": 8})
+    x = jax.device_put(jnp.arange(64, dtype=jnp.float32).reshape(8, 8),
+                       NamedSharding(mesh, P("data")))
+    state = {"w/scope": x, "step": np.int64(7)}
+    p = str(tmp_path / "ck1")
+    save_state(state, p)
+    back = load_state(p)
+    np.testing.assert_array_equal(np.asarray(back["w/scope"]),
+                                  np.asarray(x))
+    assert int(back["step"]) == 7
+
+    p2 = str(tmp_path / "ck2")
+    saver = async_save({"a": jnp.ones((16,))}, p2)
+    saver.wait()
+    np.testing.assert_array_equal(np.asarray(load_state(p2)["a"]),
+                                  np.ones((16,)))

