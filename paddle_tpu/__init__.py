@@ -33,6 +33,9 @@ from . import metric
 from . import hapi
 from .hapi import Model
 from .framework_io import load, save
+from . import distribution
+from . import vision
+from . import text
 from . import inference
 from . import profiler
 from .fluid.flags import get_flags, set_flags

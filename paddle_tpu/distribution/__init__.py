@@ -1,0 +1,272 @@
+"""paddle.distribution — probability distributions.
+
+Reference: /root/reference/python/paddle/fluid/layers/distributions.py /
+python/paddle/distribution.py (Normal, Uniform, Categorical,
+MultivariateNormalDiag: sample / entropy / log_prob / probs /
+kl_divergence, built by emitting fluid ops).
+
+TPU-native re-design: distributions are thin eager objects over
+jax.random / jnp math wrapped in the dygraph tracer (trace_fn), so
+sampling rides the framework's deterministic per-op RNG stream and
+every method is differentiable where it mathematically should be
+(log_prob/entropy w.r.t. parameters; `sample` uses reparameterization
+for Normal/Uniform).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..fluid.dygraph.tracer import trace_fn, _tracer
+from ..fluid.dygraph.varbase import Tensor
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical",
+           "MultivariateNormalDiag", "kl_divergence"]
+
+
+def _as_tensor(x, dtype="float32"):
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(np.asarray(x, dtype=dtype))
+
+
+def _rng_key():
+    import jax
+
+    tr = _tracer()
+    if tr is not None:
+        return tr.next_rng_key()
+    return jax.random.PRNGKey(np.random.randint(0, 2 ** 31 - 1))
+
+
+class Distribution:
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def probs(self, value):
+        import jax.numpy as jnp
+
+        return trace_fn(lambda lp: jnp.exp(lp),
+                        {"lp": self.log_prob(value)})
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+
+class Normal(Distribution):
+    """N(loc, scale) (reference distributions.py Normal)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _as_tensor(loc)
+        self.scale = _as_tensor(scale)
+
+    def sample(self, shape=(), seed=0):
+        import jax
+        import jax.numpy as jnp
+
+        key = _rng_key() if not seed else jax.random.PRNGKey(seed)
+
+        def f(loc, scale):
+            full = tuple(shape) + tuple(np.broadcast_shapes(
+                loc.shape, scale.shape))
+            eps = jax.random.normal(key, full, loc.dtype)
+            return loc + scale * eps  # reparameterized
+
+        return trace_fn(f, {"loc": self.loc, "scale": self.scale})
+
+    def entropy(self):
+        import jax.numpy as jnp
+
+        return trace_fn(
+            lambda scale: 0.5 + 0.5 * math.log(2 * math.pi)
+            + jnp.log(scale), {"scale": self.scale})
+
+    def log_prob(self, value):
+        import jax.numpy as jnp
+
+        return trace_fn(
+            lambda v, loc, scale: -((v - loc) ** 2) / (2 * scale ** 2)
+            - jnp.log(scale) - 0.5 * math.log(2 * math.pi),
+            {"v": _as_tensor(value), "loc": self.loc,
+             "scale": self.scale})
+
+    def kl_divergence(self, other):
+        import jax.numpy as jnp
+
+        assert isinstance(other, Normal)
+
+        def f(l1, s1, l2, s2):
+            var_ratio = (s1 / s2) ** 2
+            t1 = ((l1 - l2) / s2) ** 2
+            return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+
+        return trace_fn(f, {"l1": self.loc, "s1": self.scale,
+                            "l2": other.loc, "s2": other.scale})
+
+
+class Uniform(Distribution):
+    """U[low, high) (reference distributions.py Uniform)."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _as_tensor(low)
+        self.high = _as_tensor(high)
+
+    def sample(self, shape=(), seed=0):
+        import jax
+
+        key = _rng_key() if not seed else jax.random.PRNGKey(seed)
+
+        def f(low, high):
+            full = tuple(shape) + tuple(np.broadcast_shapes(
+                low.shape, high.shape))
+            u = jax.random.uniform(key, full, low.dtype)
+            return low + (high - low) * u
+
+        return trace_fn(f, {"low": self.low, "high": self.high})
+
+    def entropy(self):
+        import jax.numpy as jnp
+
+        return trace_fn(lambda low, high: jnp.log(high - low),
+                        {"low": self.low, "high": self.high})
+
+    def log_prob(self, value):
+        import jax.numpy as jnp
+
+        def f(v, low, high):
+            inside = jnp.logical_and(v >= low, v < high)
+            lp = -jnp.log(high - low)
+            return jnp.where(inside, lp, -jnp.inf)
+
+        return trace_fn(f, {"v": _as_tensor(value), "low": self.low,
+                            "high": self.high})
+
+
+class Categorical(Distribution):
+    """Categorical over unnormalized logits (reference Categorical)."""
+
+    def __init__(self, logits, name=None):
+        self.logits = _as_tensor(logits)
+
+    def sample(self, shape=(), seed=0):
+        import jax
+
+        key = _rng_key() if not seed else jax.random.PRNGKey(seed)
+
+        def f(logits):
+            return jax.random.categorical(key, logits,
+                                          shape=tuple(shape)
+                                          + logits.shape[:-1])
+
+        return trace_fn(f, {"logits": self.logits})
+
+    def _log_pmf(self):
+        import jax
+
+        return trace_fn(lambda l: jax.nn.log_softmax(l, axis=-1),
+                        {"l": self.logits})
+
+    def entropy(self):
+        import jax
+        import jax.numpy as jnp
+
+        def f(l):
+            lp = jax.nn.log_softmax(l, axis=-1)
+            return -jnp.sum(jnp.exp(lp) * lp, axis=-1)
+
+        return trace_fn(f, {"l": self.logits})
+
+    def log_prob(self, value):
+        import jax
+        import jax.numpy as jnp
+
+        def f(l, v):
+            lp = jax.nn.log_softmax(l, axis=-1)
+            return jnp.take_along_axis(
+                lp, v[..., None].astype(jnp.int32), axis=-1)[..., 0]
+
+        return trace_fn(f, {"l": self.logits, "v": _as_tensor(value,
+                                                              "int64")})
+
+    def kl_divergence(self, other):
+        import jax
+        import jax.numpy as jnp
+
+        assert isinstance(other, Categorical)
+
+        def f(a, b):
+            pa = jax.nn.log_softmax(a, axis=-1)
+            pb = jax.nn.log_softmax(b, axis=-1)
+            return jnp.sum(jnp.exp(pa) * (pa - pb), axis=-1)
+
+        return trace_fn(f, {"a": self.logits, "b": other.logits})
+
+
+class MultivariateNormalDiag(Distribution):
+    """N(loc, diag(scale^2)) (reference MultivariateNormalDiag)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _as_tensor(loc)
+        self.scale = _as_tensor(scale)  # diagonal std, last dim = event
+
+    def sample(self, shape=(), seed=0):
+        import jax
+
+        key = _rng_key() if not seed else jax.random.PRNGKey(seed)
+
+        def f(loc, scale):
+            full = tuple(shape) + tuple(np.broadcast_shapes(
+                loc.shape, scale.shape))
+            eps = jax.random.normal(key, full, loc.dtype)
+            return loc + scale * eps
+
+        return trace_fn(f, {"loc": self.loc, "scale": self.scale})
+
+    def entropy(self):
+        import jax.numpy as jnp
+
+        def f(scale):
+            d = scale.shape[-1]
+            return (0.5 * d * (1 + math.log(2 * math.pi))
+                    + jnp.sum(jnp.log(scale), axis=-1))
+
+        return trace_fn(f, {"scale": self.scale})
+
+    def log_prob(self, value):
+        import jax.numpy as jnp
+
+        def f(v, loc, scale):
+            d = scale.shape[-1]
+            z = (v - loc) / scale
+            return (-0.5 * jnp.sum(z ** 2, axis=-1)
+                    - jnp.sum(jnp.log(scale), axis=-1)
+                    - 0.5 * d * math.log(2 * math.pi))
+
+        return trace_fn(f, {"v": _as_tensor(value), "loc": self.loc,
+                            "scale": self.scale})
+
+    def kl_divergence(self, other):
+        import jax.numpy as jnp
+
+        assert isinstance(other, MultivariateNormalDiag)
+
+        def f(l1, s1, l2, s2):
+            var_ratio = (s1 / s2) ** 2
+            t1 = ((l1 - l2) / s2) ** 2
+            return 0.5 * jnp.sum(
+                var_ratio + t1 - 1 - jnp.log(var_ratio), axis=-1)
+
+        return trace_fn(f, {"l1": self.loc, "s1": self.scale,
+                            "l2": other.loc, "s2": other.scale})
+
+
+def kl_divergence(p, q):
+    return p.kl_divergence(q)

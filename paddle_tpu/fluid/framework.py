@@ -429,16 +429,25 @@ class Program:
 
     # -- serialization -----------------------------------------------------
     def to_dict(self) -> dict:
+        from .op_version_registry import version_map
+
+        # only ops this program uses (the reference's OpVersionMap,
+        # framework.proto:185, embedded per-program the same way)
+        used = {op.type for b in self.blocks for op in b.ops}
         return {
             "format": "paddle_tpu.program.v1",
             "random_seed": self.random_seed,
             "op_id_counter": self._op_id_counter,
+            "op_version_map": version_map(used),
             "blocks": [b.to_dict() for b in self.blocks],
         }
 
     @staticmethod
     def from_dict(d: dict) -> "Program":
         assert d.get("format") == "paddle_tpu.program.v1", "unknown program format"
+        from .op_version_registry import check_compatibility
+
+        check_compatibility(d.get("op_version_map", {}))
         p = Program()
         p.random_seed = d.get("random_seed", 0)
         p._op_id_counter = d.get("op_id_counter", 0)

@@ -1,0 +1,100 @@
+"""Op semantic-version registry for model compatibility.
+
+Reference: /root/reference/paddle/fluid/framework/op_version_registry.h
+(+ .cc): every op change registers a version bump with a change note
+(NewInput/ModifyAttr/...); ProgramDescs carry an OpVersionMap
+(framework.proto:185) and loading checks the map against the running
+framework so old checkpoints either translate or fail loudly.
+
+TPU build: pure-Python registry with the same contract —
+`register_op_version(op, version, note)` at definition sites, programs
+serialize `op_version_map` in their JSON, and `check_compatibility`
+compares a saved map against the registry on load (warn on older,
+raise on newer-than-runtime: a newer writer may rely on semantics this
+runtime lacks).
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Dict, List, Tuple
+
+_REGISTRY: Dict[str, List[Tuple[int, str]]] = {}
+
+
+def register_op_version(op_type: str, version: int, note: str = ""):
+    """Record that `op_type` changed at `version` (monotonic per op)."""
+    entries = _REGISTRY.setdefault(op_type, [])
+    if entries and version <= entries[-1][0]:
+        raise ValueError(
+            f"op_version_registry: {op_type} version {version} is not "
+            f"greater than the last registered {entries[-1][0]}")
+    entries.append((version, note))
+
+
+def op_version(op_type: str) -> int:
+    """Current semantic version of an op (1 = never bumped)."""
+    entries = _REGISTRY.get(op_type)
+    return entries[-1][0] if entries else 1
+
+
+def version_map(op_types=None) -> Dict[str, int]:
+    """Snapshot {op_type: version}.  With `op_types`, restrict to those
+    (Program.to_dict passes its used-op set); default: every registered
+    op."""
+    if op_types is None:
+        from ..ops import registry as op_registry
+
+        op_types = op_registry.registered_ops()
+    return {t: op_version(t) for t in sorted(op_types)}
+
+
+def change_notes(op_type: str) -> List[Tuple[int, str]]:
+    return list(_REGISTRY.get(op_type, []))
+
+
+def check_compatibility(saved_map: Dict[str, int], strict: bool = False):
+    """Compare a loaded program's op-version map with this runtime.
+
+    newer-than-runtime op -> RuntimeError (the writer relied on
+    semantics we don't have); older -> warning listing the change notes
+    between the two versions (the reference's pass-through-with-
+    converters case).  Unknown ops fail at lowering anyway, so they are
+    reported only in strict mode."""
+    problems, notes = [], []
+    for op_type, saved_v in (saved_map or {}).items():
+        cur = op_version(op_type)
+        if saved_v > cur:
+            problems.append(f"{op_type}: saved v{saved_v} > runtime "
+                            f"v{cur}")
+        elif saved_v < cur:
+            changes = [f"v{v}: {n}" for v, n in change_notes(op_type)
+                       if v > saved_v]
+            notes.append(f"{op_type}: v{saved_v} -> v{cur} "
+                         f"({'; '.join(changes) or 'no notes'})")
+        if strict and op_type not in _REGISTRY:
+            from ..ops import registry as op_registry
+
+            if not op_registry.has_op(op_type):
+                problems.append(f"{op_type}: not registered in this "
+                                "runtime")
+    if problems:
+        raise RuntimeError(
+            "program was saved by a NEWER framework: "
+            + "; ".join(problems))
+    if notes:
+        warnings.warn(
+            "program uses older op semantics; behavior may have "
+            "changed: " + "; ".join(notes), UserWarning, stacklevel=2)
+
+
+# -- registered semantic changes of THIS framework ---------------------------
+# (ops whose behavior changed after their first release in round 1/2)
+register_op_version(
+    "softmax_with_cross_entropy", 2,
+    "ignore_index/weighted mean follow sum(w*l)/sum(w) semantics (r3)")
+register_op_version(
+    "recv_v2", 2,
+    "unpaired recv raises instead of returning zeros (r3)")
+register_op_version(
+    "beam_search", 2, "honors is_accumulated (r3)")

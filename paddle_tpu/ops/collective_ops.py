@@ -168,9 +168,17 @@ def _comm_bootstrap(ctx, op, ins):
 
 @register_op("send_v2")
 def _send_v2(ctx, op, ins):
-    # P2P send: on TPU expressed as ppermute by the pipeline compiler;
-    # standalone send is a no-op at trace level (value is carried
-    # functionally by the paired recv's ppermute).
+    """P2P send (reference operators/collective/send_v2_op.cc).
+
+    XLA has no one-sided send: the value is carried by the ppermute the
+    PAIRED recv_v2 emits.  The payload and its destination rank are
+    queued on the trace context (FIFO per ring_id); the matching
+    recv_v2 later in the same program consumes it (ADVICE r2 #1 — the
+    old no-op form let recv silently produce zeros)."""
+    x = first(ins, "X", None)
+    if x is not None:
+        ctx.p2p_queue.setdefault(int(op.attr("ring_id", 0)), []).append(
+            (x, int(op.attr("peer", 0))))
     return {}
 
 
@@ -185,9 +193,29 @@ def _recv_v2(ctx, op, ins):
             perm = [(src, d) for d in range(n)]
             return {"Out": [lax.ppermute(x, axis, perm)]}
         return {"Out": [x]}
-    shape = tuple(op.attr("out_shape", []))
-    import numpy as _np
-
-    from .registry import jdt
-
-    return {"Out": [jnp.zeros(shape, jdt(op.attr("dtype", "float32")))]}
+    # no explicit X: consume the oldest unpaired send on this ring — the
+    # functional form of the reference's matched send_v2/recv_v2 pair
+    # (data travels as a ppermute edge src -> dst, where src is this
+    # recv's peer attr and dst is the send's).  Ranks outside the edge
+    # receive ppermute's zero-fill, matching XLA collective-permute
+    # semantics.
+    ring = int(op.attr("ring_id", 0))
+    queue = ctx.p2p_queue.get(ring, [])
+    axis = _axis_for(ctx, op)
+    if queue and axis is not None:
+        sent, dst = queue.pop(0)
+        src = int(op.attr("peer", 0))
+        want_shape = tuple(op.attr("out_shape", []) or ())
+        if want_shape and tuple(sent.shape) != want_shape:
+            raise ValueError(
+                f"recv_v2 on ring {ring} paired (FIFO) with a send of "
+                f"shape {tuple(sent.shape)} but declares out_shape "
+                f"{want_shape} — sends and recvs are mis-ordered in the "
+                "program")
+        return {"Out": [lax.ppermute(sent, axis, [(src, dst)])]}
+    raise ValueError(
+        "recv_v2 has no data source: no X input and no earlier matching "
+        f"send_v2 on ring {ring} in this program"
+        + ("" if axis is not None else " (and no mesh axis is active)")
+        + ". A recv that silently returned zeros would corrupt training "
+        "(ADVICE r2 #1); pair it with a send_v2 or pass the value as X.")

@@ -67,6 +67,7 @@ def _while(ctx, op, ins):
         # draw fresh values every iteration
         bctx = registry.LowerCtx(jax.random.fold_in(ctx.base_key, i),
                                  block=block, mesh_axes=ctx.mesh_axes)
+        bctx.p2p_queue = ctx.p2p_queue  # send/recv may pair across blocks
         registry.lower_block(bctx, block, env)
         return (i + 1, tuple(env[n] for n in carried))
 
@@ -105,6 +106,7 @@ def _conditional_block(ctx, op, ins):
         env = dict(outer_env)
         bctx = registry.LowerCtx(ctx.base_key, block=block,
                                  mesh_axes=ctx.mesh_axes)
+        bctx.p2p_queue = ctx.p2p_queue  # send/recv may pair across blocks
         registry.lower_block(bctx, block, env)
         return tuple(env[n] for n in out_names)
 
@@ -187,6 +189,7 @@ def _recompute_segment_grad(ctx, op, ins):
         # the recompute replays identical randomness (dropout masks)
         inner = registry.LowerCtx(ctx.base_key, block=block,
                                   mesh_axes=ctx.mesh_axes)
+        inner.p2p_queue = ctx.p2p_queue  # send/recv may pair across blocks
         for o in seg_ops:
             registry.lower_op(inner, o, env)
         return [env[n] for n in seg_outputs]

@@ -93,6 +93,9 @@ class LowerCtx:
         # axis names available for collectives when tracing under shard_map
         self.mesh_axes = mesh_axes or {}
         self.abstract = abstract  # True during eval_shape-based InferShape
+        # in-flight send_v2 payloads per ring, consumed FIFO by recv_v2
+        # (functional p2p pairing, collective_ops.py)
+        self.p2p_queue: Dict[int, list] = {}
 
     def rng_key(self, op: Operator):
         """Deterministic per-op key: seed attr wins (OpTest reproducibility),

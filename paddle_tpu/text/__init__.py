@@ -1,0 +1,7 @@
+"""paddle.text — text datasets (reference python/paddle/text/datasets:
+Imdb, UCIHousing, WMT14...).  Zero-egress: parsers read the standard
+local file formats; FakeTextDataset synthesizes token streams for
+tests."""
+
+from . import datasets  # noqa: F401
+from .datasets import Imdb, UCIHousing, FakeTextDataset  # noqa: F401

@@ -1,0 +1,5 @@
+"""paddle.vision — datasets, transforms, models (reference
+python/paddle/vision/, re-based: host-side numpy transforms, IDX/pickle
+file parsers with zero-egress contract, eager-Layer models)."""
+
+from . import datasets, models, transforms  # noqa: F401

@@ -1,0 +1,132 @@
+"""paddle.vision.datasets — MNIST / Cifar10 / FakeData.
+
+Reference: /root/reference/python/paddle/vision/datasets (mnist.py,
+cifar.py) which download + parse the standard archives.  This build is
+zero-egress: `download=True` raises with instructions, and the parsers
+read the STANDARD file formats (IDX for MNIST, the python-pickle batch
+format for CIFAR) from a local path — drop the official files in and
+they load.  FakeData generates deterministic synthetic samples for
+tests/benchmarks (the reference uses fake readers the same way,
+SURVEY §4.2 book tests).
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "FakeData"]
+
+_NO_DOWNLOAD = ("this TPU build runs zero-egress: download the official "
+                "archive on a connected machine and pass the local "
+                "path(s)")
+
+
+class MNIST(Dataset):
+    """IDX-format MNIST (reference vision/datasets/mnist.py).
+
+    Pass image_path/label_path to the (optionally gzipped) idx files.
+    """
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        if download or image_path is None or label_path is None:
+            raise ValueError(f"MNIST: image_path and label_path are "
+                             f"required ({_NO_DOWNLOAD})")
+        self.transform = transform
+        self.images = self._read_images(image_path)
+        self.labels = self._read_labels(label_path)
+        assert len(self.images) == len(self.labels)
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") \
+            else open(path, "rb")
+
+    def _read_images(self, path):
+        with self._open(path) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            if magic != 2051:
+                raise ValueError(f"{path}: bad IDX image magic {magic}")
+            data = np.frombuffer(f.read(n * rows * cols), dtype=np.uint8)
+            return data.reshape(n, rows, cols)
+
+    def _read_labels(self, path):
+        with self._open(path) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            if magic != 2049:
+                raise ValueError(f"{path}: bad IDX label magic {magic}")
+            return np.frombuffer(f.read(n), dtype=np.uint8).astype("int64")
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+
+class FashionMNIST(MNIST):
+    """Same IDX format, different archive."""
+
+
+class Cifar10(Dataset):
+    """CIFAR-10 python-pickle batches (reference vision/datasets/
+    cifar.py): pass the batch file paths (data_batch_1..5 / test_batch).
+    """
+
+    def __init__(self, batch_paths=None, mode="train", transform=None,
+                 download=False, backend=None):
+        if download or not batch_paths:
+            raise ValueError(f"Cifar10: batch_paths is required "
+                             f"({_NO_DOWNLOAD})")
+        self.transform = transform
+        imgs, labels = [], []
+        for p in batch_paths:
+            with open(p, "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            imgs.append(np.asarray(d[b"data"], np.uint8)
+                        .reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
+            labels.extend(d[b"labels"])
+        self.images = np.concatenate(imgs)
+        self.labels = np.asarray(labels, "int64")
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic image dataset for tests/benchmarks."""
+
+    def __init__(self, size=100, image_shape=(3, 32, 32), num_classes=10,
+                 transform=None, seed=0):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.seed = seed
+
+    def __len__(self):
+        return self.size
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self.seed * 100003 + idx)
+        img = rng.randint(0, 256, self.image_shape).astype("uint8")
+        label = np.int64(rng.randint(0, self.num_classes))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label

@@ -1,0 +1,176 @@
+"""paddle.vision.transforms — numpy-based image preprocessing.
+
+Reference: /root/reference/python/paddle/vision/transforms (Compose,
+Resize, RandomCrop, RandomHorizontalFlip, Normalize, ToTensor, ...).
+TPU-native note: transforms run HOST-side on numpy (they feed the
+DataLoader's worker threads); nothing here touches the device — the
+accelerator sees only the final batched arrays.
+"""
+
+from __future__ import annotations
+
+import numbers
+import random
+
+import numpy as np
+
+__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+           "Transpose", "Pad"]
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class ToTensor:
+    """HWC uint8 [0,255] -> CHW float32 [0,1]."""
+
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        a = np.asarray(img)
+        if a.ndim == 2:
+            a = a[:, :, None]
+        a = a.astype("float32") / 255.0
+        if self.data_format == "CHW":
+            a = np.transpose(a, (2, 0, 1))
+        return a
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW"):
+        self.mean = np.asarray(mean, "float32")
+        self.std = np.asarray(std, "float32")
+        self.data_format = data_format
+
+    def __call__(self, img):
+        a = np.asarray(img, "float32")
+        shape = ((-1, 1, 1) if self.data_format == "CHW"
+                 else (1, 1, -1))
+        return (a - self.mean.reshape(shape)) / self.std.reshape(shape)
+
+
+def _hwc(a):
+    if a.ndim == 2:
+        return a[:, :, None], True
+    return a, False
+
+
+class Resize:
+    """Nearest-neighbor resize (no PIL dependency on the image)."""
+
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, numbers.Number) \
+            else tuple(size)
+
+    def __call__(self, img):
+        a = np.asarray(img)
+        a, squeeze = _hwc(a)
+        h, w = self.size
+        ys = (np.arange(h) * a.shape[0] / h).astype(int)
+        xs = (np.arange(w) * a.shape[1] / w).astype(int)
+        out = a[ys][:, xs]
+        return out[:, :, 0] if squeeze else out
+
+
+def _pad_to(a, h, w):
+    """Zero-pad so the array is at least (h, w): crops always return
+    the REQUESTED size (a silent smaller output would blow up later at
+    batch stacking, far from the cause)."""
+    ph = max(0, h - a.shape[0])
+    pw = max(0, w - a.shape[1])
+    if ph or pw:
+        a = np.pad(a, ((ph // 2, ph - ph // 2),
+                       (pw // 2, pw - pw // 2), (0, 0)))
+    return a
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, numbers.Number) \
+            else tuple(size)
+
+    def __call__(self, img):
+        a = np.asarray(img)
+        a, squeeze = _hwc(a)
+        h, w = self.size
+        a = _pad_to(a, h, w)
+        y = (a.shape[0] - h) // 2
+        x = (a.shape[1] - w) // 2
+        out = a[y:y + h, x:x + w]
+        return out[:, :, 0] if squeeze else out
+
+
+class RandomCrop:
+    def __init__(self, size, pad_if_needed=True):
+        self.size = (size, size) if isinstance(size, numbers.Number) \
+            else tuple(size)
+        self.pad_if_needed = pad_if_needed
+
+    def __call__(self, img):
+        a = np.asarray(img)
+        a, squeeze = _hwc(a)
+        h, w = self.size
+        if self.pad_if_needed:
+            a = _pad_to(a, h, w)
+        elif a.shape[0] < h or a.shape[1] < w:
+            raise ValueError(
+                f"RandomCrop{self.size}: image {a.shape[:2]} is smaller "
+                "and pad_if_needed=False")
+        y = random.randint(0, max(0, a.shape[0] - h))
+        x = random.randint(0, max(0, a.shape[1] - w))
+        out = a[y:y + h, x:x + w]
+        return out[:, :, 0] if squeeze else out
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if random.random() < self.prob:
+            return np.asarray(img)[:, ::-1].copy()
+        return np.asarray(img)
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if random.random() < self.prob:
+            return np.asarray(img)[::-1].copy()
+        return np.asarray(img)
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def __call__(self, img):
+        a = np.asarray(img)
+        if a.ndim == 2:
+            a = a[:, :, None]
+        return np.transpose(a, self.order)
+
+
+class Pad:
+    def __init__(self, padding, fill=0):
+        self.padding = padding if not isinstance(padding, int) \
+            else (padding, padding, padding, padding)  # l, t, r, b
+        self.fill = fill
+
+    def __call__(self, img):
+        a = np.asarray(img)
+        a, squeeze = _hwc(a)
+        l, t, r, b = self.padding
+        out = np.pad(a, ((t, b), (l, r), (0, 0)), constant_values=self.fill)
+        return out[:, :, 0] if squeeze else out

@@ -326,3 +326,52 @@ def test_grad_scale_uses_runtime_axis_size(fresh_programs):
     (out,) = exe.run(compiled, feed={"x": X}, fetch_list=[y])
     # each shard: 1/8; allreduce over 8 shards: sum = 1.0
     np.testing.assert_allclose(out[:1], np.ones((1, 2)), rtol=1e-6)
+
+
+def test_send_recv_pairing(fresh_programs):
+    """send_v2/recv_v2 pair into a real ppermute edge: rank 0's row
+    lands on rank 3; unpaired recv raises instead of yielding zeros
+    (ADVICE r2 #1)."""
+    main, startup, scope = fresh_programs
+    x = fluid.data("x", [8, 4], "float32")
+    block = main.global_block()
+    out = block.create_var(dtype="float32", shape=[1, 4])
+    block.append_op("send_v2", inputs={"X": [x]}, outputs={},
+                    attrs={"ring_id": 0, "peer": 3}, infer_shape=False)
+    block.append_op("recv_v2", inputs={}, outputs={"Out": [out]},
+                    attrs={"ring_id": 0, "peer": 0,
+                           "out_shape": [1, 4], "dtype": "float32"},
+                    infer_shape=False)
+    # gather each shard's received row so the (replicated) fetch can
+    # observe all of them
+    gathered = block.create_var(dtype="float32", shape=[8, 4])
+    block.append_op("c_allgather", inputs={"X": [out]},
+                    outputs={"Out": [gathered]},
+                    attrs={"ring_id": 0, "nranks": 8}, infer_shape=False)
+    compiled = fluid.CompiledProgram(main).with_data_parallel()
+    exe = fluid.Executor()
+    X = np.arange(32, dtype="float32").reshape(8, 4)
+    (o,) = exe.run(compiled, feed={"x": X}, fetch_list=[gathered])
+    # shard 3 received shard 0's row; all other shards zero-filled
+    np.testing.assert_allclose(o[3], X[0])
+    assert np.all(o[:3] == 0) and np.all(o[4:] == 0)
+
+
+def test_unpaired_recv_raises(fresh_programs):
+    main, startup, scope = fresh_programs
+    x = fluid.data("x", [8, 4], "float32")
+    block = main.global_block()
+    out = block.create_var(dtype="float32", shape=[1, 4])
+    block.append_op("recv_v2", inputs={}, outputs={"Out": [out]},
+                    attrs={"ring_id": 5, "peer": 0,
+                           "out_shape": [1, 4], "dtype": "float32"},
+                    infer_shape=False)
+    # keep x alive in the program so the feed is used
+    block.append_op("scale", inputs={"X": [x]}, outputs={"Out": [x]},
+                    attrs={"scale": 1.0, "bias": 0.0,
+                           "bias_after_scale": True}, infer_shape=False)
+    compiled = fluid.CompiledProgram(main).with_data_parallel()
+    exe = fluid.Executor()
+    X = np.zeros((8, 4), "float32")
+    with pytest.raises(Exception, match="no data source|no earlier"):
+        exe.run(compiled, feed={"x": X}, fetch_list=[out])

@@ -1,0 +1,54 @@
+"""Op version registry / model-compat (reference
+paddle/fluid/framework/op_version_registry.h + OpVersionMap,
+framework.proto:185)."""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import op_version_registry as ovr
+
+
+def _make_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [-1, 4], "float32")
+        fluid.layers.fc(x, 2)
+    return main
+
+
+class TestRegistry:
+    def test_default_and_bumped_versions(self):
+        assert ovr.op_version("elementwise_add") == 1  # never bumped
+        assert ovr.op_version("recv_v2") == 2          # bumped in r3
+
+    def test_monotonic_enforced(self):
+        with pytest.raises(ValueError):
+            ovr.register_op_version("recv_v2", 1, "going backwards")
+
+    def test_program_roundtrip_carries_map(self, fresh_programs):
+        main = _make_program()
+        d = main.to_dict()
+        assert "mul" in d["op_version_map"] or "matmul_v2" in \
+            d["op_version_map"] or len(d["op_version_map"]) > 0
+        back = fluid.Program.from_json(main.to_json())
+        assert back.to_dict()["op_version_map"] == d["op_version_map"]
+
+    def test_newer_writer_raises(self, fresh_programs):
+        main = _make_program()
+        d = main.to_dict()
+        some_op = next(iter(d["op_version_map"]))
+        d["op_version_map"][some_op] = 999
+        with pytest.raises(RuntimeError, match="NEWER framework"):
+            fluid.Program.from_dict(d)
+
+    def test_older_writer_warns(self, fresh_programs):
+        main = _make_program()
+        d = main.to_dict()
+        d["op_version_map"]["recv_v2"] = 1  # pre-r3 semantics
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            fluid.Program.from_dict(d)
+        assert any("older op semantics" in str(x.message) for x in w)

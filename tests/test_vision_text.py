@@ -1,0 +1,155 @@
+"""paddle.vision / paddle.text / transforms tests (reference:
+python/paddle/tests/test_datasets.py, test_vision_models.py,
+test_transforms.py).  File-format parsers are tested against tiny
+archives written in the REAL formats (IDX, CIFAR pickle, aclImdb tar)."""
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.fluid.dygraph import guard, to_variable
+from paddle_tpu.vision import datasets as vd
+from paddle_tpu.vision import models as vm
+from paddle_tpu.vision import transforms as T
+
+
+class TestTransforms:
+    def test_compose_to_tensor_normalize(self):
+        img = np.full((4, 4, 3), 255, "uint8")
+        t = T.Compose([T.ToTensor(),
+                       T.Normalize(mean=[0.5, 0.5, 0.5],
+                                   std=[0.5, 0.5, 0.5])])
+        out = t(img)
+        assert out.shape == (3, 4, 4)
+        np.testing.assert_allclose(out, 1.0)
+
+    def test_resize_crop_flip_pad(self):
+        img = np.arange(64, dtype="uint8").reshape(8, 8)
+        assert T.Resize(4)(img).shape == (4, 4)
+        assert T.CenterCrop(4)(img).shape == (4, 4)
+        assert T.RandomCrop(4)(img).shape == (4, 4)
+        assert T.Pad(2)(img).shape == (12, 12)
+        flipped = T.RandomHorizontalFlip(prob=1.0)(img)
+        np.testing.assert_array_equal(flipped, img[:, ::-1])
+
+
+class TestDatasets:
+    def _write_idx(self, tmp, n=10):
+        rng = np.random.RandomState(0)
+        imgs = rng.randint(0, 256, (n, 28, 28)).astype("uint8")
+        labels = rng.randint(0, 10, n).astype("uint8")
+        ip = str(tmp / "imgs.idx.gz")
+        lp = str(tmp / "labels.idx")
+        with gzip.open(ip, "wb") as f:
+            f.write(struct.pack(">IIII", 2051, n, 28, 28))
+            f.write(imgs.tobytes())
+        with open(lp, "wb") as f:
+            f.write(struct.pack(">II", 2049, n))
+            f.write(labels.tobytes())
+        return ip, lp, imgs, labels
+
+    def test_mnist_idx_roundtrip(self, tmp_path):
+        ip, lp, imgs, labels = self._write_idx(tmp_path)
+        ds = vd.MNIST(image_path=ip, label_path=lp)
+        assert len(ds) == 10
+        img, lab = ds[3]
+        np.testing.assert_array_equal(img, imgs[3])
+        assert lab == labels[3]
+
+    def test_mnist_download_raises(self):
+        with pytest.raises(ValueError, match="zero-egress"):
+            vd.MNIST(download=True)
+
+    def test_cifar_pickle_roundtrip(self, tmp_path):
+        rng = np.random.RandomState(1)
+        data = rng.randint(0, 256, (8, 3 * 32 * 32)).astype("uint8")
+        labels = list(rng.randint(0, 10, 8))
+        p = str(tmp_path / "data_batch_1")
+        with open(p, "wb") as f:
+            pickle.dump({b"data": data, b"labels": labels}, f)
+        ds = vd.Cifar10(batch_paths=[p])
+        assert len(ds) == 8
+        img, lab = ds[0]
+        assert img.shape == (32, 32, 3)
+        np.testing.assert_array_equal(
+            img, data[0].reshape(3, 32, 32).transpose(1, 2, 0))
+
+    def test_fake_data_deterministic(self):
+        a = vd.FakeData(size=5, seed=3)
+        b = vd.FakeData(size=5, seed=3)
+        np.testing.assert_array_equal(a[2][0], b[2][0])
+
+
+class TestTextDatasets:
+    def test_imdb_tar(self, tmp_path):
+        import io as _io
+
+        tp = str(tmp_path / "aclImdb.tar")
+        with tarfile.open(tp, "w") as tf:
+            for name, body in [
+                ("aclImdb/train/pos/0_9.txt", b"good great movie good"),
+                ("aclImdb/train/neg/1_2.txt", b"bad awful movie bad"),
+                ("aclImdb/test/pos/0_8.txt", b"ignored"),
+            ]:
+                info = tarfile.TarInfo(name)
+                info.size = len(body)
+                tf.addfile(info, _io.BytesIO(body))
+        ds = paddle.text.Imdb(data_path=tp, mode="train", cutoff=1)
+        assert len(ds) == 2
+        toks, lab = ds[0]
+        assert toks.dtype == np.int64 and lab in (0, 1)
+        # 'movie' appears in both docs -> must be in vocab
+        assert "movie" in ds.word_idx
+
+    def test_uci_housing(self, tmp_path):
+        rng = np.random.RandomState(0)
+        raw = rng.rand(20, 14).astype("float32")
+        p = str(tmp_path / "housing.data")
+        np.savetxt(p, raw)
+        tr = paddle.text.UCIHousing(data_path=p, mode="train")
+        te = paddle.text.UCIHousing(data_path=p, mode="test")
+        assert len(tr) == 16 and len(te) == 4
+        x, y = tr[0]
+        assert x.shape == (13,) and y.shape == (1,)
+        assert x.min() >= 0.0 and x.max() <= 1.0
+
+
+class TestVisionModels:
+    def test_lenet_forward_backward(self):
+        with guard():
+            paddle.seed(0)
+            net = vm.LeNet(num_classes=10)
+            x = to_variable(np.random.RandomState(0)
+                            .rand(2, 1, 28, 28).astype("float32"))
+            out = net(x)
+            assert out.shape == [2, 10]
+            import paddle_tpu.nn.functional as F
+
+            loss = F.cross_entropy(
+                out, to_variable(np.array([1, 2], "int64")))
+            loss.backward()
+            g = net.fc[0].weight.grad
+            assert g is not None and np.isfinite(g.numpy()).all()
+
+    def test_resnet18_forward(self):
+        with guard():
+            paddle.seed(0)
+            net = vm.resnet18(num_classes=7)
+            net.eval()
+            x = to_variable(np.random.RandomState(0)
+                            .rand(2, 3, 64, 64).astype("float32"))
+            out = net(x)
+            assert out.shape == [2, 7]
+
+    def test_resnet50_builds(self):
+        with guard():
+            paddle.seed(0)
+            net = vm.resnet50(num_classes=3)
+            # bottleneck expansion: final fc consumes 2048 features
+            assert net.fc.weight.shape[0] == 2048
