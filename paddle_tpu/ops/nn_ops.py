@@ -76,16 +76,12 @@ def _conv2d_transpose(ctx, op, ins):
     if pads == "SAME":
         kh, kw = w.shape[-2:]
         pads = [((kh - 1) // 2, kh // 2), ((kw - 1) // 2, kw // 2)]
-    # conv_transpose = gradient of conv wrt input: use transposed conv via
-    # lax.conv_transpose with IOHW kernel spec.
-    out = lax.conv_transpose(
-        x, w, strides=strides,
-        padding=[(dilations[i] * (w.shape[-2:][i] - 1) - pads[i][0],
-                  dilations[i] * (w.shape[-2:][i] - 1) - pads[i][1])
-                 for i in range(2)],
-        rhs_dilation=dilations,
-        dimension_numbers=("NCHW", "IOHW", "NCHW"),
-        transpose_kernel=False,
+    # conv_transpose = gradient of conv wrt input: input-dilated conv with
+    # the kernel flipped spatially (paddle places x[i,j]*W[ki,kj] at
+    # [i*s+ki, j*s+kj], i.e. a correlation against the FLIPPED kernel —
+    # reference conv_transpose_op.h col2im path).
+    out = _conv_transpose_flipped(
+        x, w, strides, pads, dilations
     ) if groups == 1 else _grouped_conv_transpose(x, w, strides, pads, dilations, groups)
     output_padding = op.attr("output_padding", [])
     if output_padding:
@@ -94,19 +90,24 @@ def _conv2d_transpose(ctx, op, ins):
     return {"Output": [out]}
 
 
+def _conv_transpose_flipped(x, w, strides, pads, dilations):
+    return lax.conv_general_dilated(
+        x, w[..., ::-1, ::-1],
+        window_strides=(1, 1),
+        padding=[(dilations[i] * (w.shape[-2:][i] - 1) - pads[i][0],
+                  dilations[i] * (w.shape[-2:][i] - 1) - pads[i][1])
+                 for i in range(2)],
+        lhs_dilation=strides,
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "IOHW", "NCHW"))
+
+
 def _grouped_conv_transpose(x, w, strides, pads, dilations, groups):
     xs = jnp.split(x, groups, axis=1)
     ws = jnp.split(w, groups, axis=0)
-    outs = []
-    for xg, wg in zip(xs, ws):
-        outs.append(lax.conv_transpose(
-            xg, wg, strides=strides,
-            padding=[(dilations[i] * (wg.shape[-2:][i] - 1) - pads[i][0],
-                      dilations[i] * (wg.shape[-2:][i] - 1) - pads[i][1])
-                     for i in range(2)],
-            rhs_dilation=dilations,
-            dimension_numbers=("NCHW", "IOHW", "NCHW")))
-    return jnp.concatenate(outs, axis=1)
+    return jnp.concatenate(
+        [_conv_transpose_flipped(xg, wg, strides, pads, dilations)
+         for xg, wg in zip(xs, ws)], axis=1)
 
 
 @register_op("conv3d")
