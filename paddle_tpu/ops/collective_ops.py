@@ -202,7 +202,7 @@ def _recv_v2(ctx, op, ins):
     ring = int(op.attr("ring_id", 0))
     queue = ctx.p2p_queue.get(ring, [])
     axis = _axis_for(ctx, op)
-    if queue and axis is not None:
+    if queue:
         sent, dst = queue.pop(0)
         src = int(op.attr("peer", 0))
         want_shape = tuple(op.attr("out_shape", []) or ())
@@ -212,10 +212,13 @@ def _recv_v2(ctx, op, ins):
                 f"shape {tuple(sent.shape)} but declares out_shape "
                 f"{want_shape} — sends and recvs are mis-ordered in the "
                 "program")
+        if axis is None:
+            # single-device trace (no mesh): a paired send/recv is an
+            # identity pass-through, matching the X-input form above
+            return {"Out": [sent]}
         return {"Out": [lax.ppermute(sent, axis, [(src, dst)])]}
     raise ValueError(
         "recv_v2 has no data source: no X input and no earlier matching "
-        f"send_v2 on ring {ring} in this program"
-        + ("" if axis is not None else " (and no mesh axis is active)")
-        + ". A recv that silently returned zeros would corrupt training "
-        "(ADVICE r2 #1); pair it with a send_v2 or pass the value as X.")
+        f"send_v2 on ring {ring} in this program. A recv that silently "
+        "returned zeros would corrupt training (ADVICE r2 #1); pair it "
+        "with a send_v2 or pass the value as X.")

@@ -106,7 +106,16 @@ def _conditional_block(ctx, op, ins):
         env = dict(outer_env)
         bctx = registry.LowerCtx(ctx.base_key, block=block,
                                  mesh_axes=ctx.mesh_axes)
-        bctx.p2p_queue = ctx.p2p_queue  # send/recv may pair across blocks
+        # The block is traced more than once (jax.eval_shape below, then
+        # lax.cond), so it must never mutate the outer p2p queue: each
+        # trace pairs against its own COPY.  A recv inside the block may
+        # consume a send from before the block; a send inside the block
+        # dies with the copy — its tracer must not escape the cond trace
+        # (an outer recv popping it would surface as an
+        # UnexpectedTracerError far from the cause).  Keep send/recv
+        # pairs on the same side of a conditional boundary; a straddling
+        # send-in/recv-out pair raises recv_v2's loud no-source error.
+        bctx.p2p_queue = {k: list(v) for k, v in ctx.p2p_queue.items()}
         registry.lower_block(bctx, block, env)
         return tuple(env[n] for n in out_names)
 
@@ -186,10 +195,16 @@ def _recompute_segment_grad(ctx, op, ins):
             vals[i] = v
         env = dict(zip(seg_inputs, vals))
         # plain forward lowering; rng keys are deterministic per op id so
-        # the recompute replays identical randomness (dropout masks)
+        # the recompute replays identical randomness (dropout masks).
+        # The replay gets a FRESH p2p queue: the segment's ops were
+        # already lowered once in the main forward pass, so sharing the
+        # outer queue would double-enqueue sends / double-consume recvs
+        # and silently FIFO-mis-pair later p2p ops.  In-segment
+        # send/recv pairs still pair with each other; a pair straddling
+        # the segment boundary raises recv_v2's loud no-source error at
+        # backward-lowering time (keep both ends in one segment).
         inner = registry.LowerCtx(ctx.base_key, block=block,
                                   mesh_axes=ctx.mesh_axes)
-        inner.p2p_queue = ctx.p2p_queue  # send/recv may pair across blocks
         for o in seg_ops:
             registry.lower_op(inner, o, env)
         return [env[n] for n in seg_outputs]

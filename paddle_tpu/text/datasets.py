@@ -32,21 +32,25 @@ class Imdb(Dataset):
         if download or data_path is None:
             raise ValueError(f"Imdb: data_path to aclImdb tar required "
                              f"({_NO_DOWNLOAD})")
-        pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        # the vocabulary is built over BOTH splits (reference imdb.py
+        # build_dict tokenizes train+test) so train- and test-mode
+        # datasets agree on every word id; only `mode`'s documents
+        # become samples
+        pat = re.compile(r"aclImdb/(train|test)/(pos|neg)/.*\.txt$")
         self._docs, self._labels = [], []
-        texts = []
+        texts, freq = [], {}
         with tarfile.open(data_path) as tf:
             for m in tf.getmembers():
                 mm = pat.match(m.name)
                 if mm:
                     body = tf.extractfile(m).read().decode(
                         "utf-8", "ignore").lower()
-                    texts.append((re.findall(r"[a-z']+", body),
-                                  1 if mm.group(1) == "pos" else 0))
-        freq = {}
-        for toks, _ in texts:
-            for t in toks:
-                freq[t] = freq.get(t, 0) + 1
+                    toks = re.findall(r"[a-z']+", body)
+                    for t in toks:
+                        freq[t] = freq.get(t, 0) + 1
+                    if mm.group(1) == mode:
+                        texts.append((toks,
+                                      1 if mm.group(2) == "pos" else 0))
         vocab = [w for w, c in sorted(freq.items(),
                                       key=lambda kv: (-kv[1], kv[0]))
                  if c >= cutoff]

@@ -89,6 +89,16 @@ class Cifar10(Dataset):
             raise ValueError(f"Cifar10: batch_paths is required "
                              f"({_NO_DOWNLOAD})")
         self.transform = transform
+        # mode selects the split by the archive's standard file names
+        # (data_batch_* = train, test_batch = test), so passing the whole
+        # extracted directory's files with mode='test' does what the
+        # reference does instead of silently loading everything
+        names = [os.path.basename(p) for p in batch_paths]
+        if any(n.startswith("data_batch") for n in names) and \
+                any(n.startswith("test_batch") for n in names):
+            want = "test_batch" if mode == "test" else "data_batch"
+            batch_paths = [p for p, n in zip(batch_paths, names)
+                           if n.startswith(want)]
         imgs, labels = [], []
         for p in batch_paths:
             with open(p, "rb") as f:

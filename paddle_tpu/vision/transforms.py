@@ -65,16 +65,29 @@ def _hwc(a):
 
 
 class Resize:
-    """Nearest-neighbor resize (no PIL dependency on the image)."""
+    """Nearest-neighbor resize (no PIL dependency on the image).
+
+    An int size resizes the SHORTER edge to that length preserving
+    aspect ratio (reference paddle.vision.transforms.Resize); a
+    (h, w) pair resizes to exactly that shape.
+    """
 
     def __init__(self, size):
-        self.size = (size, size) if isinstance(size, numbers.Number) \
+        self.size = int(size) if isinstance(size, numbers.Number) \
             else tuple(size)
 
     def __call__(self, img):
         a = np.asarray(img)
         a, squeeze = _hwc(a)
-        h, w = self.size
+        if isinstance(self.size, int):
+            # int() truncation, matching reference functional_cv2.resize
+            ih, iw = a.shape[:2]
+            if ih <= iw:
+                h, w = self.size, max(1, int(iw * self.size / ih))
+            else:
+                h, w = max(1, int(ih * self.size / iw)), self.size
+        else:
+            h, w = self.size
         ys = (np.arange(h) * a.shape[0] / h).astype(int)
         xs = (np.arange(w) * a.shape[1] / w).astype(int)
         out = a[ys][:, xs]
@@ -163,9 +176,21 @@ class Transpose:
 
 
 class Pad:
+    """padding: int (all sides), (pad_x, pad_y), or (l, t, r, b) —
+    the three forms the reference Pad transform accepts."""
+
     def __init__(self, padding, fill=0):
-        self.padding = padding if not isinstance(padding, int) \
-            else (padding, padding, padding, padding)  # l, t, r, b
+        if isinstance(padding, numbers.Number):
+            p = int(padding)
+            padding = (p, p, p, p)
+        elif len(padding) == 2:
+            px, py = padding
+            padding = (px, py, px, py)
+        elif len(padding) != 4:
+            raise ValueError(
+                f"Pad: padding must be an int, a (pad_x, pad_y) pair or "
+                f"an (l, t, r, b) 4-tuple, got {padding!r}")
+        self.padding = tuple(padding)
         self.fill = fill
 
     def __call__(self, img):

@@ -357,6 +357,109 @@ def test_send_recv_pairing(fresh_programs):
     assert np.all(o[:3] == 0) and np.all(o[4:] == 0)
 
 
+def test_send_recv_pair_single_device(fresh_programs):
+    """On a single device (no mesh) a paired send/recv degrades to an
+    identity pass-through instead of raising a misleading 'no earlier
+    matching send' error (r3 review: the X-form already degraded
+    gracefully; the paired form must too)."""
+    main, startup, scope = fresh_programs
+    x = fluid.data("x", [2, 4], "float32")
+    block = main.global_block()
+    out = block.create_var(dtype="float32", shape=[2, 4])
+    block.append_op("send_v2", inputs={"X": [x]}, outputs={},
+                    attrs={"ring_id": 0, "peer": 1}, infer_shape=False)
+    block.append_op("recv_v2", inputs={}, outputs={"Out": [out]},
+                    attrs={"ring_id": 0, "peer": 0,
+                           "out_shape": [2, 4], "dtype": "float32"},
+                    infer_shape=False)
+    exe = fluid.Executor()
+    X = np.arange(8, dtype="float32").reshape(2, 4)
+    (o,) = exe.run(main, feed={"x": X}, fetch_list=[out])
+    np.testing.assert_allclose(o, X)
+
+
+def test_send_recv_in_conditional_block(fresh_programs):
+    """A send/recv pair inside a conditional_block survives the abstract
+    eval_shape trace: the p2p queue is snapshot/restored around it, so
+    the real lax.cond trace still finds the pairing (r3 review: the
+    double trace used to drain the queue and raise / mis-pair)."""
+    from paddle_tpu.fluid.framework import EMPTY_VAR_NAME
+
+    main, startup, scope = fresh_programs
+    x = fluid.data("x", [8, 4], "float32")
+    block = main.global_block()
+    cond_v = block.create_var(name="cond_v", dtype="bool")
+    block.append_op("fill_constant", outputs={"Out": [cond_v]},
+                    attrs={"shape": [1], "dtype": "bool", "value": 1.0},
+                    infer_shape=False)
+    out = block.create_var(name="recv_out", dtype="float32", shape=[1, 4])
+    sub = main._create_block()
+    sub.append_op("send_v2", inputs={"X": [x.name]}, outputs={},
+                  attrs={"ring_id": 0, "peer": 3}, infer_shape=False)
+    sub.append_op("recv_v2", inputs={}, outputs={"Out": [out.name]},
+                  attrs={"ring_id": 0, "peer": 0,
+                         "out_shape": [1, 4], "dtype": "float32"},
+                  infer_shape=False)
+    main._rollback()
+    block.append_op("conditional_block",
+                    inputs={"Cond": [cond_v], "Input": [x.name]},
+                    outputs={"Out": [out.name], "Scope": [EMPTY_VAR_NAME]},
+                    attrs={"sub_block": sub.idx,
+                           "is_scalar_condition": True},
+                    infer_shape=False)
+    gathered = block.create_var(dtype="float32", shape=[8, 4])
+    block.append_op("c_allgather", inputs={"X": [out]},
+                    outputs={"Out": [gathered]},
+                    attrs={"ring_id": 0, "nranks": 8}, infer_shape=False)
+    compiled = fluid.CompiledProgram(main).with_data_parallel()
+    exe = fluid.Executor()
+    X = np.arange(32, dtype="float32").reshape(8, 4)
+    (o,) = exe.run(compiled, feed={"x": X}, fetch_list=[gathered])
+    np.testing.assert_allclose(o[3], X[0])
+    assert np.all(o[:3] == 0) and np.all(o[4:] == 0)
+
+
+def test_send_in_block_recv_outside_raises(fresh_programs):
+    """A send inside a conditional_block must not leak its (cond-trace)
+    tracer into the outer queue: an outer recv finds no source and gets
+    the loud ValueError, not an UnexpectedTracerError."""
+    from paddle_tpu.fluid.framework import EMPTY_VAR_NAME
+
+    main, startup, scope = fresh_programs
+    x = fluid.data("x", [8, 4], "float32")
+    block = main.global_block()
+    cond_v = block.create_var(name="cond_v", dtype="bool")
+    block.append_op("fill_constant", outputs={"Out": [cond_v]},
+                    attrs={"shape": [1], "dtype": "bool", "value": 1.0},
+                    infer_shape=False)
+    marker = block.create_var(name="marker", dtype="float32", shape=[8, 4])
+    sub = main._create_block()
+    sub.append_op("send_v2", inputs={"X": [x.name]}, outputs={},
+                  attrs={"ring_id": 0, "peer": 3}, infer_shape=False)
+    sub.append_op("scale", inputs={"X": [x.name]},
+                  outputs={"Out": [marker.name]},
+                  attrs={"scale": 1.0, "bias": 0.0,
+                         "bias_after_scale": True}, infer_shape=False)
+    main._rollback()
+    block.append_op("conditional_block",
+                    inputs={"Cond": [cond_v], "Input": [x.name]},
+                    outputs={"Out": [marker.name],
+                             "Scope": [EMPTY_VAR_NAME]},
+                    attrs={"sub_block": sub.idx,
+                           "is_scalar_condition": True},
+                    infer_shape=False)
+    out = block.create_var(dtype="float32", shape=[1, 4])
+    block.append_op("recv_v2", inputs={}, outputs={"Out": [out]},
+                    attrs={"ring_id": 0, "peer": 0,
+                           "out_shape": [1, 4], "dtype": "float32"},
+                    infer_shape=False)
+    compiled = fluid.CompiledProgram(main).with_data_parallel()
+    exe = fluid.Executor()
+    X = np.zeros((8, 4), "float32")
+    with pytest.raises(Exception, match="no data source|no earlier"):
+        exe.run(compiled, feed={"x": X}, fetch_list=[out])
+
+
 def test_unpaired_recv_raises(fresh_programs):
     main, startup, scope = fresh_programs
     x = fluid.data("x", [8, 4], "float32")

@@ -38,6 +38,20 @@ class TestTransforms:
         flipped = T.RandomHorizontalFlip(prob=1.0)(img)
         np.testing.assert_array_equal(flipped, img[:, ::-1])
 
+    def test_resize_int_preserves_aspect_ratio(self):
+        # reference semantics: int size -> shorter edge, keep aspect
+        img = np.zeros((6, 12), "uint8")
+        assert T.Resize(3)(img).shape == (3, 6)
+        tall = np.zeros((12, 6), "uint8")
+        assert T.Resize(3)(tall).shape == (6, 3)
+        assert T.Resize((3, 5))(img).shape == (3, 5)
+
+    def test_pad_two_tuple(self):
+        img = np.zeros((8, 8), "uint8")
+        assert T.Pad((2, 4))(img).shape == (8 + 4 + 4, 8 + 2 + 2)
+        with pytest.raises(ValueError, match="padding"):
+            T.Pad((1, 2, 3))
+
 
 class TestDatasets:
     def _write_idx(self, tmp, n=10):
@@ -80,6 +94,19 @@ class TestDatasets:
         np.testing.assert_array_equal(
             img, data[0].reshape(3, 32, 32).transpose(1, 2, 0))
 
+    def test_cifar_mode_selects_split(self, tmp_path):
+        rng = np.random.RandomState(2)
+        paths = []
+        for name, n in [("data_batch_1", 6), ("test_batch", 4)]:
+            data = rng.randint(0, 256, (n, 3 * 32 * 32)).astype("uint8")
+            p = str(tmp_path / name)
+            with open(p, "wb") as f:
+                pickle.dump({b"data": data,
+                             b"labels": list(rng.randint(0, 10, n))}, f)
+            paths.append(p)
+        assert len(vd.Cifar10(batch_paths=paths, mode="train")) == 6
+        assert len(vd.Cifar10(batch_paths=paths, mode="test")) == 4
+
     def test_fake_data_deterministic(self):
         a = vd.FakeData(size=5, seed=3)
         b = vd.FakeData(size=5, seed=3)
@@ -106,6 +133,27 @@ class TestTextDatasets:
         assert toks.dtype == np.int64 and lab in (0, 1)
         # 'movie' appears in both docs -> must be in vocab
         assert "movie" in ds.word_idx
+
+    def test_imdb_vocab_shared_across_splits(self, tmp_path):
+        import io as _io
+
+        tp = str(tmp_path / "aclImdb.tar")
+        with tarfile.open(tp, "w") as tf:
+            for name, body in [
+                ("aclImdb/train/pos/0_9.txt", b"alpha beta beta"),
+                ("aclImdb/train/neg/1_2.txt", b"gamma alpha"),
+                ("aclImdb/test/pos/0_8.txt", b"delta gamma gamma gamma"),
+            ]:
+                info = tarfile.TarInfo(name)
+                info.size = len(body)
+                tf.addfile(info, _io.BytesIO(body))
+        tr = paddle.text.Imdb(data_path=tp, mode="train", cutoff=1)
+        te = paddle.text.Imdb(data_path=tp, mode="test", cutoff=1)
+        # same id for the same word in both modes (vocab built over both
+        # splits, like the reference build_dict)
+        assert tr.word_idx == te.word_idx
+        assert "delta" in tr.word_idx  # test-only word still in train vocab
+        assert len(tr) == 2 and len(te) == 1
 
     def test_uci_housing(self, tmp_path):
         rng = np.random.RandomState(0)
