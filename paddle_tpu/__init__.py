@@ -38,6 +38,7 @@ from . import vision
 from . import text
 from . import inference
 from . import profiler
+from . import utils
 from .fluid.flags import get_flags, set_flags
 from .nn.layer.layers import Layer  # 2.0 alias: paddle.nn.Layer
 from .tensor import (to_tensor, zeros, ones, full, zeros_like, ones_like,

@@ -13,6 +13,7 @@ meta_optimizers/)."""
 from .base import (DistributedStrategy, Fleet, PaddleCloudRoleMaker,  # noqa: F401
                    Role, UserDefinedRoleMaker, fleet)
 from . import meta_optimizers  # noqa: F401
+from . import utils  # noqa: F401
 
 # module-level delegation so `from paddle_tpu.distributed import fleet;
 # fleet.init(...)` works like the reference

@@ -1,0 +1,7 @@
+"""fleet.utils — filesystem abstraction for checkpoint/data paths
+(reference python/paddle/distributed/fleet/utils/)."""
+
+from .fs import FS, LocalFS, HDFSClient, FSFileExistsError, FSFileNotExistsError  # noqa: F401
+
+__all__ = ["FS", "LocalFS", "HDFSClient", "FSFileExistsError",
+           "FSFileNotExistsError"]

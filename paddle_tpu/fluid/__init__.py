@@ -72,3 +72,4 @@ def device_count():
 
 from ..parallel.compiler import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa: E402
 from . import compiler  # noqa: E402
+from . import contrib  # noqa: E402

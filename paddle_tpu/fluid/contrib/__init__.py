@@ -1,4 +1,5 @@
 """fluid.contrib (mirror of /root/reference/python/paddle/fluid/contrib/):
-mixed_precision is the maintained piece; slim/quant land later."""
+mixed_precision (AMP) and slim (quantization-aware training)."""
 
 from . import mixed_precision  # noqa: F401
+from . import slim  # noqa: F401

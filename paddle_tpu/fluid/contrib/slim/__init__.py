@@ -1,0 +1,11 @@
+"""paddle.fluid.contrib.slim — quantization-aware training.
+
+Reference: /root/reference/python/paddle/fluid/contrib/slim/ (the
+quantization passes; the pruning/distillation sub-packages were removed
+upstream in this version and live in PaddleSlim)."""
+
+from .quantization import (  # noqa: F401
+    QuantizationTransformPass, ImperativeQuantAware,
+)
+
+__all__ = ["QuantizationTransformPass", "ImperativeQuantAware"]

@@ -273,8 +273,16 @@ class Block:
     # -- operators ---------------------------------------------------------
     def append_op(self, type: str, inputs=None, outputs=None, attrs=None,
                   infer_shape: bool = True) -> Operator:
-        op = Operator(self, self.program._next_op_id(), type, inputs, outputs, attrs)
-        self.ops.append(op)
+        return self.insert_op(len(self.ops), type, inputs, outputs, attrs,
+                              infer_shape)
+
+    def insert_op(self, index: int, type: str, inputs=None, outputs=None,
+                  attrs=None, infer_shape: bool = True) -> Operator:
+        """Insert an op at `index` (used by program-rewrite passes, e.g.
+        the quantization transform)."""
+        op = Operator(self, self.program._next_op_id(), type, inputs,
+                      outputs, attrs)
+        self.ops.insert(index, op)
         self.program._bump_version()
         if infer_shape:
             self._infer_shapes(op)
@@ -282,12 +290,7 @@ class Block:
 
     def _prepend_op(self, type: str, inputs=None, outputs=None, attrs=None,
                     infer_shape: bool = True) -> Operator:
-        op = Operator(self, self.program._next_op_id(), type, inputs, outputs, attrs)
-        self.ops.insert(0, op)
-        self.program._bump_version()
-        if infer_shape:
-            self._infer_shapes(op)
-        return op
+        return self.insert_op(0, type, inputs, outputs, attrs, infer_shape)
 
     def _infer_shapes(self, op: Operator) -> None:
         """Derive output var shapes/dtypes by jax.eval_shape over the op's

@@ -11,4 +11,7 @@ from .loss import *  # noqa: F401,F403
 from .control_flow import *  # noqa: F401,F403
 from .rnn import *  # noqa: F401,F403
 from .learning_rate_scheduler import *  # noqa: F401,F403
-from . import tensor, nn, loss, control_flow, rnn, learning_rate_scheduler  # noqa: F401
+from .sequence_lod import *  # noqa: F401,F403
+from .detection import *  # noqa: F401,F403
+from . import detection  # noqa: F401
+from . import tensor, nn, loss, control_flow, rnn, learning_rate_scheduler, sequence_lod  # noqa: F401

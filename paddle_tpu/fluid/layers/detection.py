@@ -1,0 +1,142 @@
+"""Detection layers — the fluid.layers detection surface
+(reference python/paddle/fluid/layers/detection.py: prior_box:526,
+multiclass_nms:2250, box_coder:1087, yolo_box:1025, iou_similarity:1035,
+bipartite_match:1549, anchor_generator:2450, box_clip:2852,
+sigmoid_focal_loss:160, roi_align via nn.py).
+
+Dense-output contract: ops that return ragged LoD results in the
+reference return fixed-shape padded tensors + counts here (see
+ops/detection_ops.py module docstring)."""
+
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "prior_box", "anchor_generator", "box_coder", "iou_similarity",
+    "box_clip", "bipartite_match", "multiclass_nms", "yolo_box",
+    "sigmoid_focal_loss", "roi_align",
+]
+
+
+def _det_op(op_type, inputs, attrs, out_slots, dtype="float32", name=None):
+    """out_slots: slot names; per-slot dtype via a (slot, dtype) tuple,
+    plain slots default to `dtype`."""
+    helper = LayerHelper(op_type, name=name)
+    slots = [(s, dtype) if isinstance(s, str) else s for s in out_slots]
+    outs = {s: [helper.create_variable_for_type_inference(dtype=dt)]
+            for s, dt in slots}
+    helper.append_op(op_type, inputs=inputs, outputs=outs,
+                     attrs=attrs or {}, infer_shape=False)
+    ret = [outs[s][0] for s, _ in slots]
+    return ret[0] if len(ret) == 1 else tuple(ret)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    return _det_op("prior_box", {"Input": [input], "Image": [image]},
+                   {"min_sizes": list(min_sizes),
+                    "max_sizes": list(max_sizes or []),
+                    "aspect_ratios": list(aspect_ratios),
+                    "variances": list(variance), "flip": flip,
+                    "clip": clip, "step_w": steps[0], "step_h": steps[1],
+                    "offset": offset,
+                    "min_max_aspect_ratios_order":
+                        min_max_aspect_ratios_order},
+                   ("Boxes", "Variances"), name=name)
+
+
+def anchor_generator(input, anchor_sizes, aspect_ratios,
+                     variance=(0.1, 0.1, 0.2, 0.2), stride=(16.0, 16.0),
+                     offset=0.5, name=None):
+    return _det_op("anchor_generator", {"Input": [input]},
+                   {"anchor_sizes": list(anchor_sizes),
+                    "aspect_ratios": list(aspect_ratios),
+                    "variances": list(variance), "stride": list(stride),
+                    "offset": offset},
+                   ("Anchors", "Variances"), name=name)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    ins = {"PriorBox": [prior_box], "TargetBox": [target_box]}
+    attrs = {"code_type": code_type, "box_normalized": box_normalized,
+             "axis": axis}
+    if isinstance(prior_box_var, (list, tuple)):
+        attrs["variance"] = [float(v) for v in prior_box_var]
+    elif prior_box_var is not None:
+        ins["PriorBoxVar"] = [prior_box_var]
+    return _det_op("box_coder", ins, attrs, ("OutputBox",), name=name)
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    return _det_op("iou_similarity", {"X": [x], "Y": [y]},
+                   {"box_normalized": box_normalized}, ("Out",), name=name)
+
+
+def box_clip(input, im_info, name=None):
+    return _det_op("box_clip", {"Input": [input], "ImInfo": [im_info]},
+                   {}, ("Output",), name=name)
+
+
+def bipartite_match(dist_matrix, match_type="bipartite",
+                    dist_threshold=0.5, name=None):
+    return _det_op("bipartite_match", {"DistMat": [dist_matrix]},
+                   {"match_type": match_type,
+                    "dist_threshold": dist_threshold},
+                   (("ColToRowMatchIndices", "int32"),
+                    ("ColToRowMatchDist", "float32")), name=name)
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.0, nms_top_k=64,
+                   keep_top_k=64, nms_threshold=0.3, normalized=True,
+                   background_label=0, return_rois_num=True, name=None):
+    """Dense NMS: returns (out (B, keep_top_k, 6), rois_num (B,)); rows
+    past an image's count carry label -1."""
+    helper = LayerHelper("multiclass_nms", name=name)
+    out = helper.create_variable_for_type_inference(dtype="float32")
+    num = helper.create_variable_for_type_inference(dtype="int32")
+    helper.append_op("multiclass_nms3",
+                     inputs={"BBoxes": [bboxes], "Scores": [scores]},
+                     outputs={"Out": [out], "NmsRoisNum": [num]},
+                     attrs={"score_threshold": score_threshold,
+                            "nms_top_k": nms_top_k,
+                            "keep_top_k": keep_top_k,
+                            "nms_threshold": nms_threshold,
+                            "normalized": normalized,
+                            "background_label": background_label},
+                     infer_shape=False)
+    return (out, num) if return_rois_num else out
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+             name=None):
+    return _det_op("yolo_box", {"X": [x], "ImgSize": [img_size]},
+                   {"anchors": [int(a) for a in anchors],
+                    "class_num": class_num, "conf_thresh": conf_thresh,
+                    "downsample_ratio": downsample_ratio,
+                    "clip_bbox": clip_bbox, "scale_x_y": scale_x_y},
+                   ("Boxes", "Scores"), name=name)
+
+
+def sigmoid_focal_loss(x, label, fg_num, gamma=2.0, alpha=0.25, name=None):
+    return _det_op("sigmoid_focal_loss",
+                   {"X": [x], "Label": [label], "FgNum": [fg_num]},
+                   {"gamma": gamma, "alpha": alpha}, ("Out",), name=name)
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, rois_num=None,
+              name=None):
+    ins = {"X": [input], "ROIs": [rois]}
+    if rois_num is not None:
+        ins["RoisNum"] = [rois_num]
+    return _det_op("roi_align", ins,
+                   {"pooled_height": pooled_height,
+                    "pooled_width": pooled_width,
+                    "spatial_scale": spatial_scale,
+                    "sampling_ratio": sampling_ratio}, ("Out",), name=name)

@@ -29,7 +29,7 @@ __all__ = [
     "equal", "not_equal", "less_than", "less_equal", "greater_than",
     "greater_equal", "logical_and", "logical_or", "logical_not",
     "logical_xor", "maximum", "minimum", "cumsum", "isfinite",
-    "interpolate",
+    "interpolate", "py_func", "auc",
 ]
 
 
@@ -815,3 +815,56 @@ def interpolate(input, out_shape=None, scale=None, mode="nearest",
                 align_corners=False, name=None):
     return image_resize(input, out_shape, scale,
                         "BILINEAR" if mode == "bilinear" else "NEAREST", name)
+
+
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None):
+    """Host-python op (reference layers/nn.py py_func:13475).  `out`
+    vars must carry static shapes/dtypes; the callable runs host-side
+    via jax.pure_callback (ops/misc_ops.py).  backward_func is not
+    supported — declare out.stop_gradient=True or compute the grad in
+    graph ops (a silently zero gradient would corrupt training)."""
+    if backward_func is not None:
+        raise NotImplementedError(
+            "py_func backward_func is not supported on TPU; compute the "
+            "backward in-graph or mark outputs stop_gradient")
+    from ...ops.misc_ops import register_py_func
+
+    helper = LayerHelper("py_func")
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    for o in outs:
+        o.stop_gradient = True
+    fid = register_py_func(func)
+    helper.append_op("py_func", inputs={"X": list(xs)},
+                     outputs={"Out": list(outs)},
+                     attrs={"forward_callable_id": fid},
+                     infer_shape=False)
+    return out
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=0):
+    """Streaming AUC (reference layers/metric_op.py auc:111): returns
+    (auc_out, [batch stats placeholders], [stat_pos, stat_neg]) -- the
+    accumulators are persistable global vars updated functionally."""
+    from .tensor import create_global_var
+
+    helper = LayerHelper("auc")
+    n = num_thresholds + 1
+    stat_pos = create_global_var([n], 0.0, "float32", persistable=True,
+                                 name=helper.name + ".stat_pos")
+    stat_neg = create_global_var([n], 0.0, "float32", persistable=True,
+                                 name=helper.name + ".stat_neg")
+    auc_out = helper.create_variable_for_type_inference(
+        dtype="float32", stop_gradient=True)
+    helper.append_op(
+        "auc",
+        inputs={"Predict": [input], "Label": [label],
+                "StatPos": [stat_pos], "StatNeg": [stat_neg]},
+        outputs={"AUC": [auc_out], "StatPosOut": [stat_pos],
+                 "StatNegOut": [stat_neg]},
+        attrs={"num_thresholds": num_thresholds,
+               "slide_steps": slide_steps, "curve": curve},
+        infer_shape=False)
+    return auc_out, [auc_out], [stat_pos, stat_neg]

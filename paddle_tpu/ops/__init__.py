@@ -12,4 +12,8 @@ from . import optimizer_ops  # noqa: F401
 from . import collective_ops  # noqa: F401
 from . import control_flow_ops  # noqa: F401
 from . import rnn_ops  # noqa: F401
+from . import sequence_ops  # noqa: F401
+from . import detection_ops  # noqa: F401
+from . import quantize_ops  # noqa: F401
+from . import misc_ops  # noqa: F401
 from .registry import register_op, register_grad, registered_ops, has_op  # noqa: F401

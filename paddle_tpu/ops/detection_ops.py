@@ -1,0 +1,511 @@
+"""Detection op lowerings — SSD / RPN / YOLO building blocks.
+
+Reference: /root/reference/paddle/fluid/operators/detection/ (31 ops).
+This module implements the core set every detection pipeline composes —
+prior_box, anchor_generator, box_coder, iou_similarity, box_clip,
+bipartite_match, multiclass_nms(+v2/v3), yolo_box, sigmoid_focal_loss,
+roi_align.  The long tail (generate_proposals, matrix_nms, FPN
+redistribution, mask utilities) raises through the registry's
+unknown-op error until added.
+
+TPU re-design notes:
+- prior_box / anchor_generator are SHAPE-only functions of static attrs:
+  they are computed in numpy at trace time and embedded as constants —
+  zero device work, XLA folds them into consumers.
+- The reference's NMS family returns ragged LoDTensors sized by how many
+  boxes survive.  XLA is static-shape, so multiclass_nms returns a dense
+  (B, keep_top_k, 6) tensor padded with label -1 plus per-image counts
+  (the v3 RoisNum contract generalized to every version).
+- Greedy sequential algorithms (NMS suppression, bipartite matching)
+  become `lax.fori_loop`s over masks — O(k^2) IoU matrices are tiny
+  next to the backbone and stay on-device instead of round-tripping to
+  host like the reference's CPU kernels.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import first, register_op
+
+
+# -- trace-time constant generators -----------------------------------------
+
+def _expand_aspect_ratios(ars, flip):
+    out = [1.0]
+    for ar in ars:
+        if all(abs(ar - o) > 1e-6 for o in out):
+            out.append(ar)
+            if flip:
+                out.append(1.0 / ar)
+    return out
+
+
+@register_op("prior_box")
+def _prior_box(ctx, op, ins):
+    """SSD priors (reference detection/prior_box_op.h): a pure function
+    of the feature-map/image SHAPES and static attrs — computed in numpy
+    and emitted as a constant."""
+    feat = first(ins, "Input")
+    img = first(ins, "Image")
+    fh, fw = int(feat.shape[2]), int(feat.shape[3])
+    ih, iw = int(img.shape[2]), int(img.shape[3])
+    min_sizes = [float(s) for s in op.attr("min_sizes", [])]
+    max_sizes = [float(s) for s in op.attr("max_sizes", []) or []]
+    ars = _expand_aspect_ratios(
+        [float(a) for a in op.attr("aspect_ratios", [1.0])],
+        op.attr("flip", False))
+    variances = [float(v) for v in op.attr("variances",
+                                           [0.1, 0.1, 0.2, 0.2])]
+    step_w = op.attr("step_w", 0.0) or iw / fw
+    step_h = op.attr("step_h", 0.0) or ih / fh
+    offset = op.attr("offset", 0.5)
+    mmar_order = op.attr("min_max_aspect_ratios_order", False)
+
+    boxes = []
+    for h in range(fh):
+        for w in range(fw):
+            cx = (w + offset) * step_w
+            cy = (h + offset) * step_h
+
+            def emit(bw, bh):
+                boxes.append([(cx - bw) / iw, (cy - bh) / ih,
+                              (cx + bw) / iw, (cy + bh) / ih])
+
+            for s, mn in enumerate(min_sizes):
+                if mmar_order:
+                    emit(mn / 2.0, mn / 2.0)
+                    if max_sizes:
+                        sq = math.sqrt(mn * max_sizes[s]) / 2.0
+                        emit(sq, sq)
+                    for ar in ars:
+                        if abs(ar - 1.0) < 1e-6:
+                            continue
+                        emit(mn * math.sqrt(ar) / 2.0,
+                             mn / math.sqrt(ar) / 2.0)
+                else:
+                    for ar in ars:
+                        emit(mn * math.sqrt(ar) / 2.0,
+                             mn / math.sqrt(ar) / 2.0)
+                    if max_sizes:
+                        sq = math.sqrt(mn * max_sizes[s]) / 2.0
+                        emit(sq, sq)
+    num_priors = len(boxes) // (fh * fw)
+    b = np.asarray(boxes, np.float32).reshape(fh, fw, num_priors, 4)
+    if op.attr("clip", False):
+        b = np.clip(b, 0.0, 1.0)
+    v = np.broadcast_to(np.asarray(variances, np.float32),
+                        (fh, fw, num_priors, 4)).copy()
+    return {"Boxes": [jnp.asarray(b)], "Variances": [jnp.asarray(v)]}
+
+
+@register_op("anchor_generator")
+def _anchor_generator(ctx, op, ins):
+    """RPN anchors (reference detection/anchor_generator_op.h) — numpy
+    at trace time, constant in the graph."""
+    feat = first(ins, "Input")
+    fh, fw = int(feat.shape[2]), int(feat.shape[3])
+    sizes = [float(s) for s in op.attr("anchor_sizes", [64.0])]
+    ars = [float(a) for a in op.attr("aspect_ratios", [1.0])]
+    variances = [float(v) for v in op.attr("variances",
+                                           [0.1, 0.1, 0.2, 0.2])]
+    stride = [float(s) for s in op.attr("stride", [16.0, 16.0])]
+    offset = op.attr("offset", 0.5)
+    sw, sh = stride[0], stride[1]
+    a = np.zeros((fh, fw, len(ars) * len(sizes), 4), np.float32)
+    for hi in range(fh):
+        for wi in range(fw):
+            xc = wi * sw + offset * (sw - 1)
+            yc = hi * sh + offset * (sh - 1)
+            idx = 0
+            for ar in ars:
+                for size in sizes:
+                    area = sw * sh
+                    base_w = round(math.sqrt(area / ar))
+                    base_h = round(base_w * ar)
+                    aw = size / sw * base_w
+                    ah = size / sh * base_h
+                    a[hi, wi, idx] = [xc - 0.5 * (aw - 1),
+                                      yc - 0.5 * (ah - 1),
+                                      xc + 0.5 * (aw - 1),
+                                      yc + 0.5 * (ah - 1)]
+                    idx += 1
+    v = np.broadcast_to(np.asarray(variances, np.float32),
+                        a.shape).copy()
+    return {"Anchors": [jnp.asarray(a)], "Variances": [jnp.asarray(v)]}
+
+
+# -- box arithmetic ---------------------------------------------------------
+
+def _wh_cxcy(box, normalized):
+    off = 0.0 if normalized else 1.0
+    w = box[..., 2] - box[..., 0] + off
+    h = box[..., 3] - box[..., 1] + off
+    cx = box[..., 0] + w / 2
+    cy = box[..., 1] + h / 2
+    return w, h, cx, cy
+
+
+@register_op("box_coder")
+def _box_coder(ctx, op, ins):
+    """Center-size encode/decode (reference detection/box_coder_op.h)."""
+    prior = first(ins, "PriorBox")         # (M, 4)
+    pvar = first(ins, "PriorBoxVar", None)  # (M, 4) or None
+    target = first(ins, "TargetBox")
+    code_type = op.attr("code_type", "encode_center_size")
+    normalized = op.attr("box_normalized", True)
+    axis = op.attr("axis", 0)
+    var_attr = op.attr("variance", []) or []
+
+    pw, ph, pcx, pcy = _wh_cxcy(prior, normalized)
+    if code_type == "encode_center_size":
+        # target (N, 4) vs prior (M, 4) -> (N, M, 4)
+        tw, th, tcx, tcy = _wh_cxcy(target, normalized)
+        ex = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        ey = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        ew = jnp.log(jnp.abs(tw[:, None] / pw[None, :]))
+        eh = jnp.log(jnp.abs(th[:, None] / ph[None, :]))
+        out = jnp.stack([ex, ey, ew, eh], axis=-1)
+        if pvar is not None:
+            out = out / pvar[None, :, :]
+        elif var_attr:
+            out = out / jnp.asarray(var_attr, out.dtype)
+        return {"OutputBox": [out]}
+    # decode: target (N, M, 4) or (N, 4) deltas against prior along axis
+    if target.ndim == 2:
+        t = target[:, None, :]
+    else:
+        t = target
+    if axis == 0:
+        pw_, ph_, pcx_, pcy_ = (pw[None, :], ph[None, :],
+                                pcx[None, :], pcy[None, :])
+    else:
+        pw_, ph_, pcx_, pcy_ = (pw[:, None], ph[:, None],
+                                pcx[:, None], pcy[:, None])
+    if pvar is not None:
+        v = pvar[None, :, :] if axis == 0 else pvar[:, None, :]
+        vx, vy, vw, vh = v[..., 0], v[..., 1], v[..., 2], v[..., 3]
+    elif var_attr:
+        vx, vy, vw, vh = var_attr
+    else:
+        vx = vy = vw = vh = 1.0
+    dcx = vx * t[..., 0] * pw_ + pcx_
+    dcy = vy * t[..., 1] * ph_ + pcy_
+    dw = jnp.exp(vw * t[..., 2]) * pw_
+    dh = jnp.exp(vh * t[..., 3]) * ph_
+    off = 0.0 if normalized else 1.0
+    out = jnp.stack([dcx - dw / 2, dcy - dh / 2,
+                     dcx + dw / 2 - off, dcy + dh / 2 - off], axis=-1)
+    if target.ndim == 2:
+        out = out[:, 0, :] if out.shape[1] == 1 else out
+    return {"OutputBox": [out]}
+
+
+def _iou_matrix(a, b, normalized=True):
+    """(N, 4) x (M, 4) -> (N, M) IoU."""
+    off = 0.0 if normalized else 1.0
+    ax1, ay1, ax2, ay2 = a[:, 0], a[:, 1], a[:, 2], a[:, 3]
+    bx1, by1, bx2, by2 = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+    ix1 = jnp.maximum(ax1[:, None], bx1[None, :])
+    iy1 = jnp.maximum(ay1[:, None], by1[None, :])
+    ix2 = jnp.minimum(ax2[:, None], bx2[None, :])
+    iy2 = jnp.minimum(ay2[:, None], by2[None, :])
+    iw = jnp.maximum(ix2 - ix1 + off, 0.0)
+    ih = jnp.maximum(iy2 - iy1 + off, 0.0)
+    inter = iw * ih
+    aa = (ax2 - ax1 + off) * (ay2 - ay1 + off)
+    ab = (bx2 - bx1 + off) * (by2 - by1 + off)
+    union = aa[:, None] + ab[None, :] - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1e-10), 0.0)
+
+
+@register_op("iou_similarity")
+def _iou_similarity(ctx, op, ins):
+    x = first(ins, "X")
+    y = first(ins, "Y")
+    normalized = op.attr("box_normalized", True)
+    return {"Out": [_iou_matrix(x, y, normalized)]}
+
+
+@register_op("box_clip")
+def _box_clip(ctx, op, ins):
+    """Clip boxes to image (reference detection/box_clip_op.h); ImInfo
+    rows are (h, w, scale)."""
+    boxes = first(ins, "Input")
+    im_info = first(ins, "ImInfo")
+    if boxes.ndim == 2:
+        h = im_info[0, 0] / im_info[0, 2] - 1
+        w = im_info[0, 1] / im_info[0, 2] - 1
+        x1 = jnp.clip(boxes[..., 0], 0, w)
+        y1 = jnp.clip(boxes[..., 1], 0, h)
+        x2 = jnp.clip(boxes[..., 2], 0, w)
+        y2 = jnp.clip(boxes[..., 3], 0, h)
+        return {"Output": [jnp.stack([x1, y1, x2, y2], axis=-1)]}
+    h = (im_info[:, 0] / im_info[:, 2] - 1)[:, None]
+    w = (im_info[:, 1] / im_info[:, 2] - 1)[:, None]
+    out = jnp.stack([jnp.clip(boxes[..., 0], 0, w),
+                     jnp.clip(boxes[..., 1], 0, h),
+                     jnp.clip(boxes[..., 2], 0, w),
+                     jnp.clip(boxes[..., 3], 0, h)], axis=-1)
+    return {"Output": [out]}
+
+
+@register_op("bipartite_match")
+def _bipartite_match(ctx, op, ins):
+    """Greedy bipartite matching (reference detection/
+    bipartite_match_op.cc BipartiteMatch): repeatedly take the global
+    max of the remaining (row, col) pairs; then, with
+    match_type='per_prediction', also match leftover cols whose best
+    row clears dist_threshold.  Sequential on CPU in the reference; a
+    fori_loop over masks here."""
+    dist = first(ins, "DistMat")  # (N, M) rows=gt cols=pred
+    if dist.ndim == 2:
+        dist = dist[None]
+    match_type = op.attr("match_type", "bipartite")
+    thr = op.attr("dist_threshold", 0.5)
+    b, n, m = dist.shape
+
+    def one(d):
+        def body(_, state):
+            row_free, col_idx, col_dist = state
+            masked = jnp.where(
+                row_free[:, None] & (col_idx[None, :] < 0), d, -1.0)
+            flat = jnp.argmax(masked)
+            r, c = flat // m, flat % m
+            ok = masked[r, c] > 0
+            col_idx = jnp.where(ok, col_idx.at[c].set(r.astype(jnp.int32)),
+                                col_idx)
+            col_dist = jnp.where(ok, col_dist.at[c].set(masked[r, c]),
+                                 col_dist)
+            row_free = jnp.where(ok, row_free.at[r].set(False), row_free)
+            return row_free, col_idx, col_dist
+
+        init = (jnp.ones((n,), bool), jnp.full((m,), -1, jnp.int32),
+                jnp.zeros((m,), d.dtype))
+        _, col_idx, col_dist = lax.fori_loop(0, min(n, m), body, init)
+        if match_type == "per_prediction":
+            best_r = jnp.argmax(d, axis=0).astype(jnp.int32)
+            best_d = jnp.max(d, axis=0)
+            extra = (col_idx < 0) & (best_d >= thr)
+            col_idx = jnp.where(extra, best_r, col_idx)
+            col_dist = jnp.where(extra, best_d, col_dist)
+        return col_idx, col_dist
+
+    idx, dst = jax.vmap(one)(dist)
+    return {"ColToRowMatchIndices": [idx], "ColToRowMatchDist": [dst]}
+
+
+def _nms_keep(boxes, scores, iou_thr, score_thr, normalized):
+    """Greedy NMS over k pre-sorted candidates: returns keep mask."""
+    k = boxes.shape[0]
+    iou = _iou_matrix(boxes, boxes, normalized)
+    valid = scores > score_thr
+
+    def body(i, state):
+        keep, suppressed = state
+        take = valid[i] & jnp.logical_not(suppressed[i])
+        keep = keep.at[i].set(take)
+        suppressed = jnp.where(take, suppressed | (iou[i] > iou_thr),
+                               suppressed)
+        return keep, suppressed
+
+    keep, _ = lax.fori_loop(
+        0, k, body, (jnp.zeros((k,), bool), jnp.zeros((k,), bool)))
+    return keep
+
+
+@register_op("multiclass_nms")
+@register_op("multiclass_nms2")
+@register_op("multiclass_nms3")
+def _multiclass_nms(ctx, op, ins):
+    """reference detection/multiclass_nms_op.cc.  Dense contract:
+    Out (B, keep_top_k, 6) = [label, score, x1, y1, x2, y2], rows past
+    an image's detection count padded with label -1 / zeros; NmsRoisNum
+    (B,) carries the per-image counts the reference encodes as LoD."""
+    bboxes = first(ins, "BBoxes")   # (B, M, 4)
+    scores = first(ins, "Scores")   # (B, C, M)
+    bg = op.attr("background_label", 0)
+    score_thr = op.attr("score_threshold", 0.0)
+    nms_top_k = int(op.attr("nms_top_k", 64) or 64)
+    iou_thr = op.attr("nms_threshold", 0.3)
+    keep_top_k = int(op.attr("keep_top_k", 64) or 64)
+    normalized = op.attr("normalized", True)
+    b, c, m = scores.shape
+    k = min(nms_top_k, m) if nms_top_k > 0 else m
+
+    def per_image(boxes, sc):
+        all_scores, all_labels, all_boxes = [], [], []
+        for cls in range(c):
+            if cls == bg:
+                continue
+            s_top, idx = lax.top_k(sc[cls], k)
+            b_top = boxes[idx]
+            keep = _nms_keep(b_top, s_top, iou_thr, score_thr, normalized)
+            all_scores.append(jnp.where(keep, s_top, -1.0))
+            all_labels.append(jnp.full((k,), cls, jnp.float32))
+            all_boxes.append(b_top)
+        s_cat = jnp.concatenate(all_scores)
+        l_cat = jnp.concatenate(all_labels)
+        b_cat = jnp.concatenate(all_boxes)
+        kk = min(keep_top_k, s_cat.shape[0]) if keep_top_k > 0 \
+            else s_cat.shape[0]
+        s_fin, idx = lax.top_k(s_cat, kk)
+        det = jnp.concatenate(
+            [jnp.where(s_fin > 0, l_cat[idx], -1.0)[:, None],
+             jnp.maximum(s_fin, 0.0)[:, None], b_cat[idx]], axis=-1)
+        det = jnp.where((s_fin > 0)[:, None], det,
+                        jnp.concatenate([jnp.full((kk, 1), -1.0),
+                                         jnp.zeros((kk, 5))], -1)
+                        .astype(det.dtype))
+        return det, jnp.sum(s_fin > 0).astype(jnp.int32), idx
+
+    det, counts, index = jax.vmap(per_image)(bboxes, scores)
+    outs = {"Out": [det]}
+    if "Index" in op.outputs:
+        outs["Index"] = [index]
+    if "NmsRoisNum" in op.outputs:
+        outs["NmsRoisNum"] = [counts]
+    return outs
+
+
+@register_op("yolo_box")
+def _yolo_box(ctx, op, ins):
+    """reference detection/yolo_box_op.h GetYoloBox/CalcDetectionBox."""
+    x = first(ins, "X")             # (B, A*(5+C), H, W)
+    img_size = first(ins, "ImgSize")  # (B, 2) [h, w]
+    anchors = [int(a) for a in op.attr("anchors", [])]
+    class_num = int(op.attr("class_num", 1))
+    conf_thresh = op.attr("conf_thresh", 0.01)
+    downsample = int(op.attr("downsample_ratio", 32))
+    clip_bbox = op.attr("clip_bbox", True)
+    scale = op.attr("scale_x_y", 1.0)
+    bias = -0.5 * (scale - 1.0)
+    b, _, h, w = x.shape
+    a = len(anchors) // 2
+    xr = x.reshape(b, a, 5 + class_num, h, w)
+    img_h = img_size[:, 0].astype(x.dtype).reshape(b, 1, 1, 1)
+    img_w = img_size[:, 1].astype(x.dtype).reshape(b, 1, 1, 1)
+    grid_x = jnp.arange(w, dtype=x.dtype)[None, None, None, :]
+    grid_y = jnp.arange(h, dtype=x.dtype)[None, None, :, None]
+    an_w = jnp.asarray(anchors[0::2], x.dtype).reshape(1, a, 1, 1)
+    an_h = jnp.asarray(anchors[1::2], x.dtype).reshape(1, a, 1, 1)
+    in_h = downsample * h
+    in_w = downsample * w
+    cx = (grid_x + jax.nn.sigmoid(xr[:, :, 0]) * scale + bias) * img_w / w
+    cy = (grid_y + jax.nn.sigmoid(xr[:, :, 1]) * scale + bias) * img_h / h
+    bw = jnp.exp(xr[:, :, 2]) * an_w * img_w / in_w
+    bh = jnp.exp(xr[:, :, 3]) * an_h * img_h / in_h
+    conf = jax.nn.sigmoid(xr[:, :, 4])
+    mask = conf >= conf_thresh
+    x1 = cx - bw / 2
+    y1 = cy - bh / 2
+    x2 = cx + bw / 2
+    y2 = cy + bh / 2
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, img_w - 1)
+        y1 = jnp.clip(y1, 0, img_h - 1)
+        x2 = jnp.clip(x2, 0, img_w - 1)
+        y2 = jnp.clip(y2, 0, img_h - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1)
+    boxes = jnp.where(mask[..., None], boxes, 0.0)
+    probs = jax.nn.sigmoid(xr[:, :, 5:]) * conf[:, :, None]
+    probs = jnp.where(mask[:, :, None], probs, 0.0)
+    # (B, A*H*W, 4) / (B, A*H*W, C) row order = (a, h, w) like the ref
+    return {"Boxes": [boxes.reshape(b, a * h * w, 4)],
+            "Scores": [jnp.moveaxis(probs, 2, -1)
+                       .reshape(b, a * h * w, class_num)]}
+
+
+@register_op("sigmoid_focal_loss")
+def _sigmoid_focal_loss(ctx, op, ins):
+    """reference detection/sigmoid_focal_loss_op.cu: FL(p) with
+    per-class one-vs-all targets; label 0 = background, class c uses
+    logit column c-1; fg_num normalizes."""
+    x = first(ins, "X")          # (N, C)
+    label = first(ins, "Label")  # (N, 1)
+    fg_num = first(ins, "FgNum")  # (1,)
+    gamma = op.attr("gamma", 2.0)
+    alpha = op.attr("alpha", 0.25)
+    n, c = x.shape
+    lab = label.reshape(-1).astype(jnp.int32)
+    tgt = (lab[:, None] == (jnp.arange(c, dtype=jnp.int32)[None, :] + 1)
+           ).astype(x.dtype)
+    fg = jnp.maximum(fg_num.reshape(()).astype(x.dtype), 1.0)
+    p = jax.nn.sigmoid(x)
+    ce = (tgt * (-jax.nn.log_sigmoid(x))
+          + (1 - tgt) * (-jax.nn.log_sigmoid(-x)))
+    w = tgt * alpha * jnp.power(1 - p, gamma) \
+        + (1 - tgt) * (1 - alpha) * jnp.power(p, gamma)
+    return {"Out": [w * ce / fg]}
+
+
+@register_op("roi_align")
+def _roi_align(ctx, op, ins):
+    """reference roi_align_op.h: average-pool bilinear samples per bin.
+    ROIs come with RoisNum (B,) mapping rows to images (the dense form
+    of the reference's LoD).
+
+    DEVIATION: with sampling_ratio<=0 the reference adapts the per-bin
+    sample count to ceil(roi_size/pooled_size) PER ROI — a data-dependent
+    shape XLA cannot express.  Here sampling_ratio<=0 uses a fixed 2x2
+    grid per bin; pass an explicit sampling_ratio for parity with a
+    reference configuration (detection heads conventionally use 2)."""
+    x = first(ins, "X")         # (B, C, H, W)
+    rois = first(ins, "ROIs")   # (R, 4) [x1, y1, x2, y2]
+    rois_num = first(ins, "RoisNum", None)
+    ph = int(op.attr("pooled_height", 1))
+    pw = int(op.attr("pooled_width", 1))
+    sscale = op.attr("spatial_scale", 1.0)
+    ratio = int(op.attr("sampling_ratio", -1))
+    b, c, hh, ww = x.shape
+    r = rois.shape[0]
+    if rois_num is not None:
+        counts = rois_num.reshape(-1).astype(jnp.int32)
+        starts = jnp.cumsum(counts) - counts
+        batch_idx = jnp.sum(
+            jnp.arange(r)[:, None] >= starts[None, :], axis=1) - 1
+    else:
+        batch_idx = jnp.zeros((r,), jnp.int32)
+
+    sr = ratio if ratio > 0 else 2
+
+    def one_roi(roi, bi):
+        x1, y1, x2, y2 = roi * sscale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        # sample grid: (ph*sr, pw*sr) points
+        gy = y1 + (jnp.arange(ph * sr) + 0.5) * rh / (ph * sr)
+        gx = x1 + (jnp.arange(pw * sr) + 0.5) * rw / (pw * sr)
+        img = x[bi]  # (C, H, W)
+
+        def bilinear(yy, xx):
+            y0 = jnp.clip(jnp.floor(yy), 0, hh - 1)
+            x0 = jnp.clip(jnp.floor(xx), 0, ww - 1)
+            y1i = jnp.clip(y0 + 1, 0, hh - 1).astype(jnp.int32)
+            x1i = jnp.clip(x0 + 1, 0, ww - 1).astype(jnp.int32)
+            y0i = y0.astype(jnp.int32)
+            x0i = x0.astype(jnp.int32)
+            ly = jnp.clip(yy - y0, 0.0, 1.0)
+            lx = jnp.clip(xx - x0, 0.0, 1.0)
+            v = (img[:, y0i, x0i] * (1 - ly) * (1 - lx)
+                 + img[:, y0i, x1i] * (1 - ly) * lx
+                 + img[:, y1i, x0i] * ly * (1 - lx)
+                 + img[:, y1i, x1i] * ly * lx)
+            inside = (yy >= -1) & (yy <= hh) & (xx >= -1) & (xx <= ww)
+            return jnp.where(inside, v, 0.0)
+
+        yy, xx = jnp.meshgrid(gy, gx, indexing="ij")
+        samples = jax.vmap(jax.vmap(bilinear))(yy, xx)  # (phsr, pwsr, C)
+        samples = samples.reshape(ph, sr, pw, sr, c)
+        return jnp.mean(samples, axis=(1, 3)).transpose(2, 0, 1)
+
+    out = jax.vmap(one_roi)(rois, batch_idx)
+    return {"Out": [out]}

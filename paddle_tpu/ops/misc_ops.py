@@ -1,0 +1,128 @@
+"""Miscellaneous framework ops: auc, py_func, run_program.
+
+Reference: /root/reference/paddle/fluid/operators/metrics/auc_op.h,
+py_func_op.cc (host-python escape hatch), run_program_op.cc (executes a
+captured sub-program — the jit.ProgramTranslator runtime op).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .registry import first, register_op
+
+
+@register_op("auc")
+def _auc(ctx, op, ins):
+    """Streaming ROC-AUC over threshold buckets (reference
+    metrics/auc_op.h statAuc:? + calcAuc): bucket positive-class scores,
+    accumulate pos/neg counts, trapezoid-sum.  Functional state: returns
+    the UPDATED StatPos/StatNeg (the reference mutates persistable
+    outputs in place).  slide_steps (batch-windowed AUC) is not
+    implemented — the global accumulator is the mode every bundled model
+    uses; pass slide_steps=0."""
+    predict = first(ins, "Predict")   # (N, 2) [p(neg), p(pos)]
+    label = first(ins, "Label")
+    stat_pos = first(ins, "StatPos")
+    stat_neg = first(ins, "StatNeg")
+    num_t = int(op.attr("num_thresholds", 4095))
+    if int(op.attr("slide_steps", 0) or 0) != 0:
+        raise NotImplementedError(
+            "auc op: slide_steps>0 (windowed AUC) is not implemented on "
+            "TPU; use the global accumulator (slide_steps=0)")
+    pos_score = predict[:, 1] if predict.ndim == 2 and predict.shape[1] > 1 \
+        else predict.reshape(-1)
+    lab = label.reshape(-1).astype(jnp.int32)
+    bucket = jnp.clip((pos_score * num_t).astype(jnp.int32), 0, num_t)
+    one = jnp.ones_like(bucket, dtype=stat_pos.dtype)
+    zero = jnp.zeros_like(one)
+    pos_new = stat_pos.reshape(-1).at[bucket].add(
+        jnp.where(lab == 1, one, zero))
+    neg_new = stat_neg.reshape(-1).at[bucket].add(
+        jnp.where(lab == 0, one, zero))
+    # trapezoid over buckets from high threshold to low
+    pos_r = pos_new[::-1].astype(jnp.float32)
+    neg_r = neg_new[::-1].astype(pos_r.dtype)
+    cum_pos = jnp.cumsum(pos_r)
+    prev_pos = cum_pos - pos_r
+    area = jnp.sum(neg_r * (cum_pos + prev_pos) / 2.0)
+    tot_pos = cum_pos[-1]
+    tot_neg = jnp.sum(neg_r)
+    auc = jnp.where(tot_pos * tot_neg > 0,
+                    area / jnp.maximum(tot_pos * tot_neg, 1.0), 0.0)
+    return {"AUC": [auc],
+            "StatPosOut": [pos_new.reshape(stat_pos.shape)],
+            "StatNegOut": [neg_new.reshape(stat_neg.shape)]}
+
+
+# -- py_func ----------------------------------------------------------------
+
+_PY_FUNC_REGISTRY: list = []
+
+
+def register_py_func(fn) -> int:
+    """Register a host callable; returns the id stored in the op attr
+    (the reference keeps the same registry in C++,
+    py_func_op.cc PyFuncRegistry)."""
+    _PY_FUNC_REGISTRY.append(fn)
+    return len(_PY_FUNC_REGISTRY) - 1
+
+
+@register_op("py_func")
+def _py_func(ctx, op, ins):
+    """Host-python escape hatch (reference py_func_op.cc).  TPU-native:
+    the callable runs on host via jax.pure_callback — XLA inserts the
+    device<->host transfers; output shapes/dtypes come from the declared
+    output vars (host code can't dictate device shapes at run time).
+    Gradients don't flow through (reference requires an explicit
+    backward_func; pass stop_gradient outputs)."""
+    fid = int(op.attr("forward_callable_id"))
+    fn = _PY_FUNC_REGISTRY[fid]
+    xs = [v for v in ins.get("X", []) if v is not None]
+    out_names = op.output("Out")
+    block = ctx.block
+    shapes = []
+    for n in out_names:
+        var = block.var(n) if block is not None else None
+        if var is None or var.shape is None or any(
+                s is None or s < 0 for s in var.shape):
+            raise ValueError(
+                f"py_func output {n!r} needs a fully static shape "
+                "declared on the out var (XLA host-callback contract)")
+        from ..fluid import core
+
+        shapes.append(jax.ShapeDtypeStruct(tuple(var.shape),
+                                           core.np_dtype(var.dtype)))
+
+    def host_fn(*arrs):
+        res = fn(*arrs)
+        if not isinstance(res, (list, tuple)):
+            res = [res]
+        return tuple(np.asarray(r, dtype=s.dtype).reshape(s.shape)
+                     for r, s in zip(res, shapes))
+
+    outs = jax.pure_callback(host_fn, tuple(shapes), *xs)
+    return {"Out": list(outs)}
+
+
+@register_op("run_program")
+def _run_program(ctx, op, ins):
+    """Execute a captured sub-program inline (reference
+    run_program_op.cc, the jit.TracedLayer/ProgramTranslator runtime):
+    lower the sub-block's ops into the current trace — under XLA the
+    'program call' inlines and fuses with the caller."""
+    from . import registry
+
+    block = ctx.block.program.blocks[op.attr("sub_block")]
+    env = {}
+    for slot, names in op.inputs.items():
+        for n, v in zip(names, ins.get(slot, [])):
+            env[n] = v
+    bctx = registry.LowerCtx(ctx.base_key, block=block,
+                             mesh_axes=ctx.mesh_axes)
+    bctx.p2p_queue = ctx.p2p_queue
+    registry.lower_block(bctx, block, env)
+    return {"Out": [env[n] for n in op.output("Out")]}

@@ -1,0 +1,156 @@
+"""Fake-quantization op lowerings (QAT + PTQ observers).
+
+Reference: /root/reference/paddle/fluid/operators/fake_quantize_op.cc
+(ClipAndFakeQuantFunctor:85, FindAbsMaxFunctor:32, the moving-average /
+range observers) and fake_dequantize_op.cc.  Semantics: with
+bin_cnt = 2^(bits-1) - 1 and scale s,
+
+    quant(x)   = round(bin_cnt / s * clip(x, -s, s))     (int-valued f32)
+    dequant(q) = q * s / bin_cnt
+
+TPU re-design notes:
+- Quantized TRAINING math stays in the quant-dequant form (the
+  reference's QAT does the same); int8 matmul execution is an XLA
+  lowering concern, not an op-graph concern.
+- round() has zero gradient, so every quant op lowers with the
+  straight-through estimator built in: out = x + stop_gradient(q - x).
+  The reference implements STE as a separate identity GradOpMaker
+  (fake_quantize_op.cc FakeQuantizeGradOp); here it falls out of the
+  vjp of stop_gradient — no extra grad op needed.
+- Observer state (scale / accum / state) flows functionally: the ops
+  RETURN updated state tensors instead of mutating buffers in place.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import first, register_op
+
+
+def _bin_cnt(op):
+    return float((1 << (int(op.attr("bit_length", 8)) - 1)) - 1)
+
+
+def _quant_dequant_ste(x, s, bin_cnt):
+    s = jnp.maximum(s, 1e-9)
+    q = jnp.round(bin_cnt / s * jnp.clip(x, -s, s)) * s / bin_cnt
+    return x + lax.stop_gradient(q - x)  # straight-through
+
+
+@register_op("fake_quantize_abs_max")
+def _fake_quantize_abs_max(ctx, op, ins):
+    x = first(ins, "X")
+    bc = _bin_cnt(op)
+    s = jnp.max(jnp.abs(x))
+    return {"Out": [lax.stop_gradient(
+        jnp.round(bc / jnp.maximum(s, 1e-9) * jnp.clip(x, -s, s)))],
+        "OutScale": [s.reshape(1)]}
+
+
+@register_op("fake_quantize_dequantize_abs_max")
+def _fake_qdq_abs_max(ctx, op, ins):
+    x = first(ins, "X")
+    bc = _bin_cnt(op)
+    s = lax.stop_gradient(jnp.max(jnp.abs(x)))
+    return {"Out": [_quant_dequant_ste(x, s, bc)],
+            "OutScale": [s.reshape(1)]}
+
+
+@register_op("fake_quantize_moving_average_abs_max")
+@register_op("fake_quantize_dequantize_moving_average_abs_max")
+def _fake_q_moving(ctx, op, ins):
+    x = first(ins, "X")
+    in_scale = first(ins, "InScale").reshape(())
+    bc = _bin_cnt(op)
+    rate = op.attr("moving_rate", 0.9)
+    is_test = op.attr("is_test", False)
+    dequant = op.type == "fake_quantize_dequantize_moving_average_abs_max"
+    if is_test:
+        scale = in_scale
+        outs = {}
+    else:
+        accum = first(ins, "InAccum", jnp.ones(())).reshape(())
+        state = first(ins, "InState", jnp.ones(())).reshape(())
+        cur = lax.stop_gradient(jnp.max(jnp.abs(x)))
+        state_out = rate * state + 1.0
+        accum_out = rate * accum + cur
+        scale = accum_out / state_out
+        outs = {"OutState": [state_out.reshape(1)],
+                "OutAccum": [accum_out.reshape(1)]}
+    outs["OutScale"] = [scale.reshape(1)]
+    if dequant:
+        outs["Out"] = [_quant_dequant_ste(x, scale, bc)]
+    else:
+        s = jnp.maximum(scale, 1e-9)
+        outs["Out"] = [lax.stop_gradient(
+            jnp.round(bc / s * jnp.clip(x, -s, s)))]
+    return outs
+
+
+@register_op("fake_quantize_range_abs_max")
+def _fake_q_range(ctx, op, ins):
+    """Window-max observer (reference FakeQuantizeRangeAbsMaxOp): the
+    running scale is the max of the current batch's absmax and the
+    previous scale (the reference's windowed variant collapses to this
+    monotone form when window_size covers training — documented
+    simplification)."""
+    x = first(ins, "X")
+    in_scale = first(ins, "InScale").reshape(())
+    bc = _bin_cnt(op)
+    if op.attr("is_test", False):
+        scale = in_scale
+    else:
+        scale = jnp.maximum(lax.stop_gradient(jnp.max(jnp.abs(x))),
+                            in_scale)
+    s = jnp.maximum(scale, 1e-9)
+    outs = {"Out": [lax.stop_gradient(
+        jnp.round(bc / s * jnp.clip(x, -s, s)))],
+        "OutScale": [scale.reshape(1)]}
+    if "OutScales" in op.outputs:
+        outs["OutScales"] = [scale.reshape(1)]
+    return outs
+
+
+@register_op("fake_channel_wise_quantize_abs_max")
+@register_op("fake_channel_wise_quantize_dequantize_abs_max")
+def _fake_q_channel(ctx, op, ins):
+    x = first(ins, "X")
+    bc = _bin_cnt(op)
+    axis = int(op.attr("quant_axis", 0))
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    s = jnp.max(jnp.abs(x), axis=red, keepdims=True)
+    s = lax.stop_gradient(jnp.maximum(s, 1e-9))
+    if op.type.endswith("dequantize_abs_max"):
+        out = _quant_dequant_ste(x, s, bc)
+    else:
+        out = lax.stop_gradient(jnp.round(bc / s * jnp.clip(x, -s, s)))
+    return {"Out": [out], "OutScale": [s.reshape(-1)]}
+
+
+@register_op("fake_dequantize_max_abs")
+def _fake_dequantize(ctx, op, ins):
+    x = first(ins, "X")
+    scale = first(ins, "Scale").reshape(())
+    max_range = op.attr("max_range", 127.0)
+    return {"Out": [x * scale / max_range]}
+
+
+@register_op("moving_average_abs_max_scale")
+def _moving_scale(ctx, op, ins):
+    """Observer only: records the moving absmax, passes X through."""
+    x = first(ins, "X")
+    rate = op.attr("moving_rate", 0.9)
+    accum = first(ins, "InAccum", jnp.ones(())).reshape(())
+    state = first(ins, "InState", jnp.ones(())).reshape(())
+    cur = lax.stop_gradient(jnp.max(jnp.abs(x)))
+    state_out = rate * state + 1.0
+    accum_out = rate * accum + cur
+    outs = {"OutScale": [(accum_out / state_out).reshape(1)],
+            "OutState": [state_out.reshape(1)],
+            "OutAccum": [accum_out.reshape(1)]}
+    if "Out" in op.outputs:
+        outs["Out"] = [x]
+    return outs

@@ -1,0 +1,310 @@
+"""Sequence (LoD-family) op lowerings — the dense TPU re-design.
+
+The reference implements these over LoDTensor, a values buffer plus a
+ragged row-offset table mutated on the host
+(/root/reference/paddle/fluid/operators/sequence_ops/ — 40+ files:
+sequence_pool_op.cc, sequence_softmax_op.cc, sequence_pad_op.cc,
+sequence_conv_op.cc, sequence_expand_op.cc, sequence_mask_op.cc, ...).
+Ragged shapes cannot exist inside an XLA program, so here a sequence
+batch is a PADDED dense tensor `X (B, T, ...)` plus an explicit
+`Length (B,)` int vector — the same dense re-design the reference
+itself applies at its fused-transformer boundary (sequence_pad /
+sequence_unpad bridge LoD into dense for CUDA kernels; we live on the
+dense side permanently and LoD never exists).
+
+Ops that SHRINK rows (unpad/erase/slice/concat) cannot return ragged
+results; they return the same static shape with every row's survivors
+FRONT-PACKED (a stable argsort on the invalid mask — an O(T log T)
+XLA sort instead of a host-side memmove) plus the new lengths.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import first, jdt, register_op
+
+
+def _lens(ins, x, t_axis=1):
+    """Length (B,) int32; defaults to full rows when absent."""
+    ln = first(ins, "Length", None)
+    if ln is None:
+        return jnp.full((x.shape[0],), x.shape[t_axis], jnp.int32)
+    return ln.reshape(x.shape[0]).astype(jnp.int32)
+
+
+def _time_mask(x, lens):
+    """(B, T) bool validity mask from lengths."""
+    t = jnp.arange(x.shape[1], dtype=jnp.int32)
+    return t[None, :] < lens[:, None]
+
+
+def _bcast_mask(mask, x):
+    return mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+
+
+def _front_pack(vals, valid):
+    """Per-row stable front-pack: move rows' valid steps to the front,
+    zero the rest.  vals (B, T, ...), valid (B, T) bool."""
+    order = jnp.argsort(jnp.logical_not(valid), axis=1, stable=True)
+    packed = jnp.take_along_axis(
+        vals, order.reshape(order.shape + (1,) * (vals.ndim - 2)), axis=1)
+    n_valid = jnp.sum(valid, axis=1).astype(jnp.int32)
+    keep = _time_mask(packed, n_valid)
+    packed = jnp.where(_bcast_mask(keep, packed), packed,
+                       jnp.zeros((), packed.dtype))
+    return packed, n_valid
+
+
+@register_op("sequence_mask")
+def _sequence_mask(ctx, op, ins):
+    """reference sequence_ops/sequence_mask_op.cc: lengths -> (B, maxlen)
+    0/1 matrix."""
+    x = first(ins, "X").astype(jnp.int32)
+    maxlen = first(ins, "MaxLenTensor", op.attr("maxlen", -1))
+    maxlen = int(maxlen)
+    if maxlen < 0:
+        raise ValueError(
+            "sequence_mask needs a static maxlen on TPU (XLA static-shape "
+            "contract): pass maxlen=... instead of deriving it from the "
+            "data")
+    t = jnp.arange(maxlen, dtype=jnp.int32)
+    mask = t[None, :] < x.reshape(-1, 1)
+    mask = mask.reshape(tuple(x.shape) + (maxlen,))
+    return {"Y": [mask.astype(jdt(op.attr("out_dtype", "int64")))]}
+
+
+@register_op("sequence_pool")
+def _sequence_pool(ctx, op, ins):
+    """reference sequence_pool_op.cc + sequence_pooling.cu: pool each
+    row's valid prefix.  X (B, T, D) + Length -> Out (B, D)."""
+    x = first(ins, "X")
+    lens = _lens(ins, x)
+    mask = _bcast_mask(_time_mask(x, lens), x)
+    pooltype = op.attr("pooltype", "SUM").upper()
+    pad_value = op.attr("pad_value", 0.0)
+    denom = jnp.maximum(lens, 1).astype(x.dtype)
+    denom = denom.reshape((-1,) + (1,) * (x.ndim - 2))
+    zero = jnp.zeros((), x.dtype)
+    if pooltype == "SUM":
+        out = jnp.sum(jnp.where(mask, x, zero), axis=1)
+    elif pooltype == "AVERAGE" or pooltype == "MEAN":
+        out = jnp.sum(jnp.where(mask, x, zero), axis=1) / denom
+    elif pooltype == "SQRT":
+        out = jnp.sum(jnp.where(mask, x, zero), axis=1) / jnp.sqrt(denom)
+    elif pooltype == "MAX":
+        neg = jnp.full((), -jnp.inf, x.dtype)
+        out = jnp.max(jnp.where(mask, x, neg), axis=1)
+    elif pooltype == "LAST":
+        idx = jnp.maximum(lens - 1, 0)
+        out = jnp.take_along_axis(
+            x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1
+        ).squeeze(1)
+    elif pooltype == "FIRST":
+        out = x[:, 0]
+    else:
+        raise ValueError(f"sequence_pool: unknown pooltype {pooltype}")
+    # empty rows take the pad value (reference behavior for 0-len rows)
+    empty = (lens == 0).reshape((-1,) + (1,) * (x.ndim - 2))
+    out = jnp.where(empty, jnp.asarray(pad_value, x.dtype), out)
+    outs = {"Out": [out]}
+    if "MaxIndex" in op.outputs:
+        neg = jnp.full((), -jnp.inf, x.dtype)
+        outs["MaxIndex"] = [jnp.argmax(
+            jnp.where(mask, x, neg), axis=1).astype(jnp.int32)]
+    return outs
+
+
+@register_op("sequence_softmax")
+def _sequence_softmax(ctx, op, ins):
+    """reference sequence_softmax_op.cc: softmax over each row's valid
+    prefix; padding gets probability 0."""
+    x = first(ins, "X")
+    lens = _lens(ins, x)
+    mask = _time_mask(x, lens)
+    if x.ndim > 2:
+        mask = _bcast_mask(mask, x)
+    neg = jnp.full((), -jnp.inf, x.dtype)
+    p = jax.nn.softmax(jnp.where(mask, x, neg), axis=1)
+    return {"Out": [jnp.where(mask, p, jnp.zeros((), x.dtype))]}
+
+
+@register_op("sequence_reverse")
+def _sequence_reverse(ctx, op, ins):
+    """reference sequence_reverse_op.h: reverse each row's valid prefix,
+    padding stays in place."""
+    x = first(ins, "X")
+    lens = _lens(ins, x)
+    t = jnp.arange(x.shape[1], dtype=jnp.int32)[None, :]
+    rev = lens[:, None] - 1 - t
+    idx = jnp.where(t < lens[:, None], rev, t)
+    return {"Y": [jnp.take_along_axis(
+        x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)), axis=1)]}
+
+
+@register_op("sequence_expand")
+@register_op("sequence_expand_as")
+def _sequence_expand_as(ctx, op, ins):
+    """reference sequence_expand_as_op.cc (and the dense collapse of
+    sequence_expand_op.cc with ref_level): broadcast each row of X over
+    the matching row of Y's time axis, masked to Y's lengths.
+    X (B, D) or (B, 1, D); Y (B, T, ...) supplies T and Length."""
+    x = first(ins, "X")
+    y = first(ins, "Y")
+    if x.ndim >= 3 and x.shape[1] == 1:
+        x = x[:, 0]
+    t = y.shape[1]
+    lens = _lens(ins, y)
+    out = jnp.broadcast_to(x[:, None], (x.shape[0], t) + x.shape[1:])
+    mask = _bcast_mask(_time_mask(out, lens), out)
+    return {"Out": [jnp.where(mask, out, jnp.zeros((), out.dtype))]}
+
+
+@register_op("sequence_pad")
+def _sequence_pad(ctx, op, ins):
+    """reference sequence_pad_op.cc: re-pad rows to padded_length with
+    PadValue.  Dense form: keep each row's valid prefix, fill the rest
+    (and any extension) with the pad value."""
+    x = first(ins, "X")
+    lens = _lens(ins, x)
+    pad_v = first(ins, "PadValue", 0.0)
+    plen = int(op.attr("padded_length", -1))
+    if plen < 0:
+        plen = x.shape[1]
+    if plen > x.shape[1]:
+        cfg = [(0, 0), (0, plen - x.shape[1])] + [(0, 0)] * (x.ndim - 2)
+        x = jnp.pad(x, cfg)
+    else:
+        x = x[:, :plen]
+    mask = _bcast_mask(_time_mask(x, lens), x)
+    out = jnp.where(mask, x, jnp.asarray(pad_v, x.dtype))
+    return {"Out": [out], "Length": [lens.astype(jnp.int64)]}
+
+
+@register_op("sequence_unpad")
+def _sequence_unpad(ctx, op, ins):
+    """reference sequence_unpad_op.cc strips padding into a ragged
+    LoDTensor; the static-shape form front-packs all valid steps into
+    a flat (B*T, ...) buffer (order preserved) and zero-fills the
+    tail.  Row b's tokens start at sum(Length[:b])."""
+    x = first(ins, "X")
+    lens = _lens(ins, x)
+    valid = _time_mask(x, lens)
+    flat = x.reshape((-1,) + tuple(x.shape[2:]))
+    vflat = valid.reshape(-1)
+    order = jnp.argsort(jnp.logical_not(vflat), stable=True)
+    packed = flat[order]
+    n = jnp.sum(lens)
+    keep = jnp.arange(flat.shape[0], dtype=jnp.int32) < n
+    packed = jnp.where(keep.reshape((-1,) + (1,) * (packed.ndim - 1)),
+                       packed, jnp.zeros((), packed.dtype))
+    return {"Out": [packed]}
+
+
+@register_op("sequence_concat")
+def _sequence_concat(ctx, op, ins):
+    """reference sequence_concat_op.cc: concatenate the i-th rows of all
+    inputs time-wise.  Dense form: (B, T1+T2+..., ...) with each row's
+    segments front-packed; new lengths = sum of input lengths."""
+    xs = [v for v in ins.get("X", []) if v is not None]
+    lens_in = ins.get("Length", [])
+    lens = []
+    for i, x in enumerate(xs):
+        ln = lens_in[i] if i < len(lens_in) and lens_in[i] is not None \
+            else None
+        lens.append(ln.reshape(x.shape[0]).astype(jnp.int32) if ln is not None
+                    else jnp.full((x.shape[0],), x.shape[1], jnp.int32))
+    cat = jnp.concatenate(xs, axis=1)
+    valid = jnp.concatenate(
+        [_time_mask(x, ln) for x, ln in zip(xs, lens)], axis=1)
+    packed, n_valid = _front_pack(cat, valid)
+    return {"Out": [packed], "OutLength": [n_valid.astype(jnp.int64)]}
+
+
+@register_op("sequence_erase")
+def _sequence_erase(ctx, op, ins):
+    """reference sequence_erase_op.cc: drop every token in `tokens`,
+    front-packing the survivors; emits the new lengths (the reference
+    carries them in the output LoD)."""
+    x = first(ins, "X")
+    lens = _lens(ins, x)
+    tokens = op.attr("tokens", []) or []
+    valid = _time_mask(x, lens)
+    for tok in tokens:
+        valid = jnp.logical_and(valid, x != jnp.asarray(tok, x.dtype))
+    packed, n_valid = _front_pack(x[..., None], valid)
+    return {"Out": [packed[..., 0]], "OutLength": [n_valid.astype(jnp.int64)]}
+
+
+@register_op("sequence_slice")
+def _sequence_slice(ctx, op, ins):
+    """reference sequence_slice_op.cc: per-row [offset, offset+length)
+    slice of the valid prefix, front-packed to t=0."""
+    x = first(ins, "X")
+    offset = first(ins, "Offset").reshape(x.shape[0]).astype(jnp.int32)
+    length = first(ins, "Length").reshape(x.shape[0]).astype(jnp.int32)
+    t = jnp.arange(x.shape[1], dtype=jnp.int32)[None, :]
+    idx = jnp.clip(offset[:, None] + t, 0, x.shape[1] - 1)
+    shifted = jnp.take_along_axis(
+        x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)), axis=1)
+    keep = t < length[:, None]
+    out = jnp.where(_bcast_mask(keep, shifted), shifted,
+                    jnp.zeros((), x.dtype))
+    return {"Out": [out]}
+
+
+@register_op("sequence_enumerate")
+def _sequence_enumerate(ctx, op, ins):
+    """reference sequence_enumerate_op.cc: win_size sliding windows of
+    ids; positions past a row's length emit pad_value."""
+    x = first(ins, "X")
+    squeeze = x.ndim == 2 and x.shape[-1] == 1
+    if squeeze:
+        x = x[..., 0]
+    lens = _lens(ins, x)
+    win = int(op.attr("win_size", 2))
+    pad = op.attr("pad_value", 0)
+    t = jnp.arange(x.shape[1], dtype=jnp.int32)[None, :, None]
+    k = jnp.arange(win, dtype=jnp.int32)[None, None, :]
+    pos = t + k
+    idx = jnp.broadcast_to(jnp.clip(pos, 0, x.shape[1] - 1),
+                           (x.shape[0], x.shape[1], win))
+    gathered = jnp.take_along_axis(
+        x, idx.reshape(x.shape[0], -1), axis=1
+    ).reshape(x.shape[0], x.shape[1], win)
+    ok = pos < lens[:, None, None]
+    out = jnp.where(ok, gathered, jnp.asarray(pad, x.dtype))
+    # whole windows starting past the row length are all-pad already via ok
+    return {"Out": [out]}
+
+
+@register_op("sequence_conv")
+def _sequence_conv(ctx, op, ins):
+    """reference sequence_conv_op.cc (context-window projection,
+    IM2COL + GEMM — sequence_project functor): for each valid step,
+    concat the context window [t+start, t+start+len) of D-dim features
+    (zeros beyond the row) and project by Filter
+    ((context_length*D, M)).  MXU-native: one batched matmul."""
+    x = first(ins, "X")  # (B, T, D)
+    w = first(ins, "Filter")
+    lens = _lens(ins, x)
+    clen = int(op.attr("contextLength", op.attr("context_length", 3)))
+    cstart = int(op.attr("contextStart", op.attr("context_start",
+                                                 -(clen - 1) // 2)))
+    b, t, d = x.shape
+    valid = _time_mask(x, lens)
+    cols = []
+    for k in range(clen):
+        shift = cstart + k
+        idx = jnp.clip(jnp.arange(t, dtype=jnp.int32) + shift, 0, t - 1)
+        g = x[:, idx]
+        ok = ((jnp.arange(t, dtype=jnp.int32)[None, :] + shift >= 0)
+              & (jnp.arange(t, dtype=jnp.int32)[None, :] + shift
+                 < lens[:, None]))
+        cols.append(jnp.where(ok[..., None], g, jnp.zeros((), x.dtype)))
+    im2col = jnp.concatenate(cols, axis=-1)  # (B, T, clen*D)
+    out = im2col @ w  # (B, T, M)
+    out = jnp.where(valid[..., None], out, jnp.zeros((), out.dtype))
+    return {"Out": [out]}

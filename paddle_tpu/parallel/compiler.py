@@ -115,8 +115,9 @@ class CompiledProgram:
 
     def _has_collective_ops(self, program) -> bool:
         for op in program.global_block().ops:
-            if op.type.startswith("c_") or op.type in ("barrier", "alltoall",
-                                                       "send_v2", "recv_v2"):
+            if op.type.startswith("c_") or op.type in (
+                    "barrier", "alltoall", "send_v2", "recv_v2",
+                    "mp_allreduce_sum"):
                 return True
         return False
 

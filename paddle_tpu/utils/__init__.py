@@ -1,0 +1,6 @@
+"""paddle.utils — dlpack interop and small helpers
+(reference python/paddle/utils/)."""
+
+from . import dlpack  # noqa: F401
+
+__all__ = ["dlpack"]

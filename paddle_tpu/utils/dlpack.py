@@ -1,0 +1,38 @@
+"""DLPack interop (reference python/paddle/utils/dlpack.py wrapping
+fluid/framework/tensor_util dlpack converters; pybind/tensor.cc
+_to_dlpack).
+
+TPU-native: jax arrays implement the dlpack protocol, so zero-copy
+exchange with torch/numpy/cupy works through jax.dlpack — no C++
+converter needed.  Dygraph Tensors unwrap to their jax.Array.
+"""
+
+from __future__ import annotations
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def _unwrap(x):
+    # dygraph Tensor wraps a jax.Array in ._value
+    return getattr(x, "_value", x)
+
+
+def to_dlpack(x):
+    """Export a Tensor/jax.Array as a DLPack capsule."""
+    import jax.dlpack
+
+    return jax.dlpack.to_dlpack(_unwrap(x))
+
+
+def from_dlpack(capsule):
+    """Import a DLPack capsule (or any object with __dlpack__) as an
+    eager Tensor (dygraph) / jax.Array (static helpers)."""
+    import jax.dlpack
+
+    arr = jax.dlpack.from_dlpack(capsule)
+    try:
+        from ..fluid.dygraph.varbase import Tensor
+
+        return Tensor(arr)
+    except Exception:
+        return arr

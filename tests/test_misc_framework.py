@@ -1,0 +1,159 @@
+"""Tests for auc / py_func / run_program ops, dlpack interop, and the
+fleet fs abstraction (reference unittests: test_auc_op.py,
+test_py_func_op.py, test_run_program_op.py, test_dlpack.py, test_fs.py).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework, unique_name
+from paddle_tpu.fluid.executor import Scope, scope_guard
+
+
+class TestAuc:
+    def test_auc_matches_sklearn_style_oracle(self, fresh_programs):
+        main, startup, scope = fresh_programs
+        pred = fluid.data("pred", [-1, 2], "float32")
+        label = fluid.data("label", [-1, 1], "int32")
+        auc_out, _, _ = fluid.layers.auc(pred, label, num_thresholds=200)
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        # separable-ish scores: positives skew high
+        n = 500
+        y = (rng.rand(n) < 0.4).astype("int32")
+        score = np.clip(0.35 * y + 0.3 * rng.rand(n), 0, 0.999)
+        p = np.stack([1 - score, score], 1).astype("float32")
+        (auc_val,) = exe.run(main,
+                             feed={"pred": p, "label": y[:, None]},
+                             fetch_list=[auc_out])
+        # numpy rank-based AUC oracle
+        order = np.argsort(score)
+        ranks = np.empty(n)
+        ranks[order] = np.arange(1, n + 1)
+        n_pos, n_neg = y.sum(), n - y.sum()
+        want = (ranks[y == 1].sum() - n_pos * (n_pos + 1) / 2) / (
+            n_pos * n_neg)
+        np.testing.assert_allclose(float(np.asarray(auc_val)), want,
+                                   atol=0.01)
+
+    def test_auc_accumulates_across_batches(self, fresh_programs):
+        main, startup, scope = fresh_programs
+        pred = fluid.data("pred", [-1, 2], "float32")
+        label = fluid.data("label", [-1, 1], "int32")
+        auc_out, _, _ = fluid.layers.auc(pred, label, num_thresholds=50)
+        exe = fluid.Executor()
+        exe.run(startup)
+        # batch 1: only positives -> auc 0; batch 2 adds separable negs
+        p1 = np.array([[0.1, 0.9], [0.2, 0.8]], "float32")
+        exe.run(main, feed={"pred": p1,
+                            "label": np.array([[1], [1]], "int32")},
+                fetch_list=[auc_out])
+        p2 = np.array([[0.9, 0.1], [0.8, 0.2]], "float32")
+        (v,) = exe.run(main, feed={"pred": p2,
+                                   "label": np.array([[0], [0]], "int32")},
+                       fetch_list=[auc_out])
+        np.testing.assert_allclose(float(np.asarray(v)), 1.0, atol=1e-6)
+
+
+class TestPyFunc:
+    def test_py_func_runs_host_code(self, fresh_programs):
+        main, startup, scope = fresh_programs
+        x = fluid.data("x", [2, 3], "float32")
+        out = main.global_block().create_var(
+            name="pf_out", dtype="float32", shape=[2, 3])
+        fluid.layers.py_func(lambda a: a * 2 + 1, x, out)
+        exe = fluid.Executor()
+        X = np.arange(6, dtype="float32").reshape(2, 3)
+        (o,) = exe.run(main, feed={"x": X}, fetch_list=[out])
+        np.testing.assert_allclose(o, X * 2 + 1)
+
+    def test_py_func_backward_unsupported(self, fresh_programs):
+        main, startup, scope = fresh_programs
+        x = fluid.data("x", [2], "float32")
+        out = main.global_block().create_var(name="o", dtype="float32",
+                                             shape=[2])
+        with pytest.raises(NotImplementedError, match="backward"):
+            fluid.layers.py_func(lambda a: a, x, out,
+                                 backward_func=lambda g: g)
+
+
+class TestRunProgram:
+    def test_run_program_inlines_subblock(self, fresh_programs):
+        main, startup, scope = fresh_programs
+        x = fluid.data("x", [2, 2], "float32")
+        block = main.global_block()
+        out = block.create_var(name="rp_out", dtype="float32",
+                               shape=[2, 2])
+        sub = main._create_block()
+        tmp = sub.create_var(name="rp_tmp", dtype="float32", shape=[2, 2])
+        sub.append_op("scale", inputs={"X": [x.name]},
+                      outputs={"Out": [tmp.name]},
+                      attrs={"scale": 3.0, "bias": 1.0,
+                             "bias_after_scale": True}, infer_shape=False)
+        sub.append_op("relu", inputs={"X": [tmp.name]},
+                      outputs={"Out": [out.name]}, infer_shape=False)
+        main._rollback()
+        block.append_op("run_program", inputs={"X": [x.name]},
+                        outputs={"Out": [out.name]},
+                        attrs={"sub_block": sub.idx}, infer_shape=False)
+        exe = fluid.Executor()
+        X = np.array([[-1.0, 0.5], [2.0, -3.0]], "float32")
+        (o,) = exe.run(main, feed={"x": X}, fetch_list=[out])
+        np.testing.assert_allclose(o, np.maximum(X * 3 + 1, 0))
+
+
+class TestDLPack:
+    def test_roundtrip_with_torch(self):
+        import torch
+
+        import paddle_tpu as paddle
+        from paddle_tpu.utils import dlpack
+
+        paddle.disable_static()
+        try:
+            t = paddle.to_tensor(np.arange(12, dtype="float32")
+                                 .reshape(3, 4))
+            # jax -> torch (torch consumes objects with __dlpack__)
+            tt = torch.from_dlpack(t._value)
+            np.testing.assert_allclose(tt.numpy(), t.numpy())
+            # torch -> paddle
+            back = dlpack.from_dlpack(torch.arange(6).reshape(2, 3))
+            np.testing.assert_array_equal(back.numpy(),
+                                          np.arange(6).reshape(2, 3))
+        finally:
+            paddle.enable_static()
+
+
+class TestLocalFS:
+    def test_fs_operations(self, tmp_path):
+        from paddle_tpu.distributed.fleet.utils import (
+            FSFileExistsError, LocalFS)
+
+        fs = LocalFS()
+        root = str(tmp_path / "fsroot")
+        fs.mkdirs(root)
+        assert fs.is_dir(root) and fs.is_exist(root)
+        f1 = root + "/a.txt"
+        fs.touch(f1)
+        assert fs.is_file(f1)
+        fs.mkdirs(root + "/sub")
+        dirs, files = fs.ls_dir(root)
+        assert dirs == ["sub"] and files == ["a.txt"]
+        assert fs.list_dirs(root) == ["sub"]
+        fs.mv(f1, root + "/b.txt")
+        assert fs.is_file(root + "/b.txt") and not fs.is_exist(f1)
+        fs.touch(root + "/c.txt")
+        with pytest.raises(FSFileExistsError):
+            fs.mv(root + "/b.txt", root + "/c.txt")
+        fs.mv(root + "/b.txt", root + "/c.txt", overwrite=True)
+        fs.delete(root)
+        assert not fs.is_exist(root)
+        assert fs.need_upload_download() is False
+
+    def test_hdfs_raises(self):
+        from paddle_tpu.distributed.fleet.utils import HDFSClient
+
+        with pytest.raises(NotImplementedError, match="LocalFS"):
+            HDFSClient("/opt/hadoop", None)

@@ -1,0 +1,273 @@
+"""Detection op tests (reference unittests: test_prior_box_op.py,
+test_anchor_generator_op.py, test_box_coder_op.py, test_iou_similarity_op.py,
+test_bipartite_match_op.py, test_multiclass_nms_op.py, test_yolo_box_op.py,
+test_sigmoid_focal_loss_op.py, test_roi_align_op.py, test_box_clip_op.py).
+Oracles are direct numpy re-derivations of the reference C++ kernels."""
+
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.executor import Scope, scope_guard
+
+from op_test import OpTest, randf
+
+
+def run_det_op(op_type, inputs, attrs, out_slots, out_dtypes=None):
+    t = OpTest()
+    t.op_type, t.inputs, t.attrs = op_type, inputs, attrs
+    t.outputs = {s: np.zeros(1, (out_dtypes or {}).get(s, "float32"))
+                 for s in out_slots}
+    main, startup, feed, fetch_names, _ = t._build()
+    with scope_guard(Scope()):
+        exe = fluid.Executor()
+        outs = exe.run(main, feed=feed,
+                       fetch_list=[n for _, _, n in fetch_names])
+    return {slot: np.asarray(o)
+            for (slot, i, n), o in zip(fetch_names, outs)}
+
+
+def np_iou(a, b, off=0.0):
+    ix1 = np.maximum(a[:, None, 0], b[None, :, 0])
+    iy1 = np.maximum(a[:, None, 1], b[None, :, 1])
+    ix2 = np.minimum(a[:, None, 2], b[None, :, 2])
+    iy2 = np.minimum(a[:, None, 3], b[None, :, 3])
+    inter = np.maximum(ix2 - ix1 + off, 0) * np.maximum(iy2 - iy1 + off, 0)
+    aa = (a[:, 2] - a[:, 0] + off) * (a[:, 3] - a[:, 1] + off)
+    ab = (b[:, 2] - b[:, 0] + off) * (b[:, 3] - b[:, 1] + off)
+    union = aa[:, None] + ab[None, :] - inter
+    return np.where(union > 0, inter / np.maximum(union, 1e-10), 0.0)
+
+
+def rand_boxes(n, seed, scale=10.0):
+    rng = np.random.RandomState(seed)
+    xy = rng.rand(n, 2) * scale
+    wh = rng.rand(n, 2) * scale / 2 + 0.5
+    return np.concatenate([xy, xy + wh], axis=1).astype("float32")
+
+
+def test_iou_similarity():
+    a, b = rand_boxes(4, 1), rand_boxes(6, 2)
+    out = run_det_op("iou_similarity", {"X": a, "Y": b},
+                     {"box_normalized": True}, ["Out"])["Out"]
+    np.testing.assert_allclose(out, np_iou(a, b), rtol=1e-5, atol=1e-6)
+
+
+def test_prior_box_matches_reference_loop():
+    feat = np.zeros((1, 8, 2, 2), "float32")
+    img = np.zeros((1, 3, 32, 32), "float32")
+    attrs = {"min_sizes": [4.0], "max_sizes": [8.0],
+             "aspect_ratios": [2.0], "flip": True, "clip": True,
+             "variances": [0.1, 0.1, 0.2, 0.2], "offset": 0.5,
+             "step_w": 0.0, "step_h": 0.0}
+    d = run_det_op("prior_box", {"Input": feat, "Image": img}, attrs,
+                   ["Boxes", "Variances"])
+    boxes, variances = d["Boxes"], d["Variances"]
+    # ars expand to [1, 2, 0.5] -> 3 + 1 max_size = 4 priors
+    assert boxes.shape == (2, 2, 4, 4)
+    step = 32 / 2
+    cx, cy = (0 + 0.5) * step, (0 + 0.5) * step
+    want00 = []
+    for ar in [1.0, 2.0, 0.5]:
+        bw, bh = 4 * math.sqrt(ar) / 2, 4 / math.sqrt(ar) / 2
+        want00.append([(cx - bw) / 32, (cy - bh) / 32,
+                       (cx + bw) / 32, (cy + bh) / 32])
+    sq = math.sqrt(4.0 * 8.0) / 2
+    want00.append([(cx - sq) / 32, (cy - sq) / 32,
+                   (cx + sq) / 32, (cy + sq) / 32])
+    np.testing.assert_allclose(boxes[0, 0],
+                               np.clip(np.asarray(want00), 0, 1),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(variances[1, 1, 2], [0.1, 0.1, 0.2, 0.2])
+
+
+def test_anchor_generator_matches_reference_loop():
+    feat = np.zeros((1, 8, 2, 3), "float32")
+    d = run_det_op("anchor_generator", {"Input": feat},
+                   {"anchor_sizes": [32.0, 64.0], "aspect_ratios": [1.0],
+                    "stride": [16.0, 16.0], "offset": 0.5,
+                    "variances": [0.1, 0.1, 0.2, 0.2]},
+                   ["Anchors", "Variances"])
+    a = d["Anchors"]
+    assert a.shape == (2, 3, 2, 4)
+    xc = 1 * 16.0 + 0.5 * 15.0
+    yc = 0 * 16.0 + 0.5 * 15.0
+    base = round(math.sqrt(16 * 16 / 1.0))
+    aw = 32.0 / 16.0 * base
+    np.testing.assert_allclose(
+        a[0, 1, 0],
+        [xc - 0.5 * (aw - 1), yc - 0.5 * (aw - 1),
+         xc + 0.5 * (aw - 1), yc + 0.5 * (aw - 1)], rtol=1e-5)
+
+
+def test_box_coder_encode_decode_roundtrip():
+    prior = rand_boxes(5, 3)
+    target = rand_boxes(4, 4)
+    var = [0.1, 0.1, 0.2, 0.2]
+    enc = run_det_op("box_coder",
+                     {"PriorBox": prior, "TargetBox": target},
+                     {"code_type": "encode_center_size",
+                      "box_normalized": True, "variance": var},
+                     ["OutputBox"])["OutputBox"]
+    assert enc.shape == (4, 5, 4)
+    dec = run_det_op("box_coder",
+                     {"PriorBox": prior, "TargetBox": enc},
+                     {"code_type": "decode_center_size",
+                      "box_normalized": True, "variance": var, "axis": 0},
+                     ["OutputBox"])["OutputBox"]
+    # decoding the encoding recovers each target against every prior
+    for j in range(5):
+        np.testing.assert_allclose(dec[:, j], target, rtol=1e-4, atol=1e-4)
+
+
+def test_box_clip():
+    boxes = np.array([[[-5.0, -3.0, 50.0, 40.0]]], "float32")
+    im_info = np.array([[20.0, 30.0, 1.0]], "float32")
+    out = run_det_op("box_clip", {"Input": boxes, "ImInfo": im_info}, {},
+                     ["Output"])["Output"]
+    np.testing.assert_allclose(out[0, 0], [0, 0, 29, 19])
+
+
+def test_bipartite_match_greedy():
+    # classic example: global max first, then next-best disjoint pair
+    dist = np.array([[0.1, 0.9, 0.3],
+                     [0.8, 0.2, 0.7]], "float32")
+    d = run_det_op("bipartite_match", {"DistMat": dist},
+                   {"match_type": "bipartite"},
+                   ["ColToRowMatchIndices", "ColToRowMatchDist"],
+                   {"ColToRowMatchIndices": "int32"})
+    idx, dst = d["ColToRowMatchIndices"][0], d["ColToRowMatchDist"][0]
+    np.testing.assert_array_equal(idx, [1, 0, -1])
+    np.testing.assert_allclose(dst, [0.8, 0.9, 0.0], rtol=1e-5)
+
+
+def test_bipartite_match_per_prediction():
+    dist = np.array([[0.1, 0.9, 0.6],
+                     [0.8, 0.2, 0.65]], "float32")
+    d = run_det_op("bipartite_match", {"DistMat": dist},
+                   {"match_type": "per_prediction",
+                    "dist_threshold": 0.5},
+                   ["ColToRowMatchIndices", "ColToRowMatchDist"],
+                   {"ColToRowMatchIndices": "int32"})
+    idx = d["ColToRowMatchIndices"][0]
+    # col 2 unmatched by bipartite but best row 1 has 0.65 >= 0.5
+    np.testing.assert_array_equal(idx, [1, 0, 1])
+
+
+def test_multiclass_nms_dense():
+    # 2 well-separated boxes + 1 overlapping duplicate, 1 class
+    boxes = np.array([[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                       [20, 20, 30, 30]]], "float32")
+    scores = np.array([[[0.0, 0.0, 0.0],      # background
+                        [0.9, 0.8, 0.7]]], "float32")  # class 1
+    d = run_det_op("multiclass_nms3",
+                   {"BBoxes": boxes, "Scores": scores},
+                   {"background_label": 0, "score_threshold": 0.1,
+                    "nms_top_k": 3, "keep_top_k": 3,
+                    "nms_threshold": 0.5, "normalized": True},
+                   ["Out", "NmsRoisNum"], {"NmsRoisNum": "int32"})
+    out, num = d["Out"], d["NmsRoisNum"]
+    assert num[0] == 2  # duplicate suppressed
+    assert out.shape == (1, 3, 6)
+    np.testing.assert_allclose(out[0, 0, :2], [1, 0.9], rtol=1e-5)
+    np.testing.assert_allclose(out[0, 0, 2:], [0, 0, 10, 10], rtol=1e-5)
+    np.testing.assert_allclose(out[0, 1, :2], [1, 0.7], rtol=1e-5)
+    assert out[0, 2, 0] == -1  # padding row
+
+
+def test_yolo_box_formula():
+    b, a, h, w, cnum = 1, 1, 2, 2, 2
+    rng = np.random.RandomState(7)
+    x = rng.randn(b, a * (5 + cnum), h, w).astype("float32")
+    img = np.array([[64, 64]], "int32")
+    anchors = [10, 14]
+    d = run_det_op("yolo_box", {"X": x, "ImgSize": img},
+                   {"anchors": anchors, "class_num": cnum,
+                    "conf_thresh": 0.0, "downsample_ratio": 32,
+                    "clip_bbox": False, "scale_x_y": 1.0},
+                   ["Boxes", "Scores"])
+    boxes, sc = d["Boxes"], d["Scores"]
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    # cell (i=1, j=0) -> flat row h*w index 0*2+1
+    cx = (1 + sig(x[0, 0, 0, 1])) * 64 / w
+    cy = (0 + sig(x[0, 1, 0, 1])) * 64 / h
+    bw = np.exp(x[0, 2, 0, 1]) * 10 * 64 / (32 * w)
+    bh = np.exp(x[0, 3, 0, 1]) * 14 * 64 / (32 * h)
+    np.testing.assert_allclose(
+        boxes[0, 1], [cx - bw / 2, cy - bh / 2, cx + bw / 2, cy + bh / 2],
+        rtol=1e-4)
+    conf = sig(x[0, 4, 0, 1])
+    np.testing.assert_allclose(sc[0, 1, 0], sig(x[0, 5, 0, 1]) * conf,
+                               rtol=1e-4)
+
+
+def test_sigmoid_focal_loss_matches_numpy():
+    rng = np.random.RandomState(8)
+    x = rng.randn(6, 3).astype("float32")
+    label = np.array([[0], [1], [2], [3], [1], [0]], "int32")
+    fg = np.array([4], "int32")
+    out = run_det_op("sigmoid_focal_loss",
+                     {"X": x, "Label": label, "FgNum": fg},
+                     {"gamma": 2.0, "alpha": 0.25}, ["Out"])["Out"]
+    p = 1 / (1 + np.exp(-x))
+    tgt = (label == np.arange(1, 4)[None, :]).astype("float32")
+    ce = -(tgt * np.log(p) + (1 - tgt) * np.log(1 - p))
+    w = tgt * 0.25 * (1 - p) ** 2 + (1 - tgt) * 0.75 * p ** 2
+    np.testing.assert_allclose(out, w * ce / 4.0, rtol=1e-4, atol=1e-5)
+
+
+def test_roi_align_constant_region():
+    # constant image -> every pooled value equals that constant
+    x = np.full((1, 2, 8, 8), 3.0, "float32")
+    rois = np.array([[1.0, 1.0, 5.0, 5.0]], "float32")
+    out = run_det_op("roi_align",
+                     {"X": x, "ROIs": rois,
+                      "RoisNum": np.array([1], "int32")},
+                     {"pooled_height": 2, "pooled_width": 2,
+                      "spatial_scale": 1.0, "sampling_ratio": 2},
+                     ["Out"])["Out"]
+    assert out.shape == (1, 2, 2, 2)
+    np.testing.assert_allclose(out, 3.0, rtol=1e-5)
+
+
+def test_roi_align_batch_mapping():
+    # two images with distinct constants; RoisNum maps rois to images
+    x = np.stack([np.full((1, 4, 4), 1.0), np.full((1, 4, 4), 5.0)]
+                 ).astype("float32")
+    rois = np.array([[0, 0, 3, 3], [0, 0, 3, 3]], "float32")
+    out = run_det_op("roi_align",
+                     {"X": x, "ROIs": rois,
+                      "RoisNum": np.array([1, 1], "int32")},
+                     {"pooled_height": 1, "pooled_width": 1,
+                      "spatial_scale": 1.0, "sampling_ratio": 2},
+                     ["Out"])["Out"]
+    np.testing.assert_allclose(out[0, 0, 0, 0], 1.0, rtol=1e-5)
+    np.testing.assert_allclose(out[1, 0, 0, 0], 5.0, rtol=1e-5)
+
+
+def test_detection_layers_build():
+    """Layer wrappers wire into a Program and execute."""
+    from paddle_tpu.fluid import framework, unique_name
+
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup), unique_name.guard():
+        import paddle_tpu.fluid.layers as layers
+
+        feat = fluid.data("feat", [1, 8, 2, 2], "float32")
+        img = fluid.data("img", [1, 3, 32, 32], "float32")
+        boxes, variances = layers.prior_box(feat, img, min_sizes=[4.0])
+        a = fluid.data("a", [3, 4], "float32")
+        b = fluid.data("b", [2, 4], "float32")
+        iou = layers.iou_similarity(a, b)
+    with scope_guard(Scope()):
+        exe = fluid.Executor()
+        bo, io = exe.run(
+            main,
+            feed={"feat": np.zeros((1, 8, 2, 2), "float32"),
+                  "img": np.zeros((1, 3, 32, 32), "float32"),
+                  "a": rand_boxes(3, 9), "b": rand_boxes(2, 10)},
+            fetch_list=[boxes, iou])
+    assert np.asarray(bo).shape == (2, 2, 1, 4)
+    assert np.asarray(io).shape == (3, 2)

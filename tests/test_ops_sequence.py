@@ -1,0 +1,218 @@
+"""Sequence (LoD-family) op tests — dense (data, lengths) re-design
+(reference unittests: test_sequence_pool.py, test_sequence_softmax_op.py,
+test_sequence_pad_op.py, test_sequence_unpad_op.py,
+test_sequence_reverse.py, test_sequence_erase_op.py,
+test_sequence_mask.py, test_sequence_conv.py, test_sequence_slice_op.py,
+test_sequence_enumerate_op.py, test_sequence_expand_as.py,
+test_sequence_concat.py).  Oracles computed per-row on the ragged view
+(the semantics the reference defines over LoD), then re-padded."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework, unique_name
+from paddle_tpu.fluid.executor import Scope, scope_guard
+
+from op_test import OpTest, randf
+
+
+def run_seq_op(op_type, inputs, attrs, out_slots, out_dtypes=None):
+    t = OpTest()
+    t.op_type, t.inputs, t.attrs = op_type, inputs, attrs
+    t.outputs = {s: np.zeros(1, (out_dtypes or {}).get(s, "float32"))
+                 for s in out_slots}
+    main, startup, feed, fetch_names, _ = t._build()
+    with scope_guard(Scope()):
+        exe = fluid.Executor()
+        outs = exe.run(main, feed=feed,
+                       fetch_list=[n for _, _, n in fetch_names])
+    return {slot: np.asarray(o)
+            for (slot, i, n), o in zip(fetch_names, outs)}
+
+
+X = randf(3, 5, 4, seed=201)          # (B=3, T=5, D=4)
+LENS = np.array([5, 3, 0], "int32")   # incl. an empty row
+MASK = np.arange(5)[None, :] < LENS[:, None]
+
+
+class TestSequencePool:
+    @pytest.mark.parametrize("ptype,fn", [
+        ("SUM", lambda r: r.sum(0)),
+        ("AVERAGE", lambda r: r.mean(0)),
+        ("SQRT", lambda r: r.sum(0) / np.sqrt(len(r))),
+        ("MAX", lambda r: r.max(0)),
+        ("LAST", lambda r: r[-1]),
+        ("FIRST", lambda r: r[0]),
+    ])
+    def test_pool(self, ptype, fn):
+        out = run_seq_op("sequence_pool", {"X": X, "Length": LENS},
+                         {"pooltype": ptype, "pad_value": -7.0},
+                         ["Out"])["Out"]
+        want = np.stack([fn(X[b, :LENS[b]]) if LENS[b] else
+                         np.full(4, -7.0, "float32")
+                         for b in range(3)])
+        np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
+
+
+def test_sequence_softmax():
+    x2 = randf(3, 5, seed=202)
+    out = run_seq_op("sequence_softmax", {"X": x2, "Length": LENS}, {},
+                     ["Out"])["Out"]
+    for b in range(3):
+        n = LENS[b]
+        if n:
+            e = np.exp(x2[b, :n] - x2[b, :n].max())
+            np.testing.assert_allclose(out[b, :n], e / e.sum(), rtol=1e-5)
+        assert np.all(out[b, n:] == 0)
+
+
+def test_sequence_reverse():
+    out = run_seq_op("sequence_reverse", {"X": X, "Length": LENS}, {},
+                     ["Y"])["Y"]
+    for b in range(3):
+        n = LENS[b]
+        np.testing.assert_allclose(out[b, :n], X[b, :n][::-1])
+        np.testing.assert_allclose(out[b, n:], X[b, n:])  # padding in place
+
+
+def test_sequence_mask():
+    out = run_seq_op("sequence_mask", {"X": LENS},
+                     {"maxlen": 6, "out_dtype": "float32"}, ["Y"])["Y"]
+    want = (np.arange(6)[None, :] < LENS[:, None]).astype("float32")
+    np.testing.assert_array_equal(out, want)
+
+
+def test_sequence_expand_as():
+    xr = randf(3, 4, seed=203)
+    out = run_seq_op("sequence_expand_as",
+                     {"X": xr, "Y": X, "Length": LENS}, {}, ["Out"])["Out"]
+    for b in range(3):
+        n = LENS[b]
+        np.testing.assert_allclose(out[b, :n], np.tile(xr[b], (n, 1)))
+        assert np.all(out[b, n:] == 0)
+
+
+def test_sequence_pad_extends_and_fills():
+    out, ln = (lambda d: (d["Out"], d["Length"]))(run_seq_op(
+        "sequence_pad",
+        {"X": X, "Length": LENS, "PadValue": np.float32(9.0)},
+        {"padded_length": 7}, ["Out", "Length"],
+        {"Length": "int64"}))
+    assert out.shape == (3, 7, 4)
+    for b in range(3):
+        n = LENS[b]
+        np.testing.assert_allclose(out[b, :n], X[b, :n])
+        assert np.all(out[b, n:] == 9.0)
+    np.testing.assert_array_equal(ln, LENS)
+
+
+def test_sequence_unpad_front_packs():
+    out = run_seq_op("sequence_unpad", {"X": X, "Length": LENS}, {},
+                     ["Out"])["Out"]
+    assert out.shape == (15, 4)
+    want = np.concatenate([X[b, :LENS[b]] for b in range(3)])
+    np.testing.assert_allclose(out[:len(want)], want)
+    assert np.all(out[len(want):] == 0)
+
+
+def test_sequence_concat():
+    x2 = randf(3, 4, 4, seed=204)
+    l2 = np.array([2, 4, 1], "int32")
+    d = run_seq_op("sequence_concat",
+                   {"X": [X, x2], "Length": [LENS, l2]}, {},
+                   ["Out", "OutLength"], {"OutLength": "int64"})
+    out, ln = d["Out"], d["OutLength"]
+    assert out.shape == (3, 9, 4)
+    np.testing.assert_array_equal(ln, LENS + l2)
+    for b in range(3):
+        want = np.concatenate([X[b, :LENS[b]], x2[b, :l2[b]]])
+        np.testing.assert_allclose(out[b, :len(want)], want)
+        assert np.all(out[b, len(want):] == 0)
+
+
+def test_sequence_erase():
+    ids = np.array([[2, 1, 2, 3, 5], [7, 2, 2, 0, 0], [1, 1, 1, 0, 0]],
+                   "int32")
+    lens = np.array([5, 3, 2], "int32")
+    d = run_seq_op("sequence_erase", {"X": ids, "Length": lens},
+                   {"tokens": [2, 1]}, ["Out", "OutLength"],
+                   {"Out": "int32", "OutLength": "int64"})
+    np.testing.assert_array_equal(d["OutLength"], [2, 1, 0])
+    np.testing.assert_array_equal(d["Out"][0, :2], [3, 5])
+    np.testing.assert_array_equal(d["Out"][1, :1], [7])
+    assert np.all(d["Out"][2] == 0)
+
+
+def test_sequence_slice():
+    off = np.array([[1], [0], [2]], "int32")
+    ln = np.array([[2], [3], [1]], "int32")
+    out = run_seq_op("sequence_slice",
+                     {"X": X, "Offset": off, "Length": ln}, {},
+                     ["Out"])["Out"]
+    for b in range(3):
+        np.testing.assert_allclose(out[b, :ln[b, 0]],
+                                   X[b, off[b, 0]:off[b, 0] + ln[b, 0]])
+        assert np.all(out[b, ln[b, 0]:] == 0)
+
+
+def test_sequence_enumerate():
+    ids = np.array([[1, 2, 3, 4, 0], [9, 8, 0, 0, 0]], "int32")
+    lens = np.array([4, 2], "int32")
+    out = run_seq_op("sequence_enumerate", {"X": ids, "Length": lens},
+                     {"win_size": 2, "pad_value": 0}, ["Out"],
+                     {"Out": "int32"})["Out"]
+    np.testing.assert_array_equal(
+        out[0], [[1, 2], [2, 3], [3, 4], [4, 0], [0, 0]])
+    np.testing.assert_array_equal(
+        out[1], [[9, 8], [8, 0], [0, 0], [0, 0], [0, 0]])
+
+
+def test_sequence_conv_matches_manual_window():
+    x = randf(2, 4, 3, seed=205)
+    lens = np.array([4, 2], "int32")
+    w = randf(9, 5, seed=206)  # context 3 * D 3 -> 5
+    out = run_seq_op("sequence_conv",
+                     {"X": x, "Length": lens, "Filter": w},
+                     {"contextLength": 3, "contextStart": -1}, ["Out"]
+                     )["Out"]
+    for b in range(2):
+        n = lens[b]
+        for t in range(4):
+            if t >= n:
+                assert np.all(np.abs(out[b, t]) < 1e-6)
+                continue
+            ctx = []
+            for k in range(-1, 2):
+                p = t + k
+                ctx.append(x[b, p] if 0 <= p < n else np.zeros(3, "float32"))
+            want = np.concatenate(ctx) @ w
+            np.testing.assert_allclose(out[b, t], want, rtol=1e-4,
+                                       atol=1e-5)
+
+
+def test_sequence_layers_build_and_grad():
+    """The layer wrappers wire into Programs and append_backward flows
+    gradients through the masked ops."""
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup), unique_name.guard():
+        x = fluid.data("x", [3, 5, 4], "float32")
+        x.stop_gradient = False
+        ln = fluid.data("ln", [3], "int32")
+        import paddle_tpu.fluid.layers as layers
+
+        sm = layers.sequence_softmax(layers.sequence_reverse(x, length=ln),
+                                     length=ln)
+        pooled = layers.sequence_pool(sm * x, "SUM", length=ln)
+        loss = layers.reduce_sum(pooled)
+        grads = fluid.append_backward(loss)
+    with scope_guard(Scope()):
+        exe = fluid.Executor()
+        g = exe.run(main, feed={"x": X, "ln": LENS},
+                    fetch_list=[framework.grad_var_name("x")])[0]
+    g = np.asarray(g)
+    assert g.shape == X.shape
+    # padding positions receive no gradient
+    for b in range(3):
+        assert np.all(g[b, LENS[b]:] == 0)
+    assert np.abs(g).max() > 0

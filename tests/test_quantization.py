@@ -1,0 +1,208 @@
+"""Quantization tests: fake-quant op numerics + STE gradients +
+the static QAT transform pass + dygraph ImperativeQuantAware
+(reference unittests: test_fake_quantize_op.py, test_fake_dequantize_op.py,
+test_quantization_pass.py, test_imperative_qat.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework, unique_name
+from paddle_tpu.fluid.executor import Scope, scope_guard
+
+from op_test import OpTest, randf
+
+
+def run_q_op(op_type, inputs, attrs, out_slots):
+    t = OpTest()
+    t.op_type, t.inputs, t.attrs = op_type, inputs, attrs
+    t.outputs = {s: np.zeros(1, "float32") for s in out_slots}
+    main, startup, feed, fetch_names, _ = t._build()
+    with scope_guard(Scope()):
+        exe = fluid.Executor()
+        outs = exe.run(main, feed=feed,
+                       fetch_list=[n for _, _, n in fetch_names])
+    return {slot: np.asarray(o)
+            for (slot, i, n), o in zip(fetch_names, outs)}
+
+
+def ref_quant(x, s, bits=8):
+    bc = (1 << (bits - 1)) - 1
+    return np.round(bc / max(s, 1e-9) * np.clip(x, -s, s))
+
+
+class TestFakeQuantOps:
+    def test_abs_max(self):
+        x = randf(4, 5, seed=301) * 3
+        d = run_q_op("fake_quantize_abs_max", {"X": x},
+                     {"bit_length": 8}, ["Out", "OutScale"])
+        s = np.abs(x).max()
+        np.testing.assert_allclose(d["OutScale"], [s], rtol=1e-6)
+        np.testing.assert_allclose(d["Out"], ref_quant(x, s), atol=1e-4)
+
+    def test_qdq_abs_max_roundtrip_error_bounded(self):
+        x = randf(4, 5, seed=302) * 3
+        d = run_q_op("fake_quantize_dequantize_abs_max", {"X": x},
+                     {"bit_length": 8}, ["Out", "OutScale"])
+        s = np.abs(x).max()
+        np.testing.assert_allclose(d["Out"], ref_quant(x, s) * s / 127,
+                                   atol=1e-5)
+        # dequantized value within half a quantization step
+        assert np.abs(d["Out"] - x).max() <= s / 127 / 2 + 1e-6
+
+    def test_moving_average_observer_updates(self):
+        x = randf(3, 4, seed=303) * 2
+        d = run_q_op("fake_quantize_dequantize_moving_average_abs_max",
+                     {"X": x, "InScale": np.array([0.5], "float32"),
+                      "InAccum": np.array([1.0], "float32"),
+                      "InState": np.array([1.0], "float32")},
+                     {"bit_length": 8, "moving_rate": 0.9,
+                      "is_test": False},
+                     ["Out", "OutScale", "OutAccum", "OutState"])
+        cur = np.abs(x).max()
+        state = 0.9 * 1.0 + 1.0
+        accum = 0.9 * 1.0 + cur
+        np.testing.assert_allclose(d["OutState"], [state], rtol=1e-5)
+        np.testing.assert_allclose(d["OutAccum"], [accum], rtol=1e-5)
+        np.testing.assert_allclose(d["OutScale"], [accum / state],
+                                   rtol=1e-5)
+
+    def test_channel_wise(self):
+        x = randf(3, 4, seed=304) * np.array([1, 10, 100])[:, None]
+        x = x.astype("float32")
+        d = run_q_op("fake_channel_wise_quantize_abs_max", {"X": x},
+                     {"bit_length": 8, "quant_axis": 0},
+                     ["Out", "OutScale"])
+        for c in range(3):
+            s = np.abs(x[c]).max()
+            np.testing.assert_allclose(d["OutScale"][c], s, rtol=1e-5)
+            np.testing.assert_allclose(d["Out"][c], ref_quant(x[c], s),
+                                       atol=1e-3)
+
+    def test_dequantize(self):
+        q = np.array([[-127, 0, 64]], "float32")
+        d = run_q_op("fake_dequantize_max_abs",
+                     {"X": q, "Scale": np.array([2.0], "float32")},
+                     {"max_range": 127.0}, ["Out"])
+        np.testing.assert_allclose(d["Out"], q * 2.0 / 127.0, rtol=1e-6)
+
+    def test_ste_gradient_is_identity(self):
+        """d qdq(x) / d x == 1 away from clip range (straight-through)."""
+        main, startup = framework.Program(), framework.Program()
+        with framework.program_guard(main, startup), unique_name.guard():
+            x = fluid.data("x", [3, 4], "float32")
+            x.stop_gradient = False
+            out = main.global_block().create_var(name="q", dtype="float32")
+            sc = main.global_block().create_var(name="s", dtype="float32")
+            main.global_block().append_op(
+                "fake_quantize_dequantize_abs_max",
+                inputs={"X": [x]}, outputs={"Out": [out], "OutScale": [sc]},
+                attrs={"bit_length": 8}, infer_shape=False)
+            loss = fluid.layers.reduce_sum(main.global_block().var("q"))
+            fluid.append_backward(loss)
+        with scope_guard(Scope()):
+            exe = fluid.Executor()
+            g = exe.run(main, feed={"x": randf(3, 4, seed=305)},
+                        fetch_list=[framework.grad_var_name("x")])[0]
+        np.testing.assert_allclose(np.asarray(g), np.ones((3, 4)),
+                                   rtol=1e-6)
+
+
+class TestQuantizationTransformPass:
+    def _build_fc_net(self):
+        x = fluid.data("x", [-1, 8], "float32")
+        y = fluid.data("y", [-1, 1], "float32")
+        h = fluid.layers.fc(x, 16, act="relu")
+        pred = fluid.layers.fc(h, 1)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.loss.square_error_cost(pred, y))
+        return loss
+
+    def test_pass_inserts_qdq_ops(self, fresh_programs):
+        from paddle_tpu.fluid.contrib.slim import QuantizationTransformPass
+
+        main, startup, scope = fresh_programs
+        loss = self._build_fc_net()
+        QuantizationTransformPass().apply(main, startup)
+        types = [op.type for op in main.global_block().ops]
+        n_w = types.count("fake_quantize_dequantize_abs_max")
+        n_a = types.count(
+            "fake_quantize_dequantize_moving_average_abs_max")
+        assert n_w == 2   # two fc weights
+        assert n_a == 2   # two fc activations
+        # every mul now consumes quant_dequant inputs
+        for op in main.global_block().ops:
+            if op.type == "mul":
+                for names in op.inputs.values():
+                    for n in names:
+                        assert "quant_dequant" in n
+
+    def test_channel_wise_weight_type_honored(self, fresh_programs):
+        import paddle_tpu  # the reference import path must resolve
+        from paddle_tpu.fluid.contrib.slim import QuantizationTransformPass
+
+        assert paddle_tpu.fluid.contrib.slim.QuantizationTransformPass \
+            is QuantizationTransformPass
+        main, startup, scope = fresh_programs
+        self._build_fc_net()
+        QuantizationTransformPass(
+            weight_quantize_type="channel_wise_abs_max").apply(
+                main, startup)
+        types = [op.type for op in main.global_block().ops]
+        assert types.count(
+            "fake_channel_wise_quantize_dequantize_abs_max") == 2
+        with pytest.raises(ValueError, match="weight_quantize_type"):
+            QuantizationTransformPass(weight_quantize_type="bogus")
+
+    def test_quantized_net_trains(self, fresh_programs):
+        from paddle_tpu.fluid.contrib.slim import QuantizationTransformPass
+
+        main, startup, scope = fresh_programs
+        loss = self._build_fc_net()
+        fluid.optimizer.Adam(0.05).minimize(loss)
+        QuantizationTransformPass().apply(main, startup)
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        W = rng.randn(8, 1).astype("float32")
+        losses = []
+        for _ in range(60):
+            X = rng.randn(32, 8).astype("float32")
+            l, = exe.run(main, feed={"x": X, "y": X @ W},
+                         fetch_list=[loss.name])
+            losses.append(float(np.asarray(l)))
+        assert losses[-1] < losses[0] * 0.5, losses[::10]
+
+
+class TestImperativeQuantAware:
+    def test_dygraph_qat_linear(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.fluid.contrib.slim import ImperativeQuantAware
+
+        paddle.disable_static()
+        try:
+            import paddle_tpu.nn as nn
+
+            net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                                nn.Linear(16, 1))
+            ImperativeQuantAware().quantize(net)
+            opt = paddle.optimizer.Adam(
+                learning_rate=0.05, parameters=net.parameters())
+            rng = np.random.RandomState(1)
+            W = rng.randn(8, 1).astype("float32")
+            losses = []
+            for _ in range(40):
+                X = rng.randn(32, 8).astype("float32")
+                xb = paddle.to_tensor(X)
+                pred = net(xb)
+                loss = ((pred - paddle.to_tensor(X @ W)) ** 2).mean()
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                losses.append(float(loss.numpy()))
+            assert losses[-1] < losses[0] * 0.5, losses[::10]
+            # weights remain full precision underneath
+            w = net[0].weight.numpy()
+            assert w.dtype == np.float32
+        finally:
+            paddle.enable_static()

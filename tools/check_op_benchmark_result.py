@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Benchmark regression gate (the TPU port of the reference's
+/root/reference/tools/check_op_benchmark_result.py CI gate, which diffs
+develop-vs-PR op benchmark logs and fails on speed/accuracy regressions).
+
+Usage:
+    python tools/check_op_benchmark_result.py \
+        --current bench_out.json [--baseline BENCH_r02.json] \
+        [--tolerance 0.05]
+
+Inputs are bench.py output files: the LAST parseable JSON line of each
+file is the result ({"metric", "value", "unit", "vs_baseline"}).  The
+gate fails (exit 1) when the current value regresses more than
+`tolerance` relative to the baseline value, or when the current run
+produced no parseable result (the round-1/round-2 0.0-MFU failure mode
+— a bench that silently stops producing numbers must fail CI loudly).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def parse_result(path):
+    """Last parseable JSON line wins (bench.py prints exactly one; logs
+    may prepend warnings)."""
+    try:
+        with open(path) as f:
+            lines = f.read().strip().split("\n")
+    except OSError as e:
+        print(f"[gate] cannot read {path}: {e}")
+        return None
+    for line in reversed(lines):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            d = json.loads(line)
+        except ValueError:
+            continue
+        if "value" in d:
+            return d
+    return None
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--current", required=True,
+                    help="bench.py output (file with one JSON line)")
+    ap.add_argument("--baseline", default=None,
+                    help="previous round's bench JSON to compare against")
+    ap.add_argument("--tolerance", type=float, default=0.05,
+                    help="allowed relative regression (default 5%%)")
+    args = ap.parse_args(argv)
+
+    cur = parse_result(args.current)
+    if cur is None or not isinstance(cur.get("value"), (int, float)):
+        print(f"[gate] FAIL: {args.current} contains no bench result "
+              "(a bench that stops printing numbers is a regression)")
+        return 1
+    value = float(cur["value"])
+    print(f"[gate] current: {cur.get('metric')} = {value} "
+          f"{cur.get('unit', '')}")
+    if value <= 0:
+        print("[gate] FAIL: non-positive benchmark value")
+        return 1
+
+    if args.baseline:
+        base = parse_result(args.baseline)
+        if base is None or not isinstance(base.get("value"), (int, float)) \
+                or float(base["value"]) <= 0:
+            print(f"[gate] baseline {args.baseline} has no usable result; "
+                  "treating current as the new baseline (pass)")
+            return 0
+        bval = float(base["value"])
+        ratio = value / bval
+        print(f"[gate] baseline: {bval} -> ratio {ratio:.3f}")
+        if ratio < 1.0 - args.tolerance:
+            print(f"[gate] FAIL: regression beyond {args.tolerance:.0%} "
+                  f"({value} vs baseline {bval})")
+            return 1
+    print("[gate] PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

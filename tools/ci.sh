@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# CI gate (the TPU port of the reference's paddle_build.sh test stages +
+# tools/check_* gatekeeping): unit tests on the 8-device virtual CPU
+# mesh, op-test coverage floor, TPU kernel lane when hardware is
+# present, then the bench regression gate.
+#
+# Usage: tools/ci.sh [baseline_bench.json]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== unit tests (8-dev virtual CPU mesh) =="
+python -m pytest tests/ -x -q
+
+echo "== op-test coverage floor =="
+python tools/op_coverage.py --fail-under 85
+
+if python - <<'EOF'
+import jax
+import sys
+sys.exit(0 if any(d.platform != "cpu" for d in jax.devices()) else 1)
+EOF
+then
+  echo "== TPU kernel lane (non-interpret Mosaic) =="
+  PADDLE_TPU_TEST_LANE=1 python -m pytest tests/ -q -m tpu
+fi
+
+echo "== benchmark =="
+python bench.py | tee /tmp/bench_out.json
+python tools/check_op_benchmark_result.py --current /tmp/bench_out.json \
+  ${1:+--baseline "$1"}
+
+echo "CI PASS"

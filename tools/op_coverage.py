@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Op-test coverage report (the TPU port of the reference's
+/root/reference/tools/ op-test gatekeeping — check_op_register_type.py /
+print_op_desc.py family): every registered lowering should be exercised
+by a test.
+
+Counts three kinds of exercise under tests/:
+- declarative: `op_type = "x"` class attrs and bulk-table
+  `case(op_type="x", ...)` / `unary("x", ...)` entries;
+- direct-run: `run_*_op("x", ...)` / `_run_single_op("x", ...)` calls;
+- program-level: `append_op("x"` / `trace_op("x"` occurrences in tests
+  (control-flow and collective ops are exercised this way).
+
+Usage: python tools/op_coverage.py [--fail-under PCT]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import re
+import sys
+
+PATTERNS = [
+    r'op_type\s*=\s*"([\w@]+)"',
+    r'case\(op_type="([\w@]+)"',
+    r'unary\("([\w@]+)"',
+    r'run_\w*op\(\s*"([\w@]+)"',
+    r'_run_single_op\(\s*"([\w@]+)"',
+    r'_one_op\(\s*"([\w@]+)"',
+    r'run_collective\(\s*\w+,\s*"([\w@]+)"',
+    r'append_op\(\s*"([\w@]+)"',
+    r'trace_op\(\s*"([\w@]+)"',
+    r'\.append_op\(\s*"([\w@]+)"',
+]
+
+# fluid.layers wrappers used by tests; a call to the wrapper exercises
+# the op types it appends (kept in sync with fluid/layers/*.py)
+LAYER_WRAPPERS = {
+    r"\barray_write\(": ["write_to_array"],
+    r"\barray_read\(": ["read_from_array"],
+    r"\barray_length\(": ["lod_array_length"],
+    r"\bcreate_array\(": ["allocate_array"],
+    r"\btensor_array_to_tensor\(|\barray_to_tensor\(":
+        ["tensor_array_to_tensor"],
+    r"\bWhile\(|\bwhile_loop\(": ["while"],
+    r"\blayers\.cond\(": ["select_input"],
+    r"\bbeam_search\(": ["beam_search"],
+    r"\bbeam_search_decode\(": ["beam_search_decode"],
+    r"\blayers\.auc\(": ["auc"],
+    r"\blayers\.py_func\(": ["py_func"],
+    r"\bPrint\(|\blayers\.Print\(": ["print"],
+    r"\bAssert\(|\blayers\.Assert\(": ["assert"],
+    r"recompute": ["recompute_segment_grad"],
+}
+
+
+def tested_ops(test_dir):
+    found = set()
+    for f in glob.glob(os.path.join(test_dir, "**", "*.py"),
+                       recursive=True):
+        s = open(f, encoding="utf-8").read()
+        for pat in PATTERNS:
+            found |= set(re.findall(pat, s))
+        for pat, ops in LAYER_WRAPPERS.items():
+            if re.search(pat, s):
+                found |= set(ops)
+        # parametrized loops: for opname, fn in [("equal", ...), ...]
+        found |= set(re.findall(r'[\[(]\s*"([a-z_0-9]+)",\s*np\.', s))
+    return found
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--fail-under", type=float, default=0.0,
+                    help="exit 1 if coverage %% falls below this")
+    ap.add_argument("--list-untested", action="store_true")
+    args = ap.parse_args(argv)
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+    from paddle_tpu.ops import registry  # noqa: E402
+
+    ops = set(registry.registered_ops())
+    tested = tested_ops(os.path.join(repo, "tests")) & ops
+    untested = sorted(ops - tested)
+    pct = 100.0 * len(tested) / max(len(ops), 1)
+    print(f"registered ops : {len(ops)}")
+    print(f"tested ops     : {len(tested)}")
+    print(f"coverage       : {pct:.1f}%")
+    if args.list_untested or untested:
+        print(f"untested ({len(untested)}): {untested}")
+    if pct < args.fail_under:
+        print(f"FAIL: coverage {pct:.1f}% < required {args.fail_under}%")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
