@@ -769,7 +769,16 @@ def pad2d(x, paddings=[0, 0, 0, 0], mode="constant", pad_value=0.0,
 
 
 def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
-    raise NotImplementedError("unfold: pending im2col lowering")
+    """im2col patches (reference layers/nn.py unfold; unfold_op.cc)."""
+    pair = lambda v: [v, v] if isinstance(v, int) else list(v)
+    helper = LayerHelper("unfold", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("unfold", inputs={"X": [x]}, outputs={"Y": [out]},
+                     attrs={"kernel_sizes": pair(kernel_sizes),
+                            "strides": pair(strides),
+                            "paddings": pair(paddings),
+                            "dilations": pair(dilations)})
+    return out
 
 
 def image_resize(input, out_shape=None, scale=None, resample="BILINEAR",

@@ -323,6 +323,11 @@ def build_pretrain_step(model: BertForPretraining,
         t = state["t"] + 1
         key = jax.random.fold_in(jax.random.PRNGKey(20), t)
         loss, grads = jax.value_and_grad(loss_fn)(params, batch, key)
+        # keep the dW dots out of the AdamW elementwise fusions: without
+        # the barrier XLA output-fuses each weight-grad convolution with
+        # its f32 optimizer math and the fused conv runs far off MXU
+        # peak (profiled round 3)
+        grads = jax.lax.optimization_barrier(grads)
         tf = t.astype(jnp.float32)
         new_p, new_m, new_v = {}, {}, {}
         for k, p in params.items():

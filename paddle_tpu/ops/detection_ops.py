@@ -177,11 +177,17 @@ def _box_coder(ctx, op, ins):
         elif var_attr:
             out = out / jnp.asarray(var_attr, out.dtype)
         return {"OutputBox": [out]}
-    # decode: target (N, M, 4) or (N, 4) deltas against prior along axis
+    # decode: target must be rank 3 (N, M, 4) — the reference enforces
+    # this (box_coder_op.cc InferShape); silently broadcasting a rank-2
+    # target would produce an (N, N, 4) cross-product, not a pairwise
+    # decode
     if target.ndim == 2:
-        t = target[:, None, :]
-    else:
-        t = target
+        raise ValueError(
+            "box_coder decode_center_size needs a rank-3 TargetBox "
+            f"(N, M, 4); got {target.shape}. For pairwise decode "
+            "expand deltas to (N, 1, 4) against a 1-prior axis or use "
+            "axis=1")
+    t = target
     if axis == 0:
         pw_, ph_, pcx_, pcy_ = (pw[None, :], ph[None, :],
                                 pcx[None, :], pcy[None, :])
@@ -202,8 +208,6 @@ def _box_coder(ctx, op, ins):
     off = 0.0 if normalized else 1.0
     out = jnp.stack([dcx - dw / 2, dcy - dh / 2,
                      dcx + dw / 2 - off, dcy + dh / 2 - off], axis=-1)
-    if target.ndim == 2:
-        out = out[:, 0, :] if out.shape[1] == 1 else out
     return {"OutputBox": [out]}
 
 

@@ -1,7 +1,8 @@
 """paddle.vision.models — LeNet and ResNet variants as dygraph Layers.
 
 Reference: /root/reference/python/paddle/vision/models (lenet.py,
-resnet.py: resnet18/34/50/101/152).  The static-graph ResNet used for
+resnet.py: resnet18/34/50/101/152, vgg.py, mobilenetv1.py,
+mobilenetv2.py).  The static-graph ResNet used for
 the image-classification benchmark lives in
 paddle_tpu/models/resnet.py; these are the 2.0 eager-Layer builds.
 """
@@ -11,7 +12,9 @@ from __future__ import annotations
 from .. import nn
 
 __all__ = ["LeNet", "ResNet", "resnet18", "resnet34", "resnet50",
-           "resnet101", "resnet152"]
+           "resnet101", "resnet152", "VGG", "vgg11", "vgg13", "vgg16",
+           "vgg19", "MobileNetV1", "MobileNetV2", "mobilenet_v1",
+           "mobilenet_v2"]
 
 
 class LeNet(nn.Layer):
@@ -130,3 +133,185 @@ def resnet101(num_classes=1000, **kw):
 
 def resnet152(num_classes=1000, **kw):
     return ResNet(BottleneckBlock, [3, 8, 36, 3], num_classes, **kw)
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    """reference vision/models/mobilenetv2.py _make_divisible: round
+    channel counts to multiples of `divisor`, never dropping more than
+    10%% — required for reference-checkpoint shape compatibility."""
+    if min_value is None:
+        min_value = divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class VGG(nn.Layer):
+    """VGG (reference vision/models/vgg.py): conv stages from a cfg list,
+    adaptive pool to 7x7, 3-layer classifier."""
+
+    def __init__(self, features, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.features = features
+        self.with_pool = with_pool
+        self.flatten = nn.Flatten()
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D((7, 7))
+        self.classifier = nn.Sequential(
+            nn.Linear(512 * 7 * 7, 4096), nn.ReLU(), nn.Dropout(),
+            nn.Linear(4096, 4096), nn.ReLU(), nn.Dropout(),
+            nn.Linear(4096, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        return self.classifier(self.flatten(x))
+
+
+_VGG_CFGS = {
+    "A": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "B": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+          512, 512, "M"],
+    "D": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512,
+          "M", 512, 512, 512, "M"],
+    "E": [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+          512, 512, 512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+def _vgg_features(cfg, batch_norm=False):
+    layers, in_ch = [], 3
+    for v in cfg:
+        if v == "M":
+            layers.append(nn.MaxPool2D(2, 2))
+        else:
+            layers.append(nn.Conv2D(in_ch, v, 3, padding=1))
+            if batch_norm:
+                layers.append(nn.BatchNorm2D(v))
+            layers.append(nn.ReLU())
+            in_ch = v
+    return nn.Sequential(*layers)
+
+
+def vgg11(batch_norm=False, **kw):
+    return VGG(_vgg_features(_VGG_CFGS["A"], batch_norm), **kw)
+
+
+def vgg13(batch_norm=False, **kw):
+    return VGG(_vgg_features(_VGG_CFGS["B"], batch_norm), **kw)
+
+
+def vgg16(batch_norm=False, **kw):
+    return VGG(_vgg_features(_VGG_CFGS["D"], batch_norm), **kw)
+
+
+def vgg19(batch_norm=False, **kw):
+    return VGG(_vgg_features(_VGG_CFGS["E"], batch_norm), **kw)
+
+
+class _ConvBNReLU(nn.Layer):
+    def __init__(self, in_c, out_c, k, stride=1, padding=0, groups=1,
+                 act=True):
+        super().__init__()
+        self.conv = nn.Conv2D(in_c, out_c, k, stride=stride,
+                              padding=padding, groups=groups,
+                              bias_attr=False)
+        self.bn = nn.BatchNorm2D(out_c)
+        self.act = nn.ReLU6() if act else None
+
+    def forward(self, x):
+        x = self.bn(self.conv(x))
+        return self.act(x) if self.act is not None else x
+
+
+class MobileNetV1(nn.Layer):
+    """reference vision/models/mobilenetv1.py: depthwise-separable
+    stacks; on TPU the depthwise convs lower to grouped XLA convolutions."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        s = lambda c: _make_divisible(c * scale)
+        cfg = [(32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+               (256, 256, 1), (256, 512, 2)] + [(512, 512, 1)] * 5 + \
+              [(512, 1024, 2), (1024, 1024, 1)]
+        layers = [_ConvBNReLU(3, s(32), 3, stride=2, padding=1)]
+        for in_c, out_c, stride in cfg:
+            layers.append(_ConvBNReLU(s(in_c), s(in_c), 3, stride=stride,
+                                      padding=1, groups=s(in_c)))
+            layers.append(_ConvBNReLU(s(in_c), s(out_c), 1))
+        self.features = nn.Sequential(*layers)
+        self.with_pool = with_pool
+        self.flatten = nn.Flatten()
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc = nn.Linear(s(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        return self.fc(self.flatten(x))
+
+
+class _InvertedResidual(nn.Layer):
+    def __init__(self, in_c, out_c, stride, expand):
+        super().__init__()
+        hidden = int(round(in_c * expand))
+        self.use_res = stride == 1 and in_c == out_c
+        layers = []
+        if expand != 1:
+            layers.append(_ConvBNReLU(in_c, hidden, 1))
+        layers += [
+            _ConvBNReLU(hidden, hidden, 3, stride=stride, padding=1,
+                        groups=hidden),
+            _ConvBNReLU(hidden, out_c, 1, act=False),
+        ]
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(nn.Layer):
+    """reference vision/models/mobilenetv2.py: inverted residuals with
+    linear bottlenecks."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        s = lambda c: _make_divisible(c * scale)
+        cfg = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2),
+               (6, 64, 4, 2), (6, 96, 3, 1), (6, 160, 3, 2),
+               (6, 320, 1, 1)]
+        layers = [_ConvBNReLU(3, s(32), 3, stride=2, padding=1)]
+        in_c = s(32)
+        for expand, c, n, stride in cfg:
+            for i in range(n):
+                layers.append(_InvertedResidual(
+                    in_c, s(c), stride if i == 0 else 1, expand))
+                in_c = s(c)
+        last = _make_divisible(1280 * max(1.0, scale))
+        layers.append(_ConvBNReLU(in_c, last, 1))
+        self.features = nn.Sequential(*layers)
+        self.with_pool = with_pool
+        self.flatten = nn.Flatten()
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        self.classifier = nn.Sequential(nn.Dropout(0.2),
+                                        nn.Linear(last, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        return self.classifier(self.flatten(x))
+
+
+def mobilenet_v1(scale=1.0, **kw):
+    return MobileNetV1(scale=scale, **kw)
+
+
+def mobilenet_v2(scale=1.0, **kw):
+    return MobileNetV2(scale=scale, **kw)

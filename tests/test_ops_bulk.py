@@ -133,12 +133,16 @@ case(op_type="logical_xor", inputs={"X": _bool_a, "Y": _bool_b},
 
 _cmp_a = np.array([[1, 5, 3], [2, 2, 7]], dtype="int32")
 _cmp_b = np.array([[1, 4, 3], [3, 2, 6]], dtype="int32")
-for opname, fn in [("equal", np.equal), ("not_equal", np.not_equal),
-                   ("less_equal", np.less_equal),
-                   ("greater_than", np.greater),
-                   ("greater_equal", np.greater_equal)]:
-    case(op_type=opname, inputs={"X": _cmp_a, "Y": _cmp_b},
-         outputs={"Out": fn(_cmp_a, _cmp_b)})
+case(op_type="equal", inputs={"X": _cmp_a, "Y": _cmp_b},
+     outputs={"Out": np.equal(_cmp_a, _cmp_b)})
+case(op_type="not_equal", inputs={"X": _cmp_a, "Y": _cmp_b},
+     outputs={"Out": np.not_equal(_cmp_a, _cmp_b)})
+case(op_type="less_equal", inputs={"X": _cmp_a, "Y": _cmp_b},
+     outputs={"Out": np.less_equal(_cmp_a, _cmp_b)})
+case(op_type="greater_than", inputs={"X": _cmp_a, "Y": _cmp_b},
+     outputs={"Out": np.greater(_cmp_a, _cmp_b)})
+case(op_type="greater_equal", inputs={"X": _cmp_a, "Y": _cmp_b},
+     outputs={"Out": np.greater_equal(_cmp_a, _cmp_b)})
 
 # -- binary elementwise -----------------------------------------------------
 
@@ -150,11 +154,14 @@ case(op_type="elementwise_pow", inputs={"X": _ew_x, "Y": _ew_y},
 _mm_x = randf(3, 4, seed=13)
 _mm_y = randf(3, 4, seed=14)
 _mm_y = np.where(np.abs(_mm_x - _mm_y) < 0.1, _mm_y + 0.3, _mm_y)
-for opname, fn in [("elementwise_max", np.maximum),
-                   ("elementwise_min", np.minimum),
-                   ("maximum", np.maximum), ("minimum", np.minimum)]:
-    case(op_type=opname, inputs={"X": _mm_x, "Y": _mm_y},
-         outputs={"Out": fn(_mm_x, _mm_y)}, grad=["X"])
+case(op_type="elementwise_max", inputs={"X": _mm_x, "Y": _mm_y},
+     outputs={"Out": np.maximum(_mm_x, _mm_y)}, grad=["X"])
+case(op_type="elementwise_min", inputs={"X": _mm_x, "Y": _mm_y},
+     outputs={"Out": np.minimum(_mm_x, _mm_y)}, grad=["X"])
+case(op_type="maximum", inputs={"X": _mm_x, "Y": _mm_y},
+     outputs={"Out": np.maximum(_mm_x, _mm_y)}, grad=["X"])
+case(op_type="minimum", inputs={"X": _mm_x, "Y": _mm_y},
+     outputs={"Out": np.minimum(_mm_x, _mm_y)}, grad=["X"])
 _mod_x = np.array([[7, -5, 9], [4, 11, -3]], dtype="int32")
 _mod_y = np.array([[3, 3, 4], [5, 4, 2]], dtype="int32")
 case(op_type="elementwise_mod", inputs={"X": _mod_x, "Y": _mod_y},
@@ -600,6 +607,48 @@ case(op_type="dpsgd",
      inputs={"Param": _opt_p, "Grad": _opt_g, "LearningRate": _opt_lr},
      outputs={"ParamOut": _opt_p - 0.1 * (_opt_g * _dp_scale)},
      attrs={"clip": 1.0, "batch_size": 4.0, "sigma": 0.0}, atol=1e-4)
+
+
+# -- unfold (im2col) --------------------------------------------------------
+
+_uf_x = randf(2, 3, 6, 6, seed=401)
+
+
+def _unfold_oracle(x, k, pad):
+    import torch
+
+    return torch.nn.functional.unfold(torch.tensor(x), k,
+                                      padding=pad).numpy()
+
+
+case(op_type="unfold", inputs={"X": _uf_x},
+     outputs={"Y": _unfold_oracle(_uf_x, 3, 1)},
+     attrs={"kernel_sizes": [3, 3], "strides": [1, 1],
+            "paddings": [1, 1], "dilations": [1, 1]},
+     grad=["X"], grad_out="Y", atol=1e-4)
+
+# -- adaptive pool, non-divisible + upsampling windows ----------------------
+
+
+def _adaptive_pool_oracle(x, oh, ow, mode):
+    import torch
+
+    t = torch.tensor(x)
+    if mode == "avg":
+        return torch.nn.functional.adaptive_avg_pool2d(t, (oh, ow)).numpy()
+    return torch.nn.functional.adaptive_max_pool2d(t, (oh, ow)).numpy()
+
+
+_ap_x = randf(2, 2, 5, 7, seed=402)
+case(op_type="pool2d", inputs={"X": _ap_x},
+     outputs={"Out": _adaptive_pool_oracle(_ap_x, 3, 3, "avg")},
+     attrs={"pooling_type": "avg", "adaptive": True, "ksize": [3, 3]},
+     atol=1e-5, id="pool2d_adaptive_nondiv")
+_ap_small = randf(1, 2, 2, 2, seed=403)
+case(op_type="pool2d", inputs={"X": _ap_small},
+     outputs={"Out": _adaptive_pool_oracle(_ap_small, 4, 4, "max")},
+     attrs={"pooling_type": "max", "adaptive": True, "ksize": [4, 4]},
+     atol=1e-5, id="pool2d_adaptive_upsample")
 
 
 # -- the runner -------------------------------------------------------------

@@ -195,6 +195,26 @@ class TestVisionModels:
             out = net(x)
             assert out.shape == [2, 7]
 
+    def test_vgg_mobilenet_forward(self):
+        import paddle_tpu as paddle
+
+        paddle.disable_static()
+        try:
+            x = paddle.to_tensor(
+                np.random.RandomState(0).randn(2, 3, 32, 32)
+                .astype("float32"))
+            for build in (lambda: vm.vgg11(num_classes=7),
+                          lambda: vm.mobilenet_v1(scale=0.25,
+                                                  num_classes=7),
+                          lambda: vm.mobilenet_v2(scale=0.25,
+                                                  num_classes=7)):
+                net = build()
+                net.eval()
+                out = net(x)
+                assert tuple(out.shape) == (2, 7)
+        finally:
+            paddle.enable_static()
+
     def test_resnet50_builds(self):
         with guard():
             paddle.seed(0)

@@ -33,6 +33,8 @@ PATTERNS = [
     r'append_op\(\s*"([\w@]+)"',
     r'trace_op\(\s*"([\w@]+)"',
     r'\.append_op\(\s*"([\w@]+)"',
+    # collective variants exercised through parametrize tables
+    r'"((?:c_|mp_)[a-z_0-9]+)"',
 ]
 
 # fluid.layers wrappers used by tests; a call to the wrapper exercises
@@ -66,8 +68,6 @@ def tested_ops(test_dir):
         for pat, ops in LAYER_WRAPPERS.items():
             if re.search(pat, s):
                 found |= set(ops)
-        # parametrized loops: for opname, fn in [("equal", ...), ...]
-        found |= set(re.findall(r'[\[(]\s*"([a-z_0-9]+)",\s*np\.', s))
     return found
 
 
