@@ -29,7 +29,8 @@ __all__ = [
     "equal", "not_equal", "less_than", "less_equal", "greater_than",
     "greater_equal", "logical_and", "logical_or", "logical_not",
     "logical_xor", "maximum", "minimum", "cumsum", "isfinite",
-    "interpolate", "py_func", "auc",
+    "interpolate", "py_func", "auc", "warpctc",
+    "ctc_greedy_decoder", "edit_distance",
 ]
 
 
@@ -877,3 +878,60 @@ def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
                "slide_steps": slide_steps, "curve": curve},
         infer_shape=False)
     return auc_out, [auc_out], [stat_pos, stat_neg]
+
+
+def warpctc(input, label, blank=0, norm_by_times=False,
+            input_length=None, label_length=None):
+    """CTC loss (reference layers/nn.py warpctc; operators/warpctc_op.cc).
+    Dense contract: input (T, B, C) raw logits, label (B, L) padded,
+    lengths explicit (the LoD-form variable-length encoding collapses to
+    the length vectors)."""
+    helper = LayerHelper("warpctc")
+    loss = helper.create_variable_for_type_inference(dtype=input.dtype)
+    ins = {"Logits": [input], "Label": [label]}
+    if input_length is not None:
+        ins["LogitsLength"] = [input_length]
+    if label_length is not None:
+        ins["LabelLength"] = [label_length]
+    helper.append_op("warpctc", inputs=ins, outputs={"Loss": [loss]},
+                     attrs={"blank": blank,
+                            "norm_by_times": norm_by_times},
+                     infer_shape=False)
+    return loss
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, name=None):
+    """Greedy CTC decode (reference layers/nn.py ctc_greedy_decoder):
+    argmax over classes, collapse repeats, drop blanks; returns
+    (decoded (B, T) front-packed, lengths (B, 1))."""
+    from .tensor import argmax
+
+    helper = LayerHelper("ctc_greedy_decoder")
+    ids = argmax(input, axis=-1)
+    out = helper.create_variable_for_type_inference(dtype="int64")
+    out_len = helper.create_variable_for_type_inference(dtype="int32")
+    ins = {"Input": [ids]}
+    if input_length is not None:
+        ins["InputLength"] = [input_length]
+    helper.append_op("ctc_align", inputs=ins,
+                     outputs={"Output": [out], "OutputLength": [out_len]},
+                     attrs={"blank": blank, "padding_value": 0},
+                     infer_shape=False)
+    return out, out_len
+
+
+def edit_distance(input, label, normalized=True, input_length=None,
+                  label_length=None, name=None):
+    """Levenshtein distance (reference layers/nn.py edit_distance)."""
+    helper = LayerHelper("edit_distance")
+    out = helper.create_variable_for_type_inference(dtype="float32")
+    seq_num = helper.create_variable_for_type_inference(dtype="int64")
+    ins = {"Hyps": [input], "Refs": [label]}
+    if input_length is not None:
+        ins["HypsLength"] = [input_length]
+    if label_length is not None:
+        ins["RefsLength"] = [label_length]
+    helper.append_op("edit_distance", inputs=ins,
+                     outputs={"Out": [out], "SequenceNum": [seq_num]},
+                     attrs={"normalized": normalized}, infer_shape=False)
+    return out, seq_num

@@ -125,6 +125,22 @@ def _conv3d(ctx, op, ins):
     return {"Output": [out]}
 
 
+def _adaptive_pool_axis(v, out_sz, axis, red):
+    """Interval pooling along one axis (reference adaptive_pool2d:
+    window i = [floor(i*S/out), ceil((i+1)*S/out))).  Output size is a
+    static attr so the loop unrolls at trace time; covers output >
+    input (windows of one repeated element)."""
+    size = v.shape[axis]
+    parts = []
+    for i in range(int(out_sz)):
+        a = (i * size) // out_sz
+        b = max(-(-((i + 1) * size) // out_sz), a + 1)
+        sl = [slice(None)] * v.ndim
+        sl[axis] = slice(a, b)
+        parts.append(red(v[tuple(sl)], axis=axis, keepdims=True))
+    return jnp.concatenate(parts, axis=axis)
+
+
 @register_op("pool2d")
 def _pool2d(ctx, op, ins):
     x = first(ins, "X")
@@ -142,22 +158,9 @@ def _pool2d(ctx, op, ins):
         if h % oh == 0 and w % ow == 0:
             x5 = x.reshape(x.shape[0], x.shape[1], oh, h // oh, ow, w // ow)
             return {"Out": [red(x5, axis=(3, 5))]}
-        # general interval pooling (reference adaptive_pool2d: window i =
-        # [floor(i*H/oh), ceil((i+1)*H/oh))) — output sizes are static
-        # attrs, so the window loop unrolls at trace time; also covers
-        # output > input (windows of one repeated element)
-        def pool_axis(v, out_sz, axis):
-            size = v.shape[axis]
-            parts = []
-            for i in range(int(out_sz)):
-                a = (i * size) // out_sz
-                b = max(-(-((i + 1) * size) // out_sz), a + 1)
-                sl = [slice(None)] * v.ndim
-                sl[axis] = slice(a, b)
-                parts.append(red(v[tuple(sl)], axis=axis, keepdims=True))
-            return jnp.concatenate(parts, axis=axis)
-
-        return {"Out": [pool_axis(pool_axis(x, oh, 2), ow, 3)]}
+        # general interval pooling: see _adaptive_pool_axis
+        return {"Out": [_adaptive_pool_axis(
+            _adaptive_pool_axis(x, oh, 2, red), ow, 3, red)]}
     ksize = tuple(op.attr("ksize", [2, 2]))
     strides = tuple(op.attr("strides", [1, 1]))
     pads = _conv_paddings(op.attr("padding_algorithm", "EXPLICIT"),
@@ -578,3 +581,135 @@ def _unfold(ctx, op, ins):
         rhs_dilation=dl)
     l = patches.shape[2] * patches.shape[3]
     return {"Y": [patches.reshape(n, c * ks[0] * ks[1], l)]}
+
+
+@register_op("hinge_loss")
+def _hinge_loss(ctx, op, ins):
+    """reference operators/hinge_loss_op.cc: max(1 - y*pred, 0) with
+    labels in {0, 1} mapped to {-1, +1}."""
+    logits = first(ins, "Logits")
+    labels = first(ins, "Labels").astype(logits.dtype)
+    y = 2.0 * labels - 1.0
+    return {"Loss": [jnp.maximum(1.0 - y * logits, 0.0)]}
+
+
+@register_op("data_norm")
+def _data_norm(ctx, op, ins):
+    """reference operators/data_norm_op.cc (CTR models): normalize by
+    accumulated batch statistics carried as functional state
+    (BatchSize/BatchSum/BatchSquareSum)."""
+    x = first(ins, "X")
+    bsize = first(ins, "BatchSize")
+    bsum = first(ins, "BatchSum")
+    bsq = first(ins, "BatchSquareSum")
+    eps = op.attr("epsilon", 1e-4)
+    # reference data_norm_op.cc: mean = sum/N, scale = sqrt(N/sum_sq)
+    # (sum_sq is accumulated CENTERED: sum((x-mean)^2) + N*eps, so no
+    # mean subtraction happens here)
+    means = bsum / bsize
+    scales = jnp.sqrt(bsize / bsq)
+    y = (x - means) * scales
+    outs = {"Y": [y], "Means": [means], "Scales": [scales]}
+    if "BatchSizeOut" in op.outputs:
+        n = jnp.asarray(x.shape[0], bsize.dtype)
+        outs["BatchSizeOut"] = [bsize + n]
+        outs["BatchSumOut"] = [bsum + jnp.sum(x, axis=0)]
+        outs["BatchSquareSumOut"] = [
+            bsq + jnp.sum(jnp.square(x - means), axis=0) + n * eps]
+    return outs
+
+
+@register_op("spp")
+def _spp(ctx, op, ins):
+    """Spatial pyramid pooling (reference operators/spp_op.cc): concat
+    flattened adaptive pools at 1x1, 2x2, ... 2^(L-1) bins."""
+    x = first(ins, "X")
+    levels = int(op.attr("pyramid_height", 3))
+    ptype = op.attr("pooling_type", "max")
+    red = jnp.max if ptype == "max" else jnp.mean
+    n, c, h, w = x.shape
+
+    outs = [_adaptive_pool_axis(
+        _adaptive_pool_axis(x, 2 ** l, 2, red), 2 ** l, 3, red)
+        .reshape(n, -1) for l in range(levels)]
+    return {"Out": [jnp.concatenate(outs, axis=1)]}
+
+
+@register_op("hierarchical_sigmoid")
+def _hsigmoid(ctx, op, ins):
+    """reference operators/hierarchical_sigmoid_op.cc: per-sample loss =
+    sum over tree-path nodes of BCE(w_node . x + b_node, code).  The
+    general custom-tree form: PathTable (B, P) node ids (pad < 0) and
+    PathCode (B, P) 0/1; without them, the complete-binary-tree path of
+    Label over num_classes is derived here (matching the reference
+    default tree)."""
+    x = first(ins, "X")                  # (B, D)
+    w = first(ins, "W")                  # (num_nodes, D)
+    label = first(ins, "Label")
+    bias = first(ins, "Bias", None)
+    path = first(ins, "PathTable", None)
+    code = first(ins, "PathCode", None)
+    if path is None:
+        import numpy as np
+
+        num_classes = int(op.attr("num_classes", 2))
+        depth = max(1, int(np.ceil(np.log2(max(num_classes, 2)))))
+        lab = label.reshape(-1).astype(jnp.int32)
+        # complete binary tree: internal node ids 0..num_classes-2;
+        # leaf for class c is node (c + num_classes - 1) in heap order
+        node = lab + (num_classes - 1)
+        paths, codes = [], []
+        for _ in range(depth):
+            parent = (node - 1) // 2
+            is_right = (node % 2 == 0)
+            paths.append(jnp.where(node > 0, parent, -1))
+            codes.append(is_right.astype(x.dtype))
+            node = parent
+        path = jnp.stack(paths[::-1], axis=1)
+        code = jnp.stack(codes[::-1], axis=1)
+    p_idx = jnp.maximum(path.astype(jnp.int32), 0)
+    valid = (path >= 0)
+    wsel = w[p_idx]                       # (B, P, D)
+    logits = jnp.einsum("bpd,bd->bp", wsel, x)
+    if bias is not None:
+        logits = logits + bias.reshape(-1)[p_idx]
+    codef = code.astype(logits.dtype)
+    bce = (codef * (-jax.nn.log_sigmoid(logits))
+           + (1 - codef) * (-jax.nn.log_sigmoid(-logits)))
+    bce = jnp.where(valid, bce, 0.0)
+    return {"Out": [jnp.sum(bce, axis=1, keepdims=True)],
+            "PreOut": [logits]}
+
+
+@register_op("nce")
+def _nce(ctx, op, ins):
+    """Noise-contrastive estimation (reference operators/nce_op.cc):
+    logistic loss over the true class vs num_neg_samples noise classes
+    drawn from the uniform sampler (sampler attr 0).  Custom samplers
+    and SelectedRows-sparse weight grads are GPU/PS mechanics the TPU
+    build does not carry; the dense grad is XLA's scatter-add."""
+    x = first(ins, "Input")              # (B, D)
+    label = first(ins, "Label")          # (B, T)
+    w = first(ins, "Weight")             # (V, D)
+    bias = first(ins, "Bias", None)
+    total = int(op.attr("num_total_classes", w.shape[0]))
+    k = int(op.attr("num_neg_samples", 10))
+    b = x.shape[0]
+    lab = label.astype(jnp.int32).reshape(b, -1)
+    num_true = lab.shape[1]
+    samples = jax.random.randint(ctx.rng_key(op), (b, k), 0, total,
+                                 dtype=jnp.int32)
+    ids = jnp.concatenate([lab, samples], axis=1)   # (B, T+K)
+    logits = jnp.einsum("btd,bd->bt", w[ids], x)
+    if bias is not None:
+        logits = logits + bias.reshape(-1)[ids]
+    # reference nce_op.h:250,273: o = sigmoid(z); with uniform noise
+    # kq = num_neg_samples/total, pos cost = -log(o/(o+kq)) and
+    # neg cost = -log(kq/(o+kq)); SampleLogits carries the ACTIVATED o
+    o = jax.nn.sigmoid(logits)
+    kq = jnp.asarray(k / total, o.dtype)
+    pos = -jnp.log(o[:, :num_true] / (o[:, :num_true] + kq)).sum(axis=1)
+    neg = -jnp.log(kq / (o[:, num_true:] + kq)).sum(axis=1)
+    cost = (pos + neg).reshape(b, 1)
+    return {"Cost": [cost], "SampleLogits": [o],
+            "SampleLabels": [ids]}

@@ -217,3 +217,155 @@ def _beam_search_decode(ctx, op, ins):
     scores = first(ins, "Scores")
     return {"SentenceIds": [dense_beam_backtrack(ids, parents)],
             "SentenceScores": [scores[-1]]}
+
+
+@register_op("warpctc")
+def _warpctc(ctx, op, ins):
+    """CTC loss (reference operators/warpctc_op.cc wrapping the warp-ctc
+    library).  TPU re-design: the forward-backward recursion runs as a
+    lax.scan over time in log space — pure jnp ops, so jax autodiff
+    yields the gradient and no hand-written backward kernel (warp-ctc's
+    GPU kernels) is needed.
+
+    Inputs (norm_by_times/padding contract of the 2.0 API):
+      Logits (T, B, C) raw activations (softmax applied here, matching
+      the reference), Label (B, L) int padded with blank,
+      LogitsLength (B,), LabelLength (B,).
+    Attr: blank (default 0).
+    Outputs: Loss (B, 1); WarpCTCGrad is internal in the reference and
+    not materialized here (autodiff owns it).
+    """
+    logits = first(ins, "Logits")
+    label = first(ins, "Label")
+    logits_len = first(ins, "LogitsLength", None)
+    label_len = first(ins, "LabelLength", None)
+    blank = int(op.attr("blank", 0))
+    t_max, b, c = logits.shape
+    l_max = label.shape[1]
+    if logits_len is None:
+        logits_len = jnp.full((b,), t_max, jnp.int32)
+    if label_len is None:
+        label_len = jnp.full((b,), l_max, jnp.int32)
+    logits_len = logits_len.reshape(b).astype(jnp.int32)
+    label_len = label_len.reshape(b).astype(jnp.int32)
+
+    log_probs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    neg_inf = jnp.float32(-1e30)
+
+    # extended label sequence: blank, l1, blank, l2, ... blank  (2L+1)
+    s_max = 2 * l_max + 1
+    lab = label.astype(jnp.int32)
+    ext = jnp.full((b, s_max), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(lab)
+    # transition mask: alpha[s] may come from s, s-1, and s-2 when
+    # ext[s] != blank and ext[s] != ext[s-2]
+    same_as_2back = jnp.concatenate(
+        [jnp.ones((b, 2), bool),
+         ext[:, 2:] == ext[:, :-2]], axis=1)
+    can_skip = (ext != blank) & jnp.logical_not(same_as_2back)
+
+    def shift(a, k):
+        pad = jnp.full((b, k), neg_inf, a.dtype)
+        return jnp.concatenate([pad, a[:, :-k]], axis=1) if k else a
+
+    # init: alpha_0 = p(blank) at s=0, p(l1) at s=1
+    p0 = log_probs[0]  # (B, C)
+    alpha0 = jnp.full((b, s_max), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(p0[jnp.arange(b), blank])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(label_len > 0, p0[jnp.arange(b), ext[:, 1]], neg_inf))
+
+    def step(alpha, t):
+        stay = alpha
+        from1 = shift(alpha, 1)
+        from2 = jnp.where(can_skip, shift(alpha, 2), neg_inf)
+        merged = jnp.logaddexp(jnp.logaddexp(stay, from1), from2)
+        emit = jnp.take_along_axis(log_probs[t], ext, axis=1)
+        new = merged + emit
+        # frozen past each row's logits length
+        new = jnp.where((t < logits_len)[:, None], new, alpha)
+        return new, None
+
+    alpha_T, _ = lax.scan(step, alpha0, jnp.arange(1, t_max))
+    # loss = -log(alpha[S-1] + alpha[S-2]) at S = 2*label_len+1
+    s_last = 2 * label_len  # index of final blank
+    idx_b = jnp.arange(b)
+    a_last = alpha_T[idx_b, s_last]
+    a_prev = jnp.where(label_len > 0,
+                       alpha_T[idx_b, jnp.maximum(s_last - 1, 0)],
+                       neg_inf)
+    loss = -jnp.logaddexp(a_last, a_prev)
+    if op.attr("norm_by_times", False):
+        loss = loss / jnp.maximum(logits_len.astype(loss.dtype), 1.0)
+    return {"Loss": [loss.reshape(b, 1)]}
+
+
+@register_op("ctc_align")
+def _ctc_align(ctx, op, ins):
+    """Greedy CTC decode (reference operators/ctc_align_op.cc): collapse
+    repeats, drop blanks; static-shape form front-packs survivors and
+    pads with `padding_value`."""
+    x = first(ins, "Input")  # (B, T) argmax ids
+    blank = int(op.attr("blank", 0))
+    pad_value = int(op.attr("padding_value", 0))
+    in_len = first(ins, "InputLength", None)
+    if in_len is not None:
+        # steps past each row's length decode as blank (reference
+        # ctc_align_op.h iterates only i < input_length)
+        t = jnp.arange(x.shape[1], dtype=jnp.int32)[None, :]
+        x = jnp.where(t < in_len.reshape(-1, 1).astype(jnp.int32), x,
+                      jnp.asarray(blank, x.dtype))
+    prev = jnp.concatenate(
+        [jnp.full((x.shape[0], 1), -1, x.dtype), x[:, :-1]], axis=1)
+    keep = (x != blank) & (x != prev)
+    order = jnp.argsort(jnp.logical_not(keep), axis=1, stable=True)
+    packed = jnp.take_along_axis(x, order, axis=1)
+    n = jnp.sum(keep, axis=1).astype(jnp.int32)
+    t = jnp.arange(x.shape[1], dtype=jnp.int32)[None, :]
+    out = jnp.where(t < n[:, None], packed,
+                    jnp.asarray(pad_value, x.dtype))
+    return {"Output": [out], "OutputLength": [n.reshape(-1, 1)]}
+
+
+@register_op("edit_distance")
+def _edit_distance(ctx, op, ins):
+    """Levenshtein distance (reference operators/edit_distance_op.cc):
+    DP over the reference strings via lax.scan; rows beyond each
+    sequence's length are masked out of the recursion."""
+    hyp = first(ins, "Hyps").astype(jnp.int32)      # (B, L1)
+    ref = first(ins, "Refs").astype(jnp.int32)      # (B, L2)
+    hyp_len = first(ins, "HypsLength", None)
+    ref_len = first(ins, "RefsLength", None)
+    b, l1 = hyp.shape
+    l2 = ref.shape[1]
+    hyp_len = (jnp.full((b,), l1, jnp.int32) if hyp_len is None
+               else hyp_len.reshape(b).astype(jnp.int32))
+    ref_len = (jnp.full((b,), l2, jnp.int32) if ref_len is None
+               else ref_len.reshape(b).astype(jnp.int32))
+    # dp over hyp positions; row = distances against ref prefix
+    row0 = jnp.broadcast_to(jnp.arange(l2 + 1, dtype=jnp.int32),
+                            (b, l2 + 1))
+    # clamp at ref_len so positions past the end don't contribute
+    def step(row, i):
+        hy = hyp[:, i]
+        sub_cost = (hy[:, None] != ref).astype(jnp.int32)
+        new0 = jnp.where(i < hyp_len, row[:, 0] + 1, row[:, 0])
+
+        def col(carry, j):
+            prev_new = carry
+            cand = jnp.minimum(
+                jnp.minimum(row[:, j + 1] + 1, prev_new + 1),
+                row[:, j] + sub_cost[:, j])
+            cand = jnp.where(i < hyp_len, cand, row[:, j + 1])
+            return cand, cand
+
+        _, cols = lax.scan(col, new0, jnp.arange(l2))
+        new_row = jnp.concatenate([new0[:, None], cols.T], axis=1)
+        return new_row, None
+
+    row_final, _ = lax.scan(step, row0, jnp.arange(l1))
+    dist = row_final[jnp.arange(b), ref_len].astype(jnp.float32)
+    if op.attr("normalized", True):
+        dist = dist / jnp.maximum(ref_len.astype(jnp.float32), 1.0)
+    return {"Out": [dist.reshape(b, 1)],
+            "SequenceNum": [jnp.asarray(b, jnp.int64)]}

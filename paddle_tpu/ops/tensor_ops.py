@@ -583,3 +583,24 @@ def _assign_value(ctx, op, ins):
 
     arr = np.asarray(vals).reshape(op.attr("shape", None) or np.shape(vals))
     return {"Out": [jnp.asarray(arr, dtype=jdt(op.attr("dtype", "float32")))]}
+
+
+@register_op("masked_select")
+def _masked_select(ctx, op, ins):
+    """reference operators/masked_select_op.cc returns a dynamic-length
+    vector; the static-shape form front-packs the selected elements into
+    a flat buffer of x.size zeros-padded, with the count as a second
+    output (same contract as the sequence front-pack family)."""
+    x = first(ins, "X")
+    mask = first(ins, "Mask")
+    flat = x.reshape(-1)
+    mflat = jnp.broadcast_to(mask, x.shape).reshape(-1)
+    order = jnp.argsort(jnp.logical_not(mflat), stable=True)
+    packed = flat[order]
+    n = jnp.sum(mflat).astype(jnp.int32)
+    keep = jnp.arange(flat.shape[0], dtype=jnp.int32) < n
+    out = jnp.where(keep, packed, jnp.zeros((), x.dtype))
+    outs = {"Y": [out]}
+    if "Count" in op.outputs:
+        outs["Count"] = [n]
+    return outs

@@ -1,0 +1,216 @@
+"""CTC family tests: warpctc vs torch.nn.functional.ctc_loss,
+ctc_align greedy collapse, edit_distance vs a numpy Levenshtein oracle
+(reference unittests: test_warpctc_op.py, test_ctc_align.py,
+test_edit_distance_op.py), plus hinge_loss / data_norm / masked_select.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework, unique_name
+from paddle_tpu.fluid.executor import Scope, scope_guard
+
+from op_test import OpTest, randf
+
+
+def run_op(op_type, inputs, attrs, out_slots, out_dtypes=None):
+    t = OpTest()
+    t.op_type, t.inputs, t.attrs = op_type, inputs, attrs
+    t.outputs = {s: np.zeros(1, (out_dtypes or {}).get(s, "float32"))
+                 for s in out_slots}
+    main, startup, feed, fetch_names, _ = t._build()
+    with scope_guard(Scope()):
+        exe = fluid.Executor()
+        outs = exe.run(main, feed=feed,
+                       fetch_list=[n for _, _, n in fetch_names])
+    return {slot: np.asarray(o)
+            for (slot, i, n), o in zip(fetch_names, outs)}
+
+
+class TestWarpCTC:
+    def test_matches_torch_ctc_loss(self):
+        import torch
+
+        rng = np.random.RandomState(0)
+        T, B, C, L = 12, 3, 6, 4
+        logits = rng.randn(T, B, C).astype("float32")
+        labels = rng.randint(1, C, (B, L)).astype("int32")
+        logit_len = np.array([12, 9, 7], "int32")
+        label_len = np.array([4, 3, 2], "int32")
+        d = run_op("warpctc",
+                   {"Logits": logits, "Label": labels,
+                    "LogitsLength": logit_len, "LabelLength": label_len},
+                   {"blank": 0}, ["Loss"])
+        ref = torch.nn.functional.ctc_loss(
+            torch.log_softmax(torch.tensor(logits), -1),
+            torch.tensor(labels.astype("int64")),
+            torch.tensor(logit_len.astype("int64")),
+            torch.tensor(label_len.astype("int64")),
+            blank=0, reduction="none").numpy()
+        np.testing.assert_allclose(d["Loss"].reshape(-1), ref,
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_grad_flows(self, fresh_programs):
+        main, startup, scope = fresh_programs
+        rng = np.random.RandomState(1)
+        lg = fluid.data("lg", [8, 2, 5], "float32")
+        lg.stop_gradient = False
+        lb = fluid.data("lb", [2, 3], "int32")
+        loss_var = main.global_block().create_var(name="ctcl",
+                                                  dtype="float32")
+        main.global_block().append_op(
+            "warpctc", inputs={"Logits": [lg], "Label": [lb]},
+            outputs={"Loss": [loss_var]}, attrs={"blank": 0},
+            infer_shape=False)
+        total = fluid.layers.reduce_sum(main.global_block().var("ctcl"))
+        fluid.append_backward(total)
+        exe = fluid.Executor()
+        g = exe.run(main,
+                    feed={"lg": rng.randn(8, 2, 5).astype("float32"),
+                          "lb": rng.randint(1, 5, (2, 3)).astype("int32")},
+                    fetch_list=[framework.grad_var_name("lg")])[0]
+        g = np.asarray(g)
+        assert g.shape == (8, 2, 5)
+        assert np.isfinite(g).all() and np.abs(g).max() > 0
+
+
+def test_ctc_align_respects_input_length():
+    ids = np.array([[1, 0, 2, 9, 9, 9]], "int32")
+    d = run_op("ctc_align",
+               {"Input": ids, "InputLength": np.array([[3]], "int32")},
+               {"blank": 0, "padding_value": -1},
+               ["Output", "OutputLength"],
+               {"Output": "int32", "OutputLength": "int32"})
+    # steps >= 3 are padding and must not decode
+    np.testing.assert_array_equal(d["Output"][0, :2], [1, 2])
+    assert np.all(d["Output"][0, 2:] == -1)
+    np.testing.assert_array_equal(d["OutputLength"].reshape(-1), [2])
+
+
+def test_ctc_align_collapse():
+    ids = np.array([[1, 1, 0, 2, 2, 0, 3],
+                    [0, 0, 4, 4, 4, 0, 0]], "int32")
+    d = run_op("ctc_align", {"Input": ids},
+               {"blank": 0, "padding_value": -1}, ["Output", "OutputLength"],
+               {"Output": "int32", "OutputLength": "int32"})
+    np.testing.assert_array_equal(d["Output"][0, :3], [1, 2, 3])
+    assert np.all(d["Output"][0, 3:] == -1)
+    np.testing.assert_array_equal(d["Output"][1, :1], [4])
+    np.testing.assert_array_equal(d["OutputLength"].reshape(-1), [3, 1])
+
+
+def _lev(a, b):
+    dp = np.zeros((len(a) + 1, len(b) + 1), int)
+    dp[:, 0] = np.arange(len(a) + 1)
+    dp[0, :] = np.arange(len(b) + 1)
+    for i in range(1, len(a) + 1):
+        for j in range(1, len(b) + 1):
+            dp[i, j] = min(dp[i - 1, j] + 1, dp[i, j - 1] + 1,
+                           dp[i - 1, j - 1] + (a[i - 1] != b[j - 1]))
+    return dp[len(a), len(b)]
+
+
+def test_edit_distance_matches_numpy():
+    rng = np.random.RandomState(2)
+    hyp = rng.randint(0, 5, (4, 6)).astype("int32")
+    ref = rng.randint(0, 5, (4, 7)).astype("int32")
+    hl = np.array([6, 4, 5, 2], "int32")
+    rl = np.array([7, 5, 3, 2], "int32")
+    d = run_op("edit_distance",
+               {"Hyps": hyp, "Refs": ref, "HypsLength": hl,
+                "RefsLength": rl},
+               {"normalized": False}, ["Out", "SequenceNum"],
+               {"SequenceNum": "int64"})
+    want = [_lev(list(hyp[i, :hl[i]]), list(ref[i, :rl[i]]))
+            for i in range(4)]
+    np.testing.assert_allclose(d["Out"].reshape(-1), want)
+    assert int(d["SequenceNum"]) == 4
+
+
+def test_hinge_loss():
+    logits = np.array([[0.5], [-2.0], [1.5]], "float32")
+    labels = np.array([[1.0], [0.0], [0.0]], "float32")
+    d = run_op("hinge_loss", {"Logits": logits, "Labels": labels}, {},
+               ["Loss"])
+    np.testing.assert_allclose(
+        d["Loss"], np.maximum(1 - (2 * labels - 1) * logits, 0),
+        rtol=1e-6)
+
+
+def test_data_norm():
+    rng = np.random.RandomState(3)
+    x = rng.randn(6, 4).astype("float32") * 3 + 1
+    bsize = np.full((4,), 100.0, "float32")
+    bsum = np.full((4,), 200.0, "float32")   # mean 2
+    bsq = np.full((4,), 500.0, "float32")
+    d = run_op("data_norm",
+               {"X": x, "BatchSize": bsize, "BatchSum": bsum,
+                "BatchSquareSum": bsq},
+               {"epsilon": 1e-4}, ["Y", "Means", "Scales"])
+    # reference formula: scale = sqrt(N / sum_sq) (sum_sq accumulated
+    # centered by the update path)
+    means = 200.0 / 100.0
+    scales = np.sqrt(100.0 / 500.0)
+    np.testing.assert_allclose(d["Means"], np.full(4, means), rtol=1e-5)
+    np.testing.assert_allclose(d["Y"], (x - means) * scales, rtol=1e-4)
+
+
+def test_masked_select_front_packs():
+    x = np.arange(12, dtype="float32").reshape(3, 4)
+    mask = x % 2 == 0
+    d = run_op("masked_select", {"X": x, "Mask": mask}, {}, ["Y"])
+    np.testing.assert_allclose(d["Y"][:6],
+                               np.array([0, 2, 4, 6, 8, 10], "float32"))
+    assert np.all(d["Y"][6:] == 0)
+
+
+def test_ctc_layers_api(fresh_programs):
+    main, startup, scope = fresh_programs
+    probs = fluid.data("probs", [2, 7, 5], "float32")
+    decoded, dlen = fluid.layers.ctc_greedy_decoder(probs, blank=0)
+    exe = fluid.Executor()
+    rng = np.random.RandomState(4)
+    P = rng.rand(2, 7, 5).astype("float32")
+    P[0, :, :] = 0
+    P[0, :3, 2] = 5.0  # -> [2,2,2, argmax rest 0...] collapses to [2]
+    o, ln = exe.run(main, feed={"probs": P}, fetch_list=[decoded, dlen])
+    assert np.asarray(o)[0, 0] == 2
+    assert np.asarray(ln).reshape(-1)[0] >= 1
+
+
+def test_spp_concats_pyramid():
+    x = np.random.RandomState(5).randn(2, 3, 8, 8).astype("float32")
+    d = run_op("spp", {"X": x},
+               {"pyramid_height": 3, "pooling_type": "max"}, ["Out"])
+    # 1 + 4 + 16 bins per channel
+    assert d["Out"].shape == (2, 3 * 21)
+    np.testing.assert_allclose(d["Out"][:, :3],
+                               x.max(axis=(2, 3)), rtol=1e-5)
+
+
+def test_hsigmoid_binary_tree_loss_positive():
+    rng = np.random.RandomState(6)
+    x = rng.randn(4, 5).astype("float32")
+    num_classes = 8
+    w = rng.randn(num_classes - 1, 5).astype("float32") * 0.1
+    label = rng.randint(0, num_classes, (4, 1)).astype("int32")
+    d = run_op("hierarchical_sigmoid",
+               {"X": x, "W": w, "Label": label},
+               {"num_classes": num_classes}, ["Out", "PreOut"])
+    assert d["Out"].shape == (4, 1)
+    assert (d["Out"] > 0).all() and np.isfinite(d["Out"]).all()
+
+
+def test_nce_cost_shape_and_finite():
+    rng = np.random.RandomState(7)
+    x = rng.randn(4, 6).astype("float32")
+    w = rng.randn(20, 6).astype("float32") * 0.1
+    label = rng.randint(0, 20, (4, 1)).astype("int32")
+    d = run_op("nce", {"Input": x, "Label": label, "Weight": w},
+               {"num_total_classes": 20, "num_neg_samples": 5},
+               ["Cost", "SampleLogits", "SampleLabels"],
+               {"SampleLabels": "int32"})
+    assert d["Cost"].shape == (4, 1)
+    assert np.isfinite(d["Cost"]).all() and (d["Cost"] > 0).all()
+    assert d["SampleLabels"].shape == (4, 6)
