@@ -486,3 +486,107 @@ class DGCMomentumOptimizer(Optimizer):
 
 
 DGCMomentum = DGCMomentumOptimizer
+
+
+class DecayedAdagradOptimizer(Optimizer):
+    """reference fluid/optimizer.py DecayedAdagradOptimizer
+    (optimizers/decayed_adagrad_op.cc)."""
+
+    type = "decayed_adagrad"
+
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._decay, self._epsilon = decay, epsilon
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m = self._add_accumulator("moment", p)
+        return block.append_op(
+            "decayed_adagrad",
+            inputs={"Param": [p], "Grad": [g], "Moment": [m],
+                    "LearningRate": [self._global_learning_rate()]},
+            outputs={"ParamOut": [p], "MomentOut": [m]},
+            attrs=self._opt_attrs({"decay": self._decay,
+                                   "epsilon": self._epsilon}),
+            infer_shape=False)
+
+
+class ProximalGDOptimizer(Optimizer):
+    """reference ProximalGDOptimizer (optimizers/proximal_gd_op.cc)."""
+
+    type = "proximal_gd"
+
+    def __init__(self, learning_rate, l1_regularization_strength=0.0,
+                 l2_regularization_strength=0.0, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._l1 = l1_regularization_strength
+        self._l2 = l2_regularization_strength
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            "proximal_gd",
+            inputs={"Param": [p], "Grad": [g],
+                    "LearningRate": [self._global_learning_rate()]},
+            outputs={"ParamOut": [p]},
+            attrs=self._opt_attrs({"l1": self._l1, "l2": self._l2}),
+            infer_shape=False)
+
+
+class ProximalAdagradOptimizer(Optimizer):
+    """reference ProximalAdagradOptimizer
+    (optimizers/proximal_adagrad_op.cc)."""
+
+    type = "proximal_adagrad"
+
+    def __init__(self, learning_rate, initial_accumulator_value=0.1,
+                 l1_regularization_strength=0.0,
+                 l2_regularization_strength=0.0, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._initial = initial_accumulator_value
+        self._l1 = l1_regularization_strength
+        self._l2 = l2_regularization_strength
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m = self._add_accumulator("moment", p, fill_value=self._initial)
+        return block.append_op(
+            "proximal_adagrad",
+            inputs={"Param": [p], "Grad": [g], "Moment": [m],
+                    "LearningRate": [self._global_learning_rate()]},
+            outputs={"ParamOut": [p], "MomentOut": [m]},
+            attrs=self._opt_attrs({"l1": self._l1, "l2": self._l2}),
+            infer_shape=False)
+
+
+class FtrlOptimizer(Optimizer):
+    """reference FtrlOptimizer (optimizers/ftrl_op.h)."""
+
+    type = "ftrl"
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5,
+                 **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        sq = self._add_accumulator("squared", p)
+        lin = self._add_accumulator("linear", p)
+        return block.append_op(
+            "ftrl",
+            inputs={"Param": [p], "Grad": [g],
+                    "SquaredAccumulator": [sq],
+                    "LinearAccumulator": [lin],
+                    "LearningRate": [self._global_learning_rate()]},
+            outputs={"ParamOut": [p], "SquaredAccumOut": [sq],
+                     "LinearAccumOut": [lin]},
+            attrs=self._opt_attrs({"l1": self._l1, "l2": self._l2,
+                                   "lr_power": self._lr_power}),
+            infer_shape=False)
+
+
+DecayedAdagrad = DecayedAdagradOptimizer
+ProximalGD = ProximalGDOptimizer
+ProximalAdagrad = ProximalAdagradOptimizer
+Ftrl = FtrlOptimizer

@@ -1,12 +1,13 @@
 """Detection op lowerings — SSD / RPN / YOLO building blocks.
 
 Reference: /root/reference/paddle/fluid/operators/detection/ (31 ops).
-This module implements the core set every detection pipeline composes —
-prior_box, anchor_generator, box_coder, iou_similarity, box_clip,
-bipartite_match, multiclass_nms(+v2/v3), yolo_box, sigmoid_focal_loss,
-roi_align.  The long tail (generate_proposals, matrix_nms, FPN
-redistribution, mask utilities) raises through the registry's
-unknown-op error until added.
+This module implements the set every detection pipeline composes —
+prior_box, density_prior_box, anchor_generator, box_coder,
+iou_similarity, box_clip, bipartite_match, multiclass_nms(+v2/v3),
+yolo_box, sigmoid_focal_loss, roi_align, target_assign,
+mine_hard_examples, polygon_box_transform.  The remaining tail
+(generate_proposals, matrix_nms, FPN redistribution, mask utilities)
+raises through the registry's unknown-op error until added.
 
 TPU re-design notes:
 - prior_box / anchor_generator are SHAPE-only functions of static attrs:
@@ -513,3 +514,119 @@ def _roi_align(ctx, op, ins):
 
     out = jax.vmap(one_roi)(rois, batch_idx)
     return {"Out": [out]}
+
+
+@register_op("density_prior_box")
+def _density_prior_box(ctx, op, ins):
+    """Density priors (reference detection/density_prior_box_op.h) —
+    like prior_box, a pure function of shapes and static attrs, built
+    in numpy at trace time."""
+    feat = first(ins, "Input")
+    img = first(ins, "Image")
+    fh, fw = int(feat.shape[2]), int(feat.shape[3])
+    ih, iw = int(img.shape[2]), int(img.shape[3])
+    fixed_sizes = [float(s) for s in op.attr("fixed_sizes", [])]
+    fixed_ratios = [float(r) for r in op.attr("fixed_ratios", [1.0])]
+    densities = [int(d) for d in op.attr("densities", [])]
+    variances = [float(v) for v in op.attr("variances",
+                                           [0.1, 0.1, 0.2, 0.2])]
+    step_w = op.attr("step_w", 0.0) or iw / fw
+    step_h = op.attr("step_h", 0.0) or ih / fh
+    offset = op.attr("offset", 0.5)
+    clip = op.attr("clip", False)
+    step_avg = int((step_w + step_h) * 0.5)
+    num_priors = sum(len(fixed_ratios) * d * d for d in densities)
+    b = np.zeros((fh, fw, num_priors, 4), np.float32)
+    for h in range(fh):
+        for w in range(fw):
+            cx = (w + offset) * step_w
+            cy = (h + offset) * step_h
+            idx = 0
+            for size, density in zip(fixed_sizes, densities):
+                shift = step_avg // density
+                for r in fixed_ratios:
+                    bw = size * math.sqrt(r)
+                    bhh = size / math.sqrt(r)
+                    dcx = cx - step_avg / 2.0 + shift / 2.0
+                    dcy = cy - step_avg / 2.0 + shift / 2.0
+                    for di in range(density):
+                        for dj in range(density):
+                            cxt = dcx + dj * shift
+                            cyt = dcy + di * shift
+                            b[h, w, idx] = [
+                                max((cxt - bw / 2.0) / iw, 0.0),
+                                max((cyt - bhh / 2.0) / ih, 0.0),
+                                min((cxt + bw / 2.0) / iw, 1.0),
+                                min((cyt + bhh / 2.0) / ih, 1.0)]
+                            idx += 1
+    if clip:
+        b = np.clip(b, 0.0, 1.0)
+    v = np.broadcast_to(np.asarray(variances, np.float32), b.shape).copy()
+    return {"Boxes": [jnp.asarray(b)], "Variances": [jnp.asarray(v)]}
+
+
+@register_op("polygon_box_transform")
+def _polygon_box_transform(ctx, op, ins):
+    """reference detection/polygon_box_transform_op.cc (EAST text
+    detection): for active cells, offsets become absolute quad
+    coordinates: out = 4*cell_coord - in."""
+    x = first(ins, "Input")  # (N, geo=8k, H, W)
+    n, g, h, w = x.shape
+    col = jnp.broadcast_to(jnp.arange(w, dtype=x.dtype), (h, w))
+    row = jnp.broadcast_to(jnp.arange(h, dtype=x.dtype)[:, None], (h, w))
+    # even channels are x-offsets (use col), odd are y-offsets (use row)
+    base = jnp.stack([col if i % 2 == 0 else row for i in range(g)])
+    return {"Output": [4.0 * base[None] - x]}
+
+
+@register_op("target_assign")
+def _target_assign(ctx, op, ins):
+    """reference detection/target_assign_op.cc: out[i, j] =
+    X[i, match[i, j]] for matched columns (match >= 0), `mismatch_value`
+    elsewhere; OutWeight 1 for matched, 0 otherwise.  The reference
+    reads X through a per-image LoD (NegIndices path); dense form takes
+    X already batched (B, G, K)."""
+    x = first(ins, "X")                      # (B, G, K)
+    match = first(ins, "MatchIndices")       # (B, M) int32
+    mismatch = op.attr("mismatch_value", 0)
+    m = match.astype(jnp.int32)
+    safe = jnp.clip(m, 0, x.shape[1] - 1)
+    gathered = jnp.take_along_axis(x, safe[..., None], axis=1)
+    matched = (m >= 0)[..., None]
+    out = jnp.where(matched, gathered,
+                    jnp.asarray(mismatch, x.dtype))
+    wt = matched.astype(jnp.float32)
+    return {"Out": [out], "OutWeight": [wt]}
+
+
+@register_op("mine_hard_examples")
+def _mine_hard_examples(ctx, op, ins):
+    """reference detection/mine_hard_examples_op.cc (SSD hard-negative
+    mining, max_negative mode): keep the highest-loss unmatched priors
+    up to neg_pos_ratio * num_positives per image.  The reference emits
+    ragged NegIndices LoD; the dense form returns a 0/1 negative mask
+    (B, M) in NegIndices plus UpdatedMatchIndices where un-selected
+    negatives stay -1."""
+    cls_loss = first(ins, "ClsLoss")          # (B, M)
+    match = first(ins, "MatchIndices").astype(jnp.int32)  # (B, M)
+    ratio = op.attr("neg_pos_ratio", 3.0)
+    mining = op.attr("mining_type", "max_negative")
+    if mining != "max_negative":
+        raise NotImplementedError(
+            "mine_hard_examples: only max_negative mining is "
+            "implemented (hard_example mode needs sample_size "
+            "semantics nobody's TPU configs use)")
+    # reference mine_hard_examples_op.cc: max_negative ranks by
+    # cls_loss ALONE (LocLoss joins only in hard_example mode), selects
+    # num_pos*ratio negatives with NO floor (an image with zero
+    # positives keeps zero negatives), and ignores sample_size
+    loss = cls_loss
+    is_neg = match < 0
+    n_pos = jnp.sum(match >= 0, axis=1)
+    n_neg_max = (n_pos.astype(jnp.float32) * ratio).astype(jnp.int32)
+    neg_loss = jnp.where(is_neg, loss, -jnp.inf)
+    order = jnp.argsort(-neg_loss, axis=1)
+    rank = jnp.argsort(order, axis=1)  # rank of each prior by neg loss
+    selected = is_neg & (rank < n_neg_max[:, None])
+    return {"NegIndices": [selected.astype(jnp.int32)],
+            "UpdatedMatchIndices": [match]}

@@ -650,8 +650,6 @@ def _hsigmoid(ctx, op, ins):
     path = first(ins, "PathTable", None)
     code = first(ins, "PathCode", None)
     if path is None:
-        import numpy as np
-
         num_classes = int(op.attr("num_classes", 2))
         depth = max(1, int(np.ceil(np.log2(max(num_classes, 2)))))
         lab = label.reshape(-1).astype(jnp.int32)

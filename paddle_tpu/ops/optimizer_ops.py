@@ -308,3 +308,75 @@ def _dgc(ctx, op, ins):
     return {"U_out": [u_new * (1.0 - mask)],
             "V_out": [v_new * (1.0 - mask)],
             "EncodeGrad": [encode]}
+
+
+@register_op("decayed_adagrad")
+def _decayed_adagrad(ctx, op, ins):
+    """reference optimizers/decayed_adagrad_op.cc."""
+    p = first(ins, "Param")
+    g = first(ins, "Grad").astype(p.dtype)
+    m = first(ins, "Moment")
+    lr = first(ins, "LearningRate").astype(p.dtype)
+    decay = op.attr("decay", 0.95)
+    eps = op.attr("epsilon", 1e-6)
+    m_out = decay * m + (1 - decay) * jnp.square(g)
+    p_out = p - lr * g / (jnp.sqrt(m_out) + eps)
+    return {"ParamOut": [p_out], "MomentOut": [m_out]}
+
+
+@register_op("proximal_gd")
+def _proximal_gd(ctx, op, ins):
+    """reference optimizers/proximal_gd_op.cc: gradient step then the
+    l1/l2 proximal shrink."""
+    p = first(ins, "Param")
+    g = first(ins, "Grad").astype(p.dtype)
+    lr = first(ins, "LearningRate").astype(p.dtype)
+    l1 = op.attr("l1", 0.0)
+    l2 = op.attr("l2", 0.0)
+    prox = p - lr * g
+    p_out = (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+             / (1.0 + lr * l2))
+    return {"ParamOut": [p_out]}
+
+
+@register_op("proximal_adagrad")
+def _proximal_adagrad(ctx, op, ins):
+    """reference optimizers/proximal_adagrad_op.cc."""
+    p = first(ins, "Param")
+    g = first(ins, "Grad").astype(p.dtype)
+    m = first(ins, "Moment")
+    lr = first(ins, "LearningRate").astype(p.dtype)
+    l1 = op.attr("l1", 0.0)
+    l2 = op.attr("l2", 0.0)
+    m_out = m + jnp.square(g)
+    lr_t = lr / jnp.sqrt(m_out)
+    prox = p - lr_t * g
+    p_out = (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr_t * l1, 0.0)
+             / (1.0 + lr_t * l2))
+    return {"ParamOut": [p_out], "MomentOut": [m_out]}
+
+
+@register_op("ftrl")
+def _ftrl(ctx, op, ins):
+    """reference optimizers/ftrl_op.h (FTRL-proximal)."""
+    p = first(ins, "Param")
+    g = first(ins, "Grad").astype(p.dtype)
+    sq = first(ins, "SquaredAccumulator")
+    lin = first(ins, "LinearAccumulator")
+    lr = first(ins, "LearningRate").astype(p.dtype)
+    l1 = op.attr("l1", 0.0)
+    l2 = op.attr("l2", 0.0)
+    lr_power = op.attr("lr_power", -0.5)
+    new_sq = sq + jnp.square(g)
+    if lr_power == -0.5:
+        sigma = (jnp.sqrt(new_sq) - jnp.sqrt(sq)) / lr
+        y = jnp.sqrt(new_sq) / lr + 2.0 * l2
+    else:
+        sigma = (jnp.power(new_sq, -lr_power)
+                 - jnp.power(sq, -lr_power)) / lr
+        y = jnp.power(new_sq, -lr_power) / lr + 2.0 * l2
+    lin_out = lin + g - sigma * p
+    x = l1 * jnp.sign(lin_out) - lin_out
+    p_out = jnp.where(jnp.abs(lin_out) > l1, x / y, jnp.zeros_like(p))
+    return {"ParamOut": [p_out], "SquaredAccumOut": [new_sq],
+            "LinearAccumOut": [lin_out]}

@@ -216,6 +216,12 @@ def _flash_forward(q, k, v, kbias, seed, heads, is_causal=False, scale=None,
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
+        # bh/iq steps write disjoint outputs -> parallel lets Mosaic
+        # double-buffer DMA across grid steps (the (bh, 1, 1) grid at
+        # 512-blocks is otherwise serialized per-step overhead); ik
+        # accumulates in scratch -> arbitrary
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(seed, q, k, v, kbias)
     return out, lse
@@ -383,6 +389,8 @@ def _flash_backward(q, k, v, kbias, seed, out, lse, g, heads,
                    jax.ShapeDtypeStruct((bh, sk, d), v.dtype)],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(seed, q, g, lse, delta, k, v, kbias)
 
@@ -394,6 +402,8 @@ def _flash_backward(q, k, v, kbias, seed, out, lse, g, heads,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(seed, q, g, lse, delta, k, v, kbias)
     return dq, dk, dv

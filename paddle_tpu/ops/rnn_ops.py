@@ -296,7 +296,11 @@ def _warpctc(ctx, op, ins):
                        neg_inf)
     loss = -jnp.logaddexp(a_last, a_prev)
     if op.attr("norm_by_times", False):
-        loss = loss / jnp.maximum(logits_len.astype(loss.dtype), 1.0)
+        # reference warpctc_op.h scales only the GRADIENT by 1/T; the
+        # reported Loss stays unnormalized.  value(L) + grad(L/T):
+        t_inv = 1.0 / jnp.maximum(logits_len.astype(loss.dtype), 1.0)
+        loss = (lax.stop_gradient(loss)
+                + loss * t_inv - lax.stop_gradient(loss * t_inv))
     return {"Loss": [loss.reshape(b, 1)]}
 
 
@@ -368,4 +372,4 @@ def _edit_distance(ctx, op, ins):
     if op.attr("normalized", True):
         dist = dist / jnp.maximum(ref_len.astype(jnp.float32), 1.0)
     return {"Out": [dist.reshape(b, 1)],
-            "SequenceNum": [jnp.asarray(b, jnp.int64)]}
+            "SequenceNum": [jnp.asarray(b, jnp.int32)]}

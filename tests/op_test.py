@@ -154,3 +154,24 @@ class OpTest:
 def randf(*shape, low=-1.0, high=1.0, seed=None):
     rng = np.random.RandomState(seed if seed is not None else abs(hash(shape)) % 2**31)
     return rng.uniform(low, high, size=shape).astype("float32")
+
+
+def run_single_op(op_type, inputs, attrs, out_slots, out_dtypes=None):
+    """Build + run a one-op Program through the real Executor, returning
+    outputs by slot name (shared harness for the table-driven test
+    files)."""
+    import paddle_tpu.fluid as _fluid
+    from paddle_tpu.fluid.executor import Scope as _Scope
+    from paddle_tpu.fluid.executor import scope_guard as _scope_guard
+
+    t = OpTest()
+    t.op_type, t.inputs, t.attrs = op_type, inputs, attrs
+    t.outputs = {s: np.zeros(1, (out_dtypes or {}).get(s, "float32"))
+                 for s in out_slots}
+    main, startup, feed, fetch_names, _ = t._build()
+    with _scope_guard(_Scope()):
+        exe = _fluid.Executor()
+        outs = exe.run(main, feed=feed,
+                       fetch_list=[n for _, _, n in fetch_names])
+    return {slot: np.asarray(o)
+            for (slot, i, n), o in zip(fetch_names, outs)}

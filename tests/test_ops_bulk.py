@@ -739,3 +739,60 @@ def test_uniform_random_batch_size_like():
                          {"Out": np.zeros((7, 5), "float32")})["Out"]
     assert out.shape == (7, 5)
     assert out.min() >= 2.0 and out.max() < 3.0
+
+
+def test_extra_optimizer_ops():
+    """decayed_adagrad / proximal_gd / proximal_adagrad / ftrl vs numpy
+    oracles (reference optimizers/*.cc formulas)."""
+    from op_test import run_single_op as run
+
+    p = randf(3, 4, seed=501)
+    g = randf(3, 4, seed=502)
+    lr = np.array([0.1], "float32")
+
+    m = np.abs(randf(3, 4, seed=503))
+    d = run("decayed_adagrad",
+            {"Param": p, "Grad": g, "Moment": m, "LearningRate": lr},
+            {"decay": 0.9, "epsilon": 1e-6}, ["ParamOut", "MomentOut"])
+    mo = 0.9 * m + 0.1 * g ** 2
+    np.testing.assert_allclose(d["MomentOut"], mo, rtol=1e-5)
+    np.testing.assert_allclose(d["ParamOut"],
+                               p - 0.1 * g / (np.sqrt(mo) + 1e-6),
+                               rtol=1e-4)
+
+    d = run("proximal_gd",
+            {"Param": p, "Grad": g, "LearningRate": lr},
+            {"l1": 0.05, "l2": 0.01}, ["ParamOut"])
+    prox = p - 0.1 * g
+    want = np.sign(prox) * np.maximum(np.abs(prox) - 0.1 * 0.05, 0) \
+        / (1 + 0.1 * 0.01)
+    np.testing.assert_allclose(d["ParamOut"], want, rtol=1e-4, atol=1e-6)
+
+    d = run("proximal_adagrad",
+            {"Param": p, "Grad": g, "Moment": m, "LearningRate": lr},
+            {"l1": 0.05, "l2": 0.01}, ["ParamOut", "MomentOut"])
+    mo = m + g ** 2
+    lr_t = 0.1 / np.sqrt(mo)
+    prox = p - lr_t * g
+    want = np.sign(prox) * np.maximum(np.abs(prox) - lr_t * 0.05, 0) \
+        / (1 + lr_t * 0.01)
+    np.testing.assert_allclose(d["MomentOut"], mo, rtol=1e-5)
+    np.testing.assert_allclose(d["ParamOut"], want, rtol=1e-4, atol=1e-6)
+
+    sq = np.abs(randf(3, 4, seed=504)) + 0.1
+    lin = randf(3, 4, seed=505) * 0.1
+    d = run("ftrl",
+            {"Param": p, "Grad": g, "SquaredAccumulator": sq,
+             "LinearAccumulator": lin, "LearningRate": lr},
+            {"l1": 0.1, "l2": 0.01, "lr_power": -0.5},
+            ["ParamOut", "SquaredAccumOut", "LinearAccumOut"])
+    new_sq = sq + g ** 2
+    sigma = (np.sqrt(new_sq) - np.sqrt(sq)) / 0.1
+    lin_out = lin + g - sigma * p
+    y = np.sqrt(new_sq) / 0.1 + 2 * 0.01
+    x = 0.1 * np.sign(lin_out) - lin_out
+    want = np.where(np.abs(lin_out) > 0.1, x / y, 0.0)
+    np.testing.assert_allclose(d["SquaredAccumOut"], new_sq, rtol=1e-5)
+    np.testing.assert_allclose(d["LinearAccumOut"], lin_out, rtol=1e-4,
+                               atol=1e-6)
+    np.testing.assert_allclose(d["ParamOut"], want, rtol=1e-4, atol=1e-6)

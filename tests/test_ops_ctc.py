@@ -11,21 +11,11 @@ import paddle_tpu.fluid as fluid
 from paddle_tpu.fluid import framework, unique_name
 from paddle_tpu.fluid.executor import Scope, scope_guard
 
-from op_test import OpTest, randf
+from op_test import OpTest, randf, run_single_op
+
+run_op = run_single_op
 
 
-def run_op(op_type, inputs, attrs, out_slots, out_dtypes=None):
-    t = OpTest()
-    t.op_type, t.inputs, t.attrs = op_type, inputs, attrs
-    t.outputs = {s: np.zeros(1, (out_dtypes or {}).get(s, "float32"))
-                 for s in out_slots}
-    main, startup, feed, fetch_names, _ = t._build()
-    with scope_guard(Scope()):
-        exe = fluid.Executor()
-        outs = exe.run(main, feed=feed,
-                       fetch_list=[n for _, _, n in fetch_names])
-    return {slot: np.asarray(o)
-            for (slot, i, n), o in zip(fetch_names, outs)}
 
 
 class TestWarpCTC:

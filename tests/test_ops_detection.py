@@ -12,21 +12,11 @@ import pytest
 import paddle_tpu.fluid as fluid
 from paddle_tpu.fluid.executor import Scope, scope_guard
 
-from op_test import OpTest, randf
+from op_test import OpTest, randf, run_single_op
+
+run_det_op = run_single_op
 
 
-def run_det_op(op_type, inputs, attrs, out_slots, out_dtypes=None):
-    t = OpTest()
-    t.op_type, t.inputs, t.attrs = op_type, inputs, attrs
-    t.outputs = {s: np.zeros(1, (out_dtypes or {}).get(s, "float32"))
-                 for s in out_slots}
-    main, startup, feed, fetch_names, _ = t._build()
-    with scope_guard(Scope()):
-        exe = fluid.Executor()
-        outs = exe.run(main, feed=feed,
-                       fetch_list=[n for _, _, n in fetch_names])
-    return {slot: np.asarray(o)
-            for (slot, i, n), o in zip(fetch_names, outs)}
 
 
 def np_iou(a, b, off=0.0):
@@ -271,3 +261,63 @@ def test_detection_layers_build():
             fetch_list=[boxes, iou])
     assert np.asarray(bo).shape == (2, 2, 1, 4)
     assert np.asarray(io).shape == (3, 2)
+
+
+def test_density_prior_box_matches_reference_loop():
+    feat = np.zeros((1, 8, 2, 2), "float32")
+    img = np.zeros((1, 3, 32, 32), "float32")
+    d = run_det_op("density_prior_box", {"Input": feat, "Image": img},
+                   {"fixed_sizes": [4.0], "fixed_ratios": [1.0],
+                    "densities": [2], "variances": [0.1, 0.1, 0.2, 0.2],
+                    "offset": 0.5, "step_w": 0.0, "step_h": 0.0},
+                   ["Boxes", "Variances"])
+    boxes = d["Boxes"]
+    assert boxes.shape == (2, 2, 4, 4)  # 1 ratio * 2^2 density
+    # replicate the reference loop for cell (0, 0), first sub-box
+    step = 16.0
+    step_avg = int((step + step) * 0.5)
+    shift = step_avg // 2
+    cx = cy = 0.5 * step
+    dcx = cx - step_avg / 2.0 + shift / 2.0
+    want0 = [max((dcx - 2.0) / 32, 0), max((dcx - 2.0) / 32, 0),
+             min((dcx + 2.0) / 32, 1), min((dcx + 2.0) / 32, 1)]
+    np.testing.assert_allclose(boxes[0, 0, 0], want0, rtol=1e-5)
+
+
+def test_polygon_box_transform():
+    x = np.zeros((1, 8, 2, 2), "float32")
+    x[0, 0, 1, 1] = 1.0   # x-offset channel at cell (1,1)
+    d = run_det_op("polygon_box_transform", {"Input": x}, {}, ["Output"])
+    o = d["Output"]
+    # even channel uses column index: 4*col - in
+    assert o[0, 0, 1, 1] == 4.0 * 1 - 1.0
+    assert o[0, 0, 1, 0] == 0.0
+    # odd channel uses row index
+    assert o[0, 1, 1, 1] == 4.0 * 1
+    assert o[0, 1, 0, 1] == 0.0
+
+
+def test_target_assign():
+    x = rand_boxes(3, 20).reshape(1, 3, 4)
+    match = np.array([[0, -1, 2, 1]], "int32")
+    d = run_det_op("target_assign", {"X": x, "MatchIndices": match},
+                   {"mismatch_value": -5.0}, ["Out", "OutWeight"])
+    np.testing.assert_allclose(d["Out"][0, 0], x[0, 0])
+    np.testing.assert_allclose(d["Out"][0, 2], x[0, 2])
+    np.testing.assert_allclose(d["Out"][0, 3], x[0, 1])
+    assert np.all(d["Out"][0, 1] == -5.0)
+    np.testing.assert_array_equal(d["OutWeight"][0, :, 0], [1, 0, 1, 1])
+
+
+def test_mine_hard_examples_max_negative():
+    cls_loss = np.array([[0.1, 0.9, 0.5, 0.7, 0.2, 0.3]], "float32")
+    match = np.array([[2, -1, -1, -1, -1, 0]], "int32")  # 2 positives
+    d = run_det_op("mine_hard_examples",
+                   {"ClsLoss": cls_loss, "MatchIndices": match},
+                   {"neg_pos_ratio": 1.5, "mining_type": "max_negative"},
+                   ["NegIndices", "UpdatedMatchIndices"],
+                   {"NegIndices": "int32",
+                    "UpdatedMatchIndices": "int32"})
+    # 2 pos * 1.5 = 3 negatives allowed: highest-loss negs are cols 1,3,2
+    np.testing.assert_array_equal(d["NegIndices"][0], [0, 1, 1, 1, 0, 0])
+    np.testing.assert_array_equal(d["UpdatedMatchIndices"], match)

@@ -156,3 +156,38 @@ class TestStateDict:
         np.testing.assert_allclose(
             np.asarray(opt2._state[id(p)]["moment1"]),
             np.asarray(opt._state[id(p)]["moment1"]))
+
+
+def test_proximal_ftrl_decayed_adagrad_train(fresh_programs):
+    """The four long-tail fluid optimizers (reference optimizer.py:
+    DecayedAdagrad/ProximalGD/ProximalAdagrad/Ftrl) drive a regression
+    loss down through the Executor."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+
+    for opt_cls in ("DecayedAdagradOptimizer", "ProximalGDOptimizer",
+                    "ProximalAdagradOptimizer", "FtrlOptimizer"):
+        main, startup = fluid.Program(), fluid.Program()
+        from paddle_tpu.fluid import framework, unique_name
+        from paddle_tpu.fluid.executor import Scope, scope_guard
+
+        with framework.program_guard(main, startup), unique_name.guard():
+            x = fluid.data("x", [-1, 8], "float32")
+            yt = fluid.data("yt", [-1, 1], "float32")
+            pred = fluid.layers.fc(x, 1)
+            loss = fluid.layers.reduce_mean(
+                fluid.layers.loss.square_error_cost(pred, yt))
+            getattr(fluid.optimizer, opt_cls)(0.1).minimize(loss)
+            with scope_guard(Scope()):
+                exe = fluid.Executor()
+                exe.run(startup)
+                rng = np.random.RandomState(0)
+                W = rng.randn(8, 1).astype("float32")
+                losses = []
+                for _ in range(60):
+                    X = rng.randn(16, 8).astype("float32")
+                    l, = exe.run(main, feed={"x": X, "yt": X @ W},
+                                 fetch_list=[loss.name])
+                    losses.append(float(np.asarray(l)))
+        assert losses[-1] < losses[0], (opt_cls, losses[::20])

@@ -10,20 +10,11 @@ import paddle_tpu.fluid as fluid
 from paddle_tpu.fluid import framework, unique_name
 from paddle_tpu.fluid.executor import Scope, scope_guard
 
-from op_test import OpTest, randf
+from op_test import OpTest, randf, run_single_op
+
+run_q_op = run_single_op
 
 
-def run_q_op(op_type, inputs, attrs, out_slots):
-    t = OpTest()
-    t.op_type, t.inputs, t.attrs = op_type, inputs, attrs
-    t.outputs = {s: np.zeros(1, "float32") for s in out_slots}
-    main, startup, feed, fetch_names, _ = t._build()
-    with scope_guard(Scope()):
-        exe = fluid.Executor()
-        outs = exe.run(main, feed=feed,
-                       fetch_list=[n for _, _, n in fetch_names])
-    return {slot: np.asarray(o)
-            for (slot, i, n), o in zip(fetch_names, outs)}
 
 
 def ref_quant(x, s, bits=8):
