@@ -15,7 +15,7 @@ from ..layer_helper import LayerHelper
 __all__ = [
     "prior_box", "anchor_generator", "box_coder", "iou_similarity",
     "box_clip", "bipartite_match", "multiclass_nms", "yolo_box",
-    "sigmoid_focal_loss", "roi_align",
+    "sigmoid_focal_loss", "roi_align", "detection_output",
 ]
 
 
@@ -140,3 +140,28 @@ def roi_align(input, rois, pooled_height=1, pooled_width=1,
                     "pooled_width": pooled_width,
                     "spatial_scale": spatial_scale,
                     "sampling_ratio": sampling_ratio}, ("Out",), name=name)
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3,
+                     nms_top_k=400, keep_top_k=200,
+                     score_threshold=0.01, nms_eta=1.0,
+                     return_rois_num=True, name=None):
+    """SSD inference head (reference layers/detection.py
+    detection_output:97): decode location predictions against the
+    priors, then multiclass NMS.  loc (B, M, 4), scores (B, M, C) RAW
+    class logits (softmax applied here, matching the reference),
+    prior_box (M, 4), prior_box_var (M, 4).  Returns the
+    dense (out (B, keep_top_k, 6), rois_num (B,)) contract."""
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type="decode_center_size", axis=0)
+    from .nn import softmax, transpose
+
+    # the reference layer softmaxes the raw class logits itself
+    scores_t = transpose(softmax(scores), [0, 2, 1])  # (B, C, M)
+    return multiclass_nms(decoded, scores_t,
+                          score_threshold=score_threshold,
+                          nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                          nms_threshold=nms_threshold,
+                          background_label=background_label,
+                          return_rois_num=return_rois_num, name=name)

@@ -325,6 +325,45 @@ def _nms_keep(boxes, scores, iou_thr, score_thr, normalized):
     return keep
 
 
+def _multiclass_scaffold(boxes, sc, bg, keep_top_k, per_class_fn,
+                         k_per_class):
+    """Shared per-image multi-class NMS scaffolding: run `per_class_fn`
+    for every foreground class, concat, keep the global top
+    `keep_top_k`, pad with label -1 / zero boxes.  Returns
+    (det (kk, 6), count, index (kk,))."""
+    c = sc.shape[0]
+    all_s, all_b, all_l, all_i = [], [], [], []
+    for cls in range(c):
+        if cls == bg:
+            continue
+        ds, bx, idx = per_class_fn(boxes, sc[cls], cls)
+        all_s.append(ds)
+        all_b.append(bx)
+        all_l.append(jnp.full((k_per_class,), cls, jnp.float32))
+        all_i.append(idx)
+    kk = max(keep_top_k, 1)
+    if not all_s:  # every class is background: empty result
+        return (jnp.concatenate(
+                    [jnp.full((kk, 1), -1.0), jnp.zeros((kk, 5))], -1
+                ).astype(boxes.dtype),
+                jnp.int32(0), jnp.zeros((kk,), jnp.int32))
+    s_cat = jnp.concatenate(all_s)
+    b_cat = jnp.concatenate(all_b)
+    l_cat = jnp.concatenate(all_l)
+    i_cat = jnp.concatenate(all_i)
+    kk = min(keep_top_k, s_cat.shape[0]) if keep_top_k > 0 \
+        else s_cat.shape[0]
+    s_fin, sel = lax.top_k(s_cat, kk)
+    det = jnp.concatenate(
+        [jnp.where(s_fin > 0, l_cat[sel], -1.0)[:, None],
+         jnp.maximum(s_fin, 0.0)[:, None], b_cat[sel]], axis=-1)
+    det = jnp.where((s_fin > 0)[:, None], det,
+                    jnp.concatenate([jnp.full((kk, 1), -1.0),
+                                     jnp.zeros((kk, 5))], -1)
+                    .astype(det.dtype))
+    return det, jnp.sum(s_fin > 0).astype(jnp.int32), i_cat[sel]
+
+
 @register_op("multiclass_nms")
 @register_op("multiclass_nms2")
 @register_op("multiclass_nms3")
@@ -344,31 +383,15 @@ def _multiclass_nms(ctx, op, ins):
     b, c, m = scores.shape
     k = min(nms_top_k, m) if nms_top_k > 0 else m
 
+    def per_class(boxes, sc_c, cls):
+        s_top, idx = lax.top_k(sc_c, k)
+        b_top = boxes[idx]
+        keep = _nms_keep(b_top, s_top, iou_thr, score_thr, normalized)
+        return jnp.where(keep, s_top, -1.0), b_top, idx
+
     def per_image(boxes, sc):
-        all_scores, all_labels, all_boxes = [], [], []
-        for cls in range(c):
-            if cls == bg:
-                continue
-            s_top, idx = lax.top_k(sc[cls], k)
-            b_top = boxes[idx]
-            keep = _nms_keep(b_top, s_top, iou_thr, score_thr, normalized)
-            all_scores.append(jnp.where(keep, s_top, -1.0))
-            all_labels.append(jnp.full((k,), cls, jnp.float32))
-            all_boxes.append(b_top)
-        s_cat = jnp.concatenate(all_scores)
-        l_cat = jnp.concatenate(all_labels)
-        b_cat = jnp.concatenate(all_boxes)
-        kk = min(keep_top_k, s_cat.shape[0]) if keep_top_k > 0 \
-            else s_cat.shape[0]
-        s_fin, idx = lax.top_k(s_cat, kk)
-        det = jnp.concatenate(
-            [jnp.where(s_fin > 0, l_cat[idx], -1.0)[:, None],
-             jnp.maximum(s_fin, 0.0)[:, None], b_cat[idx]], axis=-1)
-        det = jnp.where((s_fin > 0)[:, None], det,
-                        jnp.concatenate([jnp.full((kk, 1), -1.0),
-                                         jnp.zeros((kk, 5))], -1)
-                        .astype(det.dtype))
-        return det, jnp.sum(s_fin > 0).astype(jnp.int32), idx
+        return _multiclass_scaffold(boxes, sc, bg, keep_top_k,
+                                    per_class, k)
 
     det, counts, index = jax.vmap(per_image)(bboxes, scores)
     outs = {"Out": [det]}
@@ -630,3 +653,145 @@ def _mine_hard_examples(ctx, op, ins):
     selected = is_neg & (rank < n_neg_max[:, None])
     return {"NegIndices": [selected.astype(jnp.int32)],
             "UpdatedMatchIndices": [match]}
+
+
+@register_op("matrix_nms")
+def _matrix_nms(ctx, op, ins):
+    """Matrix NMS (reference detection/matrix_nms_op.cc NMSMatrix):
+    score decay instead of hard suppression — decay(i) =
+    min_{j<i} f(iou_ij, iou_max_j) with f linear or gaussian.  Unlike
+    greedy NMS this is FULLY vectorizable: one (k, k) IoU matrix and a
+    masked min, no sequential loop — a shape tailor-made for the VPU.
+    Dense outputs: Out (B, keep, 6) label/score/box padded with -1,
+    Index, RoisNum."""
+    bboxes = first(ins, "BBoxes")   # (B, M, 4)
+    scores = first(ins, "Scores")   # (B, C, M)
+    bg = op.attr("background_label", 0)
+    score_thr = op.attr("score_threshold", 0.0)
+    post_thr = op.attr("post_threshold", 0.0)
+    nms_top_k = int(op.attr("nms_top_k", 64) or 64)
+    keep_top_k = int(op.attr("keep_top_k", 64) or 64)
+    use_gaussian = op.attr("use_gaussian", False)
+    sigma = op.attr("gaussian_sigma", 2.0)
+    normalized = op.attr("normalized", True)
+    b, c, m = scores.shape
+    k = min(nms_top_k, m) if nms_top_k > 0 else m
+
+    def per_class(boxes, sc_c, cls):
+        s_top, idx = lax.top_k(sc_c, k)
+        bx = boxes[idx]
+        valid = s_top > score_thr
+        iou = _iou_matrix(bx, bx, normalized)
+        tri = jnp.tril(jnp.ones((k, k), bool), -1)  # j < i
+        iou_l = jnp.where(tri, iou, 0.0)
+        iou_max = jnp.max(iou_l, axis=1)  # per sorted row: max iou vs prior
+        if use_gaussian:
+            # reference decay_score<T, true>:
+            # exp((max_iou^2 - iou^2) * sigma)
+            decay = jnp.exp((jnp.square(iou_max)[None, :]
+                             - jnp.square(iou_l)) * sigma)
+        else:
+            decay = (1.0 - iou_l) / jnp.maximum(1.0 - iou_max[None, :],
+                                                1e-10)
+        decay = jnp.where(tri, decay, 1.0)
+        min_decay = jnp.min(decay, axis=1)
+        ds = jnp.where(valid, s_top * min_decay, 0.0)
+        ds = jnp.where(ds > post_thr, ds, 0.0)
+        return ds, bx, idx
+
+    def per_image(boxes, sc):
+        return _multiclass_scaffold(boxes, sc, bg, keep_top_k,
+                                    per_class, k)
+
+    det, counts, index = jax.vmap(per_image)(bboxes, scores)
+    outs = {"Out": [det]}
+    if "Index" in op.outputs:
+        outs["Index"] = [index]
+    if "RoisNum" in op.outputs:
+        outs["RoisNum"] = [counts]
+    return outs
+
+
+@register_op("generate_proposals")
+@register_op("generate_proposals_v2")
+def _generate_proposals(ctx, op, ins):
+    """RPN proposal generation (reference detection/
+    generate_proposals_op.cc ProposalForOneImage): decode anchor deltas,
+    clip to the image, drop boxes smaller than min_size, greedy-NMS the
+    pre_nms_topN best, keep post_nms_topN.  Dense contract: RpnRois
+    (B, post_nms_topN, 4) zero-padded + RpnRoisNum (B,) (the v2 RoisNum
+    output generalized; the reference emits LoD)."""
+    scores = first(ins, "Scores")       # (B, A, H, W)
+    deltas = first(ins, "BboxDeltas")   # (B, 4A, H, W)
+    im_shape = first(ins, "ImShape", None)
+    if im_shape is None:
+        im_shape = first(ins, "ImInfo")  # v1: (B, 3) h, w, scale
+    anchors = first(ins, "Anchors")     # (H, W, A, 4)
+    variances = first(ins, "Variances", None)
+    pre_n = int(op.attr("pre_nms_topN", 6000))
+    post_n = int(op.attr("post_nms_topN", 1000))
+    nms_thresh = op.attr("nms_thresh", 0.5)
+    min_size = op.attr("min_size", 0.1)
+    b = scores.shape[0]
+    a_dim, h, w = scores.shape[1], scores.shape[2], scores.shape[3]
+    m = a_dim * h * w
+    anc = anchors.reshape(-1, 4)
+    if variances is not None:
+        var = variances.reshape(-1, 4)
+    else:
+        var = jnp.ones_like(anc)
+    pre_k = min(pre_n, m) if pre_n > 0 else m
+    post_k = min(post_n, pre_k) if post_n > 0 else pre_k
+    # v1 FilterBoxes(is_scale=true): min_size floored at 1 and box
+    # sizes compared in ORIGINAL image pixels (divided by the im_info
+    # scale); v2 compares raw sizes (generate_proposals_v2_op.cc)
+    v1 = op.type == "generate_proposals"
+    eff_min_size = max(min_size, 1.0) if v1 else min_size
+
+    def per_image(sc, dl, imr):
+        # (A, H, W) -> (H, W, A) flat, matching anchors' (H, W, A) order
+        s_flat = jnp.transpose(sc, (1, 2, 0)).reshape(-1)
+        d = jnp.transpose(dl.reshape(a_dim, 4, h, w),
+                          (2, 3, 0, 1)).reshape(-1, 4)
+        s_top, idx = lax.top_k(s_flat, pre_k)
+        anc_t, var_t, d_t = anc[idx], var[idx], d[idx]
+        # decode (reference box_coder decode vs anchor, +1 offsets)
+        aw = anc_t[:, 2] - anc_t[:, 0] + 1.0
+        ah = anc_t[:, 3] - anc_t[:, 1] + 1.0
+        acx = anc_t[:, 0] + aw * 0.5
+        acy = anc_t[:, 1] + ah * 0.5
+        cx = var_t[:, 0] * d_t[:, 0] * aw + acx
+        cy = var_t[:, 1] * d_t[:, 1] * ah + acy
+        bw = jnp.exp(jnp.minimum(var_t[:, 2] * d_t[:, 2], 10.0)) * aw
+        bh = jnp.exp(jnp.minimum(var_t[:, 3] * d_t[:, 3], 10.0)) * ah
+        x1 = cx - bw * 0.5
+        y1 = cy - bh * 0.5
+        x2 = cx + bw * 0.5 - 1.0
+        y2 = cy + bh * 0.5 - 1.0
+        ih, iw_ = imr[0], imr[1]
+        x1 = jnp.clip(x1, 0, iw_ - 1)
+        y1 = jnp.clip(y1, 0, ih - 1)
+        x2 = jnp.clip(x2, 0, iw_ - 1)
+        y2 = jnp.clip(y2, 0, ih - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1)
+        inv_scale = (1.0 / imr[2]) if v1 and imr.shape[0] > 2 else 1.0
+        keep_size = (((x2 - x1 + 1.0) * inv_scale) >= eff_min_size) \
+            & (((y2 - y1 + 1.0) * inv_scale) >= eff_min_size)
+        s_valid = jnp.where(keep_size, s_top, -jnp.inf)
+        keep = _nms_keep(boxes, s_valid, nms_thresh, -jnp.inf,
+                         normalized=False)
+        s_kept = jnp.where(keep & keep_size, s_top, -jnp.inf)
+        s_fin, sel = lax.top_k(s_kept, post_k)
+        ok = jnp.isfinite(s_fin)
+        rois = jnp.where(ok[:, None], boxes[sel], 0.0)
+        probs = jnp.where(ok, s_fin, 0.0)[:, None]
+        return rois, probs, jnp.sum(ok).astype(jnp.int32)
+
+    rois, probs, counts = jax.vmap(per_image)(scores, deltas,
+                                              im_shape.astype(scores.dtype))
+    outs = {"RpnRois": [rois], "RpnRoiProbs": [probs]}
+    if "RpnRoisNum" in op.outputs:
+        outs["RpnRoisNum"] = [counts]
+    if "RoisNum" in op.outputs:
+        outs["RoisNum"] = [counts]
+    return outs

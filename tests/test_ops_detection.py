@@ -321,3 +321,93 @@ def test_mine_hard_examples_max_negative():
     # 2 pos * 1.5 = 3 negatives allowed: highest-loss negs are cols 1,3,2
     np.testing.assert_array_equal(d["NegIndices"][0], [0, 1, 1, 1, 0, 0])
     np.testing.assert_array_equal(d["UpdatedMatchIndices"], match)
+
+
+def test_matrix_nms_decays_overlaps():
+    boxes = np.array([[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                       [20, 20, 30, 30]]], "float32")
+    scores = np.array([[[0.0, 0.0, 0.0],
+                        [0.9, 0.8, 0.7]]], "float32")
+    d = run_det_op("matrix_nms", {"BBoxes": boxes, "Scores": scores},
+                   {"background_label": 0, "score_threshold": 0.1,
+                    "post_threshold": 0.0, "nms_top_k": 3,
+                    "keep_top_k": 3, "use_gaussian": False},
+                   ["Out", "RoisNum"], {"RoisNum": "int32"})
+    out = d["Out"]
+    # top box keeps 0.9; far box keeps 0.7; overlapped box decayed
+    np.testing.assert_allclose(out[0, 0, 1], 0.9, rtol=1e-5)
+    np.testing.assert_allclose(out[0, 1, 1], 0.7, rtol=1e-5)
+    iou = np_iou(boxes[0][:1], boxes[0][1:2])[0, 0]
+    np.testing.assert_allclose(out[0, 2, 1], 0.8 * (1 - iou), rtol=1e-4)
+    assert d["RoisNum"][0] == 3
+
+
+def test_matrix_nms_gaussian_decay():
+    boxes = np.array([[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5]]],
+                     "float32")
+    scores = np.array([[[0.0, 0.0], [0.9, 0.8]]], "float32")
+    d = run_det_op("matrix_nms", {"BBoxes": boxes, "Scores": scores},
+                   {"background_label": 0, "score_threshold": 0.1,
+                    "post_threshold": 0.0, "nms_top_k": 2,
+                    "keep_top_k": 2, "use_gaussian": True,
+                    "gaussian_sigma": 2.0},
+                   ["Out", "RoisNum"], {"RoisNum": "int32"})
+    iou = np_iou(boxes[0][:1], boxes[0][1:2])[0, 0]
+    want = 0.8 * np.exp(-iou * iou * 2.0)  # max_iou of leader = 0
+    np.testing.assert_allclose(d["Out"][0, 1, 1], want, rtol=1e-4)
+    assert d["Out"][0, 1, 1] < 0.8  # decayed, never amplified
+
+
+def test_generate_proposals_basic():
+    # 1 image, 2x2 feature map, 1 anchor/cell, zero deltas -> proposals
+    # are the clipped anchors ranked by score
+    h = w = 2
+    anchors = np.zeros((h, w, 1, 4), "float32")
+    for i in range(h):
+        for j in range(w):
+            anchors[i, j, 0] = [j * 8, i * 8, j * 8 + 7, i * 8 + 7]
+    scores = np.array([[[[0.1, 0.9], [0.8, 0.2]]]], "float32")  # (1,1,2,2)
+    deltas = np.zeros((1, 4, h, w), "float32")
+    im_shape = np.array([[16.0, 16.0]], "float32")
+    d = run_det_op("generate_proposals_v2",
+                   {"Scores": scores, "BboxDeltas": deltas,
+                    "ImShape": im_shape, "Anchors": anchors,
+                    "Variances": np.ones((h, w, 1, 4), "float32")},
+                   {"pre_nms_topN": 4, "post_nms_topN": 3,
+                    "nms_thresh": 0.5, "min_size": 1.0},
+                   ["RpnRois", "RpnRoiProbs", "RpnRoisNum"],
+                   {"RpnRoisNum": "int32"})
+    rois, num = d["RpnRois"], d["RpnRoisNum"]
+    assert num[0] == 3
+    np.testing.assert_allclose(d["RpnRoiProbs"][0, :, 0],
+                               [0.9, 0.8, 0.2], rtol=1e-5)
+    # highest score 0.9 at (h=0, w=1) -> anchor [8, 0, 15, 7]
+    np.testing.assert_allclose(rois[0, 0], [8, 0, 15, 7], atol=1e-4)
+    np.testing.assert_allclose(rois[0, 1], [0, 8, 7, 15], atol=1e-4)
+
+
+def test_detection_output_layer(fresh_programs):
+    """detection_output = decode + NMS through the layer composition."""
+    main, startup, scope = fresh_programs
+    loc = fluid.data("loc", [1, 3, 4], "float32")
+    sc = fluid.data("sc", [1, 3, 2], "float32")
+    pb = fluid.data("pb", [3, 4], "float32")
+    pv = fluid.data("pv", [3, 4], "float32")
+    import paddle_tpu.fluid.layers as layers
+
+    out, num = layers.detection_output(loc, sc, pb, pv,
+                                       score_threshold=0.1,
+                                       nms_top_k=3, keep_top_k=3)
+    exe = fluid.Executor()
+    priors = np.array([[0, 0, 0.4, 0.4], [0.5, 0.5, 0.9, 0.9],
+                       [0.1, 0.1, 0.5, 0.5]], "float32")
+    logits = np.array([[[-2.0, 2.0], [-1.5, 1.5], [2.0, -2.0]]],
+                      "float32")
+    o, n = exe.run(main, feed={
+        "loc": np.zeros((1, 3, 4), "float32"),
+        "sc": logits, "pb": priors, "pv": np.ones((3, 4), "float32")},
+        fetch_list=[out, num])
+    o, n = np.asarray(o), np.asarray(n)
+    assert n[0] == 2  # two confident foreground priors survive
+    want_top = 1 / (1 + np.exp(-4.0))  # softmax([-2, 2])[1]
+    np.testing.assert_allclose(o[0, 0, 1], want_top, rtol=1e-5)
