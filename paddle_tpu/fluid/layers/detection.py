@@ -16,6 +16,7 @@ __all__ = [
     "prior_box", "anchor_generator", "box_coder", "iou_similarity",
     "box_clip", "bipartite_match", "multiclass_nms", "yolo_box",
     "sigmoid_focal_loss", "roi_align", "detection_output",
+    "yolov3_loss",
 ]
 
 
@@ -165,3 +166,23 @@ def detection_output(loc, scores, prior_box, prior_box_var,
                           nms_threshold=nms_threshold,
                           background_label=background_label,
                           return_rois_num=return_rois_num, name=name)
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=True, scale_x_y=1.0, name=None):
+    """YOLOv3 training loss (reference layers/detection.py
+    yolov3_loss:982).  Dense gt contract: gt_box (N, G, 4) normalized
+    cxcywh with zero-area rows as padding."""
+    ins = {"X": [x], "GTBox": [gt_box], "GTLabel": [gt_label]}
+    if gt_score is not None:
+        ins["GTScore"] = [gt_score]
+    return _det_op("yolov3_loss", ins,
+                   {"anchors": [float(a) for a in anchors],
+                    "anchor_mask": [int(m) for m in anchor_mask],
+                    "class_num": class_num,
+                    "ignore_thresh": ignore_thresh,
+                    "downsample_ratio": downsample_ratio,
+                    "use_label_smooth": use_label_smooth,
+                    "scale_x_y": scale_x_y},
+                   ("Loss",), name=name)

@@ -4,10 +4,11 @@ Reference: /root/reference/paddle/fluid/operators/detection/ (31 ops).
 This module implements the set every detection pipeline composes —
 prior_box, density_prior_box, anchor_generator, box_coder,
 iou_similarity, box_clip, bipartite_match, multiclass_nms(+v2/v3),
-yolo_box, sigmoid_focal_loss, roi_align, target_assign,
-mine_hard_examples, polygon_box_transform.  The remaining tail
-(generate_proposals, matrix_nms, FPN redistribution, mask utilities)
-raises through the registry's unknown-op error until added.
+matrix_nms, generate_proposals(+v2), yolo_box, yolov3_loss,
+sigmoid_focal_loss, roi_align, target_assign, mine_hard_examples,
+polygon_box_transform.  The remaining tail (FPN proposal
+redistribution, mask utilities, retinanet_detection_output) raises
+through the registry's unknown-op error until added.
 
 TPU re-design notes:
 - prior_box / anchor_generator are SHAPE-only functions of static attrs:
@@ -742,11 +743,11 @@ def _generate_proposals(ctx, op, ins):
         var = jnp.ones_like(anc)
     pre_k = min(pre_n, m) if pre_n > 0 else m
     post_k = min(post_n, pre_k) if post_n > 0 else pre_k
-    # v1 FilterBoxes(is_scale=true): min_size floored at 1 and box
-    # sizes compared in ORIGINAL image pixels (divided by the im_info
-    # scale); v2 compares raw sizes (generate_proposals_v2_op.cc)
+    # FilterBoxes (bbox_util.h:191) floors min_size at 1.0 for BOTH
+    # versions; v1 (is_scale=true) additionally measures sizes in
+    # ORIGINAL image pixels: ws = (x2-x1)/im_scale + 1
     v1 = op.type == "generate_proposals"
-    eff_min_size = max(min_size, 1.0) if v1 else min_size
+    eff_min_size = max(min_size, 1.0)
 
     def per_image(sc, dl, imr):
         # (A, H, W) -> (H, W, A) flat, matching anchors' (H, W, A) order
@@ -762,8 +763,10 @@ def _generate_proposals(ctx, op, ins):
         acy = anc_t[:, 1] + ah * 0.5
         cx = var_t[:, 0] * d_t[:, 0] * aw + acx
         cy = var_t[:, 1] * d_t[:, 1] * ah + acy
-        bw = jnp.exp(jnp.minimum(var_t[:, 2] * d_t[:, 2], 10.0)) * aw
-        bh = jnp.exp(jnp.minimum(var_t[:, 3] * d_t[:, 3], 10.0)) * ah
+        # kBBoxClipDefault = log(1000/16) (bbox_util.h:24)
+        clip_v = math.log(1000.0 / 16.0)
+        bw = jnp.exp(jnp.minimum(var_t[:, 2] * d_t[:, 2], clip_v)) * aw
+        bh = jnp.exp(jnp.minimum(var_t[:, 3] * d_t[:, 3], clip_v)) * ah
         x1 = cx - bw * 0.5
         y1 = cy - bh * 0.5
         x2 = cx + bw * 0.5 - 1.0
@@ -774,9 +777,10 @@ def _generate_proposals(ctx, op, ins):
         x2 = jnp.clip(x2, 0, iw_ - 1)
         y2 = jnp.clip(y2, 0, ih - 1)
         boxes = jnp.stack([x1, y1, x2, y2], axis=-1)
+        # v1: ws = (x2-x1)/im_scale + 1 (bbox_util.h:201); v2: raw+1
         inv_scale = (1.0 / imr[2]) if v1 and imr.shape[0] > 2 else 1.0
-        keep_size = (((x2 - x1 + 1.0) * inv_scale) >= eff_min_size) \
-            & (((y2 - y1 + 1.0) * inv_scale) >= eff_min_size)
+        keep_size = (((x2 - x1) * inv_scale + 1.0) >= eff_min_size) \
+            & (((y2 - y1) * inv_scale + 1.0) >= eff_min_size)
         s_valid = jnp.where(keep_size, s_top, -jnp.inf)
         keep = _nms_keep(boxes, s_valid, nms_thresh, -jnp.inf,
                          normalized=False)
@@ -795,3 +799,146 @@ def _generate_proposals(ctx, op, ins):
     if "RoisNum" in op.outputs:
         outs["RoisNum"] = [counts]
     return outs
+
+
+@register_op("yolov3_loss")
+def _yolov3_loss(ctx, op, ins):
+    """YOLOv3 training loss (reference detection/yolov3_loss_op.h).
+
+    Per image: every prediction whose best IoU against the gt set
+    exceeds ignore_thresh is excluded from the negative objectness
+    loss; every gt matches its best wh-IoU anchor, and when that anchor
+    belongs to this scale's anchor_mask the location (sce for x/y, L1
+    for w/h, scaled by 2-w*h), class (per-class sce, optional label
+    smooth) and positive-objectness losses apply at its cell.
+
+    Vectorization: the reference's quadruple loop becomes one decode +
+    one (A*H*W, G) IoU matrix; per-gt terms GATHER the logits at the
+    matched cell (so several gts in one cell each contribute, like the
+    reference's per-gt accumulation) and the positive mask scatters
+    with mode='drop' for padded/unmatched gts.
+    Outputs: Loss (N,), ObjectnessMask (N, mask, H, W), GTMatchMask
+    (N, G)."""
+    x = first(ins, "X")
+    gt_box = first(ins, "GTBox").astype(jnp.float32)   # (N, G, 4) cxcywh
+    gt_label = first(ins, "GTLabel").astype(jnp.int32)  # (N, G)
+    gt_score = first(ins, "GTScore", None)
+    anchors = [float(a) for a in op.attr("anchors", [])]
+    mask = [int(m) for m in op.attr("anchor_mask", [])]
+    class_num = int(op.attr("class_num", 1))
+    ignore_thresh = op.attr("ignore_thresh", 0.7)
+    downsample = int(op.attr("downsample_ratio", 32))
+    use_smooth = op.attr("use_label_smooth", True)
+    scale_xy = op.attr("scale_x_y", 1.0)
+    bias_xy = -0.5 * (scale_xy - 1.0)
+    n, _, h, w = x.shape
+    a = len(mask)
+    g = gt_box.shape[1]
+    input_size = downsample * h
+    an_w = jnp.asarray(anchors[0::2], jnp.float32)
+    an_h = jnp.asarray(anchors[1::2], jnp.float32)
+    if gt_score is None:
+        gt_score = jnp.ones((n, g), jnp.float32)
+    else:
+        gt_score = gt_score.astype(jnp.float32).reshape(n, g)
+    if use_smooth:
+        sm = min(1.0 / class_num, 1.0 / 40)
+        pos_t, neg_t = 1.0 - sm, sm
+    else:
+        pos_t, neg_t = 1.0, 0.0
+
+    def sce(logit, t):
+        return (jnp.maximum(logit, 0.0) - logit * t
+                + jnp.log1p(jnp.exp(-jnp.abs(logit))))
+
+    def iou_cxcywh(b1, b2):
+        # (..., 4) centered boxes
+        l = jnp.maximum(b1[..., 0] - b1[..., 2] / 2,
+                        b2[..., 0] - b2[..., 2] / 2)
+        r = jnp.minimum(b1[..., 0] + b1[..., 2] / 2,
+                        b2[..., 0] + b2[..., 2] / 2)
+        t_ = jnp.maximum(b1[..., 1] - b1[..., 3] / 2,
+                         b2[..., 1] - b2[..., 3] / 2)
+        bm = jnp.minimum(b1[..., 1] + b1[..., 3] / 2,
+                         b2[..., 1] + b2[..., 3] / 2)
+        inter = jnp.maximum(r - l, 0.0) * jnp.maximum(bm - t_, 0.0)
+        union = (b1[..., 2] * b1[..., 3] + b2[..., 2] * b2[..., 3]
+                 - inter)
+        return inter / jnp.maximum(union, 1e-10)
+
+    def per_image(xi, gts, labels, scores):
+        xr = xi.reshape(a, 5 + class_num, h, w).astype(jnp.float32)
+        valid = (gts[:, 2] > 0) & (gts[:, 3] > 0)          # (G,)
+        # decoded predictions, normalized cxcywh
+        gx = jnp.arange(w, dtype=jnp.float32)[None, None, :]
+        gy = jnp.arange(h, dtype=jnp.float32)[None, :, None]
+        m_w = an_w[jnp.asarray(mask)].reshape(a, 1, 1)
+        m_h = an_h[jnp.asarray(mask)].reshape(a, 1, 1)
+        pcx = (gx + jax.nn.sigmoid(xr[:, 0]) * scale_xy + bias_xy) / w
+        pcy = (gy + jax.nn.sigmoid(xr[:, 1]) * scale_xy + bias_xy) / h
+        pw = jnp.exp(xr[:, 2]) * m_w / input_size
+        ph = jnp.exp(xr[:, 3]) * m_h / input_size
+        pred = jnp.stack([pcx, pcy, pw, ph], axis=-1)  # (A, H, W, 4)
+        ious = iou_cxcywh(pred[..., None, :], gts[None, None, None])
+        ious = jnp.where(valid[None, None, None, :], ious, 0.0)
+        best_iou = jnp.max(ious, axis=-1)               # (A, H, W)
+        ignored = best_iou > ignore_thresh
+
+        # per-gt best anchor over ALL anchors by wh IoU
+        anc = jnp.stack([jnp.zeros_like(an_w), jnp.zeros_like(an_h),
+                         an_w / input_size, an_h / input_size], -1)
+        gt_shift = gts.at[:, 0:2].set(0.0)
+        an_iou = iou_cxcywh(gt_shift[:, None, :], anc[None])  # (G, A_all)
+        best_n = jnp.argmax(an_iou, axis=1).astype(jnp.int32)
+        mask_arr = jnp.asarray(mask, jnp.int32)
+        in_mask = (best_n[:, None] == mask_arr[None, :])
+        mask_idx = jnp.where(jnp.any(in_mask, 1),
+                             jnp.argmax(in_mask, 1), -1)    # (G,)
+        matched = valid & (mask_idx >= 0)
+        gi = jnp.clip((gts[:, 0] * w).astype(jnp.int32), 0, w - 1)
+        gj = jnp.clip((gts[:, 1] * h).astype(jnp.int32), 0, h - 1)
+
+        # gather logits at matched cells: (G, 5+C)
+        safe_m = jnp.maximum(mask_idx, 0)
+        cell = xr[safe_m, :, gj, gi]
+        tx = gts[:, 0] * w - gi
+        ty = gts[:, 1] * h - gj
+        tw = jnp.log(jnp.maximum(
+            gts[:, 2] * input_size / jnp.maximum(an_w[best_n], 1e-10),
+            1e-10))
+        th = jnp.log(jnp.maximum(
+            gts[:, 3] * input_size / jnp.maximum(an_h[best_n], 1e-10),
+            1e-10))
+        sc_w = (2.0 - gts[:, 2] * gts[:, 3]) * scores
+        loc = (sce(cell[:, 0], tx) + sce(cell[:, 1], ty)
+               + jnp.abs(cell[:, 2] - tw)
+               + jnp.abs(cell[:, 3] - th)) * sc_w
+        cls_t = jnp.where(
+            labels[:, None] == jnp.arange(class_num)[None, :],
+            pos_t, neg_t)
+        cls = jnp.sum(sce(cell[:, 5:], cls_t), axis=1) * scores
+        per_gt = jnp.where(matched, loc + cls, 0.0)
+
+        # objectness: positive mask scattered per matched gt
+        obj_pos = jnp.zeros((a, h, w), jnp.float32)
+        # unmatched gts scatter to index `a` (out of bounds -> dropped);
+        # -1 would WRAP to the last anchor in jax indexing
+        obj_pos = obj_pos.at[
+            jnp.where(matched, mask_idx, a), gj, gi].set(
+            scores, mode="drop")
+        obj_logit = xr[:, 4]
+        pos_loss = jnp.where(obj_pos > 1e-5,
+                             sce(obj_logit, 1.0) * obj_pos, 0.0)
+        neg_loss = jnp.where((obj_pos <= 1e-5) & jnp.logical_not(ignored),
+                             sce(obj_logit, 0.0), 0.0)
+        obj_mask = jnp.where(ignored & (obj_pos <= 1e-5), -1.0, obj_pos)
+        loss = jnp.sum(per_gt) + jnp.sum(pos_loss) + jnp.sum(neg_loss)
+        # reference stores GetMaskIndex(anchor_mask, best_n): the
+        # MASK-RELATIVE anchor index, -1 when unmatched/invalid
+        match_out = jnp.where(valid & matched, mask_idx, -1)
+        return loss, obj_mask, match_out.astype(jnp.int32)
+
+    loss, obj_mask, match = jax.vmap(per_image)(x, gt_box, gt_label,
+                                                gt_score)
+    return {"Loss": [loss], "ObjectnessMask": [obj_mask],
+            "GTMatchMask": [match]}

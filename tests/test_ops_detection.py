@@ -411,3 +411,73 @@ def test_detection_output_layer(fresh_programs):
     assert n[0] == 2  # two confident foreground priors survive
     want_top = 1 / (1 + np.exp(-4.0))  # softmax([-2, 2])[1]
     np.testing.assert_allclose(o[0, 0, 1], want_top, rtol=1e-5)
+
+
+def test_yolov3_loss_matches_numpy_oracle():
+    """Replicates the reference yolov3_loss_op.h loops in numpy on a
+    tiny config and checks the fused lowering."""
+    rng = np.random.RandomState(11)
+    n, h, w, cnum = 1, 2, 2, 2
+    anchors = [10.0, 14.0, 40.0, 40.0]
+    mask = [0]
+    a = len(mask)
+    x = rng.randn(n, a * (5 + cnum), h, w).astype("float32")
+    gt = np.array([[[0.3, 0.6, 0.2, 0.3], [0, 0, 0, 0]]], "float32")
+    gtl = np.array([[1, 0]], "int32")
+    downsample, ignore_thresh = 32, 0.5
+    input_size = downsample * h
+
+    d = run_det_op("yolov3_loss",
+                   {"X": x, "GTBox": gt, "GTLabel": gtl},
+                   {"anchors": anchors, "anchor_mask": mask,
+                    "class_num": cnum, "ignore_thresh": ignore_thresh,
+                    "downsample_ratio": downsample,
+                    "use_label_smooth": False, "scale_x_y": 1.0},
+                   ["Loss", "ObjectnessMask", "GTMatchMask"],
+                   {"GTMatchMask": "int32"})
+
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    sce = lambda l, t: max(l, 0) - l * t + np.log1p(np.exp(-abs(l)))
+
+    def iou_c(b1, b2):
+        l = max(b1[0] - b1[2] / 2, b2[0] - b2[2] / 2)
+        r = min(b1[0] + b1[2] / 2, b2[0] + b2[2] / 2)
+        t = max(b1[1] - b1[3] / 2, b2[1] - b2[3] / 2)
+        b = min(b1[1] + b1[3] / 2, b2[1] + b2[3] / 2)
+        inter = max(r - l, 0) * max(b - t, 0)
+        return inter / (b1[2] * b1[3] + b2[2] * b2[3] - inter)
+
+    xr = x[0].reshape(a, 5 + cnum, h, w)
+    g0 = gt[0, 0]
+    # best anchor over both anchors (wh iou)
+    an_ious = [iou_c([0, 0, 10 / 64, 14 / 64], [0, 0, g0[2], g0[3]]),
+               iou_c([0, 0, 40 / 64, 40 / 64], [0, 0, g0[2], g0[3]])]
+    best_n = int(np.argmax(an_ious))
+    assert best_n == 0  # matched anchor is in the mask
+    gi, gj = int(g0[0] * w), int(g0[1] * h)
+    tx, ty = g0[0] * w - gi, g0[1] * h - gj
+    tw = np.log(g0[2] * input_size / anchors[0])
+    th = np.log(g0[3] * input_size / anchors[1])
+    sc = 2 - g0[2] * g0[3]
+    loss = (sce(xr[0, 0, gj, gi], tx) + sce(xr[0, 1, gj, gi], ty)
+            + abs(xr[0, 2, gj, gi] - tw)
+            + abs(xr[0, 3, gj, gi] - th)) * sc
+    # class loss (no smooth): label 1
+    loss += sce(xr[0, 5, gj, gi], 0.0) + sce(xr[0, 6, gj, gi], 1.0)
+    # objectness: decode preds, ignore > thresh
+    for j in range(a):
+        for k in range(h):
+            for l in range(w):
+                pred = [(l + sig(xr[j, 0, k, l])) / w,
+                        (k + sig(xr[j, 1, k, l])) / h,
+                        np.exp(xr[j, 2, k, l]) * anchors[0] / input_size,
+                        np.exp(xr[j, 3, k, l]) * anchors[1] / input_size]
+                best_iou = iou_c(pred, g0)
+                is_pos = (k == gj and l == gi)
+                if is_pos:
+                    loss += sce(xr[j, 4, k, l], 1.0)
+                elif best_iou <= ignore_thresh:
+                    loss += sce(xr[j, 4, k, l], 0.0)
+    np.testing.assert_allclose(d["Loss"][0], loss, rtol=1e-4)
+    assert d["ObjectnessMask"][0, 0, gj, gi] == 1.0
+    np.testing.assert_array_equal(d["GTMatchMask"][0], [0, -1])
