@@ -474,6 +474,16 @@ def _sigmoid_focal_loss(ctx, op, ins):
     return {"Out": [w * ce / fg]}
 
 
+def _rois_batch_index(rois_num, r):
+    """Map dense roi rows to image indices from per-image counts (the
+    dense replacement for the reference's roi LoD)."""
+    if rois_num is None:
+        return jnp.zeros((r,), jnp.int32)
+    counts = rois_num.reshape(-1).astype(jnp.int32)
+    starts = jnp.cumsum(counts) - counts
+    return jnp.sum(jnp.arange(r)[:, None] >= starts[None, :], axis=1) - 1
+
+
 @register_op("roi_align")
 def _roi_align(ctx, op, ins):
     """reference roi_align_op.h: average-pool bilinear samples per bin.
@@ -494,13 +504,7 @@ def _roi_align(ctx, op, ins):
     ratio = int(op.attr("sampling_ratio", -1))
     b, c, hh, ww = x.shape
     r = rois.shape[0]
-    if rois_num is not None:
-        counts = rois_num.reshape(-1).astype(jnp.int32)
-        starts = jnp.cumsum(counts) - counts
-        batch_idx = jnp.sum(
-            jnp.arange(r)[:, None] >= starts[None, :], axis=1) - 1
-    else:
-        batch_idx = jnp.zeros((r,), jnp.int32)
+    batch_idx = _rois_batch_index(rois_num, r)
 
     sr = ratio if ratio > 0 else 2
 
@@ -874,7 +878,10 @@ def _yolov3_loss(ctx, op, ins):
         gy = jnp.arange(h, dtype=jnp.float32)[None, :, None]
         m_w = an_w[jnp.asarray(mask)].reshape(a, 1, 1)
         m_h = an_h[jnp.asarray(mask)].reshape(a, 1, 1)
-        pcx = (gx + jax.nn.sigmoid(xr[:, 0]) * scale_xy + bias_xy) / w
+        # the reference passes grid_size=h for BOTH axes (GetYoloBox
+        # call in yolov3_loss_op.h:330) — matched exactly, including
+        # its non-square-feature-map quirk
+        pcx = (gx + jax.nn.sigmoid(xr[:, 0]) * scale_xy + bias_xy) / h
         pcy = (gy + jax.nn.sigmoid(xr[:, 1]) * scale_xy + bias_xy) / h
         pw = jnp.exp(xr[:, 2]) * m_w / input_size
         ph = jnp.exp(xr[:, 3]) * m_h / input_size
@@ -901,7 +908,9 @@ def _yolov3_loss(ctx, op, ins):
         # gather logits at matched cells: (G, 5+C)
         safe_m = jnp.maximum(mask_idx, 0)
         cell = xr[safe_m, :, gj, gi]
-        tx = gts[:, 0] * w - gi
+        # reference CalcBoxLocationLoss gets grid_size=h for tx too
+        # (gi still floors gt.x * w) — same quirk, matched
+        tx = gts[:, 0] * h - gi
         ty = gts[:, 1] * h - gj
         tw = jnp.log(jnp.maximum(
             gts[:, 2] * input_size / jnp.maximum(an_w[best_n], 1e-10),
@@ -942,3 +951,132 @@ def _yolov3_loss(ctx, op, ins):
                                                 gt_score)
     return {"Loss": [loss], "ObjectnessMask": [obj_mask],
             "GTMatchMask": [match]}
+
+
+@register_op("roi_pool")
+def _roi_pool(ctx, op, ins):
+    """reference operators/roi_pool_op.h: quantized max pooling.  The
+    data-dependent integer bin boundaries become per-pixel membership
+    masks (bins x H / bins x W comparisons) so the max is one masked
+    reduction — no dynamic slicing."""
+    x = first(ins, "X")         # (B, C, H, W)
+    rois = first(ins, "ROIs")   # (R, 4)
+    rois_num = first(ins, "RoisNum", None)
+    ph = int(op.attr("pooled_height", 1))
+    pw = int(op.attr("pooled_width", 1))
+    sscale = op.attr("spatial_scale", 1.0)
+    b, c, hh, ww = x.shape
+    r = rois.shape[0]
+    batch_idx = _rois_batch_index(rois_num, r)
+
+    def c_round(v):
+        # C round(): half away from zero (jnp.round is half-to-even)
+        return jnp.sign(v) * jnp.floor(jnp.abs(v) + 0.5)
+
+    def one_roi(roi, bi):
+        x0 = c_round(roi[0] * sscale).astype(jnp.int32)
+        y0 = c_round(roi[1] * sscale).astype(jnp.int32)
+        x1 = c_round(roi[2] * sscale).astype(jnp.int32)
+        y1 = c_round(roi[3] * sscale).astype(jnp.int32)
+        rh = jnp.maximum(y1 - y0 + 1, 1).astype(jnp.float32)
+        rw = jnp.maximum(x1 - x0 + 1, 1).astype(jnp.float32)
+        binh, binw = rh / ph, rw / pw
+        p = jnp.arange(ph, dtype=jnp.float32)
+        q = jnp.arange(pw, dtype=jnp.float32)
+        hs = jnp.clip(jnp.floor(p * binh).astype(jnp.int32) + y0, 0, hh)
+        he = jnp.clip(jnp.ceil((p + 1) * binh).astype(jnp.int32) + y0,
+                      0, hh)
+        ws = jnp.clip(jnp.floor(q * binw).astype(jnp.int32) + x0, 0, ww)
+        we = jnp.clip(jnp.ceil((q + 1) * binw).astype(jnp.int32) + x0,
+                      0, ww)
+        rows = jnp.arange(hh, dtype=jnp.int32)
+        cols = jnp.arange(ww, dtype=jnp.int32)
+        mh = (rows[None, :] >= hs[:, None]) & (rows[None, :] < he[:, None])
+        mw = (cols[None, :] >= ws[:, None]) & (cols[None, :] < we[:, None])
+        mask = mh[:, None, :, None] & mw[None, :, None, :]  # (P,Q,H,W)
+        img = x[bi]  # (C, H, W)
+        vals = jnp.where(mask[None], img[:, None, None, :, :], -jnp.inf)
+        out = jnp.max(vals, axis=(3, 4))
+        # empty bins pool to 0 (reference is_empty path)
+        return jnp.where(jnp.isfinite(out), out, 0.0).astype(x.dtype)
+
+    out = jax.vmap(one_roi)(rois, batch_idx)
+    return {"Out": [out]}
+
+
+@register_op("distribute_fpn_proposals")
+def _distribute_fpn_proposals(ctx, op, ins):
+    """reference detection/distribute_fpn_proposals_op.h: route each roi
+    to the FPN level floor(log2(sqrt(area)/refer_scale)+refer_level).
+    Dense form: each level output keeps the full (R, 4) shape with that
+    level's rois FRONT-PACKED + per-level counts; RestoreIndex maps the
+    level-concatenated order back to the input order."""
+    rois = first(ins, "FpnRois")  # (R, 4)
+    rois_num = first(ins, "RoisNum", None)
+    min_level = int(op.attr("min_level", 2))
+    max_level = int(op.attr("max_level", 5))
+    refer_level = int(op.attr("refer_level", 4))
+    refer_scale = float(op.attr("refer_scale", 224))
+    r = rois.shape[0]
+    if rois_num is not None:
+        n_valid = jnp.sum(rois_num.reshape(-1).astype(jnp.int32))
+        valid_roi = jnp.arange(r, dtype=jnp.int32) < n_valid
+    else:
+        valid_roi = jnp.ones((r,), bool)
+    # reference BBoxArea (non-normalized): (w+1)*(h+1)
+    w = rois[:, 2] - rois[:, 0] + 1.0
+    h = rois[:, 3] - rois[:, 1] + 1.0
+    scale = jnp.sqrt(jnp.maximum(w * h, 1e-10))
+    lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-6)) + refer_level
+    lvl = jnp.clip(lvl.astype(jnp.int32), min_level, max_level)
+    # padded rows (past RoisNum) route to no level
+    lvl = jnp.where(valid_roi, lvl, max_level + 1)
+
+    outs = {"MultiFpnRois": [], "MultiLevelRoIsNum": []}
+    order_all = []
+    for l in range(min_level, max_level + 1):
+        sel = (lvl == l)
+        order = jnp.argsort(jnp.logical_not(sel), stable=True)
+        n = jnp.sum(sel).astype(jnp.int32)
+        packed = rois[order]
+        keep = jnp.arange(r, dtype=jnp.int32) < n
+        outs["MultiFpnRois"].append(
+            jnp.where(keep[:, None], packed, 0.0))
+        outs["MultiLevelRoIsNum"].append(n.reshape(1))
+        order_all.append(jnp.where(keep, order, r))  # r = invalid slot
+    # restore index: position in the level-concatenated packing for each
+    # original roi (reference writes the inverse permutation)
+    concat_order = jnp.concatenate(order_all)  # (num_level*R,) with pads
+    valid = concat_order < r
+    # compact the valid entries' positions: rank among valid
+    rank = jnp.cumsum(valid.astype(jnp.int32)) - 1
+    restore = jnp.zeros((r,), jnp.int32)
+    restore = restore.at[jnp.where(valid, concat_order, r)].set(
+        rank, mode="drop")
+    outs["RestoreIndex"] = [restore.reshape(r, 1)]
+    return outs
+
+
+@register_op("collect_fpn_proposals")
+def _collect_fpn_proposals(ctx, op, ins):
+    """reference detection/collect_fpn_proposals_op.cc: merge per-level
+    proposals, keep the post_nms_topN best by score.  Dense form over
+    front-packed per-level inputs."""
+    rois_list = [v.reshape(-1, 4)
+                 for v in ins.get("MultiLevelRois", []) if v is not None]
+    scores_list = [v for v in ins.get("MultiLevelScores", [])
+                   if v is not None]
+    post_n = int(op.attr("post_nms_topN", 1000))
+    rois = jnp.concatenate(rois_list, axis=0)
+    scores = jnp.concatenate([s.reshape(-1) for s in scores_list])
+    if rois.shape[0] != scores.shape[0]:
+        raise ValueError(
+            "collect_fpn_proposals: rois/scores row counts disagree "
+            f"({rois.shape[0]} vs {scores.shape[0]})")
+    k = min(post_n, scores.shape[0])
+    s_top, idx = lax.top_k(scores, k)
+    out = rois[idx]
+    outs = {"FpnRois": [out]}
+    if "RoisNum" in op.outputs:
+        outs["RoisNum"] = [jnp.sum(s_top > 0).astype(jnp.int32).reshape(1)]
+    return outs
