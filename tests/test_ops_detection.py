@@ -547,3 +547,26 @@ def test_distribute_then_collect_fpn():
     np.testing.assert_allclose(d["FpnRois"][0], [0, 0, 10, 10])
     np.testing.assert_allclose(d["FpnRois"][1], [5, 5, 9, 9])
     assert d["RoisNum"][0] == 2
+
+
+def test_generate_proposals_v1_iminfo_scale():
+    """v1 measures min_size in original-image pixels via ImInfo scale."""
+    h = w = 2
+    anchors = np.zeros((h, w, 1, 4), "float32")
+    for i in range(h):
+        for j in range(w):
+            anchors[i, j, 0] = [j * 8, i * 8, j * 8 + 5, i * 8 + 5]
+    scores = np.array([[[[0.9, 0.8], [0.7, 0.6]]]], "float32")
+    deltas = np.zeros((1, 4, h, w), "float32")
+    im_info = np.array([[16.0, 16.0, 2.0]], "float32")  # scale 2
+    d = run_det_op("generate_proposals",
+                   {"Scores": scores, "BboxDeltas": deltas,
+                    "ImInfo": im_info, "Anchors": anchors,
+                    "Variances": np.ones((h, w, 1, 4), "float32")},
+                   {"pre_nms_topN": 4, "post_nms_topN": 4,
+                    "nms_thresh": 0.9, "min_size": 4.0},
+                   ["RpnRois", "RpnRoiProbs", "RpnRoisNum"],
+                   {"RpnRoisNum": "int32"})
+    # box side 6 px on the feature grid -> (6-1)/2 + 1 = 3.5 < 4 in
+    # original pixels: every proposal is dropped under v1 scaling
+    assert d["RpnRoisNum"][0] == 0

@@ -27,6 +27,7 @@ PATTERNS = [
     r'case\(op_type="([\w@]+)"',
     r'unary\("([\w@]+)"',
     r'run_\w*op\(\s*"([\w@]+)"',
+    r'\brun\(\s*"([\w@]+)"',
     r'_run_single_op\(\s*"([\w@]+)"',
     r'_one_op\(\s*"([\w@]+)"',
     r'run_collective\(\s*\w+,\s*"([\w@]+)"',
