@@ -397,3 +397,109 @@ class DataLoader:
 
 def get_worker_info():
     return None  # thread workers share the dataset object
+
+
+def _dataloader_from_generator(feed_list=None, capacity=16,
+                               use_double_buffer=True, iterable=True,
+                               return_list=True, use_multiprocess=False,
+                               drop_last=True):
+    """DataLoader.from_generator (reference fluid/reader.py:337
+    GeneratorLoader).  TPU re-design: the reference inserts
+    create_py_reader/read program ops backed by a C++ LoDTensorBlockingQueue;
+    here the Executor feeds arrays directly, so the loader is a plain
+    iterable whose set_* methods mirror the reference API."""
+
+    outer_drop_last = drop_last
+
+    class _GeneratorLoader:
+        def __init__(self):
+            self._feed_names = [getattr(v, "name", str(v))
+                                for v in (feed_list or [])]
+            self._gen = None
+
+        def set_sample_generator(self, reader, batch_size,
+                                 drop_last=None, places=None):
+            if drop_last is None:
+                drop_last = outer_drop_last
+            def gen():
+                batch = []
+                for sample in reader():
+                    batch.append(sample if isinstance(sample, (list, tuple))
+                                 else (sample,))
+                    if len(batch) == batch_size:
+                        yield [np.stack([b[i] for b in batch])
+                               for i in range(len(batch[0]))]
+                        batch = []
+                if batch and not drop_last:
+                    yield [np.stack([b[i] for b in batch])
+                           for i in range(len(batch[0]))]
+
+            self._set(gen)
+            return self
+
+        def set_sample_list_generator(self, reader, places=None):
+            def gen():
+                for samples in reader():
+                    yield [np.stack([s[i] for s in samples])
+                           for i in range(len(samples[0]))]
+
+            self._set(gen)
+            return self
+
+        def set_batch_generator(self, reader, places=None):
+            self._set(reader)
+            return self
+
+        def _set(self, gen):
+            self._gen = gen
+
+        def __iter__(self):
+            if self._gen is None:
+                raise RuntimeError(
+                    "DataLoader.from_generator: no generator set — call "
+                    "set_sample_generator / set_sample_list_generator / "
+                    "set_batch_generator first")
+            for batch in self._gen():
+                if return_list:
+                    yield list(batch)
+                else:
+                    yield dict(zip(self._feed_names, batch))
+
+    return _GeneratorLoader()
+
+
+DataLoader.from_generator = staticmethod(_dataloader_from_generator)
+
+
+class PyReader:
+    """reference fluid/reader.py PyReader:1327 — the fluid-era feeding
+    reader.  Iterable mode only (start()/reset() program-op mode is
+    absorbed: the whole-block Executor consumes feed dicts, there is no
+    in-graph read op to start/stop)."""
+
+    def __init__(self, feed_list=None, capacity=16, use_double_buffer=True,
+                 iterable=True, return_list=False):
+        if not iterable:
+            raise NotImplementedError(
+                "PyReader(iterable=False) relied on in-program reader ops "
+                "(create_py_reader/read); the TPU executor feeds arrays "
+                "directly — use iterable=True and pass the batch as feed")
+        self._loader = _dataloader_from_generator(
+            feed_list=feed_list, capacity=capacity,
+            use_double_buffer=use_double_buffer, iterable=True,
+            return_list=return_list)
+        self._feed_list = feed_list or []
+
+    def decorate_sample_generator(self, sample_generator, batch_size,
+                                  drop_last=True, places=None):
+        self._loader.set_sample_generator(sample_generator, batch_size,
+                                          drop_last, places)
+
+    def decorate_sample_list_generator(self, reader, places=None):
+        self._loader.set_sample_list_generator(reader, places)
+
+    def decorate_batch_generator(self, reader, places=None):
+        self._loader.set_batch_generator(reader, places)
+
+    def __iter__(self):
+        return iter(self._loader)

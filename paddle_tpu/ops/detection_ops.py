@@ -1080,3 +1080,120 @@ def _collect_fpn_proposals(ctx, op, ins):
     if "RoisNum" in op.outputs:
         outs["RoisNum"] = [jnp.sum(s_top > 0).astype(jnp.int32).reshape(1)]
     return outs
+
+
+@register_op("box_decoder_and_assign")
+def _box_decoder_and_assign(ctx, op, ins):
+    """reference detection/box_decoder_and_assign_op.cc (Cascade R-CNN):
+    decode per-class deltas against each prior, then assign each box its
+    argmax-class decode."""
+    prior = first(ins, "PriorBox")        # (N, 4)
+    pvar = first(ins, "PriorBoxVar", None)
+    target = first(ins, "TargetBox")      # (N, C*4)
+    score = first(ins, "BoxScore")        # (N, C)
+    clip = op.attr("box_clip", 4.135)
+    n = prior.shape[0]
+    c = score.shape[1]
+    d = target.reshape(n, c, 4)
+    pw = prior[:, 2] - prior[:, 0] + 1.0
+    ph = prior[:, 3] - prior[:, 1] + 1.0
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    # reference reads ONE shared 4-vector (prior_box_var_data[0..3])
+    # for every prior
+    if pvar is not None:
+        v = pvar.reshape(-1)[:4]
+    else:
+        v = jnp.ones((4,), prior.dtype)
+    dcx = v[0] * d[..., 0] * pw[:, None] + pcx[:, None]
+    dcy = v[1] * d[..., 1] * ph[:, None] + pcy[:, None]
+    dw = jnp.exp(jnp.minimum(v[2] * d[..., 2], clip)) * pw[:, None]
+    dh = jnp.exp(jnp.minimum(v[3] * d[..., 3], clip)) * ph[:, None]
+    decoded = jnp.stack([dcx - dw / 2, dcy - dh / 2,
+                         dcx + dw / 2 - 1.0, dcy + dh / 2 - 1.0],
+                        axis=-1)  # (N, C, 4)
+    # reference argmax considers only FOREGROUND classes (j > 0) and
+    # falls back to the raw prior when background wins outright
+    fg_score = score[:, 1:] if c > 1 else score
+    best = (jnp.argmax(fg_score, axis=1) + (1 if c > 1 else 0))
+    assigned = jnp.take_along_axis(
+        decoded, best[:, None, None].repeat(4, -1), axis=1)[:, 0]
+    if c > 1:
+        bg_wins = score[:, 0] >= jnp.max(fg_score, axis=1)
+        assigned = jnp.where(bg_wins[:, None], prior, assigned)
+    return {"DecodeBox": [decoded.reshape(n, c * 4)],
+            "OutputAssignBox": [assigned]}
+
+
+@register_op("rpn_target_assign")
+def _rpn_target_assign(ctx, op, ins):
+    """reference detection/rpn_target_assign_op.cc.  Dense re-design:
+    instead of the reference's ragged index outputs
+    (LocationIndex/ScoreIndex) sized by the random subsample, this
+    returns full-length per-anchor targets plus 0/1 weight masks — the
+    same loss is computed by masking, and the random positive/negative
+    subsampling uses the op's deterministic rng key.
+
+    Outputs: ScoreTarget (B, A, 1) in {-1, 0, 1} (-1 = unsampled),
+    LocationTarget (B, A, 4), LocationWeight (B, A, 1),
+    ScoreWeight (B, A, 1)."""
+    anchors = first(ins, "Anchor").reshape(-1, 4)     # (A, 4)
+    gt = first(ins, "GtBoxes")                        # (B, G, 4)
+    if gt.ndim == 2:
+        gt = gt[None]
+    rpn_batch = int(op.attr("rpn_batch_size_per_im", 256))
+    fg_frac = op.attr("rpn_fg_fraction", 0.5)
+    pos_thr = op.attr("rpn_positive_overlap", 0.7)
+    neg_thr = op.attr("rpn_negative_overlap", 0.3)
+    b, g, _ = gt.shape
+    a = anchors.shape[0]
+    n_fg = int(rpn_batch * fg_frac)
+    key = ctx.rng_key(op)
+
+    def per_image(gts, k):
+        valid_gt = (gts[:, 2] > gts[:, 0]) & (gts[:, 3] > gts[:, 1])
+        iou = _iou_matrix(anchors, gts, normalized=False)  # (A, G)
+        iou = jnp.where(valid_gt[None, :], iou, 0.0)
+        best_iou = jnp.max(iou, axis=1)
+        best_gt = jnp.argmax(iou, axis=1)
+        # positives: iou > pos_thr, plus the best anchor per gt
+        pos = best_iou >= pos_thr
+        best_anchor = jnp.argmax(iou, axis=0)  # (G,)
+        # OR-scatter (max) so a padded gt's stale False can never
+        # overwrite a valid gt's forced positive on the same anchor
+        pos = pos.at[jnp.where(valid_gt, best_anchor, a)].max(
+            True, mode="drop")
+        neg = best_iou < neg_thr
+        # random subsample to n_fg positives / rest negatives
+        k1, k2 = jax.random.split(k)
+        r_pos = jnp.where(pos, jax.random.uniform(k1, (a,)), 2.0)
+        pos_rank = jnp.argsort(jnp.argsort(r_pos))
+        pos_keep = pos & (pos_rank < n_fg)
+        n_pos = jnp.sum(pos_keep)
+        n_neg = rpn_batch - n_pos
+        r_neg = jnp.where(neg & jnp.logical_not(pos),
+                          jax.random.uniform(k2, (a,)), 2.0)
+        neg_rank = jnp.argsort(jnp.argsort(r_neg))
+        neg_keep = neg & jnp.logical_not(pos) & (neg_rank < n_neg)
+        score_t = jnp.where(pos_keep, 1,
+                            jnp.where(neg_keep, 0, -1)).astype(jnp.int32)
+        # location targets: encode matched gt against anchor
+        mg = gts[best_gt]
+        aw = anchors[:, 2] - anchors[:, 0] + 1.0
+        ah = anchors[:, 3] - anchors[:, 1] + 1.0
+        acx = anchors[:, 0] + aw * 0.5
+        acy = anchors[:, 1] + ah * 0.5
+        gw = mg[:, 2] - mg[:, 0] + 1.0
+        gh = mg[:, 3] - mg[:, 1] + 1.0
+        gcx = mg[:, 0] + gw * 0.5
+        gcy = mg[:, 1] + gh * 0.5
+        loc_t = jnp.stack([(gcx - acx) / aw, (gcy - acy) / ah,
+                           jnp.log(gw / aw), jnp.log(gh / ah)], axis=-1)
+        return (score_t[:, None], loc_t,
+                pos_keep.astype(jnp.float32)[:, None],
+                (pos_keep | neg_keep).astype(jnp.float32)[:, None])
+
+    keys = jax.random.split(key, b)
+    st, lt, lw, sw = jax.vmap(per_image)(gt, keys)
+    return {"ScoreTarget": [st], "LocationTarget": [lt],
+            "LocationWeight": [lw], "ScoreWeight": [sw]}

@@ -181,3 +181,47 @@ class TestDatasets:
         assert sub[0] == (4,) and len(sub) == 2
         a, b = io.random_split(td1, [3, 2])
         assert len(a) == 3 and len(b) == 2
+
+
+class TestPyReader:
+    def test_pyreader_iterable(self, fresh_programs):
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.io import PyReader
+
+        main, startup, scope = fresh_programs
+        x = fluid.data("x", [-1, 4], "float32")
+        y = fluid.data("y", [-1, 1], "float32")
+        reader = PyReader(feed_list=[x, y], capacity=4, iterable=True,
+                          return_list=False)
+
+        def sample_gen():
+            for i in range(7):
+                yield (np.full(4, i, "float32"),
+                       np.array([i], "float32"))
+
+        reader.decorate_sample_generator(sample_gen, batch_size=2,
+                                         drop_last=True)
+        batches = list(reader)
+        assert len(batches) == 3  # 7 samples, bs 2, drop_last
+        assert set(batches[0].keys()) == {"x", "y"}
+        np.testing.assert_allclose(batches[1]["x"][0], np.full(4, 2))
+
+    def test_pyreader_noniterable_raises(self):
+        from paddle_tpu.io import PyReader
+
+        with pytest.raises(NotImplementedError, match="iterable"):
+            PyReader(iterable=False)
+
+    def test_dataloader_from_generator_batch(self):
+        from paddle_tpu.io import DataLoader
+
+        loader = DataLoader.from_generator(capacity=4, return_list=True)
+
+        def batches():
+            for i in range(3):
+                yield [np.full((2, 4), i, "float32")]
+
+        loader.set_batch_generator(batches)
+        got = list(loader)
+        assert len(got) == 3
+        np.testing.assert_allclose(got[2][0], np.full((2, 4), 2))

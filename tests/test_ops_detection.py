@@ -570,3 +570,42 @@ def test_generate_proposals_v1_iminfo_scale():
     # box side 6 px on the feature grid -> (6-1)/2 + 1 = 3.5 < 4 in
     # original pixels: every proposal is dropped under v1 scaling
     assert d["RpnRoisNum"][0] == 0
+
+
+def test_box_decoder_and_assign():
+    prior = np.array([[0, 0, 9, 9]], "float32")
+    # class 0: zero deltas; class 1: shift right by width
+    target = np.array([[0, 0, 0, 0, 1.0, 0, 0, 0]], "float32")
+    score = np.array([[0.2, 0.8]], "float32")
+    d = run_det_op("box_decoder_and_assign",
+                   {"PriorBox": prior, "TargetBox": target,
+                    "BoxScore": score},
+                   {"box_clip": 4.135},
+                   ["DecodeBox", "OutputAssignBox"])
+    np.testing.assert_allclose(d["DecodeBox"][0, :4], [0, 0, 9, 9],
+                               atol=1e-4)
+    np.testing.assert_allclose(d["DecodeBox"][0, 4:], [10, 0, 19, 9],
+                               atol=1e-4)
+    # argmax class is 1 -> assigned box is the shifted decode
+    np.testing.assert_allclose(d["OutputAssignBox"][0], [10, 0, 19, 9],
+                               atol=1e-4)
+
+
+def test_rpn_target_assign_masks():
+    anchors = np.array([[0, 0, 9, 9], [20, 20, 29, 29],
+                        [100, 100, 109, 109]], "float32")
+    gt = np.array([[[0, 0, 9, 9]]], "float32")  # matches anchor 0
+    d = run_det_op("rpn_target_assign",
+                   {"Anchor": anchors, "GtBoxes": gt},
+                   {"rpn_batch_size_per_im": 4, "rpn_fg_fraction": 0.5,
+                    "rpn_positive_overlap": 0.7,
+                    "rpn_negative_overlap": 0.3},
+                   ["ScoreTarget", "LocationTarget", "LocationWeight",
+                    "ScoreWeight"],
+                   {"ScoreTarget": "int32"})
+    st = d["ScoreTarget"][0, :, 0]
+    assert st[0] == 1          # perfect-overlap anchor is positive
+    assert st[1] in (0, -1) and st[2] in (0, -1)
+    assert d["LocationWeight"][0, 0, 0] == 1.0
+    # location target for the exact match is all zeros
+    np.testing.assert_allclose(d["LocationTarget"][0, 0], 0.0, atol=1e-5)
