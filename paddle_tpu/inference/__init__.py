@@ -26,13 +26,15 @@ import os
 import numpy as np
 
 
-def save_inference_model(path_prefix, layer, input_spec, fold_params=True):
+def save_inference_model(path_prefix, layer, input_spec, fold_params=True,
+                         cipher=None, key=None):
     """Export `layer.forward` over `input_spec` to StableHLO.
 
     input_spec: list of (shape, dtype) or arrays providing example
     shapes.  Writes <prefix>.stablehlo + <prefix>.json manifest (+
-    <prefix>.pdiparams when fold_params=False).
-    """
+    <prefix>.pdiparams when fold_params=False).  With `cipher` + `key`
+    (inference.crypto) the StableHLO artifact is stored ENCRYPTED — the
+    reference's encrypted-model path (framework/io/crypto)."""
     import jax
     from jax import export as jexport
 
@@ -70,14 +72,33 @@ def save_inference_model(path_prefix, layer, input_spec, fold_params=True):
         from ..framework_io import save as psave
 
         psave(state, params_path)
+        if cipher is not None or key is not None:
+            raise NotImplementedError(
+                "save_inference_model: encryption with fold_params=False "
+                "would leave the .pdiparams weights in PLAINTEXT; fold "
+                "the params (fold_params=True) so the whole model is one "
+                "encrypted StableHLO artifact")
 
     d = os.path.dirname(path_prefix)
     if d:
         os.makedirs(d, exist_ok=True)
+    blob = exp.serialize()
+    if key is not None and cipher is None:
+        from .crypto import AESCipher
+
+        cipher = AESCipher("CTR")
+    if cipher is not None:
+        if key is None:
+            raise ValueError("save_inference_model: cipher given "
+                             "without key")
+        blob = cipher.encrypt(bytes(blob), key)
     with open(path_prefix + ".stablehlo", "wb") as f:
-        f.write(exp.serialize())
+        f.write(blob)
     manifest = {
         "format": "stablehlo",
+        "encrypted": cipher is not None,
+        "cipher": (type(cipher).__name__ + ":" + cipher._mode
+                   if cipher is not None else None),
         "fold_params": fold_params,
         "inputs": [{"shape": list(s.shape), "dtype": np.dtype(s.dtype).name}
                    for s in specs],
@@ -102,9 +123,20 @@ class Config:
     def __init__(self, model_path_prefix=None):
         self.model_prefix = model_path_prefix
         self.device = None  # default jax device
+        self.cipher = None
+        self.cipher_key = None
 
     def set_model(self, prefix):
         self.model_prefix = prefix
+
+    def set_cipher(self, key, cipher=None):
+        """Key (+ cipher, default AES-CTR) for encrypted models
+        (reference predictor SetModelBuffer-over-decrypted-bytes
+        path)."""
+        from .crypto import AESCipher
+
+        self.cipher_key = key
+        self.cipher = cipher or AESCipher("CTR")
 
     def enable_memory_optim(self):
         pass  # XLA buffer assignment
@@ -121,10 +153,24 @@ class Predictor:
         from jax import export as jexport
 
         prefix = config.model_prefix
-        with open(prefix + ".stablehlo", "rb") as f:
-            self._exported = jexport.deserialize(f.read())
         with open(prefix + ".json") as f:
             self.manifest = json.load(f)
+        with open(prefix + ".stablehlo", "rb") as f:
+            blob = f.read()
+        if self.manifest.get("encrypted"):
+            if config.cipher_key is None:
+                raise ValueError(
+                    "encrypted inference model: call "
+                    "Config.set_cipher(key) before create_predictor")
+            cipher = config.cipher
+            mode = (self.manifest.get("cipher") or ":CTR").split(":")[-1]
+            if cipher is None or getattr(cipher, "_mode", mode) != mode:
+                from .crypto import AESCipher
+
+                cipher = AESCipher(mode)  # manifest wins: wrong-mode
+                # decrypt would garble the blob into an opaque parse error
+            blob = cipher.decrypt(blob, config.cipher_key)
+        self._exported = jexport.deserialize(bytearray(blob))
         self._params = None
         if self.manifest.get("params_file"):
             from ..framework_io import load as pload

@@ -1,0 +1,111 @@
+"""Encrypted-model io — the TPU port of the reference's crypto layer
+(/root/reference/paddle/fluid/framework/io/crypto/: cipher.cc
+CipherFactory::CreateCipher:22, aes_cipher.cc, cipher_utils.cc
+CipherUtils::GenKey:25).
+
+The reference wraps cryptopp AES (CTR / GCM variants) so inference
+models and parameters can ship encrypted and be decrypted in memory by
+the predictor.  Here the `cryptography` package provides the same AES
+primitives; the on-disk format is `nonce || ciphertext [|| tag]` like
+the reference's cipher-engine framing.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["Cipher", "AESCipher", "CipherFactory", "CipherUtils"]
+
+
+class Cipher:
+    """Abstract cipher (reference crypto/cipher.h)."""
+
+    def encrypt(self, plaintext: bytes, key: bytes) -> bytes:
+        raise NotImplementedError
+
+    def decrypt(self, ciphertext: bytes, key: bytes) -> bytes:
+        raise NotImplementedError
+
+    def encrypt_to_file(self, plaintext: bytes, key: bytes, path: str):
+        with open(path, "wb") as f:
+            f.write(self.encrypt(plaintext, key))
+
+    def decrypt_from_file(self, key: bytes, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return self.decrypt(f.read(), key)
+
+
+class AESCipher(Cipher):
+    """AES in CTR or GCM mode (reference aes_cipher.cc variants
+    AES_CTR_NoPadding / AES_GCM_NoPadding)."""
+
+    def __init__(self, mode="CTR", iv_size=16, tag_size=16):
+        if mode not in ("CTR", "GCM"):
+            raise ValueError(f"AESCipher: unsupported mode {mode!r}")
+        self._mode = mode
+        self._iv_size = iv_size
+        self._tag_size = tag_size
+
+    def encrypt(self, plaintext: bytes, key: bytes) -> bytes:
+        from cryptography.hazmat.primitives.ciphers import (
+            Cipher as _C, algorithms, modes)
+
+        iv = os.urandom(self._iv_size)
+        if self._mode == "GCM":
+            enc = _C(algorithms.AES(key), modes.GCM(iv)).encryptor()
+            ct = enc.update(plaintext) + enc.finalize()
+            return iv + ct + enc.tag
+        enc = _C(algorithms.AES(key), modes.CTR(iv)).encryptor()
+        return iv + enc.update(plaintext) + enc.finalize()
+
+    def decrypt(self, ciphertext: bytes, key: bytes) -> bytes:
+        from cryptography.hazmat.primitives.ciphers import (
+            Cipher as _C, algorithms, modes)
+
+        iv = ciphertext[:self._iv_size]
+        if self._mode == "GCM":
+            tag = ciphertext[-self._tag_size:]
+            body = ciphertext[self._iv_size:-self._tag_size]
+            dec = _C(algorithms.AES(key), modes.GCM(iv, tag)).decryptor()
+            return dec.update(body) + dec.finalize()
+        dec = _C(algorithms.AES(key), modes.CTR(iv)).decryptor()
+        return dec.update(ciphertext[self._iv_size:]) + dec.finalize()
+
+
+class CipherFactory:
+    """reference cipher.cc CipherFactory::CreateCipher: resolves a
+    cipher from a config name (default AES_CTR_NoPadding)."""
+
+    @staticmethod
+    def create_cipher(config_file=None) -> Cipher:
+        name = "AES_CTR_NoPadding"
+        if config_file:
+            with open(config_file) as f:
+                for line in f:
+                    if line.strip().startswith("cipher_name"):
+                        name = line.split(":")[-1].strip()
+        if name.startswith("AES_CTR"):
+            return AESCipher("CTR")
+        if name.startswith("AES_GCM"):
+            return AESCipher("GCM")
+        raise ValueError(f"unknown cipher {name!r}")
+
+
+class CipherUtils:
+    """reference cipher_utils.cc."""
+
+    @staticmethod
+    def gen_key(length_bits: int = 256) -> bytes:
+        return os.urandom(length_bits // 8)
+
+    @staticmethod
+    def gen_key_to_file(length_bits: int, path: str) -> bytes:
+        key = CipherUtils.gen_key(length_bits)
+        with open(path, "wb") as f:
+            f.write(key)
+        return key
+
+    @staticmethod
+    def read_key_from_file(path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()

@@ -421,18 +421,17 @@ def _dataloader_from_generator(feed_list=None, capacity=16,
                                  drop_last=None, places=None):
             if drop_last is None:
                 drop_last = outer_drop_last
+
             def gen():
                 batch = []
                 for sample in reader():
                     batch.append(sample if isinstance(sample, (list, tuple))
                                  else (sample,))
                     if len(batch) == batch_size:
-                        yield [np.stack([b[i] for b in batch])
-                               for i in range(len(batch[0]))]
+                        yield list(default_collate_fn(batch))
                         batch = []
                 if batch and not drop_last:
-                    yield [np.stack([b[i] for b in batch])
-                           for i in range(len(batch[0]))]
+                    yield list(default_collate_fn(batch))
 
             self._set(gen)
             return self
@@ -440,8 +439,7 @@ def _dataloader_from_generator(feed_list=None, capacity=16,
         def set_sample_list_generator(self, reader, places=None):
             def gen():
                 for samples in reader():
-                    yield [np.stack([s[i] for s in samples])
-                           for i in range(len(samples[0]))]
+                    yield list(default_collate_fn(list(samples)))
 
             self._set(gen)
             return self
@@ -463,6 +461,12 @@ def _dataloader_from_generator(feed_list=None, capacity=16,
                 if return_list:
                     yield list(batch)
                 else:
+                    if len(self._feed_names) != len(batch):
+                        raise ValueError(
+                            "DataLoader.from_generator(return_list="
+                            f"False): {len(batch)} batch columns but "
+                            f"{len(self._feed_names)} feed vars — a "
+                            "silent zip would drop data")
                     yield dict(zip(self._feed_names, batch))
 
     return _GeneratorLoader()

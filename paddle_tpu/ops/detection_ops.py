@@ -1112,15 +1112,16 @@ def _box_decoder_and_assign(ctx, op, ins):
     decoded = jnp.stack([dcx - dw / 2, dcy - dh / 2,
                          dcx + dw / 2 - 1.0, dcy + dh / 2 - 1.0],
                         axis=-1)  # (N, C, 4)
-    # reference argmax considers only FOREGROUND classes (j > 0) and
-    # falls back to the raw prior when background wins outright
-    fg_score = score[:, 1:] if c > 1 else score
-    best = (jnp.argmax(fg_score, axis=1) + (1 if c > 1 else 0))
-    assigned = jnp.take_along_axis(
-        decoded, best[:, None, None].repeat(4, -1), axis=1)[:, 0]
+    # reference: argmax over FOREGROUND classes only (j > 0),
+    # UNCONDITIONALLY — the background score is never compared; the
+    # prior-box fallback fires only when no foreground class exists
+    # (class_num == 1)
     if c > 1:
-        bg_wins = score[:, 0] >= jnp.max(fg_score, axis=1)
-        assigned = jnp.where(bg_wins[:, None], prior, assigned)
+        best = jnp.argmax(score[:, 1:], axis=1) + 1
+        assigned = jnp.take_along_axis(
+            decoded, best[:, None, None].repeat(4, -1), axis=1)[:, 0]
+    else:
+        assigned = prior
     return {"DecodeBox": [decoded.reshape(n, c * 4)],
             "OutputAssignBox": [assigned]}
 

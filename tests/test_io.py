@@ -225,3 +225,48 @@ class TestPyReader:
         got = list(loader)
         assert len(got) == 3
         np.testing.assert_allclose(got[2][0], np.full((2, 4), 2))
+
+
+class TestEncryptedInference:
+    def test_cipher_roundtrip(self, tmp_path):
+        from paddle_tpu.inference.crypto import (AESCipher, CipherFactory,
+                                                 CipherUtils)
+
+        key = CipherUtils.gen_key_to_file(256, str(tmp_path / "k"))
+        assert CipherUtils.read_key_from_file(str(tmp_path / "k")) == key
+        for mode in ("CTR", "GCM"):
+            c = AESCipher(mode)
+            blob = b"model bytes" * 100
+            enc = c.encrypt(blob, key)
+            assert enc != blob
+            assert c.decrypt(enc, key) == blob
+        assert isinstance(CipherFactory.create_cipher(), AESCipher)
+
+    def test_encrypted_model_save_load(self, tmp_path):
+        import paddle_tpu as paddle
+        from paddle_tpu import inference
+        from paddle_tpu.inference.crypto import AESCipher, CipherUtils
+
+        paddle.disable_static()
+        try:
+            import paddle_tpu.nn as nn
+
+            net = nn.Linear(4, 2)
+            key = CipherUtils.gen_key(256)
+            cipher = AESCipher("GCM")
+            prefix = str(tmp_path / "m")
+            inference.save_inference_model(
+                prefix, net, [(([1, 4]), "float32")],
+                cipher=cipher, key=key)
+            # wrong path: no key -> loud error
+            cfg = inference.Config(prefix)
+            with pytest.raises(ValueError, match="set_cipher"):
+                inference.create_predictor(cfg)
+            cfg.set_cipher(key, cipher)
+            pred = inference.create_predictor(cfg)
+            x = np.ones((1, 4), "float32")
+            (out,) = pred.run([x])
+            want = net(paddle.to_tensor(x)).numpy()
+            np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5)
+        finally:
+            paddle.enable_static()

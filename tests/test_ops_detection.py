@@ -589,6 +589,14 @@ def test_box_decoder_and_assign():
     # argmax class is 1 -> assigned box is the shifted decode
     np.testing.assert_allclose(d["OutputAssignBox"][0], [10, 0, 19, 9],
                                atol=1e-4)
+    # bg score dominating changes NOTHING: reference never compares bg
+    d2 = run_det_op("box_decoder_and_assign",
+                    {"PriorBox": prior, "TargetBox": target,
+                     "BoxScore": np.array([[0.9, 0.1]], "float32")},
+                    {"box_clip": 4.135},
+                    ["DecodeBox", "OutputAssignBox"])
+    np.testing.assert_allclose(d2["OutputAssignBox"][0], [10, 0, 19, 9],
+                               atol=1e-4)
 
 
 def test_rpn_target_assign_masks():
