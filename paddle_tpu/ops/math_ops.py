@@ -462,3 +462,65 @@ def _isfinite(ctx, op, ins):
     # isfinite_op.cc reduces over the whole tensor).
     x = first(ins, "X")
     return {"Out": [jnp.logical_not(jnp.all(jnp.isfinite(x)))]}
+
+
+@register_op("dist")
+def _dist(ctx, op, ins):
+    """reference dist_op.cc: p-norm of x - y (broadcasted)."""
+    x, y = first(ins, "X"), first(ins, "Y")
+    p = op.attr("p", 2.0)
+    d = jnp.abs(x - y)
+    if p == float("inf"):
+        return {"Out": [jnp.max(d)]}
+    if p == float("-inf"):
+        return {"Out": [jnp.min(d)]}
+    if p == 0:
+        return {"Out": [jnp.sum(d != 0).astype(x.dtype)]}
+    return {"Out": [jnp.power(jnp.sum(jnp.power(d, p)), 1.0 / p)]}
+
+
+@register_op("cross")
+def _cross(ctx, op, ins):
+    """reference cross_op.cc: 3-element cross product along `dim`."""
+    x, y = first(ins, "X"), first(ins, "Y")
+    dim = op.attr("dim", None)
+    if dim is None:
+        dim = next(i for i, s in enumerate(x.shape) if s == 3)
+    return {"Out": [jnp.cross(x, y, axis=int(dim))]}
+
+
+@register_op("cholesky")
+def _cholesky(ctx, op, ins):
+    """reference cholesky_op.cc (cusolver potrf): XLA has a native
+    blocked Cholesky."""
+    x = first(ins, "X")
+    out = jnp.linalg.cholesky(x)
+    if not op.attr("upper", False):
+        return {"Out": [out]}
+    return {"Out": [jnp.swapaxes(out, -1, -2)]}
+
+
+@register_op("histogram")
+def _histogram(ctx, op, ins):
+    """reference histogram_op.cc: fixed-bin counts; when min==max==0
+    the range spans the data — which is data-dependent, so on TPU that
+    form computes the range with a stop-gradient reduce (static bin
+    COUNT keeps shapes static)."""
+    x = first(ins, "X").reshape(-1)
+    bins = int(op.attr("bins", 100))
+    mn = float(op.attr("min", 0))
+    mx = float(op.attr("max", 0))
+    if mn == 0 and mx == 0:
+        lo = jnp.min(x).astype(jnp.float32)
+        hi = jnp.max(x).astype(jnp.float32)
+        hi = jnp.where(hi > lo, hi, lo + 1.0)
+    else:
+        lo = jnp.float32(mn)
+        hi = jnp.float32(mx)
+    xf = x.astype(jnp.float32)
+    idx = jnp.floor((xf - lo) / (hi - lo) * bins).astype(jnp.int32)
+    idx = jnp.clip(idx, 0, bins - 1)
+    in_range = (xf >= lo) & (xf <= hi)
+    counts = jnp.zeros((bins,), jnp.int32).at[
+        jnp.where(in_range, idx, bins)].add(1, mode="drop")
+    return {"Out": [counts.astype(jnp.int64)]}

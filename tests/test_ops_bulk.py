@@ -651,6 +651,31 @@ case(op_type="pool2d", inputs={"X": _ap_small},
      atol=1e-5, id="pool2d_adaptive_upsample")
 
 
+# -- linalg tail (dist / cross / cholesky / histogram) ----------------------
+
+_dx = randf(3, 4, seed=601)
+_dy = randf(3, 4, seed=602)
+case(op_type="dist", inputs={"X": _dx, "Y": _dy},
+     outputs={"Out": np.power(np.sum(np.abs(_dx - _dy) ** 2), 0.5)},
+     attrs={"p": 2.0}, grad=["X"], max_rel=1e-2)
+_cx = randf(2, 3, seed=603)
+_cy = randf(2, 3, seed=604)
+case(op_type="cross", inputs={"X": _cx, "Y": _cy},
+     outputs={"Out": np.cross(_cx, _cy, axis=1)}, attrs={"dim": 1},
+     grad=["X", "Y"])
+_ch_a = randf(3, 3, seed=605)
+_ch = _ch_a @ _ch_a.T + 3 * np.eye(3, dtype="float32")
+case(op_type="cholesky", inputs={"X": _ch},
+     outputs={"Out": np.linalg.cholesky(_ch)}, atol=1e-4)
+case(op_type="cholesky", inputs={"X": _ch},
+     outputs={"Out": np.linalg.cholesky(_ch).T},
+     attrs={"upper": True}, atol=1e-4, id="cholesky_upper")
+_h_x = np.array([0.1, 0.4, 0.6, 0.9, 0.95, -1.0, 2.0], "float32")
+case(op_type="histogram", inputs={"X": _h_x},
+     outputs={"Out": np.array([1, 2, 2], "int64")},  # 0.1|0.4,0.6|0.9,0.95
+     attrs={"bins": 3, "min": 0.0, "max": 1.0})
+
+
 # -- the runner -------------------------------------------------------------
 
 @pytest.mark.parametrize("c", CASES)
