@@ -466,17 +466,11 @@ def _isfinite(ctx, op, ins):
 
 @register_op("dist")
 def _dist(ctx, op, ins):
-    """reference dist_op.cc: p-norm of x - y (broadcasted)."""
+    """reference dist_op.cc: p-norm of the flattened x - y difference
+    (jnp.linalg.norm covers inf/-inf/0/general p identically)."""
     x, y = first(ins, "X"), first(ins, "Y")
     p = op.attr("p", 2.0)
-    d = jnp.abs(x - y)
-    if p == float("inf"):
-        return {"Out": [jnp.max(d)]}
-    if p == float("-inf"):
-        return {"Out": [jnp.min(d)]}
-    if p == 0:
-        return {"Out": [jnp.sum(d != 0).astype(x.dtype)]}
-    return {"Out": [jnp.power(jnp.sum(jnp.power(d, p)), 1.0 / p)]}
+    return {"Out": [jnp.linalg.norm((x - y).ravel(), ord=p)]}
 
 
 @register_op("cross")
@@ -485,7 +479,11 @@ def _cross(ctx, op, ins):
     x, y = first(ins, "X"), first(ins, "Y")
     dim = op.attr("dim", None)
     if dim is None:
-        dim = next(i for i, s in enumerate(x.shape) if s == 3)
+        dim = next((i for i, s in enumerate(x.shape) if s == 3), None)
+        if dim is None:
+            raise ValueError(
+                f"cross: no dimension of size 3 in shape {x.shape}; "
+                "pass dim explicitly")
     return {"Out": [jnp.cross(x, y, axis=int(dim))]}
 
 
@@ -513,7 +511,14 @@ def _histogram(ctx, op, ins):
     if mn == 0 and mx == 0:
         lo = jnp.min(x).astype(jnp.float32)
         hi = jnp.max(x).astype(jnp.float32)
-        hi = jnp.where(hi > lo, hi, lo + 1.0)
+        # all-equal data: reference widens to [v-1, v+1] (middle bin)
+        lo, hi = (jnp.where(hi > lo, lo, lo - 1.0),
+                  jnp.where(hi > lo, hi, hi + 1.0))
+    elif mn == mx:
+        # reference histogram_op.cc widens an equal range to [min-1,
+        # max+1] instead of dividing by zero
+        lo = jnp.float32(mn - 1.0)
+        hi = jnp.float32(mx + 1.0)
     else:
         lo = jnp.float32(mn)
         hi = jnp.float32(mx)

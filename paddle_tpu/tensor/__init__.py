@@ -665,17 +665,6 @@ def mode(x, axis=-1, keepdim=False, name=None):
     return trace_fn(f, {"x": x})
 
 
-def dist(x, y, p=2.0, name=None):
-    """reference tensor/linalg.py dist:455."""
-    return trace_op("dist", {"X": x, "Y": y}, {"p": float(p)})
-
-
-def cross(x, y, axis=None, name=None):
-    """reference tensor/linalg.py cross."""
-    return trace_op("cross", {"X": x, "Y": y},
-                    {"dim": axis} if axis is not None else {})
-
-
 def cholesky(x, upper=False, name=None):
     """reference tensor/linalg.py cholesky."""
     return trace_op("cholesky", {"X": x}, {"upper": upper})
@@ -685,10 +674,3 @@ def histogram(input, bins=100, min=0, max=0, name=None):
     """reference tensor/linalg.py histogram."""
     return trace_op("histogram", {"X": input},
                     {"bins": bins, "min": min, "max": max})
-
-
-def t(input, name=None):
-    """reference tensor/linalg.py t: transpose a 0/1/2-D tensor."""
-    if len(input.shape) < 2:
-        return input
-    return transpose(input, [1, 0])
