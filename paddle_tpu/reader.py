@@ -1,0 +1,217 @@
+"""paddle.reader — the fluid-era reader-decorator toolkit
+(reference python/paddle/reader/decorator.py: cache:51, map_readers:91,
+shuffle:133, chain:182, compose:247, buffered:307, firstn:366,
+xmap_readers:411, multiprocess_reader:504).
+
+A *reader creator* is a zero-arg callable returning an iterable of
+samples; every decorator maps reader creators to reader creators.  These
+are host-side python utilities — identical semantics to the reference,
+with threads instead of the reference's multiprocessing pipes for
+xmap/multiprocess (TPU hosts feed from threads; see io.DataLoader for
+the C++-queue path).
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import random
+import threading
+
+__all__ = ["cache", "map_readers", "buffered", "compose", "chain",
+           "shuffle", "firstn", "xmap_readers", "multiprocess_reader"]
+
+
+def cache(reader):
+    """Cache the first full pass in memory; later passes replay it."""
+    all_data = tuple(reader())
+
+    def creator():
+        return iter(all_data)
+
+    return creator
+
+
+def map_readers(func, *readers):
+    """Yield func(*samples) over the zip of the readers' outputs."""
+
+    def creator():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+
+    return creator
+
+
+def shuffle(reader, buf_size):
+    """Buffered shuffle: fill a buf_size window, shuffle, emit."""
+
+    def creator():
+        buf = []
+        for s in reader():
+            buf.append(s)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            random.shuffle(buf)
+            yield from buf
+
+    return creator
+
+
+def chain(*readers):
+    """Concatenate readers back to back."""
+
+    def creator():
+        return itertools.chain(*[r() for r in readers])
+
+    return creator
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into flattened tuples: (a, (b, c)) -> (a, b, c).
+    check_alignment=True (default) raises when readers end unevenly."""
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def creator():
+        rs = [r() for r in readers]
+        if not check_alignment:
+            for outputs in zip(*rs):
+                yield sum(map(make_tuple, outputs), ())
+            return
+        for outputs in itertools.zip_longest(*rs):
+            if any(o is None for o in outputs):
+                raise ValueError(
+                    "compose: readers have different lengths "
+                    "(check_alignment=True)")
+            yield sum(map(make_tuple, outputs), ())
+
+    return creator
+
+
+def buffered(reader, size):
+    """Read ahead up to `size` samples in a background thread."""
+
+    end = object()
+
+    def creator():
+        q = queue.Queue(maxsize=size)
+
+        def fill():
+            try:
+                for s in reader():
+                    q.put(s)
+            finally:
+                q.put(end)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            s = q.get()
+            if s is end:
+                return
+            yield s
+
+    return creator
+
+
+def firstn(reader, n):
+    """Only the first n samples."""
+
+    def creator():
+        return itertools.islice(reader(), n)
+
+    return creator
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over a reader with `process_num` worker threads.
+    order=True preserves input order (the reference tags samples with
+    indices and reorders on the output side)."""
+
+    end = object()
+
+    def creator():
+        in_q = queue.Queue(buffer_size)
+        out_q = queue.Queue(buffer_size)
+
+        def feed():
+            for i, s in enumerate(reader()):
+                in_q.put((i, s))
+            for _ in range(process_num):
+                in_q.put(end)
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is end:
+                    out_q.put(end)
+                    return
+                i, s = item
+                out_q.put((i, mapper(s)))
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+
+        finished = 0
+        if not order:
+            while finished < process_num:
+                item = out_q.get()
+                if item is end:
+                    finished += 1
+                    continue
+                yield item[1]
+            return
+        pending = {}
+        next_i = 0
+        while finished < process_num or pending:
+            if next_i in pending:
+                yield pending.pop(next_i)
+                next_i += 1
+                continue
+            item = out_q.get()
+            if item is end:
+                finished += 1
+                continue
+            pending[item[0]] = item[1]
+        while next_i in pending:
+            yield pending.pop(next_i)
+            next_i += 1
+
+    return creator
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Interleave multiple readers concurrently (reference uses worker
+    processes + pipes; TPU hosts feed fine from threads and avoid the
+    fork-vs-jax-runtime hazard)."""
+
+    end = object()
+
+    def creator():
+        q = queue.Queue(queue_size)
+
+        def run(r):
+            try:
+                for s in r():
+                    q.put(s)
+            finally:
+                q.put(end)
+
+        for r in readers:
+            threading.Thread(target=run, args=(r,), daemon=True).start()
+        finished = 0
+        while finished < len(readers):
+            s = q.get()
+            if s is end:
+                finished += 1
+                continue
+            yield s
+
+    return creator

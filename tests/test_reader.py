@@ -1,0 +1,70 @@
+"""paddle.reader decorator tests (reference unittests
+reader/test_decorator.py methodology)."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu import reader
+
+
+def make_reader(n):
+    def r():
+        return iter(range(n))
+    return r
+
+
+def test_cache_and_firstn():
+    calls = []
+
+    def r():
+        calls.append(1)
+        return iter([1, 2, 3])
+
+    c = reader.cache(r)
+    assert list(c()) == [1, 2, 3]
+    assert list(c()) == [1, 2, 3]
+    assert len(calls) == 1  # underlying reader consumed once
+    assert list(reader.firstn(make_reader(10), 4)()) == [0, 1, 2, 3]
+
+
+def test_map_chain_compose():
+    assert list(reader.map_readers(lambda a, b: a + b,
+                                   make_reader(3), make_reader(3))()) \
+        == [0, 2, 4]
+    assert list(reader.chain(make_reader(2), make_reader(3))()) \
+        == [0, 1, 0, 1, 2]
+    out = list(reader.compose(make_reader(2), make_reader(2))())
+    assert out == [(0, 0), (1, 1)]
+    with pytest.raises(ValueError, match="different lengths"):
+        list(reader.compose(make_reader(2), make_reader(3))())
+    # misaligned but unchecked: truncates at the shortest
+    out = list(reader.compose(make_reader(2), make_reader(3),
+                              check_alignment=False)())
+    assert out == [(0, 0), (1, 1)]
+
+
+def test_shuffle_and_buffered():
+    import random
+
+    random.seed(0)
+    got = list(reader.shuffle(make_reader(20), buf_size=10)())
+    assert sorted(got) == list(range(20))
+    assert got != list(range(20))  # actually shuffled
+    assert list(reader.buffered(make_reader(50), size=8)()) \
+        == list(range(50))
+
+
+def test_xmap_readers_ordered_and_unordered():
+    mapper = lambda x: x * x
+    ordered = list(reader.xmap_readers(mapper, make_reader(30), 4, 8,
+                                       order=True)())
+    assert ordered == [i * i for i in range(30)]
+    unordered = list(reader.xmap_readers(mapper, make_reader(30), 4, 8,
+                                         order=False)())
+    assert sorted(unordered) == sorted(i * i for i in range(30))
+
+
+def test_multiprocess_reader_interleaves_all():
+    got = list(reader.multiprocess_reader(
+        [make_reader(10), make_reader(5)])())
+    assert sorted(got) == sorted(list(range(10)) + list(range(5)))
