@@ -74,6 +74,7 @@ def compose(*readers, **kwargs):
     """Zip readers into flattened tuples: (a, (b, c)) -> (a, b, c).
     check_alignment=True (default) raises when readers end unevenly."""
     check_alignment = kwargs.pop("check_alignment", True)
+    _exhausted = object()  # private sentinel: a reader may yield None
 
     def make_tuple(x):
         return x if isinstance(x, tuple) else (x,)
@@ -84,8 +85,8 @@ def compose(*readers, **kwargs):
             for outputs in zip(*rs):
                 yield sum(map(make_tuple, outputs), ())
             return
-        for outputs in itertools.zip_longest(*rs):
-            if any(o is None for o in outputs):
+        for outputs in itertools.zip_longest(*rs, fillvalue=_exhausted):
+            if any(o is _exhausted for o in outputs):
                 raise ValueError(
                     "compose: readers have different lengths "
                     "(check_alignment=True)")
@@ -95,27 +96,47 @@ def compose(*readers, **kwargs):
 
 
 def buffered(reader, size):
-    """Read ahead up to `size` samples in a background thread."""
+    """Read ahead up to `size` samples in a background thread.  Upstream
+    exceptions re-raise in the consumer; abandoning the generator early
+    (e.g. under firstn) releases the fill thread instead of leaking it
+    blocked on a full queue."""
 
     end = object()
 
     def creator():
         q = queue.Queue(maxsize=size)
+        stop = threading.Event()
+
+        def put(item):
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def fill():
             try:
                 for s in reader():
-                    q.put(s)
-            finally:
-                q.put(end)
+                    if not put(s):
+                        return
+                put(end)
+            except BaseException as e:  # forward to the consumer
+                put(e)
 
         t = threading.Thread(target=fill, daemon=True)
         t.start()
-        while True:
-            s = q.get()
-            if s is end:
-                return
-            yield s
+        try:
+            while True:
+                s = q.get()
+                if s is end:
+                    return
+                if isinstance(s, BaseException):
+                    raise s
+                yield s
+        finally:
+            stop.set()
 
     return creator
 
@@ -141,19 +162,27 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
         out_q = queue.Queue(buffer_size)
 
         def feed():
-            for i, s in enumerate(reader()):
-                in_q.put((i, s))
-            for _ in range(process_num):
-                in_q.put(end)
+            try:
+                for i, s in enumerate(reader()):
+                    in_q.put((i, s))
+            except BaseException as e:
+                out_q.put(e)
+            finally:
+                for _ in range(process_num):
+                    in_q.put(end)
 
         def work():
-            while True:
-                item = in_q.get()
-                if item is end:
-                    out_q.put(end)
-                    return
-                i, s = item
-                out_q.put((i, mapper(s)))
+            try:
+                while True:
+                    item = in_q.get()
+                    if item is end:
+                        return
+                    i, s = item
+                    out_q.put((i, mapper(s)))
+            except BaseException as e:  # a dead worker must not deadlock
+                out_q.put(e)
+            finally:
+                out_q.put(end)
 
         threading.Thread(target=feed, daemon=True).start()
         for _ in range(process_num):
@@ -166,6 +195,8 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                 if item is end:
                     finished += 1
                     continue
+                if isinstance(item, BaseException):
+                    raise item
                 yield item[1]
             return
         pending = {}
@@ -179,10 +210,9 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
             if item is end:
                 finished += 1
                 continue
+            if isinstance(item, BaseException):
+                raise item
             pending[item[0]] = item[1]
-        while next_i in pending:
-            yield pending.pop(next_i)
-            next_i += 1
 
     return creator
 
@@ -201,6 +231,8 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
             try:
                 for s in r():
                     q.put(s)
+            except BaseException as e:
+                q.put(e)
             finally:
                 q.put(end)
 
@@ -212,6 +244,8 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
             if s is end:
                 finished += 1
                 continue
+            if isinstance(s, BaseException):
+                raise s
             yield s
 
     return creator

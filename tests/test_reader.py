@@ -68,3 +68,47 @@ def test_multiprocess_reader_interleaves_all():
     got = list(reader.multiprocess_reader(
         [make_reader(10), make_reader(5)])())
     assert sorted(got) == sorted(list(range(10)) + list(range(5)))
+
+
+def test_exceptions_propagate_not_swallowed():
+    def bad():
+        yield 1
+        raise IOError("disk gone")
+
+    with pytest.raises(IOError, match="disk gone"):
+        list(reader.buffered(lambda: bad(), 4)())
+    with pytest.raises(IOError, match="disk gone"):
+        list(reader.xmap_readers(lambda x: x, lambda: bad(), 2, 4)())
+    with pytest.raises(IOError, match="disk gone"):
+        list(reader.multiprocess_reader([lambda: bad()])())
+
+    def boom(x):
+        if x == 5:
+            raise ValueError("mapper died")
+        return x
+
+    with pytest.raises(ValueError, match="mapper died"):
+        list(reader.xmap_readers(boom, make_reader(10), 2, 4,
+                                 order=True)())
+
+
+def test_compose_allows_none_samples():
+    def with_none():
+        return iter([None, 1])
+
+    out = list(reader.compose(with_none, make_reader(2))())
+    assert out == [(None, 0), (1, 1)]
+
+
+def test_buffered_early_stop_releases_thread():
+    import threading as th
+
+    before = th.active_count()
+    for _ in range(5):
+        got = list(reader.firstn(reader.buffered(make_reader(10000), 4),
+                                 3)())
+        assert got == [0, 1, 2]
+    import time
+
+    time.sleep(0.5)  # fill threads notice the stop flag
+    assert th.active_count() <= before + 1
