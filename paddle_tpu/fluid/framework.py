@@ -621,3 +621,11 @@ def block_io(blk: "Block"):
                 writes.append(n)
             defined.add(n)
     return reads, writes
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    """Name-scope prefix for debugging/visualization (reference
+    framework.py name_scope).  Op naming is flat in this build, so the
+    scope is a no-op context retained for API parity."""
+    yield

@@ -6,9 +6,11 @@ prior_box, density_prior_box, anchor_generator, box_coder,
 iou_similarity, box_clip, bipartite_match, multiclass_nms(+v2/v3),
 matrix_nms, generate_proposals(+v2), yolo_box, yolov3_loss,
 sigmoid_focal_loss, roi_align, target_assign, mine_hard_examples,
-polygon_box_transform.  The remaining tail (FPN proposal
-redistribution, mask utilities, retinanet_detection_output) raises
-through the registry's unknown-op error until added.
+polygon_box_transform, roi_pool, distribute/collect_fpn_proposals,
+box_decoder_and_assign, rpn_target_assign,
+retinanet_detection_output.  The remaining tail (mask utilities,
+generate_proposal_labels, locality_aware_nms) raises through the
+registry's unknown-op error until added.
 
 TPU re-design notes:
 - prior_box / anchor_generator are SHAPE-only functions of static attrs:
@@ -1198,3 +1200,91 @@ def _rpn_target_assign(ctx, op, ins):
     st, lt, lw, sw = jax.vmap(per_image)(gt, keys)
     return {"ScoreTarget": [st], "LocationTarget": [lt],
             "LocationWeight": [lw], "ScoreWeight": [sw]}
+
+
+@register_op("retinanet_detection_output")
+def _retinanet_detection_output(ctx, op, ins):
+    """reference detection/retinanet_detection_output_op.cc: per-FPN-
+    level top-k candidate selection above score_threshold, anchor-delta
+    decode clipped to the (scale-corrected) image, class-wise greedy
+    NMS over the merged levels, global keep_top_k.  Dense contract:
+    Out (B, keep_top_k, 6) [label, score, box] padded with -1 labels +
+    RoisNum counts (the reference emits LoD)."""
+    bboxes_list = [v for v in ins.get("BBoxes", []) if v is not None]
+    scores_list = [v for v in ins.get("Scores", []) if v is not None]
+    anchors_list = [v for v in ins.get("Anchors", []) if v is not None]
+    im_info = first(ins, "ImInfo")      # (B, 3) h, w, scale
+    score_thr = op.attr("score_threshold", 0.05)
+    nms_top_k = int(op.attr("nms_top_k", 1000))
+    keep_top_k = int(op.attr("keep_top_k", 100))
+    nms_thr = op.attr("nms_threshold", 0.3)
+    c = scores_list[0].shape[-1]
+    b = scores_list[0].shape[0] if scores_list[0].ndim == 3 else 1
+
+    def decode_level(deltas, anchors, imr):
+        aw = anchors[:, 2] - anchors[:, 0] + 1.0
+        ah = anchors[:, 3] - anchors[:, 1] + 1.0
+        acx = anchors[:, 0] + aw * 0.5
+        acy = anchors[:, 1] + ah * 0.5
+        cx = deltas[:, 0] * aw + acx
+        cy = deltas[:, 1] * ah + acy
+        w = jnp.exp(jnp.minimum(deltas[:, 2], 10.0)) * aw
+        h = jnp.exp(jnp.minimum(deltas[:, 3], 10.0)) * ah
+        ih = jnp.round(imr[0] / imr[2])
+        iw = jnp.round(imr[1] / imr[2])
+        x1 = jnp.clip(cx - w / 2, 0, iw - 1)
+        y1 = jnp.clip(cy - h / 2, 0, ih - 1)
+        x2 = jnp.clip(cx + w / 2 - 1, 0, iw - 1)
+        y2 = jnp.clip(cy + h / 2 - 1, 0, ih - 1)
+        return jnp.stack([x1, y1, x2, y2], axis=-1)
+
+    def per_image(args):
+        level_scores, level_deltas, imr = args
+        cand_s, cand_b, cand_c = [], [], []
+        for sc, dl, an in zip(level_scores, level_deltas, anchors_list):
+            m = sc.shape[0]
+            k = min(nms_top_k, m * c)
+            flat = sc.reshape(-1)
+            s_top, idx = lax.top_k(flat, k)
+            a_idx = idx // c
+            c_idx = (idx % c).astype(jnp.int32)
+            # gather BEFORE decoding: k << m anchors per level
+            boxes = decode_level(dl[a_idx], an[a_idx], imr)
+            s_top = jnp.where(s_top > score_thr, s_top, 0.0)
+            cand_s.append(s_top)
+            cand_b.append(boxes)
+            cand_c.append(c_idx)
+        s_all = jnp.concatenate(cand_s)
+        b_all = jnp.concatenate(cand_b)
+        c_all = jnp.concatenate(cand_c)
+        kept_scores = []
+        for cls in range(c):
+            s_cls = jnp.where(c_all == cls, s_all, 0.0)
+            order = jnp.argsort(-s_cls)
+            keep = _nms_keep(b_all[order], s_cls[order], nms_thr, 0.0,
+                             normalized=False)
+            s_kept = jnp.zeros_like(s_cls).at[order].set(
+                jnp.where(keep, s_cls[order], 0.0))
+            kept_scores.append(s_kept)
+        kept = jnp.stack(kept_scores)  # (C, N) nonzero where kept
+        s_final = jnp.max(kept, axis=0)
+        kk = min(keep_top_k, s_final.shape[0]) if keep_top_k > 0 \
+            else s_final.shape[0]
+        s_out, sel = lax.top_k(s_final, kk)
+        det = jnp.concatenate(
+            [jnp.where(s_out > 0, c_all[sel].astype(jnp.float32),
+                       -1.0)[:, None],
+             s_out[:, None], b_all[sel]], axis=-1)
+        return det, jnp.sum(s_out > 0).astype(jnp.int32)
+
+    dets, counts = [], []
+    for i in range(b):
+        lv_sc = [s[i] if s.ndim == 3 else s for s in scores_list]
+        lv_dl = [d[i] if d.ndim == 3 else d for d in bboxes_list]
+        det, cnt = per_image((lv_sc, lv_dl, im_info[i]))
+        dets.append(det)
+        counts.append(cnt)
+    outs = {"Out": [jnp.stack(dets)]}
+    if "RoisNum" in op.outputs:
+        outs["RoisNum"] = [jnp.stack(counts)]
+    return outs

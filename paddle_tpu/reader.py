@@ -160,59 +160,80 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
     def creator():
         in_q = queue.Queue(buffer_size)
         out_q = queue.Queue(buffer_size)
+        stop = threading.Event()
+
+        def put(q, item):
+            # bounded put that gives up when the consumer is gone —
+            # otherwise abandoned generators leak threads blocked on
+            # full queues (and keep the upstream reader open)
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def feed():
             try:
                 for i, s in enumerate(reader()):
-                    in_q.put((i, s))
+                    if not put(in_q, (i, s)):
+                        return
             except BaseException as e:
-                out_q.put(e)
+                put(out_q, e)
             finally:
                 for _ in range(process_num):
-                    in_q.put(end)
+                    put(in_q, end)
 
         def work():
             try:
-                while True:
-                    item = in_q.get()
+                while not stop.is_set():
+                    try:
+                        item = in_q.get(timeout=0.1)
+                    except queue.Empty:
+                        continue
                     if item is end:
                         return
                     i, s = item
-                    out_q.put((i, mapper(s)))
+                    if not put(out_q, (i, mapper(s))):
+                        return
             except BaseException as e:  # a dead worker must not deadlock
-                out_q.put(e)
+                put(out_q, e)
             finally:
-                out_q.put(end)
+                put(out_q, end)
 
         threading.Thread(target=feed, daemon=True).start()
         for _ in range(process_num):
             threading.Thread(target=work, daemon=True).start()
 
-        finished = 0
-        if not order:
-            while finished < process_num:
+        try:
+            finished = 0
+            if not order:
+                while finished < process_num:
+                    item = out_q.get()
+                    if item is end:
+                        finished += 1
+                        continue
+                    if isinstance(item, BaseException):
+                        raise item
+                    yield item[1]
+                return
+            pending = {}
+            next_i = 0
+            while finished < process_num or pending:
+                if next_i in pending:
+                    yield pending.pop(next_i)
+                    next_i += 1
+                    continue
                 item = out_q.get()
                 if item is end:
                     finished += 1
                     continue
                 if isinstance(item, BaseException):
                     raise item
-                yield item[1]
-            return
-        pending = {}
-        next_i = 0
-        while finished < process_num or pending:
-            if next_i in pending:
-                yield pending.pop(next_i)
-                next_i += 1
-                continue
-            item = out_q.get()
-            if item is end:
-                finished += 1
-                continue
-            if isinstance(item, BaseException):
-                raise item
-            pending[item[0]] = item[1]
+                pending[item[0]] = item[1]
+        finally:
+            stop.set()
 
     return creator
 
@@ -226,26 +247,40 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
 
     def creator():
         q = queue.Queue(queue_size)
+        stop = threading.Event()
+
+        def put(item):
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def run(r):
             try:
                 for s in r():
-                    q.put(s)
+                    if not put(s):
+                        return
             except BaseException as e:
-                q.put(e)
+                put(e)
             finally:
-                q.put(end)
+                put(end)
 
         for r in readers:
             threading.Thread(target=run, args=(r,), daemon=True).start()
-        finished = 0
-        while finished < len(readers):
-            s = q.get()
-            if s is end:
-                finished += 1
-                continue
-            if isinstance(s, BaseException):
-                raise s
-            yield s
+        try:
+            finished = 0
+            while finished < len(readers):
+                s = q.get()
+                if s is end:
+                    finished += 1
+                    continue
+                if isinstance(s, BaseException):
+                    raise s
+                yield s
+        finally:
+            stop.set()
 
     return creator

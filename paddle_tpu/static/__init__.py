@@ -1,0 +1,80 @@
+"""paddle.static — the 2.0 static-graph namespace
+(reference python/paddle/static/__init__.py: aliases over fluid).
+
+Everything here is an alias: the TPU build's static-graph machinery
+lives in paddle_tpu.fluid (Program IR + whole-block XLA Executor); this
+module is the 2.0-era import path for it.
+"""
+
+from ..fluid import (  # noqa: F401
+    Executor, Program, Scope, append_backward, cpu_places,
+    default_main_program, default_startup_program, global_scope,
+    gradients, program_guard, scope_guard,
+)
+from ..fluid import CompiledProgram, BuildStrategy, ExecutionStrategy  # noqa: F401
+from ..fluid.framework import Variable, name_scope  # noqa: F401
+from ..fluid.io import load, save  # noqa: F401
+from ..fluid.layers.tensor import data  # noqa: F401
+from ..fluid.param_attr import WeightNormParamAttr  # noqa: F401
+from ..inference import load_inference_model, save_inference_model  # noqa: F401
+from . import nn  # noqa: F401
+
+
+class InputSpec:
+    """Input signature for program capture (reference static/input.py
+    InputSpec)."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, str(tensor.dtype), name
+                   or getattr(tensor, "name", None))
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype!r}, "
+                f"name={self.name!r})")
+
+
+def load_program_state(path):
+    """reference static/io.py load_program_state: a name->ndarray dict."""
+    import numpy as np
+
+    from ..fluid.io import load as _load
+
+    state = _load(path)
+    return {k: np.asarray(v) for k, v in state.items()} \
+        if isinstance(state, dict) else state
+
+
+def set_program_state(program, state):
+    """reference static/io.py set_program_state: bind arrays into the
+    global scope by variable name, validating names against the
+    program (a silently-ignored typo would leave init weights in
+    place)."""
+    from ..fluid.executor import global_scope
+
+    known = {v.name for blk in program.blocks for v in blk.vars.values()}
+    unknown = sorted(set(state) - known)
+    if unknown:
+        raise ValueError(
+            f"set_program_state: {len(unknown)} state keys not in the "
+            f"program: {unknown[:5]}{'...' if len(unknown) > 5 else ''}")
+    scope = global_scope()
+    for name, value in state.items():
+        scope.set(name, value)
+
+
+__all__ = [
+    "append_backward", "gradients", "Executor", "global_scope",
+    "scope_guard", "BuildStrategy", "CompiledProgram",
+    "ExecutionStrategy", "name_scope", "program_guard",
+    "WeightNormParamAttr", "default_main_program",
+    "default_startup_program", "Program", "data", "InputSpec", "save",
+    "load", "save_inference_model", "load_inference_model",
+    "load_program_state", "set_program_state", "cpu_places", "Variable",
+    "Scope", "nn",
+]

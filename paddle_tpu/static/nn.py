@@ -1,0 +1,14 @@
+"""paddle.static.nn — static-graph layer aliases
+(reference python/paddle/static/nn/__init__.py re-exports fluid.layers)."""
+
+from ..fluid.layers import (  # noqa: F401
+    batch_norm, conv2d, conv2d_transpose, conv3d, embedding, fc,
+    group_norm, instance_norm, layer_norm, prelu, sequence_conv,
+    sequence_pool, sequence_softmax, py_func,
+)
+from ..fluid.layers.control_flow import cond, while_loop  # noqa: F401
+
+__all__ = ["fc", "embedding", "conv2d", "conv2d_transpose", "conv3d",
+           "batch_norm", "instance_norm", "layer_norm", "group_norm",
+           "prelu", "sequence_conv", "sequence_pool",
+           "sequence_softmax", "py_func", "cond", "while_loop"]

@@ -71,3 +71,25 @@ def test_program_guard_isolation():
         assert framework.default_main_program() is p1
         assert framework.default_startup_program() is p2
     assert framework.default_main_program() is not p1
+
+
+def test_paddle_static_namespace(fresh_programs):
+    """paddle.static is the 2.0 alias surface over fluid
+    (reference python/paddle/static/__init__.py)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    main, startup, scope = fresh_programs
+    x = paddle.static.data("x", [-1, 8], "float32")
+    h = paddle.static.nn.fc(x, 4)
+    exe = paddle.static.Executor()
+    exe.run(startup)
+    (o,) = exe.run(main, feed={"x": np.ones((2, 8), "float32")},
+                   fetch_list=[h])
+    assert np.asarray(o).shape == (2, 4)
+    spec = paddle.static.InputSpec([None, 8], "float32", "x")
+    assert spec.shape == (None, 8)
+    with paddle.static.name_scope("scope"):
+        pass
+    assert paddle.static.Program is paddle.fluid.Program

@@ -617,3 +617,24 @@ def test_rpn_target_assign_masks():
     assert d["LocationWeight"][0, 0, 0] == 1.0
     # location target for the exact match is all zeros
     np.testing.assert_allclose(d["LocationTarget"][0, 0], 0.0, atol=1e-5)
+
+
+def test_retinanet_detection_output():
+    # one level, 2 anchors, 2 classes; zero deltas -> decoded == anchors
+    anchors = np.array([[0, 0, 9, 9], [20, 20, 29, 29]], "float32")
+    deltas = np.zeros((1, 2, 4), "float32")
+    scores = np.array([[[0.9, 0.02], [0.03, 0.6]]], "float32")
+    im_info = np.array([[32.0, 32.0, 1.0]], "float32")
+    d = run_det_op("retinanet_detection_output",
+                   {"BBoxes": [deltas], "Scores": [scores],
+                    "Anchors": [anchors], "ImInfo": im_info},
+                   {"score_threshold": 0.05, "nms_top_k": 4,
+                    "keep_top_k": 3, "nms_threshold": 0.3},
+                   ["Out", "RoisNum"], {"RoisNum": "int32"})
+    out, num = d["Out"], d["RoisNum"]
+    assert num[0] == 2
+    # best: class 0 @ anchor 0 score .9; then class 1 @ anchor 1 score .6
+    np.testing.assert_allclose(out[0, 0, :2], [0, 0.9], rtol=1e-5)
+    np.testing.assert_allclose(out[0, 0, 2:], [0, 0, 9, 9], atol=1e-4)
+    np.testing.assert_allclose(out[0, 1, :2], [1, 0.6], rtol=1e-5)
+    assert out[0, 2, 0] == -1

@@ -112,3 +112,21 @@ def test_buffered_early_stop_releases_thread():
 
     time.sleep(0.5)  # fill threads notice the stop flag
     assert th.active_count() <= before + 1
+
+
+def test_xmap_and_multiprocess_early_stop_release_threads():
+    import threading as th
+    import time
+
+    before = th.active_count()
+    for _ in range(4):
+        got = list(reader.firstn(
+            reader.xmap_readers(lambda x: x, make_reader(100000), 2, 4),
+            3)())
+        assert len(got) == 3
+        got = list(reader.firstn(
+            reader.multiprocess_reader([make_reader(100000)],
+                                       queue_size=4), 3)())
+        assert got[:3] == [0, 1, 2]
+    time.sleep(0.6)
+    assert th.active_count() <= before + 2
