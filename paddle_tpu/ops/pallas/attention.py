@@ -220,8 +220,7 @@ def _flash_forward(q, k, v, kbias, seed, heads, is_causal=False, scale=None,
         # double-buffer DMA across grid steps (the (bh, 1, 1) grid at
         # 512-blocks is otherwise serialized per-step overhead); ik
         # accumulates in scratch -> arbitrary
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        compiler_params=_compiler_params(),
         interpret=interpret,
     )(seed, q, k, v, kbias)
     return out, lse
@@ -389,8 +388,7 @@ def _flash_backward(q, k, v, kbias, seed, out, lse, g, heads,
                    jax.ShapeDtypeStruct((bh, sk, d), v.dtype)],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        compiler_params=_compiler_params(),
         interpret=interpret,
     )(seed, q, g, lse, delta, k, v, kbias)
 
@@ -402,8 +400,7 @@ def _flash_backward(q, k, v, kbias, seed, out, lse, g, heads,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        compiler_params=_compiler_params(),
         interpret=interpret,
     )(seed, q, g, lse, delta, k, v, kbias)
     return dq, dk, dv
@@ -568,7 +565,7 @@ def _probe_exact(q_shape, k_shape, heads, is_causal, dropout_p, dtype,
     key = (q_shape, k_shape, heads, is_causal, dropout_p,
            jnp.dtype(dtype).name, block_q, block_k, causal_offset)
     if key not in _EXACT_PROBE_CACHE:
-        try:
+        def compile_probe():
             sds = jax.ShapeDtypeStruct
             bh, sq, d = q_shape
             sk = k_shape[1]
@@ -584,17 +581,13 @@ def _probe_exact(q_shape, k_shape, heads, is_causal, dropout_p, dtype,
             lse = sds((bh, sq, 1), jnp.float32)
             _flash_backward.lower(x, kv, kv, kb, seed, x, lse, x, heads,
                                   **kw).compile()
-            _EXACT_PROBE_CACHE[key] = True
-        except Exception as e:  # noqa: BLE001
-            import warnings
 
-            warnings.warn(
-                "paddle_tpu: flash-attention instance "
-                f"q{q_shape} k{k_shape} blocks=({block_q},{block_k}) "
-                f"failed to compile ({type(e).__name__}: {e}); using the "
-                "XLA attention path for this shape.", RuntimeWarning,
-                stacklevel=2)
-            _EXACT_PROBE_CACHE[key] = False
+        _try_compile(
+            compile_probe, _EXACT_PROBE_CACHE, key,
+            "paddle_tpu: flash-attention instance "
+            f"q{q_shape} k{k_shape} blocks=({block_q},{block_k}) "
+            "failed to compile ({err}); using the XLA attention path "
+            "for this shape.")
     return _EXACT_PROBE_CACHE[key]
 
 
@@ -626,6 +619,63 @@ _PROBE_CACHE = {}
 _FLASH_DISABLED = None  # reason string when force-disabled
 
 
+_USE_DIM_SEMANTICS = True
+
+
+def _try_compile(compile_fn, cache, key, fail_msg):
+    """Shared probe body: compile once; on failure, retry the SAME
+    compile without grid dimension semantics — if that succeeds, the
+    semantics hint (not the kernel) was the problem, so drop the hint
+    process-wide and give every previously-failed config a second
+    chance; if the retry also fails, restore the hint (other configs
+    compiled fine with it) and record the failure for this key only."""
+    global _USE_DIM_SEMANTICS
+    try:
+        compile_fn()
+        cache[key] = True
+        return True
+    except Exception as first_err:  # noqa: BLE001
+        import warnings
+
+        if _USE_DIM_SEMANTICS:
+            _USE_DIM_SEMANTICS = False
+            _flash_forward.clear_cache()
+            _flash_backward.clear_cache()
+            try:
+                compile_fn()
+                _PROBE_CACHE.clear()
+                _EXACT_PROBE_CACHE.clear()
+                cache[key] = True
+                warnings.warn(
+                    "paddle_tpu: this Mosaic rejects Pallas grid "
+                    "dimension semantics "
+                    f"({type(first_err).__name__}); continuing without "
+                    "them (cross-grid-step DMA pipelining disabled).",
+                    RuntimeWarning, stacklevel=3)
+                return True
+            except Exception:  # noqa: BLE001
+                _USE_DIM_SEMANTICS = True
+                _flash_forward.clear_cache()
+                _flash_backward.clear_cache()
+        warnings.warn(
+            fail_msg.format(err=f"{type(first_err).__name__}: "
+                            f"{first_err}"),
+            RuntimeWarning, stacklevel=3)
+        cache[key] = False
+        return False
+
+
+def _compiler_params():
+    """Grid dimension semantics (parallel/parallel/arbitrary) let Mosaic
+    pipeline DMA across grid steps; if this Mosaic version rejects them
+    the probe flips the switch and retries plain — losing the pipelining
+    must never cost the whole Pallas path."""
+    if not _USE_DIM_SEMANTICS:
+        return None
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))
+
+
 def disable_flash(reason):
     """Force all attention dispatch onto the XLA path (used by bench.py
     when the preflight finds a numeric mismatch: a kernel that COMPILES
@@ -648,7 +698,7 @@ def _probe_flash_kernel(block_q=128, block_k=128, d=128,
     graph."""
     key = (block_q, block_k, d, jnp.dtype(dtype).name)
     if key not in _PROBE_CACHE:
-        try:
+        def compile_probe():
             s = 2 * max(block_q, block_k)
             sds = jax.ShapeDtypeStruct
             x = sds((2, s, d), dtype)
@@ -663,17 +713,13 @@ def _probe_flash_kernel(block_q=128, block_k=128, d=128,
                 x, x, x, kb, seed, x, lse, x, 2, is_causal=True,
                 dropout_p=0.1, block_q=block_q, block_k=block_k,
                 causal_offset=0).compile()
-            _PROBE_CACHE[key] = True
-        except Exception as e:  # Mosaic/lowering failure: fall back
-            import warnings
 
-            warnings.warn(
-                "paddle_tpu: Pallas flash-attention kernel failed to "
-                f"compile for this TPU ({type(e).__name__}: {e}); "
-                "falling back to the XLA attention path. Performance "
-                "will be lower but training proceeds.", RuntimeWarning,
-                stacklevel=2)
-            _PROBE_CACHE[key] = False
+        _try_compile(
+            compile_probe, _PROBE_CACHE, key,
+            "paddle_tpu: Pallas flash-attention kernel failed to "
+            "compile for this TPU ({err}); falling back to the XLA "
+            "attention path. Performance will be lower but training "
+            "proceeds.")
     return _PROBE_CACHE[key]
 
 
