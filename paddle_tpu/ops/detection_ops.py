@@ -1288,3 +1288,113 @@ def _retinanet_detection_output(ctx, op, ins):
     if "RoisNum" in op.outputs:
         outs["RoisNum"] = [jnp.stack(counts)]
     return outs
+
+
+@register_op("generate_proposal_labels")
+def _generate_proposal_labels(ctx, op, ins):
+    """Faster R-CNN second-stage sampling (reference detection/
+    generate_proposal_labels_op.cc SampleRoisForOneImage): gt boxes join
+    the candidate set, rois with max-gt-IoU >= fg_thresh are foreground
+    (random-subsampled to batch_size_per_im*fg_fraction), rois in
+    [bg_thresh_lo, bg_thresh_hi) fill the rest as background, and
+    foreground rois get center-size bbox regression targets against
+    their matched gt.
+
+    Dense contract: every output has batch_size_per_im rows per image —
+    Rois (B, S, 4), LabelsInt32 (B, S) with -1 on unsampled pad rows,
+    BboxTargets (B, S, 4*class_num), Bbox{Inside,Outside}Weights ditto,
+    plus RoisNum (B,).  The reference emits LoD-ragged rows."""
+    rois = first(ins, "RpnRois")          # (B, R, 4) or (R, 4)
+    gt_classes = first(ins, "GtClasses")  # (B, G)
+    gt_boxes = first(ins, "GtBoxes")      # (B, G, 4)
+    if rois.ndim == 2:
+        rois = rois[None]
+    if gt_boxes.ndim == 2:
+        gt_boxes = gt_boxes[None]
+        gt_classes = gt_classes[None]
+    spi = int(op.attr("batch_size_per_im", 256))
+    fg_fraction = op.attr("fg_fraction", 0.25)
+    fg_thresh = op.attr("fg_thresh", 0.5)
+    bg_hi = op.attr("bg_thresh_hi", 0.5)
+    bg_lo = op.attr("bg_thresh_lo", 0.0)
+    class_num = int(op.attr("class_nums", op.attr("class_num", 81)))
+    weights = [float(w) for w in op.attr("bbox_reg_weights",
+                                         [0.1, 0.1, 0.2, 0.2])]
+    b = rois.shape[0]
+    n_fg = int(spi * fg_fraction)
+    key = ctx.rng_key(op)
+
+    def per_image(roi, gtb, gtc, k):
+        valid_gt = (gtb[:, 2] > gtb[:, 0]) & (gtb[:, 3] > gtb[:, 1])
+        cand = jnp.concatenate([roi, gtb], axis=0)        # (R+G, 4)
+        # zero-padded roi/gt rows must not be sampled: with
+        # bg_thresh_lo=0 a degenerate (0,0,0,0) candidate would
+        # otherwise qualify as background and flood the subsample
+        valid_cand = (cand[:, 2] > cand[:, 0]) & (cand[:, 3] > cand[:, 1])
+        iou = _iou_matrix(cand, gtb, normalized=False)
+        iou = jnp.where(valid_gt[None, :], iou, 0.0)
+        max_ov = jnp.max(iou, axis=1)
+        arg_gt = jnp.argmax(iou, axis=1)
+        is_fg = valid_cand & (max_ov >= fg_thresh)
+        # the reference's bg set excludes fg by construction
+        is_bg = (valid_cand & jnp.logical_not(is_fg)
+                 & (max_ov >= bg_lo) & (max_ov < bg_hi))
+        k1, k2 = jax.random.split(k)
+        n = cand.shape[0]
+        r_fg = jnp.where(is_fg, jax.random.uniform(k1, (n,)), 2.0)
+        fg_keep = is_fg & (jnp.argsort(jnp.argsort(r_fg)) < n_fg)
+        n_fg_real = jnp.sum(fg_keep)
+        n_bg = spi - n_fg_real
+        r_bg = jnp.where(is_bg, jax.random.uniform(k2, (n,)), 2.0)
+        bg_keep = is_bg & (jnp.argsort(jnp.argsort(r_bg)) < n_bg)
+        # pack: fg rows first, then bg, pad to spi
+        sel_rank = jnp.where(
+            fg_keep, jnp.argsort(jnp.argsort(
+                jnp.where(fg_keep, r_fg, 2.0))),
+            jnp.where(bg_keep,
+                      n_fg_real + jnp.argsort(jnp.argsort(
+                          jnp.where(bg_keep, r_bg, 2.0))),
+                      spi))
+        slot = jnp.where(fg_keep | bg_keep, sel_rank, spi).astype(
+            jnp.int32)
+        out_rois = jnp.zeros((spi, 4)).at[slot].set(cand, mode="drop")
+        lab = jnp.where(fg_keep, gtc[arg_gt].astype(jnp.int32), 0)
+        out_lab = jnp.full((spi,), -1, jnp.int32).at[slot].set(
+            lab, mode="drop")
+        # fg bbox targets (center-size encode / reg weights)
+        mg = gtb[arg_gt]
+        cw = cand[:, 2] - cand[:, 0] + 1.0
+        chh = cand[:, 3] - cand[:, 1] + 1.0
+        ccx = cand[:, 0] + cw * 0.5
+        ccy = cand[:, 1] + chh * 0.5
+        gw = mg[:, 2] - mg[:, 0] + 1.0
+        gh = mg[:, 3] - mg[:, 1] + 1.0
+        gcx = mg[:, 0] + gw * 0.5
+        gcy = mg[:, 1] + gh * 0.5
+        tgt = jnp.stack([(gcx - ccx) / cw / weights[0],
+                         (gcy - ccy) / chh / weights[1],
+                         jnp.log(gw / cw) / weights[2],
+                         jnp.log(gh / chh) / weights[3]], axis=-1)
+        full_tgt = jnp.zeros((spi, 4)).at[slot].set(
+            jnp.where(fg_keep[:, None], tgt, 0.0), mode="drop")
+        # expand to per-class layout like the reference (4*class_num)
+        cls_slot = jnp.clip(out_lab, 0, class_num - 1)
+        tgt_c = jnp.zeros((spi, class_num, 4)).at[
+            jnp.arange(spi), cls_slot].set(full_tgt)
+        inside = jnp.zeros((spi, class_num, 4)).at[
+            jnp.arange(spi), cls_slot].set(
+            jnp.where(out_lab > 0, 1.0, 0.0)[:, None]
+            * jnp.ones((4,)))
+        count = (n_fg_real + jnp.sum(bg_keep)).astype(jnp.int32)
+        return (out_rois, out_lab, tgt_c.reshape(spi, -1),
+                inside.reshape(spi, -1), count)
+
+    keys = jax.random.split(key, b)
+    out_rois, labels, tgts, inw, counts = jax.vmap(per_image)(
+        rois, gt_boxes, gt_classes, keys)
+    outs = {"Rois": [out_rois], "LabelsInt32": [labels],
+            "BboxTargets": [tgts], "BboxInsideWeights": [inw],
+            "BboxOutsideWeights": [inw]}
+    if "RoisNum" in op.outputs:
+        outs["RoisNum"] = [counts]
+    return outs

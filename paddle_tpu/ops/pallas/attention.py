@@ -620,6 +620,7 @@ _FLASH_DISABLED = None  # reason string when force-disabled
 
 
 _USE_DIM_SEMANTICS = True
+_SEMANTICS_RETRY_DONE = False  # the no-hint experiment runs ONCE
 
 
 def _try_compile(compile_fn, cache, key, fail_msg):
@@ -629,7 +630,7 @@ def _try_compile(compile_fn, cache, key, fail_msg):
     process-wide and give every previously-failed config a second
     chance; if the retry also fails, restore the hint (other configs
     compiled fine with it) and record the failure for this key only."""
-    global _USE_DIM_SEMANTICS
+    global _USE_DIM_SEMANTICS, _SEMANTICS_RETRY_DONE
     try:
         compile_fn()
         cache[key] = True
@@ -637,7 +638,12 @@ def _try_compile(compile_fn, cache, key, fail_msg):
     except Exception as first_err:  # noqa: BLE001
         import warnings
 
-        if _USE_DIM_SEMANTICS:
+        if _USE_DIM_SEMANTICS and not _SEMANTICS_RETRY_DONE:
+            # per-shape failures are normal (that's why the XLA
+            # fallback exists) — run the no-hint experiment at most
+            # once per process, else every bad shape would wipe the
+            # jit caches of working kernels and double-compile
+            _SEMANTICS_RETRY_DONE = True
             _USE_DIM_SEMANTICS = False
             _flash_forward.clear_cache()
             _flash_backward.clear_cache()

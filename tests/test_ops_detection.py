@@ -638,3 +638,39 @@ def test_retinanet_detection_output():
     np.testing.assert_allclose(out[0, 0, 2:], [0, 0, 9, 9], atol=1e-4)
     np.testing.assert_allclose(out[0, 1, :2], [1, 0.6], rtol=1e-5)
     assert out[0, 2, 0] == -1
+
+
+def test_generate_proposal_labels():
+    rois = np.array([[[0, 0, 9, 9],        # IoU 1 with gt0 -> fg
+                      [0, 0, 11, 11],      # high IoU -> fg
+                      [40, 40, 49, 49],    # no overlap -> bg
+                      [100, 100, 109, 109]]], "float32")  # bg
+    gtb = np.array([[[0, 0, 9, 9], [0, 0, 0, 0]]], "float32")
+    gtc = np.array([[3, 0]], "int32")
+    d = run_det_op("generate_proposal_labels",
+                   {"RpnRois": rois, "GtClasses": gtc, "GtBoxes": gtb},
+                   {"batch_size_per_im": 4, "fg_fraction": 0.5,
+                    "fg_thresh": 0.5, "bg_thresh_hi": 0.5,
+                    "bg_thresh_lo": 0.0, "class_nums": 5,
+                    "bbox_reg_weights": [0.1, 0.1, 0.2, 0.2]},
+                   ["Rois", "LabelsInt32", "BboxTargets",
+                    "BboxInsideWeights", "RoisNum"],
+                   {"LabelsInt32": "int32", "RoisNum": "int32"})
+    labels = d["LabelsInt32"][0]
+    # fg rows lead with label 3; bg rows labeled 0
+    n_fg = int(np.sum(labels == 3))
+    assert n_fg >= 1          # at least the exact-match roi (+ gt row)
+    assert np.sum(labels == 0) >= 2
+    assert d["RoisNum"][0] == 4
+    # no degenerate (0,0,0,0) padding row is ever sampled as a roi
+    sampled = d["Rois"][0][:int(d["RoisNum"][0])]
+    w = sampled[:, 2] - sampled[:, 0]
+    assert np.all(w > 0)
+    # fg rows carry bbox targets in the class-3 slot with inside weight 1
+    fg_rows = np.where(labels == 3)[0]
+    tgt = d["BboxTargets"][0].reshape(4, 5, 4)
+    inw = d["BboxInsideWeights"][0].reshape(4, 5, 4)
+    assert np.all(inw[fg_rows, 3] == 1.0)
+    # the exact-match roi's target is ~0 (identity encode)
+    exact = fg_rows[np.argmin(np.abs(tgt[fg_rows, 3]).sum(axis=1))]
+    np.testing.assert_allclose(tgt[exact, 3], 0.0, atol=1e-5)
