@@ -8,9 +8,9 @@ matrix_nms, generate_proposals(+v2), yolo_box, yolov3_loss,
 sigmoid_focal_loss, roi_align, target_assign, mine_hard_examples,
 polygon_box_transform, roi_pool, distribute/collect_fpn_proposals,
 box_decoder_and_assign, rpn_target_assign,
-retinanet_detection_output.  The remaining tail (mask utilities,
-generate_proposal_labels, locality_aware_nms) raises through the
-registry's unknown-op error until added.
+retinanet_detection_output, generate_proposal_labels.  The remaining
+tail (generate_mask_labels' polygon utilities, locality_aware_nms)
+raises through the registry's unknown-op error until added.
 
 TPU re-design notes:
 - prior_box / anchor_generator are SHAPE-only functions of static attrs:
