@@ -8,9 +8,10 @@ matrix_nms, generate_proposals(+v2), yolo_box, yolov3_loss,
 sigmoid_focal_loss, roi_align, target_assign, mine_hard_examples,
 polygon_box_transform, roi_pool, distribute/collect_fpn_proposals,
 box_decoder_and_assign, rpn_target_assign,
-retinanet_detection_output, generate_proposal_labels.  The remaining
-tail (generate_mask_labels' polygon utilities, locality_aware_nms)
-raises through the registry's unknown-op error until added.
+retinanet_detection_output, generate_proposal_labels,
+locality_aware_nms (4-coord boxes).  The remaining tail
+(generate_mask_labels and the quad/polygon IoU paths, which need the
+gpc polygon-clipping utilities) raises loudly until added.
 
 TPU re-design notes:
 - prior_box / anchor_generator are SHAPE-only functions of static attrs:
@@ -1395,6 +1396,86 @@ def _generate_proposal_labels(ctx, op, ins):
     outs = {"Rois": [out_rois], "LabelsInt32": [labels],
             "BboxTargets": [tgts], "BboxInsideWeights": [inw],
             "BboxOutsideWeights": [inw]}
+    if "RoisNum" in op.outputs:
+        outs["RoisNum"] = [counts]
+    return outs
+
+
+def _locality_merge(boxes, scores, nms_thr, normalized):
+    """EAST-style locality-aware prepass (reference
+    locality_aware_nms_op.cc GetMaxScoreIndexWithLocalityAware +
+    PolyWeightedMerge): walk boxes in input order; while the next box
+    overlaps the current merge head beyond nms_thr, fold it in with
+    score-weighted coordinates and SUMMED scores; otherwise finalize
+    the head.  Returns same-length arrays with merged candidates
+    front-packed (zero-score padding)."""
+    n = boxes.shape[0]
+
+    def step(carry, i):
+        head_b, head_s, out_b, out_s, cnt = carry
+        b, s = boxes[i], scores[i]
+        has_head = head_s >= 0
+        iou = _iou_matrix(b[None], head_b[None], normalized)[0, 0]
+        do_merge = has_head & (iou > nms_thr)
+        merged_b = (b * s + head_b * jnp.maximum(head_s, 0.0)) \
+            / jnp.maximum(s + jnp.maximum(head_s, 0.0), 1e-12)
+        finalize = has_head & jnp.logical_not(do_merge)
+        out_b = jnp.where(finalize, out_b.at[cnt].set(head_b), out_b)
+        out_s = jnp.where(finalize, out_s.at[cnt].set(head_s), out_s)
+        cnt = cnt + finalize.astype(jnp.int32)
+        head_b = jnp.where(do_merge, merged_b, b)
+        head_s = jnp.where(do_merge, head_s + s, s)
+        return (head_b, head_s, out_b, out_s, cnt), None
+
+    init = (jnp.zeros((4,), boxes.dtype), jnp.float32(-1.0),
+            jnp.zeros_like(boxes), jnp.zeros((n,), jnp.float32),
+            jnp.int32(0))
+    (head_b, head_s, out_b, out_s, cnt), _ = lax.scan(
+        step, init, jnp.arange(n))
+    out_b = jnp.where(head_s >= 0, out_b.at[cnt].set(head_b), out_b)
+    out_s = jnp.where(head_s >= 0, out_s.at[cnt].set(head_s), out_s)
+    return out_b, out_s
+
+
+@register_op("locality_aware_nms")
+def _locality_aware_nms(ctx, op, ins):
+    """reference detection/locality_aware_nms_op.cc (EAST text
+    detection): the locality-aware weighted-merge prepass above, then
+    standard per-class greedy NMS and global keep_top_k, in the same
+    dense (B, keep_top_k, 6) + RoisNum contract as multiclass_nms.
+    Axis-aligned 4-coord boxes (the PolyIoU 8..32-coordinate quad path
+    needs polygon clipping utilities not built yet — raise loudly)."""
+    bboxes = first(ins, "BBoxes")   # (B, M, 4)
+    scores = first(ins, "Scores")   # (B, C, M)
+    if bboxes.shape[-1] != 4:
+        raise NotImplementedError(
+            "locality_aware_nms: only 4-coordinate boxes are supported "
+            f"on TPU (got box size {bboxes.shape[-1]}; polygon IoU "
+            "needs the gpc clipping utilities)")
+    bg = op.attr("background_label", -1)
+    score_thr = op.attr("score_threshold", 0.0)
+    nms_top_k = int(op.attr("nms_top_k", 64) or 64)
+    iou_thr = op.attr("nms_threshold", 0.3)
+    keep_top_k = int(op.attr("keep_top_k", 64) or 64)
+    normalized = op.attr("normalized", True)
+    b, c, m = scores.shape
+    k = min(nms_top_k, m) if nms_top_k > 0 else m
+
+    def per_class(boxes, sc_c, cls):
+        mb, ms = _locality_merge(boxes, sc_c, iou_thr, normalized)
+        s_top, idx = lax.top_k(ms, k)
+        b_top = mb[idx]
+        keep = _nms_keep(b_top, s_top, iou_thr, score_thr, normalized)
+        return jnp.where(keep, s_top, -1.0), b_top, idx
+
+    def per_image(boxes, sc):
+        return _multiclass_scaffold(boxes, sc, bg, keep_top_k,
+                                    per_class, k)
+
+    det, counts, index = jax.vmap(per_image)(bboxes, scores)
+    outs = {"Out": [det]}
+    if "Index" in op.outputs:
+        outs["Index"] = [index]
     if "RoisNum" in op.outputs:
         outs["RoisNum"] = [counts]
     return outs

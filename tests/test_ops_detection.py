@@ -674,3 +674,35 @@ def test_generate_proposal_labels():
     # the exact-match roi's target is ~0 (identity encode)
     exact = fg_rows[np.argmin(np.abs(tgt[fg_rows, 3]).sum(axis=1))]
     np.testing.assert_allclose(tgt[exact, 3], 0.0, atol=1e-5)
+
+
+def test_locality_aware_nms_merges_neighbors():
+    # three near-identical boxes in sequence merge into one candidate
+    # with summed score and score-weighted coords; a far box survives
+    boxes = np.array([[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                       [1, 1, 11, 11], [30, 30, 40, 40]]], "float32")
+    scores = np.array([[[0.5, 0.3, 0.2, 0.6]]], "float32")
+    d = run_det_op("locality_aware_nms",
+                   {"BBoxes": boxes, "Scores": scores},
+                   {"background_label": -1, "score_threshold": 0.01,
+                    "nms_top_k": 4, "keep_top_k": 4,
+                    "nms_threshold": 0.3, "normalized": False},
+                   ["Out", "RoisNum"], {"RoisNum": "int32"})
+    out, num = d["Out"], d["RoisNum"]
+    assert num[0] == 2
+    # merged cluster score = 0.5+0.3+0.2 = 1.0 ranks above the far 0.6
+    np.testing.assert_allclose(out[0, 0, 1], 1.0, rtol=1e-5)
+    # merge order: m01 = (b0*.5+b1*.3)/.8; m012 = (m01*.8+b2*.2)/1.0
+    m01 = (np.array([0, 0, 10, 10]) * 0.5
+           + np.array([0.5, 0.5, 10.5, 10.5]) * 0.3) / 0.8
+    m012 = (m01 * 0.8 + np.array([1, 1, 11, 11]) * 0.2) / 1.0
+    np.testing.assert_allclose(out[0, 0, 2:], m012, rtol=1e-4)
+    np.testing.assert_allclose(out[0, 1, 1], 0.6, rtol=1e-5)
+
+
+def test_locality_aware_nms_rejects_polygons():
+    with pytest.raises(NotImplementedError, match="4-coordinate"):
+        run_det_op("locality_aware_nms",
+                   {"BBoxes": np.zeros((1, 2, 8), "float32"),
+                    "Scores": np.zeros((1, 1, 2), "float32")},
+                   {}, ["Out"])
