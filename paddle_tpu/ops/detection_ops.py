@@ -1401,30 +1401,37 @@ def _generate_proposal_labels(ctx, op, ins):
     return outs
 
 
-def _locality_merge(boxes, scores, nms_thr, normalized):
+def _locality_merge(boxes, scores, nms_thr, normalized, score_thr=0.0):
     """EAST-style locality-aware prepass (reference
     locality_aware_nms_op.cc GetMaxScoreIndexWithLocalityAware +
     PolyWeightedMerge): walk boxes in input order; while the next box
     overlaps the current merge head beyond nms_thr, fold it in with
     score-weighted coordinates and SUMMED scores; otherwise finalize
-    the head.  Returns same-length arrays with merged candidates
+    the head.  Boxes at or below score_thr are skipped entirely — the
+    reference gates the whole walk on scores[i] > threshold, so a
+    sub-threshold box must neither join a merge nor break a merge
+    chain.  Returns same-length arrays with merged candidates
     front-packed (zero-score padding)."""
     n = boxes.shape[0]
 
     def step(carry, i):
         head_b, head_s, out_b, out_s, cnt = carry
         b, s = boxes[i], scores[i]
+        skip = s <= score_thr
         has_head = head_s >= 0
         iou = _iou_matrix(b[None], head_b[None], normalized)[0, 0]
         do_merge = has_head & (iou > nms_thr)
         merged_b = (b * s + head_b * jnp.maximum(head_s, 0.0)) \
             / jnp.maximum(s + jnp.maximum(head_s, 0.0), 1e-12)
-        finalize = has_head & jnp.logical_not(do_merge)
+        finalize = has_head & jnp.logical_not(do_merge) \
+            & jnp.logical_not(skip)
         out_b = jnp.where(finalize, out_b.at[cnt].set(head_b), out_b)
         out_s = jnp.where(finalize, out_s.at[cnt].set(head_s), out_s)
         cnt = cnt + finalize.astype(jnp.int32)
-        head_b = jnp.where(do_merge, merged_b, b)
-        head_s = jnp.where(do_merge, head_s + s, s)
+        new_head_b = jnp.where(do_merge, merged_b, b)
+        new_head_s = jnp.where(do_merge, head_s + s, s)
+        head_b = jnp.where(skip, head_b, new_head_b)
+        head_s = jnp.where(skip, head_s, new_head_s)
         return (head_b, head_s, out_b, out_s, cnt), None
 
     init = (jnp.zeros((4,), boxes.dtype), jnp.float32(-1.0),
@@ -1462,7 +1469,8 @@ def _locality_aware_nms(ctx, op, ins):
     k = min(nms_top_k, m) if nms_top_k > 0 else m
 
     def per_class(boxes, sc_c, cls):
-        mb, ms = _locality_merge(boxes, sc_c, iou_thr, normalized)
+        mb, ms = _locality_merge(boxes, sc_c, iou_thr, normalized,
+                                 score_thr=score_thr)
         s_top, idx = lax.top_k(ms, k)
         b_top = mb[idx]
         keep = _nms_keep(b_top, s_top, iou_thr, score_thr, normalized)
@@ -1472,10 +1480,15 @@ def _locality_aware_nms(ctx, op, ins):
         return _multiclass_scaffold(boxes, sc, bg, keep_top_k,
                                     per_class, k)
 
-    det, counts, index = jax.vmap(per_image)(bboxes, scores)
+    det, counts, _ = jax.vmap(per_image)(bboxes, scores)
     outs = {"Out": [det]}
     if "Index" in op.outputs:
-        outs["Index"] = [index]
+        # a merged box has no single source row: emitting top-k indices
+        # into the per-class merged packing would silently gather wrong
+        # input rows downstream
+        raise NotImplementedError(
+            "locality_aware_nms: the Index output has no meaningful "
+            "source-row mapping once boxes merge; consume Out/RoisNum")
     if "RoisNum" in op.outputs:
         outs["RoisNum"] = [counts]
     return outs

@@ -706,3 +706,20 @@ def test_locality_aware_nms_rejects_polygons():
                    {"BBoxes": np.zeros((1, 2, 8), "float32"),
                     "Scores": np.zeros((1, 1, 2), "float32")},
                    {}, ["Out"])
+
+
+def test_locality_aware_nms_subthreshold_cannot_break_chain():
+    """Reference gates the merge walk on score > threshold: a
+    sub-threshold box neither joins a merge nor breaks a chain."""
+    boxes = np.array([[[0, 0, 10, 10], [50, 50, 60, 60],
+                       [0.5, 0.5, 10.5, 10.5]]], "float32")
+    scores = np.array([[[0.9, 0.005, 0.8]]], "float32")
+    d = run_det_op("locality_aware_nms",
+                   {"BBoxes": boxes, "Scores": scores},
+                   {"background_label": -1, "score_threshold": 0.01,
+                    "nms_top_k": 3, "keep_top_k": 3,
+                    "nms_threshold": 0.3, "normalized": False},
+                   ["Out", "RoisNum"], {"RoisNum": "int32"})
+    # boxes 0 and 2 merge ACROSS the skipped low-score far box
+    assert d["RoisNum"][0] == 1
+    np.testing.assert_allclose(d["Out"][0, 0, 1], 1.7, rtol=1e-5)
