@@ -281,10 +281,30 @@ class Executor:
             if isinstance(val, _VarHolder):
                 val = val.numpy()
             arr = np.asarray(val)
-            if block.has_var(name):
-                want = core.np_dtype(block.var(name).dtype)
-                if arr.dtype != want:
-                    arr = arr.astype(want)
+            # TPU-native policy: x64 is off, so 64-bit INTEGER data
+            # narrows to 32-bit on device.  Values beyond the narrowed
+            # range would wrap SILENTLY (e.g. >2^31-row embedding ids)
+            # — reject them at the one host/device boundary.  Feeds
+            # bound for float variables are cast below and never touch
+            # an integer path, so they are exempt.
+            want = core.np_dtype(block.var(name).dtype) \
+                if block.has_var(name) else arr.dtype
+            if (arr.dtype in (np.int64, np.uint64) and arr.size
+                    and np.issubdtype(want, np.integer)):
+                # range of the dtype the value will actually LAND in
+                # after device narrowing (int64->int32, uint64->uint32)
+                narrowed = {np.dtype(np.int64): np.int32,
+                            np.dtype(np.uint64): np.uint32}.get(
+                    np.dtype(want), want)
+                info = np.iinfo(narrowed)
+                if arr.max() > info.max or arr.min() < info.min:
+                    raise OverflowError(
+                        f"feed {name!r}: {arr.dtype} values outside "
+                        f"{info.dtype} range (max {arr.max()}); TPU "
+                        f"indices are 32-bit — shard the table or "
+                        f"rebase the ids")
+            if block.has_var(name) and arr.dtype != want:
+                arr = arr.astype(want)
             out[name] = arr
         return out
 

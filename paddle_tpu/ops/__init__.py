@@ -14,6 +14,7 @@ from . import control_flow_ops  # noqa: F401
 from . import rnn_ops  # noqa: F401
 from . import sequence_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
+from . import vision_ops  # noqa: F401
 from . import quantize_ops  # noqa: F401
 from . import misc_ops  # noqa: F401
 from .registry import register_op, register_grad, registered_ops, has_op  # noqa: F401

@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .registry import first, register_op
+from .registry import first, jdt, register_op
 
 
 def _subblock_io(block, extra_reads=()):
@@ -315,7 +315,7 @@ def _read_from_array(ctx, op, ins):
 @register_op("lod_array_length")
 def _lod_array_length(ctx, op, ins):
     arr = first(ins, "X")
-    return {"Out": [arr.length.reshape((1,)).astype(jnp.int64)]}
+    return {"Out": [arr.length.reshape((1,)).astype(jdt("int64"))]}
 
 
 @register_op("allocate_array")
@@ -349,4 +349,4 @@ def _tensor_array_to_tensor(ctx, op, ins):
         out = jnp.concatenate(list(buf), axis=axis)
     return {"Out": [out],
             "OutIndex": [jnp.full((buf.shape[0],), buf.shape[1]
-                                  if buf.ndim > 1 else 1, jnp.int64)]}
+                                  if buf.ndim > 1 else 1, jdt("int64"))]}

@@ -245,19 +245,21 @@ def _iou_similarity(ctx, op, ins):
 @register_op("box_clip")
 def _box_clip(ctx, op, ins):
     """Clip boxes to image (reference detection/box_clip_op.h); ImInfo
-    rows are (h, w, scale)."""
+    rows are (h, w, scale).  The reference clips to
+    round(im_info/scale) - 1 — the round matters when h/scale is
+    fractional."""
     boxes = first(ins, "Input")
     im_info = first(ins, "ImInfo")
     if boxes.ndim == 2:
-        h = im_info[0, 0] / im_info[0, 2] - 1
-        w = im_info[0, 1] / im_info[0, 2] - 1
+        h = jnp.round(im_info[0, 0] / im_info[0, 2]) - 1
+        w = jnp.round(im_info[0, 1] / im_info[0, 2]) - 1
         x1 = jnp.clip(boxes[..., 0], 0, w)
         y1 = jnp.clip(boxes[..., 1], 0, h)
         x2 = jnp.clip(boxes[..., 2], 0, w)
         y2 = jnp.clip(boxes[..., 3], 0, h)
         return {"Output": [jnp.stack([x1, y1, x2, y2], axis=-1)]}
-    h = (im_info[:, 0] / im_info[:, 2] - 1)[:, None]
-    w = (im_info[:, 1] / im_info[:, 2] - 1)[:, None]
+    h = (jnp.round(im_info[:, 0] / im_info[:, 2]) - 1)[:, None]
+    w = (jnp.round(im_info[:, 1] / im_info[:, 2]) - 1)[:, None]
     out = jnp.stack([jnp.clip(boxes[..., 0], 0, w),
                      jnp.clip(boxes[..., 1], 0, h),
                      jnp.clip(boxes[..., 2], 0, w),
@@ -640,7 +642,9 @@ def _mine_hard_examples(ctx, op, ins):
     negatives stay -1."""
     cls_loss = first(ins, "ClsLoss")          # (B, M)
     match = first(ins, "MatchIndices").astype(jnp.int32)  # (B, M)
+    match_dist = first(ins, "MatchDist")      # (B, M)
     ratio = op.attr("neg_pos_ratio", 3.0)
+    neg_dist_thr = op.attr("neg_dist_threshold", 0.5)
     mining = op.attr("mining_type", "max_negative")
     if mining != "max_negative":
         raise NotImplementedError(
@@ -652,7 +656,11 @@ def _mine_hard_examples(ctx, op, ins):
     # num_pos*ratio negatives with NO floor (an image with zero
     # positives keeps zero negatives), and ignores sample_size
     loss = cls_loss
-    is_neg = match < 0
+    # IsEligibleMining (mine_hard_examples_op.cc:29): a prior is a
+    # candidate negative only when unmatched AND its best-gt overlap is
+    # below neg_dist_threshold — near-miss priors (high overlap but not
+    # assigned) must not become "hard negatives".
+    is_neg = (match < 0) & (match_dist < neg_dist_thr)
     n_pos = jnp.sum(match >= 0, axis=1)
     n_neg_max = (n_pos.astype(jnp.float32) * ratio).astype(jnp.int32)
     neg_loss = jnp.where(is_neg, loss, -jnp.inf)
@@ -1404,34 +1412,32 @@ def _generate_proposal_labels(ctx, op, ins):
 def _locality_merge(boxes, scores, nms_thr, normalized, score_thr=0.0):
     """EAST-style locality-aware prepass (reference
     locality_aware_nms_op.cc GetMaxScoreIndexWithLocalityAware +
-    PolyWeightedMerge): walk boxes in input order; while the next box
-    overlaps the current merge head beyond nms_thr, fold it in with
-    score-weighted coordinates and SUMMED scores; otherwise finalize
-    the head.  Boxes at or below score_thr are skipped entirely — the
-    reference gates the whole walk on scores[i] > threshold, so a
-    sub-threshold box must neither join a merge nor break a merge
-    chain.  Returns same-length arrays with merged candidates
+    PolyWeightedMerge): walk ALL boxes in input order; while the next
+    box overlaps the current merge head beyond nms_thr, fold it in
+    with score-weighted coordinates and SUMMED scores; otherwise
+    finalize the head and start a new one.  The reference runs this
+    walk unconditionally — score_threshold applies only afterwards, to
+    the MERGED head scores (locality_aware_nms_op.cc:133-137), so
+    boxes individually below threshold still contribute to merges and
+    a chain of sub-threshold boxes can surface as one supra-threshold
+    head.  Returns same-length arrays with surviving heads
     front-packed (zero-score padding)."""
     n = boxes.shape[0]
 
     def step(carry, i):
         head_b, head_s, out_b, out_s, cnt = carry
         b, s = boxes[i], scores[i]
-        skip = s <= score_thr
         has_head = head_s >= 0
         iou = _iou_matrix(b[None], head_b[None], normalized)[0, 0]
         do_merge = has_head & (iou > nms_thr)
         merged_b = (b * s + head_b * jnp.maximum(head_s, 0.0)) \
             / jnp.maximum(s + jnp.maximum(head_s, 0.0), 1e-12)
-        finalize = has_head & jnp.logical_not(do_merge) \
-            & jnp.logical_not(skip)
+        finalize = has_head & jnp.logical_not(do_merge)
         out_b = jnp.where(finalize, out_b.at[cnt].set(head_b), out_b)
         out_s = jnp.where(finalize, out_s.at[cnt].set(head_s), out_s)
         cnt = cnt + finalize.astype(jnp.int32)
-        new_head_b = jnp.where(do_merge, merged_b, b)
-        new_head_s = jnp.where(do_merge, head_s + s, s)
-        head_b = jnp.where(skip, head_b, new_head_b)
-        head_s = jnp.where(skip, head_s, new_head_s)
+        head_b = jnp.where(do_merge, merged_b, b)
+        head_s = jnp.where(do_merge, head_s + s, s)
         return (head_b, head_s, out_b, out_s, cnt), None
 
     init = (jnp.zeros((4,), boxes.dtype), jnp.float32(-1.0),
@@ -1441,6 +1447,8 @@ def _locality_merge(boxes, scores, nms_thr, normalized, score_thr=0.0):
         step, init, jnp.arange(n))
     out_b = jnp.where(head_s >= 0, out_b.at[cnt].set(head_b), out_b)
     out_s = jnp.where(head_s >= 0, out_s.at[cnt].set(head_s), out_s)
+    # threshold on merged scores only (never on the walk itself)
+    out_s = jnp.where(out_s > score_thr, out_s, 0.0)
     return out_b, out_s
 
 

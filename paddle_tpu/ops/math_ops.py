@@ -528,4 +528,4 @@ def _histogram(ctx, op, ins):
     in_range = (xf >= lo) & (xf <= hi)
     counts = jnp.zeros((bins,), jnp.int32).at[
         jnp.where(in_range, idx, bins)].add(1, mode="drop")
-    return {"Out": [counts.astype(jnp.int64)]}
+    return {"Out": [counts.astype(jdt("int64"))]}

@@ -504,40 +504,163 @@ def _accuracy(ctx, op, ins):
     return {"Accuracy": [acc], "Correct": [num_correct], "Total": [total]}
 
 
+# --- interpolation family (reference interpolate_op.h) ---------------------
+#
+# All six modes share one separable scheme: per output axis, trace-time
+# numpy computes static (tap indices, tap weights) exactly as the
+# reference kernels do — including the align_corners / align_mode
+# coordinate maps and edge clamping — then the device code is a chain
+# of gathers + weighted sums (one per spatial axis).  Static output
+# shapes come from out_d/out_h/out_w or the scale attr, so everything
+# stays XLA-compile-friendly; the dynamic OutSize/SizeTensor inputs are
+# rejected loudly (TPU programs must know shapes at trace time).
+
+def _interp_axis_taps(in_sz, out_sz, align_corners, align_mode, kind,
+                      scale=0.0):
+    """[(index (out,), weight (out,))] per tap for one axis.
+    Coordinate maps (interpolate_op.h / interpolate_v2_op.h:929-944):
+      ratio   = 0 if out<=1
+                else (in-1)/(out-1) if align_corners
+                else 1/scale if scale>0 (v2 scale-driven resize)
+                else in/out
+      nearest: src = ratio*j (+0.5 if align_corners), trunc
+      linear : align_flag ? trunc(ratio*(j+.5)-.5) : trunc(ratio*j)
+      cubic  : floor(align_corners ? ratio*j : ratio*(j+.5)-.5), 4 taps
+               with the Keys A=-0.75 kernel"""
+    j = np.arange(out_sz, dtype=np.float64)
+    if out_sz <= 1:
+        ratio = 0.0
+    elif align_corners:
+        ratio = (in_sz - 1) / (out_sz - 1)
+    elif scale > 0:
+        ratio = 1.0 / scale
+    else:
+        ratio = in_sz / out_sz
+    if kind == "nearest":
+        src = ratio * j + (0.5 if align_corners else 0.0)
+        idx = np.clip(np.trunc(src).astype(np.int32), 0, in_sz - 1)
+        return [(idx, np.ones(out_sz))]
+    if kind == "linear":
+        align_flag = (align_mode == 0) and not align_corners
+        if align_flag:
+            raw = ratio * (j + 0.5) - 0.5
+            lo = np.maximum(np.trunc(raw).astype(np.int32), 0)
+            d = np.maximum(raw, 0.0) - lo
+        else:
+            raw = ratio * j
+            lo = np.trunc(raw).astype(np.int32)
+            d = raw - lo
+        hi = np.minimum(lo + 1, in_sz - 1)
+        return [(lo, 1.0 - d), (hi, d)]
+    # cubic (get_cubic_upsample_coefficients, A = -0.75)
+    src = ratio * j if align_corners else ratio * (j + 0.5) - 0.5
+    base = np.floor(src).astype(np.int32)
+    t = src - base
+    A = -0.75
+
+    def cc1(v):
+        return ((A + 2) * v - (A + 3)) * v * v + 1
+
+    def cc2(v):
+        return ((A * v - 5 * A) * v + 8 * A) * v - 4 * A
+
+    ws = [cc2(t + 1.0), cc1(t), cc1(1.0 - t), cc2(2.0 - t)]
+    return [(np.clip(base - 1 + k, 0, in_sz - 1), ws[k])
+            for k in range(4)]
+
+
+def _interp_apply_axis(x, axis, taps):
+    acc = None
+    for idx, w in taps:
+        g = jnp.take(x, jnp.asarray(idx), axis=axis)
+        wshape = [1] * x.ndim
+        wshape[axis] = len(w)
+        g = g * jnp.asarray(w, x.dtype).reshape(wshape)
+        acc = g if acc is None else acc + g
+    return acc
+
+
+def _interp_out_sizes(op, x, n_spatial):
+    """-> ([out sizes], [scale factors]) per spatial axis; scale is 0
+    for size-driven axes so the ratio falls back to in/out."""
+    names = ["out_d", "out_h", "out_w"][3 - n_spatial:]
+    sizes = [int(op.attr(n, -1) or -1) for n in names]
+    scale = op.attr("scale", 0.0)
+    if isinstance(scale, (list, tuple)) and scale:
+        sc = list(scale) + [scale[-1]] * (n_spatial - len(scale))
+    else:
+        sc = [float(scale or 0.0)] * n_spatial
+    if all(s > 0 for s in sizes):
+        return sizes, [0.0] * n_spatial
+    in_sizes = x.shape[-n_spatial:]
+    outs = [s if s > 0 else int(i * f)
+            for s, i, f in zip(sizes, in_sizes, sc)]
+    if any(o <= 0 for o in outs):
+        raise ValueError(
+            f"{op.type}: unresolved output size {outs} — set "
+            f"{'/'.join(names)} or a positive scale attr")
+    return outs, sc
+
+
+def _interp(ctx, op, ins, kind, n_spatial):
+    if first(ins, "OutSize") is not None or ins.get("SizeTensor") \
+            or first(ins, "Scale") is not None:
+        raise NotImplementedError(
+            f"{op.type}: tensor-valued output sizes/scales are dynamic "
+            "shapes; pass out_h/out_w/scale attrs (static) on TPU")
+    x = first(ins, "X")
+    layout = op.attr("data_layout", "NCHW")
+    channels_last = layout not in ("NCHW", "NCDHW", "AnyLayout", "NCW")
+    if channels_last:
+        # NHWC/NDHWC: move channels next to batch, interp, move back
+        perm = (0, x.ndim - 1) + tuple(range(1, x.ndim - 1))
+        inv = tuple(int(p) for p in np.argsort(perm))
+        x = jnp.transpose(x, perm)
+    align_corners = bool(op.attr("align_corners", True))
+    align_mode = int(op.attr("align_mode", 1))
+    out_sizes, scales = _interp_out_sizes(op, x, n_spatial)
+    # only v2 reads 1/scale into the ratio (interpolate_v2_op.h:933)
+    is_v2 = op.type.endswith("_v2")
+    out = x
+    for i, osz in enumerate(out_sizes):
+        axis = x.ndim - n_spatial + i
+        taps = _interp_axis_taps(x.shape[axis], int(osz), align_corners,
+                                 align_mode, kind,
+                                 scale=scales[i] if is_v2 else 0.0)
+        out = _interp_apply_axis(out, axis, taps)
+    if channels_last:
+        out = jnp.transpose(out, inv)
+    return {"Out": [out]}
+
+
 @register_op("nearest_interp_v2")
 @register_op("nearest_interp")
 def _nearest_interp(ctx, op, ins):
-    x = first(ins, "X")  # NCHW
-    oh = op.attr("out_h", -1)
-    ow = op.attr("out_w", -1)
-    scale = op.attr("scale", 0.0)
-    if oh <= 0:
-        if isinstance(scale, (list, tuple)):
-            sh, sw = scale[0], scale[1] if len(scale) > 1 else scale[0]
-        else:
-            sh = sw = scale
-        oh = int(x.shape[2] * sh)
-        ow = int(x.shape[3] * sw)
-    out = jax.image.resize(x, (x.shape[0], x.shape[1], oh, ow), method="nearest")
-    return {"Out": [out]}
+    return _interp(ctx, op, ins, "nearest", 2)
 
 
 @register_op("bilinear_interp_v2")
 @register_op("bilinear_interp")
 def _bilinear_interp(ctx, op, ins):
-    x = first(ins, "X")
-    oh = op.attr("out_h", -1)
-    ow = op.attr("out_w", -1)
-    if oh <= 0:
-        scale = op.attr("scale", 1.0)
-        if isinstance(scale, (list, tuple)):
-            sh, sw = scale[0], scale[1] if len(scale) > 1 else scale[0]
-        else:
-            sh = sw = scale
-        oh = int(x.shape[2] * sh)
-        ow = int(x.shape[3] * sw)
-    out = jax.image.resize(x, (x.shape[0], x.shape[1], oh, ow), method="bilinear")
-    return {"Out": [out]}
+    return _interp(ctx, op, ins, "linear", 2)
+
+
+@register_op("linear_interp_v2")
+@register_op("linear_interp")
+def _linear_interp(ctx, op, ins):
+    return _interp(ctx, op, ins, "linear", 1)
+
+
+@register_op("trilinear_interp_v2")
+@register_op("trilinear_interp")
+def _trilinear_interp(ctx, op, ins):
+    return _interp(ctx, op, ins, "linear", 3)
+
+
+@register_op("bicubic_interp_v2")
+@register_op("bicubic_interp")
+def _bicubic_interp(ctx, op, ins):
+    return _interp(ctx, op, ins, "cubic", 2)
 
 
 @register_op("prelu")

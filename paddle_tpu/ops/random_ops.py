@@ -107,7 +107,7 @@ def _multinomial(ctx, op, ins):
         # Gumbel top-k trick for sampling without replacement.
         g = jax.random.gumbel(ctx.rng_key(op), x.shape)
         _, out = jax.lax.top_k(logits + g, n)
-    return {"Out": [out.astype(jnp.int64)]}
+    return {"Out": [out.astype(jdt("int64"))]}
 
 
 @register_op("shuffle_channel")

@@ -117,8 +117,24 @@ def first(ins: InsOuts, slot: str, default=None):
     return vals[0] if vals else default
 
 
+_NARROW_64 = {jnp.dtype("int64"): jnp.dtype("int32"),
+              jnp.dtype("uint64"): jnp.dtype("uint32"),
+              jnp.dtype("float64"): jnp.dtype("float32")}
+
+
 def jdt(dtype_name) -> jnp.dtype:
-    return jnp.dtype(core.np_dtype(dtype_name))
+    """Canonical dtype for lowerings.  TPU-native policy: x64 stays
+    off, so 64-bit requests narrow to 32-bit HERE — explicitly, once —
+    instead of inside JAX, where every creation/astype call with a
+    64-bit dtype emits a truncation warning.  Out-of-range int64 feed
+    VALUES are rejected loudly at the feed boundary
+    (executor feed normalization), so the narrowing is safe by the
+    time a lowering sees the data."""
+    import jax
+    dt = jnp.dtype(core.np_dtype(dtype_name))
+    if not jax.config.jax_enable_x64:
+        dt = _NARROW_64.get(dt, dt)
+    return dt
 
 
 def _is_diff(x) -> bool:

@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .registry import first, register_op
+from .registry import first, jdt, register_op
 
 _ACT = {
     "sigmoid": jax.nn.sigmoid,
@@ -143,7 +143,7 @@ def dense_beam_step(pre_ids, pre_scores, cand_ids, scores, w, end_id,
     bw, k = scores.shape
     b = bw // w
     if cand_ids is None:
-        cand_ids = jnp.broadcast_to(jnp.arange(k, dtype=jnp.int64),
+        cand_ids = jnp.broadcast_to(jnp.arange(k, dtype=jdt("int64")),
                                     (bw, k))
     finished = (pre_ids.reshape(bw) == end_id)
     neg = jnp.full_like(scores, -1e9)
@@ -371,5 +371,6 @@ def _edit_distance(ctx, op, ins):
     dist = row_final[jnp.arange(b), ref_len].astype(jnp.float32)
     if op.attr("normalized", True):
         dist = dist / jnp.maximum(ref_len.astype(jnp.float32), 1.0)
+    # the layer wrapper declares SequenceNum int64 like the reference
     return {"Out": [dist.reshape(b, 1)],
-            "SequenceNum": [jnp.asarray(b, jnp.int32)]}
+            "SequenceNum": [jnp.asarray(b, jdt("int64"))]}

@@ -180,7 +180,7 @@ def _sequence_pad(ctx, op, ins):
         x = x[:, :plen]
     mask = _bcast_mask(_time_mask(x, lens), x)
     out = jnp.where(mask, x, jnp.asarray(pad_v, x.dtype))
-    return {"Out": [out], "Length": [lens.astype(jnp.int64)]}
+    return {"Out": [out], "Length": [lens.astype(jdt("int64"))]}
 
 
 @register_op("sequence_unpad")
@@ -220,7 +220,7 @@ def _sequence_concat(ctx, op, ins):
     valid = jnp.concatenate(
         [_time_mask(x, ln) for x, ln in zip(xs, lens)], axis=1)
     packed, n_valid = _front_pack(cat, valid)
-    return {"Out": [packed], "OutLength": [n_valid.astype(jnp.int64)]}
+    return {"Out": [packed], "OutLength": [n_valid.astype(jdt("int64"))]}
 
 
 @register_op("sequence_erase")
@@ -235,7 +235,7 @@ def _sequence_erase(ctx, op, ins):
     for tok in tokens:
         valid = jnp.logical_and(valid, x != jnp.asarray(tok, x.dtype))
     packed, n_valid = _front_pack(x[..., None], valid)
-    return {"Out": [packed[..., 0]], "OutLength": [n_valid.astype(jnp.int64)]}
+    return {"Out": [packed[..., 0]], "OutLength": [n_valid.astype(jdt("int64"))]}
 
 
 @register_op("sequence_slice")

@@ -73,7 +73,7 @@ def _shape(ctx, op, ins):
 @register_op("size")
 def _size(ctx, op, ins):
     x = first(ins, "Input")
-    return {"Out": [jnp.array(x.size, dtype=jnp.int64)]}
+    return {"Out": [jnp.array(x.size, dtype=jdt("int64"))]}
 
 
 def _do_reshape(x, shape):
@@ -388,7 +388,7 @@ def _arg_min(ctx, op, ins):
     out = jnp.argmin(x, axis=axis)
     if op.attr("keepdims", False):
         out = jnp.expand_dims(out, axis)
-    return {"Out": [out.astype(jnp.int64)]}
+    return {"Out": [out.astype(jdt("int64"))]}
 
 
 @register_op("argsort")
@@ -399,7 +399,7 @@ def _argsort(ctx, op, ins):
     key = -x if descending else x
     idx = jnp.argsort(key, axis=axis)
     out = jnp.take_along_axis(x, idx, axis=axis)
-    return {"Out": [out], "Indices": [idx.astype(jnp.int64)]}
+    return {"Out": [out], "Indices": [idx.astype(jdt("int64"))]}
 
 
 @register_op("top_k")
@@ -421,7 +421,7 @@ def _top_k(ctx, op, ins):
     if axis not in (-1, x.ndim - 1):
         vals = jnp.moveaxis(vals, -1, axis)
         idx = jnp.moveaxis(idx, -1, axis)
-    return {"Out": [vals], "Indices": [idx.astype(jnp.int64)]}
+    return {"Out": [vals], "Indices": [idx.astype(jdt("int64"))]}
 
 
 @register_op("range")

@@ -312,15 +312,38 @@ def test_target_assign():
 def test_mine_hard_examples_max_negative():
     cls_loss = np.array([[0.1, 0.9, 0.5, 0.7, 0.2, 0.3]], "float32")
     match = np.array([[2, -1, -1, -1, -1, 0]], "int32")  # 2 positives
+    dist = np.zeros((1, 6), "float32")
     d = run_det_op("mine_hard_examples",
-                   {"ClsLoss": cls_loss, "MatchIndices": match},
-                   {"neg_pos_ratio": 1.5, "mining_type": "max_negative"},
+                   {"ClsLoss": cls_loss, "MatchIndices": match,
+                    "MatchDist": dist},
+                   {"neg_pos_ratio": 1.5, "mining_type": "max_negative",
+                    "neg_dist_threshold": 0.5},
                    ["NegIndices", "UpdatedMatchIndices"],
                    {"NegIndices": "int32",
                     "UpdatedMatchIndices": "int32"})
     # 2 pos * 1.5 = 3 negatives allowed: highest-loss negs are cols 1,3,2
     np.testing.assert_array_equal(d["NegIndices"][0], [0, 1, 1, 1, 0, 0])
     np.testing.assert_array_equal(d["UpdatedMatchIndices"], match)
+
+
+def test_mine_hard_examples_neg_dist_threshold():
+    # IsEligibleMining: an unmatched prior with match_dist >=
+    # neg_dist_threshold (a near-miss with high gt overlap) must never
+    # be selected as a hard negative, even with the highest loss.
+    cls_loss = np.array([[0.1, 0.9, 0.5, 0.7, 0.2, 0.3]], "float32")
+    match = np.array([[2, -1, -1, -1, -1, 0]], "int32")
+    dist = np.array([[0.9, 0.8, 0.1, 0.1, 0.1, 0.7]], "float32")
+    d = run_det_op("mine_hard_examples",
+                   {"ClsLoss": cls_loss, "MatchIndices": match,
+                    "MatchDist": dist},
+                   {"neg_pos_ratio": 1.5, "mining_type": "max_negative",
+                    "neg_dist_threshold": 0.5},
+                   ["NegIndices", "UpdatedMatchIndices"],
+                   {"NegIndices": "int32",
+                    "UpdatedMatchIndices": "int32"})
+    # col 1 (loss 0.9) is excluded by dist 0.8 >= 0.5; remaining
+    # eligible negs are cols 2,3,4 — all within the 3-neg budget.
+    np.testing.assert_array_equal(d["NegIndices"][0], [0, 0, 1, 1, 1, 0])
 
 
 def test_matrix_nms_decays_overlaps():
@@ -708,9 +731,12 @@ def test_locality_aware_nms_rejects_polygons():
                    {}, ["Out"])
 
 
-def test_locality_aware_nms_subthreshold_cannot_break_chain():
-    """Reference gates the merge walk on score > threshold: a
-    sub-threshold box neither joins a merge nor breaks a chain."""
+def test_locality_aware_nms_subthreshold_breaks_chain():
+    """The reference walk (GetMaxScoreIndexWithLocalityAware) runs over
+    ALL boxes — score_threshold is applied only to the merged head
+    scores afterwards.  So a low-score far box DOES break a merge
+    chain, and the two overlapping high-score boxes end up as separate
+    heads (the second then suppressed by greedy NMS)."""
     boxes = np.array([[[0, 0, 10, 10], [50, 50, 60, 60],
                        [0.5, 0.5, 10.5, 10.5]]], "float32")
     scores = np.array([[[0.9, 0.005, 0.8]]], "float32")
@@ -720,6 +746,24 @@ def test_locality_aware_nms_subthreshold_cannot_break_chain():
                     "nms_top_k": 3, "keep_top_k": 3,
                     "nms_threshold": 0.3, "normalized": False},
                    ["Out", "RoisNum"], {"RoisNum": "int32"})
-    # boxes 0 and 2 merge ACROSS the skipped low-score far box
+    # heads: 0.9, 0.005 (dropped by threshold), 0.8 (NMS-suppressed
+    # by the 0.9 head it overlaps)
     assert d["RoisNum"][0] == 1
-    np.testing.assert_allclose(d["Out"][0, 0, 1], 1.7, rtol=1e-5)
+    np.testing.assert_allclose(d["Out"][0, 0, 1], 0.9, rtol=1e-5)
+
+
+def test_locality_aware_nms_subthreshold_boxes_merge_above_threshold():
+    """Boxes individually below score_threshold still participate in
+    the walk; their merged head score can clear the threshold and must
+    be emitted (reference applies the threshold to merged scores)."""
+    boxes = np.array([[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                       [1, 1, 11, 11]]], "float32")
+    scores = np.array([[[0.04, 0.04, 0.04]]], "float32")
+    d = run_det_op("locality_aware_nms",
+                   {"BBoxes": boxes, "Scores": scores},
+                   {"background_label": -1, "score_threshold": 0.1,
+                    "nms_top_k": 3, "keep_top_k": 3,
+                    "nms_threshold": 0.3, "normalized": False},
+                   ["Out", "RoisNum"], {"RoisNum": "int32"})
+    assert d["RoisNum"][0] == 1
+    np.testing.assert_allclose(d["Out"][0, 0, 1], 0.12, rtol=1e-5)

@@ -1,0 +1,479 @@
+"""Vision-geometry op tests: interp family, grid_sampler, affine_grid,
+shuffles, index pooling, unpool, transposed-conv tails, deformable conv.
+
+Oracles: torch CPU where the semantics provably coincide (grid_sample,
+pixel_shuffle, interpolate for the align modes torch implements,
+max_pool2d with indices, conv_transpose3d), hand-computed numpy
+elsewhere (reference formulas re-derived independently of the
+lowerings)."""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as TF
+
+from op_test import OpTest, randf, run_single_op
+
+
+def run_op(op_type, inputs, attrs, outs, dtypes=None):
+    return run_single_op(op_type, inputs, attrs, outs, dtypes)
+
+
+# ---------------------------------------------------------------------------
+# interpolation
+# ---------------------------------------------------------------------------
+
+class TestBilinearAlignModes:
+    def test_align_corners_true(self):
+        x = randf(2, 3, 5, 7, seed=1)
+        d = run_op("bilinear_interp_v2", {"X": x},
+                   {"out_h": 10, "out_w": 9, "align_corners": True}, ["Out"])
+        want = TF.interpolate(torch.tensor(x), size=(10, 9), mode="bilinear",
+                              align_corners=True).numpy()
+        np.testing.assert_allclose(d["Out"], want, atol=1e-5)
+
+    def test_align_mode_0(self):
+        # align_corners=False + align_mode=0 is torch's half-pixel map
+        x = randf(1, 2, 4, 4, seed=2)
+        d = run_op("bilinear_interp_v2", {"X": x},
+                   {"out_h": 7, "out_w": 3, "align_corners": False,
+                    "align_mode": 0}, ["Out"])
+        want = TF.interpolate(torch.tensor(x), size=(7, 3), mode="bilinear",
+                              align_corners=False).numpy()
+        np.testing.assert_allclose(d["Out"], want, atol=1e-5)
+
+    def test_align_mode_1_matches_reference_formula(self):
+        # align_mode=1 (paddle default): src = ratio * dst, no half-pixel
+        x = randf(1, 1, 4, 4, seed=3)
+        d = run_op("bilinear_interp_v2", {"X": x},
+                   {"out_h": 6, "out_w": 6, "align_corners": False,
+                    "align_mode": 1}, ["Out"])
+        xs = x[0, 0]
+        want = np.zeros((6, 6), "float32")
+        ratio = 4 / 6
+        for i in range(6):
+            for j in range(6):
+                sy, sx = ratio * i, ratio * j
+                y0, x0 = int(sy), int(sx)
+                y1, x1 = min(y0 + 1, 3), min(x0 + 1, 3)
+                dy, dx = sy - y0, sx - x0
+                want[i, j] = (xs[y0, x0] * (1 - dy) * (1 - dx)
+                              + xs[y0, x1] * (1 - dy) * dx
+                              + xs[y1, x0] * dy * (1 - dx)
+                              + xs[y1, x1] * dy * dx)
+        np.testing.assert_allclose(d["Out"][0, 0], want, atol=1e-5)
+
+
+def test_bicubic_matches_torch():
+    x = randf(1, 2, 6, 6, seed=4)
+    for ac in (True, False):
+        d = run_op("bicubic_interp_v2", {"X": x},
+                   {"out_h": 11, "out_w": 8, "align_corners": ac}, ["Out"])
+        want = TF.interpolate(torch.tensor(x), size=(11, 8), mode="bicubic",
+                              align_corners=ac).numpy()
+        np.testing.assert_allclose(d["Out"], want, atol=1e-4)
+
+
+def test_trilinear_matches_torch():
+    x = randf(1, 2, 3, 4, 5, seed=5)
+    d = run_op("trilinear_interp_v2", {"X": x},
+               {"out_d": 5, "out_h": 7, "out_w": 4,
+                "align_corners": True}, ["Out"])
+    want = TF.interpolate(torch.tensor(x), size=(5, 7, 4), mode="trilinear",
+                          align_corners=True).numpy()
+    np.testing.assert_allclose(d["Out"], want, atol=1e-5)
+
+
+def test_linear_interp_1d():
+    x = randf(2, 3, 8, seed=6)
+    d = run_op("linear_interp_v2", {"X": x},
+               {"out_w": 13, "align_corners": True}, ["Out"])
+    want = TF.interpolate(torch.tensor(x), size=13, mode="linear",
+                          align_corners=True).numpy()
+    np.testing.assert_allclose(d["Out"], want, atol=1e-5)
+
+
+def test_nearest_interp_half_pixel_free():
+    # paddle nearest, align_corners=False: src = floor(ratio * dst)
+    x = randf(1, 1, 4, 4, seed=7)
+    d = run_op("nearest_interp_v2", {"X": x},
+               {"out_h": 7, "out_w": 7, "align_corners": False}, ["Out"])
+    want = TF.interpolate(torch.tensor(x), size=(7, 7),
+                          mode="nearest").numpy()
+    np.testing.assert_allclose(d["Out"], want)
+
+
+def test_bilinear_v2_scale_ratio():
+    # v2 with a scale attr and !align_corners uses ratio = 1/scale, not
+    # in/out (interpolate_v2_op.h:933): in_w=3, scale=2.5 -> out_w=7,
+    # ratio 0.4 (vs 3/7 ~ 0.4286)
+    x = randf(1, 1, 3, 3, seed=9)
+    d = run_op("bilinear_interp_v2", {"X": x},
+               {"scale": [2.5, 2.5], "align_corners": False,
+                "align_mode": 1}, ["Out"])
+    xs = x[0, 0]
+    ratio = 1.0 / 2.5
+    want = np.zeros((7, 7), "float32")
+    for i in range(7):
+        for j in range(7):
+            sy, sx = ratio * i, ratio * j
+            y0, x0 = int(sy), int(sx)
+            y1, x1 = min(y0 + 1, 2), min(x0 + 1, 2)
+            dy, dx = sy - y0, sx - x0
+            want[i, j] = (xs[y0, x0] * (1 - dy) * (1 - dx)
+                          + xs[y0, x1] * (1 - dy) * dx
+                          + xs[y1, x0] * dy * (1 - dx)
+                          + xs[y1, x1] * dy * dx)
+    assert d["Out"].shape == (1, 1, 7, 7)
+    np.testing.assert_allclose(d["Out"][0, 0], want, atol=1e-5)
+
+
+def test_int64_feed_guard():
+    """Out-of-int32-range int64 feeds into integer vars raise loudly;
+    the same values into float vars cast fine (executor feed policy)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid.executor import Scope, scope_guard
+
+    big = np.array([5_000_000_000], dtype="int64")
+    for dtype, ok in (("float32", True), ("int64", False)):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            v = fluid.data("v", [1], dtype)
+            w = fluid.layers.cast(v, "float32")
+        with scope_guard(Scope()):
+            exe = fluid.Executor()
+            if ok:
+                exe.run(main, feed={"v": big}, fetch_list=[w.name])
+            else:
+                with pytest.raises(OverflowError, match="32-bit"):
+                    exe.run(main, feed={"v": big}, fetch_list=[w.name])
+
+
+def test_interp_grad_flows():
+    t = OpTest()
+    t.op_type = "bilinear_interp_v2"
+    t.inputs = {"X": randf(1, 1, 3, 3, seed=8)}
+    t.attrs = {"out_h": 5, "out_w": 5, "align_corners": True}
+    x = torch.tensor(t.inputs["X"])
+    t.outputs = {"Out": TF.interpolate(x, size=(5, 5), mode="bilinear",
+                                       align_corners=True).numpy()}
+    t.check_output(atol=1e-5)
+    t.check_grad(["X"], "Out", max_relative_error=5e-3)
+
+
+# ---------------------------------------------------------------------------
+# grid sampling
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+@pytest.mark.parametrize("pad", ["zeros", "border", "reflection"])
+@pytest.mark.parametrize("align", [True, False])
+def test_grid_sampler_vs_torch(mode, pad, align):
+    x = randf(2, 3, 5, 6, seed=11)
+    grid = randf(2, 4, 7, 2, low=-1.3, high=1.3, seed=12)
+    d = run_op("grid_sampler", {"X": x, "Grid": grid},
+               {"mode": mode, "padding_mode": pad, "align_corners": align},
+               ["Output"])
+    want = TF.grid_sample(torch.tensor(x), torch.tensor(grid), mode=mode,
+                          padding_mode={"zeros": "zeros", "border": "border",
+                                        "reflection": "reflection"}[pad],
+                          align_corners=align).numpy()
+    np.testing.assert_allclose(d["Output"], want, atol=1e-4)
+
+
+def test_grid_sampler_grad():
+    t = OpTest()
+    t.op_type = "grid_sampler"
+    x = randf(1, 1, 3, 3, seed=13)
+    grid = randf(1, 2, 2, 2, low=-0.8, high=0.8, seed=14)
+    t.inputs = {"X": x, "Grid": grid}
+    t.attrs = {"mode": "bilinear", "padding_mode": "zeros",
+               "align_corners": True}
+    want = TF.grid_sample(torch.tensor(x), torch.tensor(grid),
+                          align_corners=True).numpy()
+    t.outputs = {"Output": want}
+    t.check_output(atol=1e-5)
+    t.check_grad(["X", "Grid"], "Output", max_relative_error=1e-2)
+
+
+def test_affine_grid_vs_torch():
+    theta = randf(2, 2, 3, seed=15)
+    for ac in (True, False):
+        d = run_op("affine_grid", {"Theta": theta},
+                   {"output_shape": [2, 3, 4, 5], "align_corners": ac},
+                   ["Output"])
+        want = TF.affine_grid(torch.tensor(theta), [2, 3, 4, 5],
+                              align_corners=ac).numpy()
+        np.testing.assert_allclose(d["Output"], want, atol=1e-5)
+
+
+def test_affine_grid_then_sample_identity():
+    # identity theta samples the image back onto itself
+    x = randf(1, 2, 6, 6, seed=16)
+    theta = np.tile(np.array([[[1, 0, 0], [0, 1, 0]]], "float32"), (1, 1, 1))
+    g = run_op("affine_grid", {"Theta": theta},
+               {"output_shape": [1, 2, 6, 6], "align_corners": True},
+               ["Output"])
+    d = run_op("grid_sampler", {"X": x, "Grid": g["Output"]},
+               {"align_corners": True}, ["Output"])
+    np.testing.assert_allclose(d["Output"], x, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# channel shuffles / shifts
+# ---------------------------------------------------------------------------
+
+def test_affine_channel():
+    x = randf(2, 3, 4, 4, seed=17)
+    s = randf(3, seed=18)
+    b = randf(3, seed=19)
+    d = run_op("affine_channel", {"X": x, "Scale": s, "Bias": b}, {}, ["Out"])
+    want = x * s[None, :, None, None] + b[None, :, None, None]
+    np.testing.assert_allclose(d["Out"], want, atol=1e-6)
+
+
+def test_pixel_shuffle_vs_torch():
+    x = randf(2, 8, 3, 3, seed=20)
+    d = run_op("pixel_shuffle", {"X": x}, {"upscale_factor": 2}, ["Out"])
+    want = TF.pixel_shuffle(torch.tensor(x), 2).numpy()
+    np.testing.assert_allclose(d["Out"], want)
+
+
+def test_space_to_depth_reference_layout():
+    # reproduce the reference functor exactly in numpy
+    # (space_to_depth_op.h:39-57)
+    x = randf(1, 4, 4, 4, seed=21)
+    bs = 2
+    n, c, h, w = x.shape
+    oc = c // (bs * bs)
+    flat_in = x.reshape(-1)
+    out_flat = np.zeros(x.size, "float32")
+    for idx in range(x.size):
+        b = idx // (c * h * w)
+        k = (idx % (c * h * w)) // (h * w)
+        j = ((idx % (c * h * w)) % (h * w)) // w
+        i = ((idx % (c * h * w)) % (h * w)) % w
+        c2 = k % oc
+        off = k // oc
+        w2 = i * bs + off % bs
+        h2 = j * bs + off // bs
+        out_idx = w2 + w * bs * (h2 + h * bs * (c2 + oc * b))
+        out_flat[out_idx] = flat_in[idx]
+    want = out_flat.reshape(n, c * bs * bs, h // bs, w // bs)
+    d = run_op("space_to_depth", {"X": x}, {"blocksize": bs}, ["Out"])
+    np.testing.assert_allclose(d["Out"], want)
+
+
+def test_temporal_shift():
+    x = randf(4, 4, 2, 2, seed=22)  # N=2, T=2, C=4, ratio .25 -> c1=1 c2=2
+    d = run_op("temporal_shift", {"X": x},
+               {"seg_num": 2, "shift_ratio": 0.25}, ["Out"])
+    v = x.reshape(2, 2, 4, 2, 2)
+    want = np.zeros_like(v)
+    for t in range(2):
+        want[:, t, 0] = v[:, t - 1, 0] if t - 1 >= 0 else 0
+        want[:, t, 1] = v[:, t + 1, 1] if t + 1 < 2 else 0
+        want[:, t, 2:] = v[:, t, 2:]
+    np.testing.assert_allclose(d["Out"], want.reshape(4, 4, 2, 2))
+
+
+# ---------------------------------------------------------------------------
+# crop / pad / expand
+# ---------------------------------------------------------------------------
+
+def test_crop_static_offsets():
+    x = randf(3, 5, 7, seed=23)
+    d = run_op("crop", {"X": x}, {"shape": [2, 2, 3],
+                                  "offsets": [1, 2, 4]}, ["Out"])
+    np.testing.assert_allclose(d["Out"], x[1:3, 2:4, 4:7])
+
+
+def test_crop_tensor_dynamic_offsets():
+    x = randf(4, 6, seed=24)
+    d = run_op("crop_tensor",
+               {"X": x, "Offsets": np.array([1, 2], "int32")},
+               {"shape": [2, 3]}, ["Out"])
+    np.testing.assert_allclose(d["Out"], x[1:3, 2:5])
+
+
+def test_pad_constant_like():
+    x = np.zeros((4, 5), "float32")
+    y = randf(2, 3, seed=25)
+    d = run_op("pad_constant_like", {"X": x, "Y": y},
+               {"pad_value": 7.0}, ["Out"])
+    want = np.full((4, 5), 7.0, "float32")
+    want[:2, :3] = y
+    np.testing.assert_allclose(d["Out"], want)
+
+
+def test_expand_as():
+    x = randf(2, 1, 3, seed=26)
+    tgt = np.zeros((4, 2, 3), "float32")
+    d = run_op("expand_as", {"X": x, "target_tensor": tgt}, {}, ["Out"])
+    np.testing.assert_allclose(d["Out"], np.tile(x, (2, 2, 1)))
+
+
+# ---------------------------------------------------------------------------
+# index pooling + unpool
+# ---------------------------------------------------------------------------
+
+def test_max_pool2d_with_index_vs_torch():
+    x = randf(2, 3, 6, 6, seed=27)
+    d = run_op("max_pool2d_with_index", {"X": x},
+               {"ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0]},
+               ["Out", "Mask"], {"Mask": "int32"})
+    out, idx = TF.max_pool2d(torch.tensor(x), 2, 2, return_indices=True)
+    np.testing.assert_allclose(d["Out"], out.numpy())
+    np.testing.assert_array_equal(d["Mask"], idx.numpy())
+
+
+def test_max_pool2d_with_index_padding():
+    x = randf(1, 1, 5, 5, seed=28)
+    d = run_op("max_pool2d_with_index", {"X": x},
+               {"ksize": [3, 3], "strides": [2, 2], "paddings": [1, 1]},
+               ["Out", "Mask"], {"Mask": "int32"})
+    out, idx = TF.max_pool2d(torch.tensor(x), 3, 2, padding=1,
+                             return_indices=True)
+    np.testing.assert_allclose(d["Out"], out.numpy())
+    np.testing.assert_array_equal(d["Mask"], idx.numpy())
+
+
+def test_max_pool3d_with_index():
+    x = randf(1, 2, 4, 4, 4, seed=29)
+    d = run_op("max_pool3d_with_index", {"X": x},
+               {"ksize": [2, 2, 2], "strides": [2, 2, 2],
+                "paddings": [0, 0, 0]},
+               ["Out", "Mask"], {"Mask": "int32"})
+    out, idx = TF.max_pool3d(torch.tensor(x), 2, 2, return_indices=True)
+    np.testing.assert_allclose(d["Out"], out.numpy())
+    np.testing.assert_array_equal(d["Mask"], idx.numpy())
+
+
+def test_max_pool2d_with_index_adaptive():
+    x = randf(1, 2, 5, 7, seed=30)
+    d = run_op("max_pool2d_with_index", {"X": x},
+               {"ksize": [2, 3], "adaptive": True},
+               ["Out", "Mask"], {"Mask": "int32"})
+    out, idx = TF.adaptive_max_pool2d(torch.tensor(x), (2, 3),
+                                      return_indices=True)
+    np.testing.assert_allclose(d["Out"], out.numpy())
+    np.testing.assert_array_equal(d["Mask"], idx.numpy())
+
+
+def test_unpool_roundtrip():
+    x = randf(1, 2, 6, 6, seed=31)
+    p = run_op("max_pool2d_with_index", {"X": x},
+               {"ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0]},
+               ["Out", "Mask"], {"Mask": "int32"})
+    d = run_op("unpool", {"X": p["Out"], "Indices": p["Mask"]},
+               {"ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0],
+                "unpooling_type": "max"}, ["Out"])
+    want = TF.max_unpool2d(torch.tensor(p["Out"]),
+                           torch.tensor(p["Mask"]).long(), 2, 2).numpy()
+    np.testing.assert_allclose(d["Out"], want)
+
+
+# ---------------------------------------------------------------------------
+# transposed conv tails
+# ---------------------------------------------------------------------------
+
+def test_conv3d_transpose_vs_torch():
+    x = randf(1, 3, 4, 4, 4, seed=32)
+    w = randf(3, 2, 3, 3, 3, seed=33)
+    d = run_op("conv3d_transpose", {"Input": x, "Filter": w},
+               {"strides": [2, 2, 2], "paddings": [1, 1, 1],
+                "dilations": [1, 1, 1]}, ["Output"])
+    want = TF.conv_transpose3d(torch.tensor(x), torch.tensor(w),
+                               stride=2, padding=1).numpy()
+    np.testing.assert_allclose(d["Output"], want, atol=1e-4)
+
+
+def test_depthwise_conv2d_transpose_vs_torch():
+    x = randf(2, 4, 5, 5, seed=34)
+    w = randf(4, 1, 3, 3, seed=35)
+    d = run_op("depthwise_conv2d_transpose", {"Input": x, "Filter": w},
+               {"strides": [2, 2], "paddings": [1, 1],
+                "dilations": [1, 1], "groups": 4}, ["Output"])
+    want = TF.conv_transpose2d(torch.tensor(x), torch.tensor(w),
+                               stride=2, padding=1, groups=4).numpy()
+    np.testing.assert_allclose(d["Output"], want, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# deformable conv
+# ---------------------------------------------------------------------------
+
+def _plain_conv(x, w, stride, pad):
+    return TF.conv2d(torch.tensor(x), torch.tensor(w), stride=stride,
+                     padding=pad).numpy()
+
+
+def test_deformable_conv_zero_offset_equals_conv():
+    """With zero offsets and all-ones mask, modulated deformable conv
+    must reduce to a plain convolution."""
+    x = randf(2, 4, 6, 6, seed=36)
+    w = randf(5, 4, 3, 3, seed=37)
+    ho = wo = 6
+    offset = np.zeros((2, 2 * 9, ho, wo), "float32")
+    mask = np.ones((2, 9, ho, wo), "float32")
+    d = run_op("deformable_conv",
+               {"Input": x, "Offset": offset, "Mask": mask, "Filter": w},
+               {"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1],
+                "groups": 1, "deformable_groups": 1}, ["Output"])
+    np.testing.assert_allclose(d["Output"], _plain_conv(x, w, 1, 1),
+                               atol=1e-4)
+
+
+def test_deformable_conv_v1_integer_shift():
+    """An integer offset of (0, +1) everywhere shifts sampling one
+    pixel right: equivalent to convolving the left-shifted image."""
+    x = randf(1, 2, 5, 5, seed=38)
+    w = randf(3, 2, 3, 3, seed=39)
+    offset = np.zeros((1, 2 * 9, 5, 5), "float32")
+    offset[:, 1::2] = 1.0  # dx = +1 for every tap
+    d = run_op("deformable_conv_v1",
+               {"Input": x, "Offset": offset, "Filter": w},
+               {"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1],
+                "groups": 1, "deformable_groups": 1}, ["Output"])
+    x_shift = np.zeros_like(x)
+    x_shift[..., :-1] = x[..., 1:]  # shift left, zero-fill the edge
+    want = _plain_conv(x_shift, w, 1, 1)
+    # column 0 differs by construction: the kj=0 taps there read x[0]
+    # in the deformable op but the conv oracle reads its zero padding;
+    # everywhere else (incl. the right edge, zero in both) they agree
+    np.testing.assert_allclose(d["Output"][..., 1:], want[..., 1:],
+                               atol=1e-4)
+
+
+def test_deformable_conv_mask_scales():
+    """Mask of 0.5 on every tap halves the output of the zero-offset
+    case."""
+    x = randf(1, 2, 4, 4, seed=40)
+    w = randf(2, 2, 3, 3, seed=41)
+    offset = np.zeros((1, 2 * 9, 4, 4), "float32")
+    mask = np.full((1, 9, 4, 4), 0.5, "float32")
+    d = run_op("deformable_conv",
+               {"Input": x, "Offset": offset, "Mask": mask, "Filter": w},
+               {"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1],
+                "groups": 1, "deformable_groups": 1}, ["Output"])
+    np.testing.assert_allclose(d["Output"], 0.5 * _plain_conv(x, w, 1, 1),
+                               atol=1e-4)
+
+
+def test_deformable_conv_grad():
+    t = OpTest()
+    t.op_type = "deformable_conv"
+    x = randf(1, 1, 3, 3, seed=42)
+    w = randf(1, 1, 3, 3, seed=43)
+    # keep sample points away from integer coords: bilinear sampling's
+    # offset-gradient has kinks at cell boundaries where the central
+    # difference is meaningless
+    offset = (0.4 + 0.08 * randf(1, 18, 3, 3, seed=44)).astype("float32")
+    mask = np.full((1, 9, 3, 3), 0.7, "float32")
+    t.inputs = {"Input": x, "Offset": offset, "Mask": mask, "Filter": w}
+    t.attrs = {"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1],
+               "groups": 1, "deformable_groups": 1}
+    t.outputs = {"Output": np.zeros((1, 1, 3, 3), "float32")}
+    # grad-only check: analytic vs numeric on all differentiable inputs
+    t.check_grad(["Input", "Offset", "Mask", "Filter"], "Output",
+                 max_relative_error=2e-2, delta=1e-3)
