@@ -331,12 +331,32 @@ def _nms_keep(boxes, scores, iou_thr, score_thr, normalized):
     return keep
 
 
+def _nms_keep_poly(boxes, scores, iou_thr, score_thr):
+    """Greedy NMS with polygon IoU (boxes (k, 2V) flattened quads)."""
+    k = boxes.shape[0]
+    pts = boxes.reshape(k, -1, 2)
+    iou = jax.vmap(lambda a: jax.vmap(lambda b: poly_iou(a, b))(pts))(pts)
+    valid = scores > score_thr
+
+    def body(i, state):
+        keep, suppressed = state
+        take = valid[i] & jnp.logical_not(suppressed[i])
+        keep = keep.at[i].set(take)
+        suppressed = jnp.where(take, suppressed | (iou[i] > iou_thr),
+                               suppressed)
+        return keep, suppressed
+
+    keep, _ = lax.fori_loop(
+        0, k, body, (jnp.zeros((k,), bool), jnp.zeros((k,), bool)))
+    return keep
+
+
 def _multiclass_scaffold(boxes, sc, bg, keep_top_k, per_class_fn,
-                         k_per_class):
+                         k_per_class, box_dim=4):
     """Shared per-image multi-class NMS scaffolding: run `per_class_fn`
     for every foreground class, concat, keep the global top
     `keep_top_k`, pad with label -1 / zero boxes.  Returns
-    (det (kk, 6), count, index (kk,))."""
+    (det (kk, 2+box_dim), count, index (kk,))."""
     c = sc.shape[0]
     all_s, all_b, all_l, all_i = [], [], [], []
     for cls in range(c):
@@ -350,7 +370,8 @@ def _multiclass_scaffold(boxes, sc, bg, keep_top_k, per_class_fn,
     kk = max(keep_top_k, 1)
     if not all_s:  # every class is background: empty result
         return (jnp.concatenate(
-                    [jnp.full((kk, 1), -1.0), jnp.zeros((kk, 5))], -1
+                    [jnp.full((kk, 1), -1.0),
+                     jnp.zeros((kk, 1 + box_dim))], -1
                 ).astype(boxes.dtype),
                 jnp.int32(0), jnp.zeros((kk,), jnp.int32))
     s_cat = jnp.concatenate(all_s)
@@ -365,7 +386,7 @@ def _multiclass_scaffold(boxes, sc, bg, keep_top_k, per_class_fn,
          jnp.maximum(s_fin, 0.0)[:, None], b_cat[sel]], axis=-1)
     det = jnp.where((s_fin > 0)[:, None], det,
                     jnp.concatenate([jnp.full((kk, 1), -1.0),
-                                     jnp.zeros((kk, 5))], -1)
+                                     jnp.zeros((kk, 1 + box_dim))], -1)
                     .astype(det.dtype))
     return det, jnp.sum(s_fin > 0).astype(jnp.int32), i_cat[sel]
 
@@ -1409,7 +1430,8 @@ def _generate_proposal_labels(ctx, op, ins):
     return outs
 
 
-def _locality_merge(boxes, scores, nms_thr, normalized, score_thr=0.0):
+def _locality_merge(boxes, scores, nms_thr, normalized, score_thr=0.0,
+                    pair_iou=None):
     """EAST-style locality-aware prepass (reference
     locality_aware_nms_op.cc GetMaxScoreIndexWithLocalityAware +
     PolyWeightedMerge): walk ALL boxes in input order; while the next
@@ -1428,7 +1450,10 @@ def _locality_merge(boxes, scores, nms_thr, normalized, score_thr=0.0):
         head_b, head_s, out_b, out_s, cnt = carry
         b, s = boxes[i], scores[i]
         has_head = head_s >= 0
-        iou = _iou_matrix(b[None], head_b[None], normalized)[0, 0]
+        if pair_iou is None:
+            iou = _iou_matrix(b[None], head_b[None], normalized)[0, 0]
+        else:
+            iou = pair_iou(b, head_b)
         do_merge = has_head & (iou > nms_thr)
         merged_b = (b * s + head_b * jnp.maximum(head_s, 0.0)) \
             / jnp.maximum(s + jnp.maximum(head_s, 0.0), 1e-12)
@@ -1440,7 +1465,7 @@ def _locality_merge(boxes, scores, nms_thr, normalized, score_thr=0.0):
         head_s = jnp.where(do_merge, head_s + s, s)
         return (head_b, head_s, out_b, out_s, cnt), None
 
-    init = (jnp.zeros((4,), boxes.dtype), jnp.float32(-1.0),
+    init = (jnp.zeros((boxes.shape[1],), boxes.dtype), jnp.float32(-1.0),
             jnp.zeros_like(boxes), jnp.zeros((n,), jnp.float32),
             jnp.int32(0))
     (head_b, head_s, out_b, out_s, cnt), _ = lax.scan(
@@ -1460,13 +1485,10 @@ def _locality_aware_nms(ctx, op, ins):
     dense (B, keep_top_k, 6) + RoisNum contract as multiclass_nms.
     Axis-aligned 4-coord boxes (the PolyIoU 8..32-coordinate quad path
     needs polygon clipping utilities not built yet — raise loudly)."""
-    bboxes = first(ins, "BBoxes")   # (B, M, 4)
+    bboxes = first(ins, "BBoxes")   # (B, M, 4) or (B, M, 8..32) quads
     scores = first(ins, "Scores")   # (B, C, M)
-    if bboxes.shape[-1] != 4:
-        raise NotImplementedError(
-            "locality_aware_nms: only 4-coordinate boxes are supported "
-            f"on TPU (got box size {bboxes.shape[-1]}; polygon IoU "
-            "needs the gpc clipping utilities)")
+    box_dim = bboxes.shape[-1]
+    is_poly = box_dim != 4
     bg = op.attr("background_label", -1)
     score_thr = op.attr("score_threshold", 0.0)
     nms_top_k = int(op.attr("nms_top_k", 64) or 64)
@@ -1476,17 +1498,28 @@ def _locality_aware_nms(ctx, op, ins):
     b, c, m = scores.shape
     k = min(nms_top_k, m) if nms_top_k > 0 else m
 
+    if is_poly:
+        # reference PolyIoU via gpc (poly_util.cc:117); the S-H convex
+        # clipper in poly_iou covers EAST's rotated-rect quads
+        def pair_iou(b1, b2):
+            return poly_iou(b1.reshape(-1, 2), b2.reshape(-1, 2))
+    else:
+        pair_iou = None
+
     def per_class(boxes, sc_c, cls):
         mb, ms = _locality_merge(boxes, sc_c, iou_thr, normalized,
-                                 score_thr=score_thr)
+                                 score_thr=score_thr, pair_iou=pair_iou)
         s_top, idx = lax.top_k(ms, k)
         b_top = mb[idx]
-        keep = _nms_keep(b_top, s_top, iou_thr, score_thr, normalized)
+        if is_poly:
+            keep = _nms_keep_poly(b_top, s_top, iou_thr, score_thr)
+        else:
+            keep = _nms_keep(b_top, s_top, iou_thr, score_thr, normalized)
         return jnp.where(keep, s_top, -1.0), b_top, idx
 
     def per_image(boxes, sc):
         return _multiclass_scaffold(boxes, sc, bg, keep_top_k,
-                                    per_class, k)
+                                    per_class, k, box_dim=box_dim)
 
     det, counts, _ = jax.vmap(per_image)(bboxes, scores)
     outs = {"Out": [det]}
@@ -1500,3 +1533,377 @@ def _locality_aware_nms(ctx, op, ins):
     if "RoisNum" in op.outputs:
         outs["RoisNum"] = [counts]
     return outs
+
+
+@register_op("psroi_pool")
+def _psroi_pool(ctx, op, ins):
+    """reference psroi_pool_op.h: position-sensitive ROI average
+    pooling — output channel c at bin (ph, pw) averages INPUT channel
+    (c*PH + ph)*PW + pw over the bin.  ROI coords round like the
+    reference: start = round(x)*scale, end = (round(x2)+1)*scale.
+    Dense contract: ROIs (R, 4) + RoisNum/batch ids; one output row
+    per roi."""
+    x = first(ins, "X")                 # (N, C_in, H, W)
+    rois = first(ins, "ROIs").reshape(-1, 4)
+    rois_num = first(ins, "RoisNum", None)
+    ph = int(op.attr("pooled_height", 1))
+    pw = int(op.attr("pooled_width", 1))
+    oc = int(op.attr("output_channels"))
+    scale = op.attr("spatial_scale", 1.0)
+    n, cin, h, w = x.shape
+    r = rois.shape[0]
+    batch_ids = _rois_batch_index(rois_num, r)
+    ys = jnp.arange(h, dtype=x.dtype)
+    xs = jnp.arange(w, dtype=x.dtype)
+
+    def one(roi, bid):
+        x1 = jnp.round(roi[0]) * scale
+        y1 = jnp.round(roi[1]) * scale
+        x2 = (jnp.round(roi[2]) + 1.0) * scale
+        y2 = (jnp.round(roi[3]) + 1.0) * scale
+        rh = jnp.maximum(y2 - y1, 0.1)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        bh, bw = rh / ph, rw / pw
+        img = x[bid]                    # (C_in, H, W)
+
+        # per output bin: [floor(y1+ph*bh), ceil(y1+(ph+1)*bh)) clipped
+        hs = jnp.clip(jnp.floor(y1 + jnp.arange(ph) * bh), 0, h)
+        he = jnp.clip(jnp.ceil(y1 + (jnp.arange(ph) + 1) * bh), 0, h)
+        ws_ = jnp.clip(jnp.floor(x1 + jnp.arange(pw) * bw), 0, w)
+        we = jnp.clip(jnp.ceil(x1 + (jnp.arange(pw) + 1) * bw), 0, w)
+        ymask = (ys[None, :] >= hs[:, None]) & (ys[None, :] < he[:, None])
+        xmask = (xs[None, :] >= ws_[:, None]) & (xs[None, :] < we[:, None])
+        # position-sensitive channel (c*PH+ph)*PW+pw in row-major
+        # order is exactly a free reshape of the channel axis
+        g = img.reshape(oc, ph, pw, h, w)
+        msk = ymask[None, :, None, :, None] * xmask[None, None, :, None, :]
+        s = jnp.sum(g * msk, axis=(3, 4))
+        area = jnp.maximum((he - hs)[:, None] * (we - ws_)[None, :], 1.0)
+        empty = ((he - hs)[:, None] <= 0) | ((we - ws_)[None, :] <= 0)
+        return jnp.where(empty[None], 0.0, s / area[None])
+
+    out = jax.vmap(one)(rois, batch_ids.astype(jnp.int32))
+    return {"Out": [out]}
+
+
+def _tri_integral(a, b, c):
+    """∫_a^b max(0, 1-|y-c|) dy with [a,b] arbitrary — closed form of
+    the PrRoIPoolingMatCalculation triangle kernel, separably."""
+    def F(u):
+        u = jnp.clip(u, -1.0, 1.0)
+        neg = 0.5 * jnp.square(u + 1.0)
+        pos = 0.5 + u - 0.5 * jnp.square(u)
+        return jnp.where(u <= 0, neg, pos)
+    return jnp.maximum(F(b - c) - F(a - c), 0.0)
+
+
+@register_op("prroi_pool")
+def _prroi_pool(ctx, op, ins):
+    """reference prroi_pool_op.h (Precise RoI Pooling): the exact
+    integral of the bilinearly-interpolated feature over each bin,
+    divided by bin area.  The reference's per-cell MatCalculation sum
+    equals a separable triangle-kernel integral: out[bin] =
+    wy^T V wx / area, with wy[h] = ∫_bin tri(y-h) dy — two small
+    matmuls per bin instead of dynamic loops."""
+    x = first(ins, "X")
+    rois = first(ins, "ROIs").reshape(-1, 4)
+    rois_num = first(ins, "BatchRoINums", None)
+    ph = int(op.attr("pooled_height", 1))
+    pw = int(op.attr("pooled_width", 1))
+    scale = op.attr("spatial_scale", 1.0)
+    n, c, h, w = x.shape
+    r = rois.shape[0]
+    batch_ids = _rois_batch_index(rois_num, r)
+    ys = jnp.arange(h, dtype=x.dtype)
+    xs = jnp.arange(w, dtype=x.dtype)
+
+    def one(roi, bid):
+        x1, y1 = roi[0] * scale, roi[1] * scale
+        x2, y2 = roi[2] * scale, roi[3] * scale
+        rw = jnp.maximum(x2 - x1, 0.0)
+        rh = jnp.maximum(y2 - y1, 0.0)
+        bh, bw = rh / ph, rw / pw
+        win = bh * bw
+        img = x[bid]
+        ph_i = jnp.arange(ph, dtype=x.dtype)
+        pw_i = jnp.arange(pw, dtype=x.dtype)
+        wy = _tri_integral(y1 + ph_i[:, None] * bh,
+                           y1 + (ph_i[:, None] + 1) * bh, ys[None])
+        wx = _tri_integral(x1 + pw_i[:, None] * bw,
+                           x1 + (pw_i[:, None] + 1) * bw, xs[None])
+        s = jnp.einsum("ph,chw,qw->cpq", wy, img, wx)
+        return jnp.where(win > 0, s / jnp.maximum(win, 1e-12), 0.0)
+
+    out = jax.vmap(one)(rois, batch_ids.astype(jnp.int32))
+    return {"Out": [out]}
+
+
+@register_op("retinanet_target_assign")
+def _retinanet_target_assign(ctx, op, ins):
+    """reference rpn_target_assign_op.cc RetinanetTargetAssignKernel:
+    like rpn_target_assign but with NO subsampling — every anchor with
+    max-IoU >= positive_overlap (plus each gt's best anchor) is
+    foreground carrying the GT CLASS label, every anchor with max-IoU <
+    negative_overlap is background (label 0), the rest ignored.
+
+    Dense re-design (same contract as this file's rpn_target_assign):
+    ScoreTarget (B, A, 1) holds the class label, 0 for bg, -1 ignored;
+    LocationTarget (B, A, 4) encoded deltas; LocationWeight /
+    ScoreWeight (B, A, 1) masks; ForegroundNumber (B, 1) = fg count +
+    1 (the reference's fg_num_data[0] = fg_fake.size() + 1)."""
+    anchors = first(ins, "Anchor").reshape(-1, 4)
+    gt = first(ins, "GtBoxes")
+    gt_labels = first(ins, "GtLabels").astype(jnp.int32)
+    if gt.ndim == 2:
+        gt = gt[None]
+        gt_labels = gt_labels.reshape(1, -1)
+    b, g, _ = gt.shape
+    gt_labels = gt_labels.reshape(b, g)
+    pos_thr = op.attr("positive_overlap", 0.5)
+    neg_thr = op.attr("negative_overlap", 0.4)
+    a = anchors.shape[0]
+
+    def per_image(gts, labs, crowd):
+        valid_gt = (gts[:, 2] > gts[:, 0]) & (gts[:, 3] > gts[:, 1])
+        if crowd is not None:
+            valid_gt = valid_gt & (crowd == 0)
+        iou = _iou_matrix(anchors, gts, normalized=False)
+        iou = jnp.where(valid_gt[None, :], iou, -1.0)
+        best_iou = jnp.max(iou, axis=1)
+        best_gt = jnp.argmax(iou, axis=1)
+        gt_best = jnp.max(iou, axis=0)           # per gt: best anchor iou
+        is_gt_best = (iou == gt_best[None, :]) & valid_gt[None, :] \
+            & (gt_best[None, :] > 0)
+        fg = (best_iou >= pos_thr) | jnp.any(is_gt_best, axis=1)
+        bg = jnp.logical_not(fg) & (best_iou < neg_thr) & (best_iou >= 0)
+        score = jnp.where(fg, labs[best_gt],
+                          jnp.where(bg, 0, -1)).astype(jnp.int32)
+        # bbox deltas vs matched gt (same encode as rpn_target_assign)
+        mg = gts[best_gt]
+        aw = anchors[:, 2] - anchors[:, 0] + 1.0
+        ah = anchors[:, 3] - anchors[:, 1] + 1.0
+        acx = anchors[:, 0] + aw * 0.5
+        acy = anchors[:, 1] + ah * 0.5
+        gw = mg[:, 2] - mg[:, 0] + 1.0
+        gh = mg[:, 3] - mg[:, 1] + 1.0
+        gcx = mg[:, 0] + gw * 0.5
+        gcy = mg[:, 1] + gh * 0.5
+        tgt = jnp.stack([(gcx - acx) / aw, (gcy - acy) / ah,
+                         jnp.log(gw / aw), jnp.log(gh / ah)], axis=1)
+        locw = fg.astype(jnp.float32)[:, None]
+        return (score[:, None], jnp.where(fg[:, None], tgt, 0.0), locw,
+                (fg | bg).astype(jnp.float32)[:, None],
+                (jnp.sum(fg) + 1).astype(jnp.int32))
+
+    crowd = first(ins, "IsCrowd", None)
+    if crowd is not None:
+        crowd = crowd.reshape(b, g).astype(jnp.int32)
+        score, loc, locw, scw, fgn = jax.vmap(per_image)(gt, gt_labels,
+                                                         crowd)
+    else:
+        score, loc, locw, scw, fgn = jax.vmap(
+            lambda gg, ll: per_image(gg, ll, None))(gt, gt_labels)
+    return {"ScoreTarget": [score], "LocationTarget": [loc],
+            "LocationWeight": [locw], "ScoreWeight": [scw],
+            "ForegroundNumber": [fgn.reshape(b, 1)]}
+
+
+# ---------------------------------------------------------------------------
+# polygon utilities (reference detection/gpc.cc, poly_util.cc,
+# mask_util.cc — re-designed as vectorized geometry, not a gpc port)
+# ---------------------------------------------------------------------------
+
+def _poly_area(poly, nv=None):
+    """Shoelace area of (V, 2) polygons; verts >= nv (when given) are
+    masked out.  Matches poly_util.cc PolyArea(|signed area|)."""
+    v = poly.shape[-2]
+    idx = jnp.arange(v)
+    nxt = (idx + 1) % v if nv is None else jnp.where(idx + 1 >= nv, 0,
+                                                     idx + 1)
+    x, y = poly[..., 0], poly[..., 1]
+    xn = jnp.take(x, nxt, axis=-1)
+    yn = jnp.take(y, nxt, axis=-1)
+    cross = x * yn - xn * y
+    if nv is not None:
+        cross = jnp.where(idx < nv, cross, 0.0)
+    return 0.5 * jnp.abs(jnp.sum(cross, axis=-1))
+
+
+def _convex_clip(subject, clip, max_out=None):
+    """Sutherland–Hodgman clip of polygon `subject` (S, 2) against
+    CONVEX polygon `clip` (C, 2); returns (out_pts (max_out, 2),
+    out_count).  This replaces the reference's general gpc clipper
+    (detection/gpc.cc) for the convex quads EAST/locality-NMS actually
+    feed it; the output vertex budget is static (S + C)."""
+    subject = jnp.asarray(subject)
+    clip = jnp.asarray(clip)
+    s = subject.shape[0]
+    c = clip.shape[0]
+    cap = max_out or (s + c)
+    # ensure counter-clockwise clip polygon (signed area > 0)
+    sign = jnp.sign(jnp.sum(clip[:, 0] * jnp.roll(clip[:, 1], -1)
+                            - jnp.roll(clip[:, 0], -1) * clip[:, 1]) + 1e-30)
+    pts = jnp.zeros((cap, 2), subject.dtype).at[:s].set(subject)
+    cnt = jnp.asarray(s, jnp.int32)
+
+    def clip_edge(carry, i):
+        pts, cnt = carry
+        a = clip[i]
+        b = clip[(i + 1) % c]
+        edge = (b - a) * sign
+
+        def inside(p):
+            return edge[0] * (p[..., 1] - a[1]) \
+                - edge[1] * (p[..., 0] - a[0]) >= 0
+
+        idxs = jnp.arange(cap)
+        cur = pts
+        nxt_i = jnp.where(idxs + 1 >= cnt, 0, idxs + 1)
+        nxt = pts[nxt_i]
+        cur_in = inside(cur) & (idxs < cnt)
+        nxt_in = inside(nxt) & (idxs < cnt)
+        # intersection of segment cur->nxt with the edge line
+        d = nxt - cur
+        denom = edge[0] * d[:, 1] - edge[1] * d[:, 0]
+        t = (edge[1] * (cur[:, 0] - a[0]) - edge[0] * (cur[:, 1] - a[1])) \
+            / jnp.where(jnp.abs(denom) < 1e-12, 1e-12, denom)
+        inter = cur + jnp.clip(t, 0.0, 1.0)[:, None] * d
+        # each input vertex emits up to 2 points:
+        #   cur_in -> cur; crossing -> intersection
+        emit1 = cur_in & (idxs < cnt)
+        emit2 = (cur_in != nxt_in) & (idxs < cnt)
+        n1 = jnp.cumsum(emit1.astype(jnp.int32)) - emit1
+        n2 = jnp.cumsum(emit2.astype(jnp.int32)) - emit2
+        pos1 = n1 + n2
+        pos2 = n1 + emit1 + n2
+        new = jnp.zeros_like(pts)
+        new = new.at[jnp.where(emit1, pos1, cap)].set(cur, mode="drop")
+        new = new.at[jnp.where(emit2, pos2, cap)].set(inter, mode="drop")
+        ncnt = jnp.sum(emit1) + jnp.sum(emit2)
+        return (new, ncnt.astype(jnp.int32)), None
+
+    (pts, cnt), _ = lax.scan(clip_edge, (pts, cnt), jnp.arange(c))
+    return pts, cnt
+
+
+def poly_iou(p1, p2):
+    """IoU of two convex polygons (V1,2)/(V2,2) via S-H intersection
+    area.  Reference convention (nms_util.h:93-97): if either area or
+    the intersection is zero, IoU is 0."""
+    a1 = _poly_area(p1)
+    a2 = _poly_area(p2)
+    inter_pts, inter_cnt = _convex_clip(p1, p2)
+    ai = _poly_area(inter_pts, nv=inter_cnt)
+    iou = ai / jnp.maximum(a1 + a2 - ai, 1e-10)
+    return jnp.where((a1 <= 0) | (a2 <= 0) | (ai <= 0), 0.0, iou)
+
+
+def _poly_raster(polys, box, resolution, valid_poly):
+    """Rasterize the union of polygons onto a resolution^2 grid over
+    `box` (mask_util.cc Polys2MaskWrtBox).  TPU re-design: the
+    reference's COCO RLE boundary-tracing is replaced by an even-odd
+    crossing test at pixel centers — identical fill away from
+    boundaries, ±1px on edge pixels where the RLE rounding differs.
+    polys (P, V, 2) image coords, valid_poly (P,) bool."""
+    m = resolution
+    w = jnp.maximum(box[2] - box[0], 1.0)
+    h = jnp.maximum(box[3] - box[1], 1.0)
+    # pixel centers in polygon (mask-grid) coordinates
+    cx = (jnp.arange(m) + 0.5)
+    cy = (jnp.arange(m) + 0.5)
+    px = (polys[..., 0] - box[0]) * m / w       # (P, V)
+    py = (polys[..., 1] - box[1]) * m / h
+    v = polys.shape[1]
+    nxt = (jnp.arange(v) + 1) % v
+    x1, y1 = px, py
+    x2 = jnp.take(px, nxt, axis=1)
+    y2 = jnp.take(py, nxt, axis=1)
+    # crossing test per pixel row (cy) and edge, then parity per column
+    yb = cy[None, None, :]                       # (1, 1, M)
+    spans = (y1[:, :, None] > yb) != (y2[:, :, None] > yb)  # (P, V, M)
+    xint = x1[:, :, None] + (yb - y1[:, :, None]) \
+        / jnp.where(jnp.abs(y2 - y1)[:, :, None] < 1e-12, 1e-12,
+                    (y2 - y1)[:, :, None]) * (x2 - x1)[:, :, None]
+    # count crossings left of each pixel center: (P, V, M, M)
+    left = spans[:, :, :, None] & (xint[:, :, :, None]
+                                   > cx[None, None, None, :])
+    cross = jnp.sum(left, axis=1)                # (P, M, M)
+    inside = (cross % 2 == 1) & valid_poly[:, None, None]
+    return jnp.any(inside, axis=0)               # (M, M) union
+
+
+@register_op("generate_mask_labels")
+def _generate_mask_labels(ctx, op, ins):
+    """reference detection/generate_mask_labels_op.cc (Mask R-CNN mask
+    head targets): each fg roi takes the gt polygon set whose bounding
+    box it best overlaps, rasterized to resolution^2 inside the roi,
+    expanded to a per-class -1/0/1 target.
+
+    Dense contract (LoD-free): GtClasses (B, G), IsCrowd (B, G),
+    GtSegms (B, G, P, V, 2) padded polygons + GtSegmsVerts (B, G, P)
+    vertex counts (0 = absent polygon), Rois (B, R, 4),
+    LabelsInt32 (B, R).  Outputs MaskRois (B, R, 4), RoiHasMaskInt32
+    (B, R) 0/1 flags (dense form of the reference's index list),
+    MaskInt32 (B, R, num_classes*res^2) with -1 ignore padding."""
+    im_info = first(ins, "ImInfo")
+    gt_classes = first(ins, "GtClasses").astype(jnp.int32)
+    is_crowd = first(ins, "IsCrowd").astype(jnp.int32)
+    segms = first(ins, "GtSegms")
+    verts = first(ins, "GtSegmsVerts", None)
+    rois = first(ins, "Rois")
+    labels = first(ins, "LabelsInt32").astype(jnp.int32)
+    num_classes = int(op.attr("num_classes"))
+    res = int(op.attr("resolution"))
+    if rois.ndim == 2:
+        rois = rois[None]
+        labels = labels.reshape(1, -1)
+        gt_classes = gt_classes.reshape(1, -1)
+        is_crowd = is_crowd.reshape(1, -1)
+        segms = segms[None] if segms.ndim == 4 else segms
+    b, r, _ = rois.shape
+    g, p, v = segms.shape[1], segms.shape[2], segms.shape[3]
+    if verts is None:
+        verts = jnp.full((b, g, p), v, jnp.int32)
+    verts = verts.astype(jnp.int32).reshape(b, g, p)
+
+    vidx = jnp.arange(v)
+
+    def per_image(scale, gcls, crowd, seg, nv, roi, lab):
+        valid_gt = (gcls > 0) & (crowd == 0) & jnp.any(nv > 0, axis=1)
+        valid_poly = nv > 0                       # (G, P)
+        vert_ok = vidx[None, None, :] < nv[:, :, None]
+        # gt bounding boxes from polygons (Poly2Boxes)
+        big = 1e30
+        xs = jnp.where(vert_ok, seg[..., 0], big)
+        ys = jnp.where(vert_ok, seg[..., 1], big)
+        x0 = jnp.min(jnp.min(xs, axis=2), axis=1)
+        y0 = jnp.min(jnp.min(ys, axis=2), axis=1)
+        xs = jnp.where(vert_ok, seg[..., 0], -big)
+        ys = jnp.where(vert_ok, seg[..., 1], -big)
+        x1 = jnp.max(jnp.max(xs, axis=2), axis=1)
+        y1 = jnp.max(jnp.max(ys, axis=2), axis=1)
+        gt_boxes = jnp.stack([x0, y0, x1, y1], axis=1)  # (G, 4)
+        fg = lab > 0
+        roi_img = roi / scale                      # back to image coords
+        iou = _iou_matrix(roi_img, gt_boxes, normalized=False)
+        iou = jnp.where(valid_gt[None, :], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=1)          # (R,)
+
+        def one_roi(rbox, gi, is_fg, cls):
+            mask = _poly_raster(seg[gi], rbox, res, valid_poly[gi])
+            flat = mask.reshape(-1).astype(jnp.int32)
+            tgt = jnp.full((num_classes, res * res), -1, jnp.int32)
+            tgt = tgt.at[cls].set(jnp.where(is_fg, flat, -1), mode="drop")
+            return jnp.where(is_fg, tgt.reshape(-1),
+                             jnp.full((num_classes * res * res,), -1,
+                                      jnp.int32))
+
+        masks = jax.vmap(one_roi)(roi_img, best_gt, fg,
+                                  jnp.clip(lab, 0, num_classes - 1))
+        return (jnp.where(fg[:, None], roi_img, 0.0),
+                fg.astype(jnp.int32), masks)
+
+    mask_rois, has_mask, masks = jax.vmap(per_image)(
+        im_info[:, 2], gt_classes, is_crowd, segms, verts, rois, labels)
+    return {"MaskRois": [mask_rois], "RoiHasMaskInt32": [has_mask],
+            "MaskInt32": [masks]}

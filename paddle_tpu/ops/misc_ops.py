@@ -7,6 +7,7 @@ captured sub-program — the jit.ProgramTranslator runtime op).
 
 from __future__ import annotations
 
+import os
 import numpy as np
 
 import jax
@@ -126,3 +127,568 @@ def _run_program(ctx, op, ins):
     bctx.p2p_queue = ctx.p2p_queue
     registry.lower_block(bctx, block, env)
     return {"Out": [env[n] for n in op.output("Out")]}
+
+
+# ---------------------------------------------------------------------------
+# long-tail framework/math ops (tools/op_parity.py closure)
+# ---------------------------------------------------------------------------
+
+from jax import lax  # noqa: E402
+from .registry import jdt  # noqa: E402
+
+
+@register_op("add_position_encoding")
+def _add_position_encoding(ctx, op, ins):
+    """reference add_position_encoding_op.h: out = alpha*x + beta*PE
+    with the interleaved sin/cos table PE[pos, i] = sin(pos/10000^(2i/D))
+    for the first D/2 columns and cos for the rest."""
+    x = first(ins, "X")               # (B, T, D)
+    alpha = op.attr("alpha", 1.0)
+    beta = op.attr("beta", 1.0)
+    b, t, d = x.shape
+    half = d // 2
+    pos = np.arange(t)[:, None]
+    # reference divisor: 10000^(k/(half-1)) — NOT the transformer
+    # paper's 10000^(2k/D) (add_position_encoding_op.h:84-86)
+    if half > 1:
+        div = np.power(10000.0, np.arange(half) / (half - 1))
+    else:
+        div = np.full((half,), 10000.0)
+    pe = np.concatenate([np.sin(pos / div), np.cos(pos / div)], axis=1)
+    return {"Out": [alpha * x + beta * jnp.asarray(pe, x.dtype)[None]]}
+
+
+@register_op("allclose")
+def _allclose(ctx, op, ins):
+    x = first(ins, "Input")
+    y = first(ins, "Other")
+    # the reference op takes Rtol/Atol as required tensor INPUTS
+    # (allclose_op.cc:66); attrs are the fallback
+    rtol_t = first(ins, "Rtol", None)
+    atol_t = first(ins, "Atol", None)
+    rtol = rtol_t.reshape(()) if rtol_t is not None \
+        else float(op.attr("rtol", 1e-5) or 1e-5)
+    atol = atol_t.reshape(()) if atol_t is not None \
+        else float(op.attr("atol", 1e-8) or 1e-8)
+    eq_nan = bool(op.attr("equal_nan", False))
+    close = jnp.abs(x - y) <= atol + rtol * jnp.abs(y)
+    if eq_nan:
+        close = close | (jnp.isnan(x) & jnp.isnan(y))
+    return {"Out": [jnp.all(close)]}
+
+
+@register_op("bilinear_tensor_product")
+def _bilinear_tensor_product(ctx, op, ins):
+    """reference bilinear_tensor_product_op.h: out[:, k] =
+    sum(x @ W[k] * y, -1) + bias."""
+    x = first(ins, "X")       # (B, M)
+    y = first(ins, "Y")       # (B, N)
+    w = first(ins, "Weight")  # (K, M, N)
+    bias = first(ins, "Bias", None)
+    out = jnp.einsum("bm,kmn,bn->bk", x, w, y)
+    if bias is not None:
+        out = out + bias.reshape(1, -1)
+    return {"Out": [out]}
+
+
+@register_op("conv_shift")
+def _conv_shift(ctx, op, ins):
+    """reference conv_shift_op.cc (NTM circular convolution):
+    out[b,i] = sum_{j=-(N-1)/2}^{(N-1)/2} x[b,(i+j) mod M] *
+    y[b, j mod N]."""
+    x = first(ins, "X")  # (B, M)
+    y = first(ins, "Y")  # (B, N)
+    m, n = x.shape[1], y.shape[1]
+    half = (n - 1) // 2
+    # out[i] += x[(i + j - half) % M] * y[j] (conv_shift_op.cc:158):
+    # roll x left by (j - half) pairs tap y[j] with x[i + j - half]
+    out = sum(jnp.roll(x, half - j, axis=1) * y[:, j][:, None]
+              for j in range(n))
+    return {"Out": [out]}
+
+
+@register_op("crf_decoding")
+def _crf_decoding(ctx, op, ins):
+    """reference crf_decoding_op.h: Viterbi decode with the
+    linear_chain_crf Transition layout (row 0 start, row 1 end, 2..
+    tag->tag).  With a Label input the output flips to a 0/1
+    per-position correctness mask (crf_decoding_op.h:69-73).  Padded
+    steps (>= Length) emit 0."""
+    emission = first(ins, "Emission")
+    trans = first(ins, "Transition")
+    label = first(ins, "Label", None)
+    length = first(ins, "Length", None)
+    if emission.ndim == 2:
+        emission = emission[None]
+    b, t, d = emission.shape
+    lens = length.reshape(b).astype(jnp.int32) if length is not None \
+        else jnp.full((b,), t, jnp.int32)
+
+    def one(x, ln):
+        a0 = trans[0] + x[0]
+
+        def fwd(a_prev, k):
+            scores = a_prev[:, None] + trans[2:]      # (D_from, D_to)
+            best = jnp.argmax(scores, axis=0).astype(jnp.int32)
+            a = jnp.max(scores, axis=0) + x[k]
+            live = k < ln
+            a = jnp.where(live, a, a_prev)
+            return a, (a, best)
+
+        _, (alphas, tracks) = lax.scan(fwd, a0, jnp.arange(1, t))
+        # tracks[k-1][tag_at_k] = best tag at k-1; alphas[k-1] = alpha_k
+        alphas = jnp.concatenate([a0[None], alphas], axis=0)  # (T, D)
+        last_tag = jnp.argmax(alphas[ln - 1] + trans[1]).astype(jnp.int32)
+
+        def back(tag, i):
+            # i runs T-2..0 (reverse); position i backtracks through
+            # tracks[i] (the pointer from step i+1) only when i <= ln-2
+            live = i <= ln - 2
+            prev = jnp.where(live, tracks[i][tag], tag)
+            return prev, prev
+
+        _, path_prefix = lax.scan(back, last_tag, jnp.arange(t - 1),
+                                  reverse=True)           # tags 0..T-2
+        path = jnp.concatenate([path_prefix, last_tag[None]])
+        path = jnp.where(jnp.arange(t) == ln - 1, last_tag, path)
+        return jnp.where(jnp.arange(t) < ln, path, 0)
+
+    path = jax.vmap(one)(emission, lens).astype(jdt("int64"))
+    if label is not None:
+        lab = label.reshape(b, t).astype(path.dtype)
+        steps = jnp.arange(t)[None]
+        ok = (lab == path) & (steps < lens[:, None])
+        path = ok.astype(path.dtype)
+    return {"ViterbiPath": [path]}
+
+
+@register_op("cvm")
+def _cvm(ctx, op, ins):
+    """reference cvm_op.h: continuous-value model columns.  use_cvm
+    keeps the (show, click) prefix with show->log(show+1),
+    click->log(click+1)-log(show+1) (cvm_op.cc doc); otherwise the two
+    columns are dropped."""
+    x = first(ins, "X")       # (B, D) with D >= 2
+    use_cvm = bool(op.attr("use_cvm", True))
+    if use_cvm:
+        show = jnp.log(x[:, :1] + 1.0)
+        clk = jnp.log(x[:, 1:2] + 1.0) - show
+        return {"Y": [jnp.concatenate([show, clk, x[:, 2:]], axis=1)]}
+    return {"Y": [x[:, 2:]]}
+
+
+@register_op("diag")
+def _diag(ctx, op, ins):
+    return {"Out": [jnp.diag(first(ins, "Diagonal").reshape(-1))]}
+
+
+@register_op("diag_embed")
+def _diag_embed(ctx, op, ins):
+    x = first(ins, "Input")
+    offset = int(op.attr("offset", 0))
+    dim1 = int(op.attr("dim1", -2))
+    dim2 = int(op.attr("dim2", -1))
+    n = x.shape[-1] + abs(offset)
+    out = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    r = idx + max(-offset, 0)
+    c = idx + max(offset, 0)
+    out = out.at[..., r, c].set(x)
+    # move the two new axes to dim1/dim2
+    nd = out.ndim
+    d1, d2 = dim1 % nd, dim2 % nd
+    perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+    order = sorted([(d1, nd - 2), (d2, nd - 1)])
+    for pos, src in order:
+        perm.insert(pos, src)
+    return {"Out": [jnp.transpose(out, perm)]}
+
+
+@register_op("empty")
+def _empty(ctx, op, ins):
+    shape = [int(s) for s in op.attr("shape", [])]
+    return {"Out": [jnp.zeros(shape, jdt(op.attr("dtype", "float32")))]}
+
+
+@register_op("fc")
+def _fc(ctx, op, ins):
+    """reference fc_op.cc: Out = act(X @ W + b), X flattened from
+    in_num_col_dims."""
+    x = first(ins, "Input")
+    w = first(ins, "W")
+    bias = first(ins, "Bias", None)
+    ncd = int(op.attr("in_num_col_dims", 1))
+    lead = x.shape[:ncd]
+    x2 = x.reshape(int(np.prod(lead)), -1)
+    out = x2 @ w
+    if bias is not None:
+        out = out + bias.reshape(1, -1)
+    act = op.attr("activation_type", "")
+    if act == "relu":
+        out = jax.nn.relu(out)
+    elif act:
+        raise NotImplementedError(f"fc activation {act}")
+    return {"Out": [out.reshape(lead + (w.shape[1],))]}
+
+
+@register_op("fill")
+def _fill(ctx, op, ins):
+    shape = [int(s) for s in op.attr("shape", [])]
+    dt = jdt(op.attr("dtype", "float32"))
+    vals = np.asarray(op.attr("value", []), dtype=dt).reshape(shape)
+    return {"Out": [jnp.asarray(vals)]}
+
+
+@register_op("fill_zeros_like2")
+def _fill_zeros_like2(ctx, op, ins):
+    x = first(ins, "X")
+    return {"Out": [jnp.zeros_like(x, jdt(op.attr("dtype", "float32")))]}
+
+
+@register_op("grad_add")
+def _grad_add(ctx, op, ins):
+    return {"Out": [first(ins, "X") + first(ins, "Y")]}
+
+
+@register_op("is_empty")
+def _is_empty(ctx, op, ins):
+    return {"Out": [jnp.asarray(first(ins, "X").size == 0)]}
+
+
+@register_op("l1_norm")
+def _l1_norm(ctx, op, ins):
+    return {"Out": [jnp.sum(jnp.abs(first(ins, "X")))]}
+
+
+@register_op("mean_iou")
+def _mean_iou(ctx, op, ins):
+    """reference mean_iou_op.h: confusion-count mean IoU with optional
+    running InWrongs/InCorrects/InMeanIou accumulators folded in."""
+    pred = first(ins, "Predictions").astype(jnp.int32).reshape(-1)
+    lab = first(ins, "Labels").astype(jnp.int32).reshape(-1)
+    nc = int(op.attr("num_classes"))
+    correct = jax.ops.segment_sum(
+        jnp.where(pred == lab, 1, 0), jnp.clip(pred, 0, nc - 1),
+        num_segments=nc)
+    miss = pred != lab
+    wrong = jax.ops.segment_sum(jnp.where(miss, 1, 0),
+                                jnp.clip(lab, 0, nc - 1), num_segments=nc) \
+        + jax.ops.segment_sum(jnp.where(miss, 1, 0),
+                              jnp.clip(pred, 0, nc - 1), num_segments=nc)
+    for extra in ins.get("InWrongs") or []:
+        wrong = wrong + extra.astype(wrong.dtype)
+    for extra in ins.get("InCorrects") or []:
+        correct = correct + extra.astype(correct.dtype)
+    denom = wrong + correct
+    valid = jnp.sum(jnp.where(denom > 0, 1, 0))
+    denom_safe = jnp.where(denom == 0, 1, denom)
+    iou_sum = jnp.sum(correct.astype(jnp.float32)
+                      / denom_safe.astype(jnp.float32))
+    mean = iou_sum / jnp.maximum(valid.astype(jnp.float32), 1.0)
+    for extra in ins.get("InMeanIou") or []:
+        mean = mean + extra.reshape(()).astype(mean.dtype)
+    return {"OutMeanIou": [mean], "OutWrong": [wrong.astype(jnp.int32)],
+            "OutCorrect": [correct.astype(jnp.int32)]}
+
+
+@register_op("minus")
+def _minus(ctx, op, ins):
+    return {"Out": [first(ins, "X") - first(ins, "Y")]}
+
+
+@register_op("modified_huber_loss")
+def _modified_huber_loss(ctx, op, ins):
+    """reference modified_huber_loss_op.h: z = 2y-1; xy*z < -1 ->
+    -4*x*z, < 1 -> (1-x*z)^2, else 0.  IntermediateVal stores x*z."""
+    x = first(ins, "X")
+    y = first(ins, "Y")
+    z = 2.0 * y - 1.0
+    xz = x * z
+    out = jnp.where(xz < -1.0, -4.0 * xz,
+                    jnp.where(xz < 1.0, jnp.square(1.0 - xz), 0.0))
+    return {"Out": [out], "IntermediateVal": [xz]}
+
+
+@register_op("sampling_id")
+def _sampling_id(ctx, op, ins):
+    """reference sampling_id_op.h: sample one column index per row from
+    the row's (already normalized) probability vector."""
+    x = first(ins, "X")
+    idx = jax.random.categorical(ctx.rng_key(op), jnp.log(x + 1e-20),
+                                 axis=1)
+    return {"Out": [idx.astype(jdt("int64"))]}
+
+
+@register_op("seed")
+def _seed(ctx, op, ins):
+    s = int(op.attr("seed", 0))
+    if s == 0:
+        s = int(jax.random.randint(ctx.rng_key(op), (), 1, 2**31 - 1))
+    return {"Out": [jnp.asarray(s, jnp.int32).reshape(1)]}
+
+
+@register_op("shard_index")
+def _shard_index(ctx, op, ins):
+    """reference shard_index_op.h: shard_size = ceil(index_num/nshards);
+    ids in this shard map to id % shard_size, others to ignore_value."""
+    x = first(ins, "X")
+    num = int(op.attr("index_num"))
+    nshards = int(op.attr("nshards"))
+    shard_id = int(op.attr("shard_id"))
+    ignore = int(op.attr("ignore_value", -1))
+    ssize = (num + nshards - 1) // nshards
+    return {"Out": [jnp.where(x // ssize == shard_id, x % ssize, ignore)]}
+
+
+@register_op("squared_l2_distance")
+def _squared_l2_distance(ctx, op, ins):
+    """reference squared_l2_distance_op.h: rowwise sum((x-y)^2); Y may
+    broadcast one row.  sub_result is an output the grad consumes."""
+    x = first(ins, "X")
+    y = first(ins, "Y")
+    sub = x.reshape(x.shape[0], -1) - y.reshape(y.shape[0], -1)
+    return {"Out": [jnp.sum(sub * sub, axis=1, keepdims=True)],
+            "sub_result": [sub]}
+
+
+@register_op("teacher_student_sigmoid_loss")
+def _teacher_student_sigmoid_loss(ctx, op, ins):
+    """reference teacher_student_sigmoid_loss_op.h: label encodes
+    (clicked, teacher score): < -1 -> bce(x, 0); < 0 -> bce(x, 1);
+    < 1 -> bce(x, 0) + bce(x, label); else bce(x, 1) + bce(x, label-1),
+    with bce the stable max(x,0) - x*z + log(1+exp(-|x|)) form."""
+    x = first(ins, "X").reshape(-1)
+    lab = first(ins, "Label").reshape(-1)
+
+    def bce(z):
+        return jnp.maximum(x, 0.0) - x * z + jnp.log1p(jnp.exp(-jnp.abs(x)))
+
+    out = jnp.where(
+        lab < -1.0, bce(0.0),
+        jnp.where(lab < 0.0, bce(1.0),
+                  jnp.where(lab < 1.0, bce(0.0) + bce(lab),
+                            bce(1.0) + bce(lab - 1.0))))
+    return {"Y": [out.reshape(-1, 1)]}
+
+
+@register_op("partial_concat")
+def _partial_concat(ctx, op, ins):
+    """reference partial_concat_op.cc: concat [start:start+length] column
+    slices of each input (length -1 = to the end)."""
+    xs = ins.get("X") or []
+    start = int(op.attr("start_index", 0))
+    length = int(op.attr("length", -1))
+    parts = []
+    for x in xs:
+        s = start if start >= 0 else x.shape[1] + start
+        e = x.shape[1] if length < 0 else s + length
+        parts.append(x[:, s:e])
+    return {"Out": [jnp.concatenate(parts, axis=1)]}
+
+
+@register_op("partial_sum")
+def _partial_sum(ctx, op, ins):
+    xs = ins.get("X") or []
+    start = int(op.attr("start_index", 0))
+    length = int(op.attr("length", -1))
+    acc = None
+    for x in xs:
+        s = start if start >= 0 else x.shape[1] + start
+        e = x.shape[1] if length < 0 else s + length
+        sl = x[:, s:e]
+        acc = sl if acc is None else acc + sl
+    return {"Out": [acc]}
+
+
+@register_op("fsp")
+def _fsp(ctx, op, ins):
+    """reference fsp_op.h (distillation FSP matrix):
+    out[b] = x_flat @ y_flat^T / (H*W)."""
+    x = first(ins, "X")  # (B, C1, H, W)
+    y = first(ins, "Y")  # (B, C2, H, W)
+    b, c1 = x.shape[:2]
+    c2 = y.shape[1]
+    hw = x.shape[2] * x.shape[3]
+    xf = x.reshape(b, c1, hw)
+    yf = y.reshape(b, c2, hw)
+    return {"Out": [jnp.einsum("bch,bdh->bcd", xf, yf) / hw]}
+
+
+@register_op("random_crop")
+def _random_crop(ctx, op, ins):
+    """reference random_crop_op.h: crop the trailing len(shape) dims to
+    `shape` at a random offset (batch dims keep their size)."""
+    x = first(ins, "X")
+    shape = [int(s) for s in op.attr("shape")]
+    k = len(shape)
+    keys = jax.random.split(ctx.rng_key(op), k)
+    starts = [0] * (x.ndim - k) + [
+        jax.random.randint(keys[i], (), 0, x.shape[x.ndim - k + i]
+                           - shape[i] + 1)
+        for i in range(k)]
+    sizes = list(x.shape[:x.ndim - k]) + shape
+    return {"Out": [lax.dynamic_slice(x, starts, sizes)],
+            "SeedOut": [first(ins, "Seed")]}
+
+
+@register_op("gaussian_random_batch_size_like")
+def _gaussian_random_batch_size_like(ctx, op, ins):
+    like = first(ins, "Input")
+    shape = [int(s) for s in op.attr("shape")]
+    bidx = int(op.attr("input_dim_idx", 0))
+    oidx = int(op.attr("output_dim_idx", 0))
+    shape[oidx] = like.shape[bidx]
+    mean = op.attr("mean", 0.0)
+    std = op.attr("std", 1.0)
+    out = mean + std * jax.random.normal(
+        ctx.rng_key(op), shape, jdt(op.attr("dtype", "float32")))
+    return {"Out": [out]}
+
+
+@register_op("average_accumulates")
+def _average_accumulates(ctx, op, ins):
+    """reference average_accumulates_op.h (ModelAverage windows),
+    faithfully: every step sum_1 += param; every kMaxNumAccumulates
+    (16384) updates sum_2 += sum_1, sum_1 = 0 (precision batching);
+    when num_accumulates >= min_average_window AND >=
+    min(max_average_window, num_updates*average_window), the window
+    rolls: sum_3 = sum_1 + sum_2, sum_1 = sum_2 = 0,
+    old_num_accumulates = num_accumulates, num_accumulates = 0."""
+    param = first(ins, "param")
+    s1 = first(ins, "in_sum_1")
+    s2 = first(ins, "in_sum_2")
+    s3 = first(ins, "in_sum_3")
+    i64 = jdt("int64")
+    num_acc = first(ins, "in_num_accumulates").reshape(()).astype(i64)
+    old_num = first(ins, "in_old_num_accumulates").reshape(()).astype(i64)
+    num_upd = first(ins, "in_num_updates").reshape(()).astype(i64)
+    avg_window = op.attr("average_window", 0.0)
+    max_avg = int(op.attr("max_average_window", 10000))
+    min_avg = int(op.attr("min_average_window", 10000))
+    k_max = 16384  # kMaxNumAccumulates (average_accumulates_op.h:33)
+
+    num_upd = num_upd + 1
+    num_acc = num_acc + 1
+    s1 = s1 + param
+    batch = num_upd % k_max == 0
+    s2 = jnp.where(batch, s2 + s1, s2)
+    s1 = jnp.where(batch, jnp.zeros_like(s1), s1)
+    window = jnp.minimum(
+        jnp.asarray(max_avg, i64),
+        (num_upd.astype(jnp.float32) * avg_window).astype(i64))
+    roll = (num_acc >= min_avg) & (num_acc >= window)
+    s3 = jnp.where(roll, s1 + s2, s3)
+    s1 = jnp.where(roll, jnp.zeros_like(s1), s1)
+    s2 = jnp.where(roll, jnp.zeros_like(s2), s2)
+    old_num = jnp.where(roll, num_acc, old_num)
+    num_acc = jnp.where(roll, jnp.zeros_like(num_acc), num_acc)
+    return {"out_sum_1": [s1], "out_sum_2": [s2], "out_sum_3": [s3],
+            "out_num_accumulates": [num_acc.astype(i64).reshape(1)],
+            "out_old_num_accumulates": [old_num.astype(i64).reshape(1)],
+            "out_num_updates": [num_upd.astype(i64).reshape(1)]}
+
+
+# ---------------------------------------------------------------------------
+# program-level io ops (reference save_op.cc, load_op.cc,
+# save_combine_op.cc, load_combine_op.cc)
+# ---------------------------------------------------------------------------
+#
+# Reference programs CONTAIN io ops — a ported ProgramDesc with a `save`
+# op must run.  TPU re-design: saving is a host side-effect, so `save`
+# lowers to an ordered jax io_callback (kept by the effects system even
+# with no data consumer); `load` is a pure host callback whose shape
+# contract comes from the declared output var, like py_func.  The file
+# format is the framework's own (framework_io pickle / npz for
+# combine), not the reference's LoDTensor binary — the Python io layer
+# (fluid/io.py) reads and writes the same format.
+
+def _host_save(path_template):
+    def fn(*arrs):
+        from .. import framework_io
+        path = path_template
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        if len(arrs) == 1:
+            framework_io.save(np.asarray(arrs[0]), path)
+        else:
+            np.savez(path if path.endswith(".npz") else path + ".npz",
+                     **{f"t{i}": np.asarray(a)
+                        for i, a in enumerate(arrs)})
+        return np.zeros((), np.int32)
+    return fn
+
+
+@register_op("save")
+def _save_op(ctx, op, ins):
+    """reference save_op.cc: write input X to file_path.  save_as_fp16
+    casts before writing."""
+    import jax.experimental
+    x = first(ins, "X")
+    path = op.attr("file_path")
+    if op.attr("save_as_fp16", False):
+        x = x.astype(jnp.float16)
+    jax.experimental.io_callback(_host_save(path),
+                                 jax.ShapeDtypeStruct((), jnp.int32),
+                                 x, ordered=True)
+    return {}
+
+
+@register_op("save_combine")
+def _save_combine_op(ctx, op, ins):
+    """reference save_combine_op.cc: write every X input into one
+    file (npz bundle keyed t0..tN in input order)."""
+    import jax.experimental
+    xs = [v for v in ins.get("X", []) if v is not None]
+    path = op.attr("file_path")
+    if op.attr("save_as_fp16", False):
+        xs = [x.astype(jnp.float16) for x in xs]
+    jax.experimental.io_callback(_host_save(path),
+                                 jax.ShapeDtypeStruct((), jnp.int32),
+                                 *xs, ordered=True)
+    return {}
+
+
+def _load_shape(ctx, op, slot_name):
+    block = ctx.block
+    var = block.var(slot_name) if block is not None else None
+    if var is None or var.shape is None or any(
+            s is None or s < 0 for s in var.shape):
+        raise ValueError(
+            f"load op output {slot_name!r} needs a fully static declared "
+            "shape (XLA host-callback contract; declare the var with its "
+            "checkpointed shape)")
+    from ..fluid import core
+    return jax.ShapeDtypeStruct(tuple(var.shape), core.np_dtype(var.dtype))
+
+
+@register_op("load")
+def _load_op(ctx, op, ins):
+    """reference load_op.cc: read file_path into the output var."""
+    path = op.attr("file_path")
+    out_name = op.output("Out")[0]
+    sds = _load_shape(ctx, op, out_name)
+
+    def fn():
+        from .. import framework_io
+        arr = np.asarray(framework_io.load(path))
+        return arr.astype(sds.dtype).reshape(sds.shape)
+
+    out = jax.pure_callback(fn, sds)
+    return {"Out": [out]}
+
+
+@register_op("load_combine")
+def _load_combine_op(ctx, op, ins):
+    """reference load_combine_op.cc: read one bundle into N output
+    vars (t0..tN keys in output order)."""
+    path = op.attr("file_path")
+    out_names = op.output("Out")
+    sds = [_load_shape(ctx, op, n) for n in out_names]
+
+    def fn():
+        p = path if path.endswith(".npz") else path + ".npz"
+        data = np.load(p)
+        return tuple(np.asarray(data[f"t{i}"]).astype(s.dtype)
+                     .reshape(s.shape) for i, s in enumerate(sds))
+
+    outs = jax.pure_callback(fn, tuple(sds))
+    return {"Out": list(outs)}

@@ -834,3 +834,298 @@ def _nce(ctx, op, ins):
     cost = (pos + neg).reshape(b, 1)
     return {"Cost": [cost], "SampleLogits": [o],
             "SampleLabels": [ids]}
+
+
+# ---------------------------------------------------------------------------
+# loss-op long tail (VERDICT r3 Missing #1)
+# ---------------------------------------------------------------------------
+
+@register_op("nll_loss")
+def _nll_loss(ctx, op, ins):
+    """reference nll_loss_op.h nll_loss_1D/2D: out = -x[label] *
+    weight[label], ignore_index rows contribute 0, reduction
+    none/sum/mean (mean divides by TOTAL WEIGHT, not batch size).
+    2D case: X (N, C, H, W) with Label (N, H, W)."""
+    x = first(ins, "X")
+    label = first(ins, "Label").astype(jnp.int32)
+    weight = first(ins, "Weight", None)
+    ignore = int(op.attr("ignore_index", -100))
+    reduction = op.attr("reduction", "mean")
+    if x.ndim == 4:
+        xm = jnp.transpose(x, (0, 2, 3, 1)).reshape(-1, x.shape[1])
+        lab = label.reshape(-1)
+    else:
+        xm = x
+        lab = label.reshape(-1)
+    valid = lab != ignore
+    safe = jnp.clip(lab, 0, x.shape[1] - 1)
+    w = weight.reshape(-1)[safe] if weight is not None \
+        else jnp.ones_like(safe, x.dtype)
+    per = -jnp.take_along_axis(xm, safe[:, None], axis=1)[:, 0] * w
+    per = jnp.where(valid, per, 0.0)
+    tw = jnp.sum(jnp.where(valid, w, 0.0))
+    if reduction == "none":
+        shape = label.shape if x.ndim == 4 else (x.shape[0],)
+        return {"Out": [per.reshape(shape)],
+                "Total_weight": [jnp.zeros((), x.dtype)]}
+    total = jnp.sum(per)
+    if reduction == "mean":
+        total = jnp.where(tw != 0, total / tw, total)
+    return {"Out": [total.reshape(())], "Total_weight": [tw.reshape(())]}
+
+
+@register_op("log_loss")
+def _log_loss(ctx, op, ins):
+    """reference log_loss_op.h: -l*log(p+eps) - (1-l)*log(1-p+eps)."""
+    p = first(ins, "Predicted")
+    l = first(ins, "Labels")
+    eps = op.attr("epsilon", 1e-4)
+    out = -(l * jnp.log(p + eps)) - (1.0 - l) * jnp.log(1.0 - p + eps)
+    return {"Loss": [out]}
+
+
+@register_op("rank_loss")
+def _rank_loss(ctx, op, ins):
+    """reference rank_loss_op.h: log(1 + e^(l-r)) - label*(l-r)."""
+    label = first(ins, "Label")
+    left = first(ins, "Left")
+    right = first(ins, "Right")
+    o = left - right
+    return {"Out": [jnp.log1p(jnp.exp(o)) - label * o]}
+
+
+@register_op("margin_rank_loss")
+def _margin_rank_loss(ctx, op, ins):
+    """reference margin_rank_loss_op.h: relu(-label*(x1-x2) + margin);
+    Activated records the relu mask for the grad."""
+    label = first(ins, "Label")
+    x1 = first(ins, "X1")
+    x2 = first(ins, "X2")
+    margin = op.attr("margin", 0.0)
+    raw = -label * (x1 - x2) + margin
+    return {"Out": [jnp.maximum(raw, 0.0)],
+            "Activated": [(raw > 0).astype(x1.dtype)]}
+
+
+@register_op("bpr_loss")
+def _bpr_loss(ctx, op, ins):
+    """reference bpr_loss_op.h: per row,
+    -mean_{j != label} -log(1 + exp(x_j - x_label)) — i.e. the mean
+    softplus margin against every other class."""
+    x = first(ins, "X")                 # (N, C)
+    label = first(ins, "Label").astype(jnp.int32).reshape(-1)
+    n, c = x.shape
+    pos = jnp.take_along_axis(x, label[:, None], axis=1)
+    sp = jnp.log1p(jnp.exp(x - pos))    # softplus(x_j - x_pos)
+    mask = jax.nn.one_hot(label, c, dtype=x.dtype)
+    loss = jnp.sum(sp * (1.0 - mask), axis=1, keepdims=True) / (c - 1)
+    return {"Y": [loss]}
+
+
+@register_op("center_loss")
+def _center_loss(ctx, op, ins):
+    """reference center_loss_op.h: diff = x - centers[label], loss =
+    ||diff||^2/2; centers move by alpha * mean-diff per class (the
+    divisor is 1 + class count, reference center_update_count init 1)."""
+    x = first(ins, "X")                  # (N, D)
+    label = first(ins, "Label").astype(jnp.int32).reshape(-1)
+    centers = first(ins, "Centers")      # (C, D)
+    rate = first(ins, "CenterUpdateRate")
+    alpha = rate.reshape(-1)[0]
+    update = bool(op.attr("need_update", True))
+    c = centers.shape[0]
+    diff = x - centers[label]
+    loss = 0.5 * jnp.sum(diff * diff, axis=1, keepdims=True)
+    outs = {"Loss": [loss], "SampleCenterDiff": [diff]}
+    if update:
+        acc = jax.ops.segment_sum(diff, label, num_segments=c)
+        cnt = 1.0 + jax.ops.segment_sum(jnp.ones_like(label, x.dtype),
+                                        label, num_segments=c)
+        centers_out = centers + alpha * acc / cnt[:, None]
+    else:
+        centers_out = centers
+    outs["CentersOut"] = [centers_out]
+    return outs
+
+
+@register_op("cos_sim")
+def _cos_sim(ctx, op, ins):
+    """reference cos_sim_op.h: rowwise cosine; Y may have one row
+    broadcast against all of X."""
+    x = first(ins, "X")
+    y = first(ins, "Y")
+    xf = x.reshape(x.shape[0], -1)
+    yf = y.reshape(y.shape[0], -1)
+    xn = jnp.sqrt(jnp.sum(xf * xf, axis=1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(yf * yf, axis=1, keepdims=True))
+    # broadcasting covers both the (N, D) and one-row (1, D) cases
+    prod = jnp.sum(xf * yf, axis=1, keepdims=True)
+    out = prod / (xn * yn)
+    return {"Out": [out], "XNorm": [xn], "YNorm": [yn]}
+
+
+@register_op("sample_logits")
+def _sample_logits(ctx, op, ins):
+    """reference sample_logits_op.h: gather true + sampled class logits
+    and subtract log q for sampled-softmax training.
+
+    TPU re-design: the reference's LogUniformSampler draws UNIQUE
+    negatives host-side (rejection loop) and shares them across the
+    batch; in-graph we draw num_samples log-uniform ids WITH
+    replacement (inverse-CDF on the op's rng key) and use the
+    standard >=1-occurrence adjustment q = -expm1(S*log1p(-p)) applied
+    to every column, true labels included — the same estimator the
+    reference applies with its dynamic num_tries (sample_prob.h:44,
+    :102-108)."""
+    logits = first(ins, "Logits")        # (N, K)
+    labels = first(ins, "Labels").astype(jnp.int32)  # (N, NT)
+    if bool(op.attr("use_customized_samples", False)):
+        samples = first(ins, "CustomizedSamples").astype(jnp.int32)
+        probs = first(ins, "CustomizedProbabilities")
+    else:
+        s = int(op.attr("num_samples", 1))
+        n, k = logits.shape
+        u = jax.random.uniform(ctx.rng_key(op), (s,))
+        # log-uniform over [0, k): P(v) = log((v+2)/(v+1)) / log(k+1)
+        neg = jnp.clip((jnp.exp(u * jnp.log(k + 1.0)) - 1.0)
+                       .astype(jnp.int32), 0, k - 1)
+        negs = jnp.broadcast_to(neg[None], (n, s))
+        samples = jnp.concatenate([labels, negs], axis=1)
+        p = (jnp.log(samples + 2.0) - jnp.log(samples + 1.0)) \
+            / jnp.log(k + 1.0)
+        # the reference adjusts EVERY column, true labels included
+        # (sample_prob.h:102-108 adjust_prob over num_sampled_classes)
+        probs = -jnp.expm1(s * jnp.log1p(-p))
+    sampled = jnp.take_along_axis(logits, samples, axis=1)
+    if bool(op.attr("remove_accidental_hits", True)):
+        nt = labels.shape[1]
+        hit = (samples[:, :, None] == labels[:, None, :]).any(-1)
+        hit = hit.at[:, :nt].set(False)
+        sampled = sampled - 1e20 * hit.astype(sampled.dtype)
+    sampled = sampled - jnp.log(probs)
+    nt = labels.shape[1]
+    sampled_labels = jnp.broadcast_to(
+        jnp.arange(nt, dtype=jdt("int64"))[None], (logits.shape[0], nt))
+    return {"Samples": [samples], "Probabilities": [probs],
+            "SampledLogits": [sampled], "SampledLabels": [sampled_labels],
+            "LogitsDim": [jnp.zeros((2,), jnp.int32)],
+            "LabelsDim": [jnp.zeros((2,), jnp.int32)]}
+
+
+# ---------------------------------------------------------------------------
+# normalization/activation long tail
+# ---------------------------------------------------------------------------
+
+@register_op("lrn")
+def _lrn(ctx, op, ins):
+    """reference lrn_op.cc LRNFunctor: mid = k + alpha *
+    sum_{c-pre..c+n-1-pre} x_c^2 (zero padded across channels), out =
+    x * mid^-beta.  NOTE alpha multiplies the RAW sum (not alpha/n)."""
+    x = first(ins, "X")
+    n = int(op.attr("n", 5))
+    k = op.attr("k", 2.0)
+    alpha = op.attr("alpha", 1e-4)
+    beta = op.attr("beta", 0.75)
+    nhwc = op.attr("data_format", "NCHW") == "NHWC"
+    if nhwc:
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    pre = (n - 1) // 2
+    sq = x * x
+    pad = jnp.pad(sq, [(0, 0), (pre, n - 1 - pre), (0, 0), (0, 0)])
+    mid = k + alpha * sum(pad[:, i:i + x.shape[1]] for i in range(n))
+    out = x * jnp.power(mid, -beta)
+    if nhwc:
+        out = jnp.transpose(out, (0, 2, 3, 1))
+        mid = jnp.transpose(mid, (0, 2, 3, 1))
+    return {"Out": [out], "MidOut": [mid]}
+
+
+@register_op("norm")
+def _norm(ctx, op, ins):
+    """reference norm_op.h: l2-normalize along `axis`; Norm output is
+    sqrt(sum x^2 + eps)."""
+    x = first(ins, "X")
+    axis = int(op.attr("axis", 1))
+    eps = op.attr("epsilon", 1e-10)
+    if axis < 0:
+        axis += x.ndim
+    norm = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True) + eps)
+    return {"Out": [x / norm], "Norm": [norm]}
+
+
+@register_op("selu")
+def _selu(ctx, op, ins):
+    """reference selu_op.h: scale * (x if x>0 else alpha*e^x - alpha)."""
+    x = first(ins, "X")
+    scale = op.attr("scale", 1.0507009873554805)
+    alpha = op.attr("alpha", 1.6732632423543772)
+    return {"Out": [scale * jnp.where(x > 0, x,
+                                      alpha * jnp.exp(x) - alpha)]}
+
+
+@register_op("spectral_norm")
+def _spectral_norm(ctx, op, ins):
+    """reference spectral_norm_op.h CalcMatrixSigmaAndNormWeight:
+    power_iters rounds of u/v power iteration on the weight reshaped
+    with `dim` first, sigma = u^T W v, Out = W / sigma.  The U/V
+    updates are in-graph (a lax.fori-free static unroll; power_iters
+    is a small attr)."""
+    w = first(ins, "Weight")
+    u = first(ins, "U").reshape(-1)
+    v = first(ins, "V").reshape(-1)
+    dim = int(op.attr("dim", 0))
+    iters = int(op.attr("power_iters", 1))
+    eps = op.attr("eps", 1e-12)
+    perm = [dim] + [i for i in range(w.ndim) if i != dim]
+    wm = jnp.transpose(w, perm).reshape(w.shape[dim], -1)
+
+    def l2n(a):
+        return a / jnp.sqrt(jnp.sum(a * a) + eps)
+
+    for _ in range(iters):
+        v = l2n(wm.T @ u)
+        u = l2n(wm @ v)
+    sigma = u @ wm @ v
+    return {"Out": [w / sigma]}
+
+
+@register_op("pool3d")
+def _pool3d(ctx, op, ins):
+    """reference pool_op.cc 3-D kernels (pooling.cc Pool3dFunctor):
+    max/avg with exclusive-count semantics, NCDHW."""
+    x = first(ins, "X")
+    ptype = op.attr("pooling_type", "max")
+    red = jnp.max if ptype == "max" else jnp.mean
+    if op.attr("global_pooling", False) or (
+            op.attr("adaptive", False)
+            and list(op.attr("ksize")) == [1, 1, 1]):
+        return {"Out": [red(x, axis=(2, 3, 4), keepdims=True)]}
+    if op.attr("adaptive", False):
+        od, oh, ow = op.attr("ksize")
+        out = _adaptive_pool_axis(x, od, 2, red)
+        out = _adaptive_pool_axis(out, oh, 3, red)
+        return {"Out": [_adaptive_pool_axis(out, ow, 4, red)]}
+    ksize = tuple(int(k) for k in op.attr("ksize", [2, 2, 2]))
+    strides = tuple(int(s) for s in op.attr("strides", [1, 1, 1]))
+    pads = _conv_paddings(op.attr("padding_algorithm", "EXPLICIT"),
+                          op.attr("paddings", [0, 0, 0]), ksize,
+                          (1, 1, 1))
+    pad_cfg = pads if pads == "SAME" else [(0, 0), (0, 0)] + list(pads)
+    window = (1, 1) + ksize
+    strides5 = (1, 1) + strides
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+            else jnp.iinfo(x.dtype).min
+        out = lax.reduce_window(x, init, lax.max, window, strides5,
+                                padding=pad_cfg)
+    else:
+        summed = lax.reduce_window(x, 0.0, lax.add, window, strides5,
+                                   padding=pad_cfg)
+        if op.attr("exclusive", True):
+            ones = jnp.ones_like(x)
+            cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides5,
+                                    padding=pad_cfg)
+            out = summed / cnt
+        else:
+            out = summed / float(np.prod(ksize))
+    return {"Out": [out]}

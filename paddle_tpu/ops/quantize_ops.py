@@ -154,3 +154,47 @@ def _moving_scale(ctx, op, ins):
     if "Out" in op.outputs:
         outs["Out"] = [x]
     return outs
+
+
+@register_op("dequantize_abs_max")
+def _dequantize_abs_max(ctx, op, ins):
+    """reference dequantize_abs_max_op.cc: out = scale * x / max_range
+    (int8 quantized embedding rows back to float)."""
+    x = first(ins, "X")
+    scale = first(ins, "Scale").reshape(())
+    max_range = op.attr("max_range", 127.0)
+    return {"Out": [x.astype(jnp.float32) * scale / max_range]}
+
+
+@register_op("dequantize_log")
+def _dequantize_log(ctx, op, ins):
+    """reference dequantize_log_op.cc: log-table dequantization —
+    x < 0 reads -dict[x+128], else dict[x] (int8 codes into a 128-entry
+    log table)."""
+    x = first(ins, "X").astype(jnp.int32)
+    table = first(ins, "Dict").reshape(-1)
+    neg = -table[jnp.clip(x + 128, 0, table.shape[0] - 1)]
+    pos = table[jnp.clip(x, 0, table.shape[0] - 1)]
+    return {"Out": [jnp.where(x < 0, neg, pos)]}
+
+
+@register_op("fake_channel_wise_dequantize_max_abs")
+def _fake_channel_wise_dequantize_max_abs(ctx, op, ins):
+    """reference fake_dequantize_op.cc ChannelDequantizeFunctor: one
+    scale tensor -> per-channel (quant_axis) rescale; two scale
+    tensors (weight-scale per channel + activation scale) -> x *
+    s1[c] * s2 / max_range with channel on axis 1."""
+    x = first(ins, "X")
+    scales = ins.get("Scales") or []
+    max_range = op.attr("max_range", 127.0)
+    axis = int(op.attr("quant_axis", 0))
+    if len(scales) == 1:
+        s = scales[0].reshape(-1)
+        shape = [1] * x.ndim
+        shape[axis] = -1
+        return {"Out": [x * s.reshape(shape) / max_range]}
+    s1 = scales[0].reshape(-1)
+    s2 = scales[1].reshape(())
+    shape = [1] * x.ndim
+    shape[1] = -1
+    return {"Out": [x * s1.reshape(shape) * s2 / max_range]}

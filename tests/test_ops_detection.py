@@ -723,12 +723,96 @@ def test_locality_aware_nms_merges_neighbors():
     np.testing.assert_allclose(out[0, 1, 1], 0.6, rtol=1e-5)
 
 
-def test_locality_aware_nms_rejects_polygons():
-    with pytest.raises(NotImplementedError, match="4-coordinate"):
-        run_det_op("locality_aware_nms",
-                   {"BBoxes": np.zeros((1, 2, 8), "float32"),
-                    "Scores": np.zeros((1, 1, 2), "float32")},
-                   {}, ["Out"])
+def test_locality_aware_nms_polygons():
+    """8-coordinate quad path: overlapping quads merge with weighted
+    coords + summed score (PolyIoU via the S-H convex clipper)."""
+    q1 = [0, 0, 10, 0, 10, 10, 0, 10]
+    q2 = [0.5, 0.5, 10.5, 0.5, 10.5, 10.5, 0.5, 10.5]
+    far = [50, 50, 60, 50, 60, 60, 50, 60]
+    boxes = np.array([[q1, q2, far]], "float32")
+    scores = np.array([[[0.6, 0.4, 0.9]]], "float32")
+    d = run_det_op("locality_aware_nms",
+                   {"BBoxes": boxes, "Scores": scores},
+                   {"background_label": -1, "score_threshold": 0.01,
+                    "nms_top_k": 3, "keep_top_k": 3,
+                    "nms_threshold": 0.3, "normalized": False},
+                   ["Out", "RoisNum"], {"RoisNum": "int32"})
+    assert d["RoisNum"][0] == 2
+    # merged head: coords weighted 0.6/0.4, score 1.0 ranks first
+    np.testing.assert_allclose(d["Out"][0, 0, 1], 1.0, rtol=1e-5)
+    want = (np.array(q1) * 0.6 + np.array(q2) * 0.4)
+    np.testing.assert_allclose(d["Out"][0, 0, 2:], want, rtol=1e-4)
+    np.testing.assert_allclose(d["Out"][0, 1, 1], 0.9, rtol=1e-5)
+
+
+def test_poly_iou_known_values():
+    from paddle_tpu.ops.detection_ops import poly_iou
+    sq = np.array([[0, 0], [10, 0], [10, 10], [0, 10]], "float32")
+    half = np.array([[5, 0], [15, 0], [15, 10], [5, 10]], "float32")
+    disjoint = np.array([[20, 20], [30, 20], [30, 30], [20, 30]],
+                        "float32")
+    np.testing.assert_allclose(float(poly_iou(sq, sq)), 1.0, rtol=1e-5)
+    np.testing.assert_allclose(float(poly_iou(sq, half)), 50 / 150,
+                               rtol=1e-4)
+    np.testing.assert_allclose(float(poly_iou(sq, disjoint)), 0.0,
+                               atol=1e-6)
+    # rotated square (diamond) inside the square: inter = diamond area
+    diamond = np.array([[5, 0], [10, 5], [5, 10], [0, 5]], "float32")
+    np.testing.assert_allclose(float(poly_iou(sq, diamond)),
+                               50 / 100, rtol=1e-4)
+
+
+def test_generate_mask_labels():
+    # one image, one gt (class 2) with a square polygon, two rois
+    im_info = np.array([[100, 100, 1.0]], "float32")
+    gt_classes = np.array([[2]], "int32")
+    is_crowd = np.array([[0]], "int32")
+    # square polygon covering [10,10]-[30,30]
+    segms = np.array([[[[[10, 10], [30, 10], [30, 30], [10, 30]]]]],
+                     "float32")  # (1, 1, 1, 4, 2)
+    verts = np.array([[[4]]], "int32")
+    rois = np.array([[[10, 10, 30, 30], [60, 60, 80, 80]]], "float32")
+    labels = np.array([[2, 0]], "int32")
+    d = run_det_op("generate_mask_labels",
+                   {"ImInfo": im_info, "GtClasses": gt_classes,
+                    "IsCrowd": is_crowd, "GtSegms": segms,
+                    "GtSegmsVerts": verts, "Rois": rois,
+                    "LabelsInt32": labels},
+                   {"num_classes": 3, "resolution": 4},
+                   ["MaskRois", "RoiHasMaskInt32", "MaskInt32"],
+                   {"RoiHasMaskInt32": "int32", "MaskInt32": "int32"})
+    np.testing.assert_array_equal(d["RoiHasMaskInt32"][0], [1, 0])
+    m = d["MaskInt32"][0, 0].reshape(3, 16)
+    # class-2 block: roi == polygon box -> all 16 pixels inside
+    np.testing.assert_array_equal(m[2], np.ones(16, "int32"))
+    # other class blocks stay ignore (-1)
+    np.testing.assert_array_equal(m[0], -np.ones(16, "int32"))
+    # bg roi: everything ignore
+    assert (d["MaskInt32"][0, 1] == -1).all()
+
+
+def test_generate_mask_labels_partial_coverage():
+    im_info = np.array([[100, 100, 1.0]], "float32")
+    gt_classes = np.array([[1]], "int32")
+    is_crowd = np.array([[0]], "int32")
+    # polygon covers the left half of the roi
+    segms = np.array([[[[[0, 0], [10, 0], [10, 20], [0, 20]]]]],
+                     "float32")
+    verts = np.array([[[4]]], "int32")
+    rois = np.array([[[0, 0, 20, 20]]], "float32")
+    labels = np.array([[1]], "int32")
+    d = run_det_op("generate_mask_labels",
+                   {"ImInfo": im_info, "GtClasses": gt_classes,
+                    "IsCrowd": is_crowd, "GtSegms": segms,
+                    "GtSegmsVerts": verts, "Rois": rois,
+                    "LabelsInt32": labels},
+                   {"num_classes": 2, "resolution": 4},
+                   ["MaskRois", "RoiHasMaskInt32", "MaskInt32"],
+                   {"RoiHasMaskInt32": "int32", "MaskInt32": "int32"})
+    m = d["MaskInt32"][0, 0].reshape(2, 4, 4)[1]
+    # left two columns covered, right two empty
+    np.testing.assert_array_equal(m[:, :2], np.ones((4, 2), "int32"))
+    np.testing.assert_array_equal(m[:, 2:], np.zeros((4, 2), "int32"))
 
 
 def test_locality_aware_nms_subthreshold_breaks_chain():

@@ -1,0 +1,302 @@
+"""Tests for the op-parity closure batch: misc framework/math ops,
+metric ops, roi pooling variants, retinanet assignment."""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as TF
+
+from op_test import OpTest, randf, run_single_op
+
+
+def run_op(op_type, inputs, attrs, outs, dtypes=None):
+    return run_single_op(op_type, inputs, attrs, outs, dtypes)
+
+
+def test_add_position_encoding():
+    x = randf(2, 5, 8, seed=1)
+    d = run_op("add_position_encoding", {"X": x},
+               {"alpha": 0.7, "beta": 1.3}, ["Out"])
+    half = 4
+    pos = np.arange(5)[:, None]
+    # reference divisor: 10000^(k/(half-1)) (add_position_encoding_op.h:84)
+    div = np.power(10000.0, np.arange(half) / (half - 1))
+    pe = np.concatenate([np.sin(pos / div), np.cos(pos / div)], 1)
+    np.testing.assert_allclose(d["Out"], 0.7 * x + 1.3 * pe[None],
+                               atol=1e-5)
+
+
+def test_allclose():
+    x = np.array([1.0, 2.0], "float32")
+    for y, want in ((np.array([1.0, 2.0 + 1e-9], "float32"), True),
+                    (np.array([1.0, 3.0], "float32"), False)):
+        d = run_op("allclose", {"Input": x, "Other": y},
+                   {"rtol": 1e-5, "atol": 1e-8}, ["Out"], {"Out": "bool"})
+        assert bool(d["Out"]) is want
+
+
+def test_bilinear_tensor_product():
+    x, y = randf(3, 4, seed=2), randf(3, 5, seed=3)
+    w = randf(2, 4, 5, seed=4)
+    b = randf(1, 2, seed=5)
+    d = run_op("bilinear_tensor_product",
+               {"X": x, "Y": y, "Weight": w, "Bias": b}, {}, ["Out"])
+    want = np.einsum("bm,kmn,bn->bk", x, w, y) + b
+    np.testing.assert_allclose(d["Out"], want, atol=1e-5)
+
+
+def test_conv_shift():
+    x = randf(2, 7, seed=6)
+    y = randf(2, 3, seed=7)
+    d = run_op("conv_shift", {"X": x, "Y": y}, {}, ["Out"])
+    m, n = 7, 3
+    half = (n - 1) // 2
+    want = np.zeros_like(x)
+    # reference kernel (conv_shift_op.cc:158)
+    for i in range(m):
+        for j in range(n):
+            want[:, i] += x[:, (i + j - half) % m] * y[:, j]
+    np.testing.assert_allclose(d["Out"], want, atol=1e-5)
+
+
+def test_crf_decoding_brute_force():
+    rng = np.random.RandomState(8)
+    B, T, D = 2, 4, 3
+    emission = rng.uniform(-1, 1, (B, T, D)).astype("float32")
+    trans = rng.uniform(-0.5, 0.5, (D + 2, D)).astype("float32")
+    lens = np.array([4, 2], "int64")
+    d = run_op("crf_decoding",
+               {"Emission": emission, "Transition": trans, "Length": lens},
+               {}, ["ViterbiPath"], {"ViterbiPath": "int64"})
+    import itertools
+    for b in range(B):
+        ln = int(lens[b])
+        best, best_s = None, -1e30
+        for path in itertools.product(range(D), repeat=ln):
+            s = trans[0, path[0]] + emission[b, 0, path[0]] \
+                + trans[1, path[-1]]
+            for k in range(1, ln):
+                s += emission[b, k, path[k]] \
+                    + trans[path[k - 1] + 2, path[k]]
+            if s > best_s:
+                best, best_s = path, s
+        np.testing.assert_array_equal(d["ViterbiPath"][b, :ln],
+                                      np.asarray(best))
+        assert (d["ViterbiPath"][b, ln:] == 0).all()
+
+
+def test_crf_decoding_label_mode():
+    rng = np.random.RandomState(9)
+    emission = rng.uniform(-1, 1, (1, 3, 3)).astype("float32")
+    trans = rng.uniform(-0.5, 0.5, (5, 3)).astype("float32")
+    p = run_op("crf_decoding", {"Emission": emission, "Transition": trans},
+               {}, ["ViterbiPath"], {"ViterbiPath": "int64"})
+    lab = p["ViterbiPath"].copy()
+    lab[0, 1] = (lab[0, 1] + 1) % 3  # corrupt one position
+    d = run_op("crf_decoding",
+               {"Emission": emission, "Transition": trans, "Label": lab},
+               {}, ["ViterbiPath"], {"ViterbiPath": "int64"})
+    np.testing.assert_array_equal(d["ViterbiPath"][0], [1, 0, 1])
+
+
+def test_cvm():
+    x = np.abs(randf(3, 6, seed=10)) + 0.1
+    d = run_op("cvm", {"X": x, "CVM": x[:, :2]}, {"use_cvm": True}, ["Y"])
+    show = np.log(x[:, :1] + 1)
+    clk = np.log(x[:, 1:2] + 1) - show
+    np.testing.assert_allclose(d["Y"],
+                               np.concatenate([show, clk, x[:, 2:]], 1),
+                               rtol=1e-5)
+    d2 = run_op("cvm", {"X": x, "CVM": x[:, :2]}, {"use_cvm": False}, ["Y"])
+    np.testing.assert_allclose(d2["Y"], x[:, 2:])
+
+
+def test_diag_and_diag_embed():
+    v = randf(4, seed=11)
+    d = run_op("diag", {"Diagonal": v}, {}, ["Out"])
+    np.testing.assert_allclose(d["Out"], np.diag(v))
+    x = randf(2, 3, seed=12)
+    d2 = run_op("diag_embed", {"Input": x},
+                {"offset": 1, "dim1": -2, "dim2": -1}, ["Out"])
+    want = torch.diag_embed(torch.tensor(x), offset=1).numpy()
+    np.testing.assert_allclose(d2["Out"], want)
+
+
+def test_fc_op():
+    x = randf(3, 4, seed=13)
+    w = randf(4, 5, seed=14)
+    b = randf(5, seed=15)
+    d = run_op("fc", {"Input": x, "W": w, "Bias": b},
+               {"in_num_col_dims": 1, "activation_type": "relu"}, ["Out"])
+    np.testing.assert_allclose(d["Out"], np.maximum(x @ w + b, 0),
+                               atol=1e-5)
+
+
+def test_mean_iou():
+    pred = np.array([0, 1, 1, 2, 2, 2], "int32")
+    lab = np.array([0, 1, 2, 2, 2, 1], "int32")
+    d = run_op("mean_iou", {"Predictions": pred, "Labels": lab},
+               {"num_classes": 3},
+               ["OutMeanIou", "OutWrong", "OutCorrect"],
+               {"OutWrong": "int32", "OutCorrect": "int32"})
+    # class ious: 0: 1/1, 1: 1/3, 2: 2/4
+    np.testing.assert_allclose(d["OutMeanIou"],
+                               (1.0 + 1 / 3 + 0.5) / 3, rtol=1e-5)
+
+
+def test_minus_l1_norm_squared_l2():
+    x, y = randf(3, 4, seed=16), randf(3, 4, seed=17)
+    d = run_op("minus", {"X": x, "Y": y}, {}, ["Out"])
+    np.testing.assert_allclose(d["Out"], x - y)
+    d = run_op("l1_norm", {"X": x}, {}, ["Out"])
+    np.testing.assert_allclose(d["Out"].reshape(()), np.abs(x).sum(),
+                               rtol=1e-5)
+    d = run_op("squared_l2_distance", {"X": x, "Y": y}, {},
+               ["Out", "sub_result"])
+    np.testing.assert_allclose(d["Out"],
+                               ((x - y) ** 2).sum(1, keepdims=True),
+                               rtol=1e-5)
+
+
+def test_modified_huber_loss():
+    x = np.array([[-2.0], [-0.5], [0.5], [2.0]], "float32")
+    y = np.array([[1.0], [1.0], [0.0], [1.0]], "float32")
+    d = run_op("modified_huber_loss", {"X": x, "Y": y}, {},
+               ["Out", "IntermediateVal"])
+    z = 2 * y - 1
+    xz = x * z
+    want = np.where(xz < -1, -4 * xz,
+                    np.where(xz < 1, (1 - xz) ** 2, 0.0))
+    np.testing.assert_allclose(d["Out"], want, atol=1e-5)
+
+
+def test_shard_index():
+    x = np.array([[1], [6], [12], [19]], "int64")
+    d = run_op("shard_index", {"X": x},
+               {"index_num": 20, "nshards": 2, "shard_id": 0,
+                "ignore_value": -1}, ["Out"], {"Out": "int64"})
+    np.testing.assert_array_equal(d["Out"], [[1], [6], [-1], [-1]])
+
+
+def test_teacher_student_sigmoid_loss():
+    x = randf(4, 1, seed=18)
+    lab = np.array([[-2.0], [-0.5], [0.3], [1.7]], "float32")
+    d = run_op("teacher_student_sigmoid_loss", {"X": x, "Label": lab},
+               {}, ["Y"])
+    def bce(xv, z):
+        return max(xv, 0) - xv * z + np.log1p(np.exp(-abs(xv)))
+    want = np.array([[bce(x[0, 0], 0)],
+                     [bce(x[1, 0], 1)],
+                     [bce(x[2, 0], 0) + bce(x[2, 0], 0.3)],
+                     [bce(x[3, 0], 1) + bce(x[3, 0], 0.7)]], "float32")
+    np.testing.assert_allclose(d["Y"], want, atol=1e-5)
+
+
+def test_partial_concat_and_sum():
+    x1, x2 = randf(2, 6, seed=19), randf(2, 6, seed=20)
+    d = run_op("partial_concat", {"X": [x1, x2]},
+               {"start_index": 1, "length": 3}, ["Out"])
+    np.testing.assert_allclose(d["Out"],
+                               np.concatenate([x1[:, 1:4], x2[:, 1:4]], 1))
+    d = run_op("partial_sum", {"X": [x1, x2]},
+               {"start_index": 2, "length": 2}, ["Out"])
+    np.testing.assert_allclose(d["Out"], x1[:, 2:4] + x2[:, 2:4])
+
+
+def test_fsp():
+    x = randf(2, 3, 4, 4, seed=21)
+    y = randf(2, 5, 4, 4, seed=22)
+    d = run_op("fsp", {"X": x, "Y": y}, {}, ["Out"])
+    want = np.einsum("bchw,bdhw->bcd", x, y) / 16
+    np.testing.assert_allclose(d["Out"], want, rtol=1e-4)
+
+
+def test_sampling_id_distribution():
+    probs = np.tile(np.array([[0.0, 1.0, 0.0]], "float32"), (8, 1))
+    d = run_op("sampling_id", {"X": probs}, {}, ["Out"], {"Out": "int64"})
+    np.testing.assert_array_equal(d["Out"], np.ones(8, "int64"))
+
+
+def test_pool3d():
+    x = randf(1, 2, 4, 4, 4, seed=23)
+    d = run_op("pool3d", {"X": x},
+               {"pooling_type": "max", "ksize": [2, 2, 2],
+                "strides": [2, 2, 2], "paddings": [0, 0, 0]}, ["Out"])
+    want = TF.max_pool3d(torch.tensor(x), 2, 2).numpy()
+    np.testing.assert_allclose(d["Out"], want)
+
+
+def test_pool3d_avg_global():
+    x = randf(1, 2, 3, 3, 3, seed=24)
+    d = run_op("pool3d", {"X": x},
+               {"pooling_type": "avg", "global_pooling": True,
+                "ksize": [1, 1, 1]}, ["Out"])
+    np.testing.assert_allclose(d["Out"],
+                               x.mean(axis=(2, 3, 4), keepdims=True),
+                               rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# roi pooling variants
+# ---------------------------------------------------------------------------
+
+def test_psroi_pool():
+    # 1 roi covering the whole 4x4 map, 2x2 bins, 2 output channels ->
+    # input has 2*2*2=8 channels; bin (ph,pw) of out-chan c averages
+    # input channel (c*2+ph)*2+pw over that spatial quadrant
+    x = randf(1, 8, 4, 4, seed=25)
+    rois = np.array([[0.0, 0.0, 3.0, 3.0]], "float32")
+    d = run_op("psroi_pool",
+               {"X": x, "ROIs": rois,
+                "RoisNum": np.array([1], "int32")},
+               {"pooled_height": 2, "pooled_width": 2,
+                "output_channels": 2, "spatial_scale": 1.0}, ["Out"])
+    for c in range(2):
+        for ph in range(2):
+            for pw in range(2):
+                chan = (c * 2 + ph) * 2 + pw
+                quad = x[0, chan, ph * 2:(ph + 1) * 2, pw * 2:(pw + 1) * 2]
+                np.testing.assert_allclose(d["Out"][0, c, ph, pw],
+                                           quad.mean(), rtol=1e-4)
+
+
+def test_prroi_pool_integral():
+    # integer-aligned roi: precise pooling == average pooling
+    x = randf(1, 3, 6, 6, seed=26)
+    rois = np.array([[0.0, 0.0, 6.0, 6.0]], "float32")
+    d = run_op("prroi_pool",
+               {"X": x, "ROIs": rois,
+                "BatchRoINums": np.array([1], "int64")},
+               {"pooled_height": 3, "pooled_width": 3,
+                "spatial_scale": 1.0}, ["Out"])
+    # The triangle kernel integrates the CONTINUOUS bilinear surface
+    # (cell [i,i+1] integral = (v_i+v_{i+1})/2), which extends past the
+    # grid with zeros (PrRoIPoolingGetData) — pad before building cells
+    v = np.pad(x[0], [(0, 0), (0, 1), (0, 1)])
+    col = 0.5 * (v[:, :-1] + v[:, 1:])                # integrate y
+    cell = 0.5 * (col[:, :, :-1] + col[:, :, 1:])     # integrate x -> (3,6,6)
+    for ph in range(3):
+        for pw in range(3):
+            acc = cell[:, ph * 2:ph * 2 + 2, pw * 2:pw * 2 + 2].sum((1, 2))
+            np.testing.assert_allclose(d["Out"][0, :, ph, pw], acc / 4,
+                                       rtol=1e-4)
+
+
+def test_retinanet_target_assign():
+    anchors = np.array([[0, 0, 9, 9], [20, 20, 29, 29],
+                        [100, 100, 109, 109]], "float32")
+    gt = np.array([[[0, 0, 9, 9], [21, 21, 30, 30]]], "float32")
+    labs = np.array([[3, 7]], "int32")
+    d = run_op("retinanet_target_assign",
+               {"Anchor": anchors, "GtBoxes": gt, "GtLabels": labs,
+                "ImInfo": np.array([[200, 200, 1]], "float32")},
+               {"positive_overlap": 0.5, "negative_overlap": 0.4},
+               ["ScoreTarget", "LocationTarget", "LocationWeight",
+                "ScoreWeight", "ForegroundNumber"],
+               {"ScoreTarget": "int32", "ForegroundNumber": "int32"})
+    st = d["ScoreTarget"][0, :, 0]
+    assert st[0] == 3          # IoU 1.0 with gt0 -> class 3
+    assert st[1] == 7          # best anchor for gt1 -> class 7
+    assert st[2] == 0          # background
+    assert d["ForegroundNumber"][0, 0] == 3  # 2 fg + 1
+    np.testing.assert_array_equal(d["LocationWeight"][0, :, 0], [1, 1, 0])

@@ -1,0 +1,108 @@
+"""Program-level io contract: save/load/save_combine/load_combine ops
+inside programs, and the load_inference_model fresh-process round-trip
+(reference save_op.cc, load_op.cc, fluid/io.py)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework
+from paddle_tpu.fluid.executor import Scope, scope_guard
+
+
+def _run_program(main, feed, fetch):
+    with scope_guard(Scope()):
+        exe = fluid.Executor()
+        return exe.run(main, feed=feed, fetch_list=fetch)
+
+
+def test_save_load_op_roundtrip(tmp_path):
+    path = str(tmp_path / "tensor.pk")
+    x = np.random.RandomState(0).randn(3, 4).astype("float32")
+
+    main = framework.Program()
+    with framework.program_guard(main, framework.Program()):
+        v = fluid.data("v", [3, 4], "float32")
+        main.global_block().append_op(
+            "save", inputs={"X": [v.name]}, outputs={},
+            attrs={"file_path": path})
+        w = fluid.layers.scale(v, 2.0)
+    _run_program(main, {"v": x}, [w.name])
+    assert os.path.exists(path)
+
+    load_prog = framework.Program()
+    with framework.program_guard(load_prog, framework.Program()):
+        block = load_prog.global_block()
+        out = block.create_var(name="loaded", shape=[3, 4],
+                               dtype="float32")
+        block.append_op("load", inputs={}, outputs={"Out": [out.name]},
+                        attrs={"file_path": path})
+        doubled = fluid.layers.scale(out, 2.0)
+    (got,) = _run_program(load_prog, {}, [doubled.name])
+    np.testing.assert_allclose(np.asarray(got), 2 * x, rtol=1e-6)
+
+
+def test_save_combine_load_combine_roundtrip(tmp_path):
+    path = str(tmp_path / "bundle")
+    rng = np.random.RandomState(1)
+    a = rng.randn(2, 3).astype("float32")
+    b = rng.randn(4).astype("float32")
+
+    main = framework.Program()
+    with framework.program_guard(main, framework.Program()):
+        va = fluid.data("a", [2, 3], "float32")
+        vb = fluid.data("b", [4], "float32")
+        main.global_block().append_op(
+            "save_combine", inputs={"X": [va.name, vb.name]}, outputs={},
+            attrs={"file_path": path})
+        s = fluid.layers.reduce_sum(va)
+    _run_program(main, {"a": a, "b": b}, [s.name])
+
+    load_prog = framework.Program()
+    with framework.program_guard(load_prog, framework.Program()):
+        block = load_prog.global_block()
+        oa = block.create_var(name="oa", shape=[2, 3], dtype="float32")
+        ob = block.create_var(name="ob", shape=[4], dtype="float32")
+        block.append_op("load_combine", inputs={},
+                        outputs={"Out": [oa.name, ob.name]},
+                        attrs={"file_path": path})
+        sa = fluid.layers.scale(oa, 1.0)
+        sb = fluid.layers.scale(ob, 1.0)
+    ga, gb = _run_program(load_prog, {}, [sa.name, sb.name])
+    np.testing.assert_allclose(np.asarray(ga), a, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(gb), b, rtol=1e-6)
+
+
+def test_load_inference_model_fresh_process(tmp_path):
+    """build -> save_inference_model -> NEW python process loads the
+    Program JSON + params with no model code -> identical fetches."""
+    dirname = str(tmp_path / "model")
+    x = np.random.RandomState(2).randn(4, 8).astype("float32")
+
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        inp = fluid.data("inp", [-1, 8], "float32")
+        hidden = fluid.layers.fc(inp, 16, act="relu")
+        out = fluid.layers.fc(hidden, 3, act="softmax")
+    scope = Scope()
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        (want,) = exe.run(main, feed={"inp": x}, fetch_list=[out.name])
+        fluid.io.save_inference_model(dirname, ["inp"], [out], exe, main)
+
+    in_path = str(tmp_path / "in.npy")
+    out_path = str(tmp_path / "out.npy")
+    np.save(in_path, x)
+    fixture = os.path.join(os.path.dirname(__file__), "fixtures",
+                           "infer_loader.py")
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(fixture)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo_root)
+    subprocess.run([sys.executable, fixture, dirname, in_path, out_path],
+                   check=True, env=env, cwd=repo_root, timeout=300)
+    got = np.load(out_path)
+    np.testing.assert_allclose(got, np.asarray(want), atol=1e-5)
