@@ -41,6 +41,7 @@ from . import profiler
 from . import utils
 from . import reader
 from . import static
+from . import onnx
 from .fluid.flags import get_flags, set_flags
 from .nn.layer.layers import Layer  # 2.0 alias: paddle.nn.Layer
 from .tensor import (to_tensor, zeros, ones, full, zeros_like, ones_like,

@@ -14,6 +14,7 @@ from .base import (DistributedStrategy, Fleet, PaddleCloudRoleMaker,  # noqa: F4
                    Role, UserDefinedRoleMaker, fleet)
 from . import meta_optimizers  # noqa: F401
 from . import utils  # noqa: F401
+from . import metrics  # noqa: F401
 
 # module-level delegation so `from paddle_tpu.distributed import fleet;
 # fleet.init(...)` works like the reference

@@ -1,0 +1,103 @@
+"""Fleet distributed metrics (reference
+python/paddle/distributed/fleet/metrics/metric.py): allreduce local
+metric state across workers, then finish the formula on the reduced
+values.
+
+TPU re-design: the reference allreduces through the rolemaker's RPC
+ring.  Here worker state lives either (a) replicated in one SPMD
+process — the reduction is a no-op sum over one contribution — or
+(b) as explicit per-shard arrays from a shard_map program / a list the
+caller collected, reduced host-side.  Every function accepts a numpy
+array, a Variable, a var name, or a LIST of per-worker arrays (the
+multi-worker form)."""
+
+from __future__ import annotations
+
+import builtins
+
+import numpy as np
+
+__all__ = ["sum", "max", "min", "auc", "mae", "rmse", "mse", "acc"]
+
+
+def _fetch(x, scope):
+    if isinstance(x, (list, tuple)):
+        return [_fetch(v, scope) for v in x]
+    if isinstance(x, np.ndarray):
+        return x
+    if hasattr(x, "name"):
+        x = x.name
+    if isinstance(x, str):
+        if scope is None:
+            from ....fluid.executor import global_scope
+            scope = global_scope()
+        return np.asarray(scope.get(x))
+    return np.asarray(x)
+
+
+def _reduce(x, scope, mode="sum"):
+    vals = _fetch(x, scope)
+    if isinstance(vals, list):
+        stack = np.stack([np.asarray(v, np.float64) for v in vals])
+        red = {"sum": np.sum, "max": np.max, "min": np.min}[mode]
+        return red(stack, axis=0)
+    return np.asarray(vals, np.float64)
+
+
+def sum(input, scope=None):  # noqa: A001 - reference API name
+    return _reduce(input, scope, "sum")
+
+
+def max(input, scope=None):  # noqa: A001
+    return _reduce(input, scope, "max")
+
+
+def min(input, scope=None):  # noqa: A001
+    return _reduce(input, scope, "min")
+
+
+def auc(stat_pos, stat_neg, scope=None):
+    """Global ROC-AUC from (allreduced) threshold-bucket stats — the
+    same trapezoid walk as the reference (metric.py:140, high threshold
+    to low)."""
+    pos = _reduce(stat_pos, scope, "sum").reshape(-1)
+    neg = _reduce(stat_neg, scope, "sum").reshape(-1)
+    area = 0.0
+    new_pos = 0.0
+    new_neg = 0.0
+    total_ins_num = 0.0
+    old_pos = 0.0
+    old_neg = 0.0
+    for i in range(len(pos) - 1, -1, -1):
+        new_pos = old_pos + pos[i]
+        new_neg = old_neg + neg[i]
+        total_ins_num += pos[i] + neg[i]
+        area += (new_neg - old_neg) * (old_pos + new_pos) / 2
+        old_pos, old_neg = new_pos, new_neg
+    if new_pos == 0 or new_neg == 0 or total_ins_num == 0:
+        return 0.5
+    return float(area / (new_pos * new_neg))
+
+
+def mae(abserr, total_ins_num, scope=None):
+    e = float(np.sum(_reduce(abserr, scope, "sum")))
+    n = float(np.sum(_reduce(total_ins_num, scope, "sum")))
+    return e / builtins.max(n, 1.0)
+
+
+def rmse(sqrerr, total_ins_num, scope=None):
+    e = float(np.sum(_reduce(sqrerr, scope, "sum")))
+    n = float(np.sum(_reduce(total_ins_num, scope, "sum")))
+    return float(np.sqrt(e / builtins.max(n, 1.0)))
+
+
+def mse(sqrerr, total_ins_num, scope=None):
+    e = float(np.sum(_reduce(sqrerr, scope, "sum")))
+    n = float(np.sum(_reduce(total_ins_num, scope, "sum")))
+    return e / builtins.max(n, 1.0)
+
+
+def acc(correct, total, scope=None):
+    c = float(np.sum(_reduce(correct, scope, "sum")))
+    t = float(np.sum(_reduce(total, scope, "sum")))
+    return c / builtins.max(t, 1.0)

@@ -134,6 +134,55 @@ class _CompiledEntry:
                  "scope")
 
 
+class FetchHandler:
+    """Async fetch contract (reference executor.py:449): var_dict maps
+    display names -> Variable/name; `handler` receives {name: ndarray}
+    snapshots every period_secs while a dataset loop runs."""
+
+    def __init__(self, var_dict=None, period_secs=60):
+        assert var_dict is not None
+        self.var_dict = var_dict
+        self.period_secs = period_secs
+
+    def handler(self, res_dict):
+        import sys
+        for key, val in res_dict.items():
+            if isinstance(val, np.ndarray):
+                sys.stdout.write(f"{key}[0]: {val.ravel()[:1]} ")
+        sys.stdout.write("\n")
+
+
+class FetchHandlerMonitor:
+    """Polling thread driving a FetchHandler (reference
+    trainer_factory.py FetchHandlerMonitor): snapshots the requested
+    scope vars every period and hands them to handler()."""
+
+    def __init__(self, scope, handler):
+        import threading
+        self._scope = scope
+        self._handler = handler
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        while not self._stop.wait(self._handler.period_secs):
+            res = {}
+            for key, var in self._handler.var_dict.items():
+                name = getattr(var, "name", var)
+                if self._scope.has(name):
+                    val = self._scope.get(name)
+                    if val is not None:
+                        res[key] = np.asarray(val)
+            self._handler.handler(res)
+
+    def start(self):
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+
 def _analyze_block(block, feed_names, scope: Scope):
     """Classify vars: which scope vars the block reads (state inputs) and
     which persistable vars it writes (state outputs)."""
@@ -189,6 +238,8 @@ class Executor:
         feed = feed or {}
         fetch_list = fetch_list or []
 
+        from ..profiler import stat_add
+        stat_add("executor_run_count")
         feed_arrays = self._normalize_feed(program, feed)
         fetch_names = [v.name if isinstance(v, Variable) else str(v)
                        for v in fetch_list]
@@ -221,7 +272,8 @@ class Executor:
 
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
-                           fetch_info=None, print_period=100):
+                           fetch_info=None, print_period=100,
+                           fetch_handler=None):
         """Dataset-driven training loop (reference executor.py:1642 ->
         C++ Executor::RunFromDataset -> MultiTrainer/HogwildWorker
         threads over DataFeed channels, trainer.h:51).
@@ -238,18 +290,27 @@ class Executor:
         fetch_list = fetch_list or []
         fetch_info = fetch_info or [getattr(v, "name", str(v))
                                     for v in fetch_list]
+        monitor = None
+        if fetch_handler is not None:
+            monitor = FetchHandlerMonitor(scope or global_scope(),
+                                          fetch_handler)
+            monitor.start()
         step = 0
         last = None
-        for feed in dataset.batch_iter():
-            outs = self.run(program, feed=feed, fetch_list=fetch_list,
-                            scope=scope)
-            last = outs
-            step += 1
-            if debug and fetch_list and step % print_period == 0:
-                msg = ", ".join(
-                    f"{n}={np.asarray(o).ravel()[:1]}"
-                    for n, o in zip(fetch_info, outs))
-                print(f"[train_from_dataset] step {step}: {msg}")
+        try:
+            for feed in dataset.batch_iter():
+                outs = self.run(program, feed=feed, fetch_list=fetch_list,
+                                scope=scope)
+                last = outs
+                step += 1
+                if debug and fetch_list and step % print_period == 0:
+                    msg = ", ".join(
+                        f"{n}={np.asarray(o).ravel()[:1]}"
+                        for n, o in zip(fetch_info, outs))
+                    print(f"[train_from_dataset] step {step}: {msg}")
+        finally:
+            if monitor is not None:
+                monitor.stop()
         return last
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
@@ -320,6 +381,8 @@ class Executor:
         entry = self._cache.get(key)
         if entry is not None:
             return entry
+        from ..profiler import stat_add
+        stat_add("executor_compile_count")
 
         from ..ops import registry
 

@@ -27,6 +27,191 @@ def _to_list(x):
     return list(x) if isinstance(x, (list, tuple)) else [x]
 
 
+class _StaticGraphAdapter:
+    """Whole-step compilation (the TPU re-design of the reference's
+    static-graph adapter, hapi/model.py StaticGraphAdapter): where the
+    reference builds train/eval/predict ProgramDescs and drives an
+    Executor, here the full step — forward, loss, gradients, optimizer
+    update — is functionalized (jit.functional_call) and compiled as
+    ONE XLA program; jax.jit's signature cache plays the Executor's
+    program cache.  amp_configs O1/O2 run the forward in bfloat16 with
+    fp32 master weights and loss-scaled gradients (skipped on inf,
+    the GradScaler contract)."""
+
+    def __init__(self, model):
+        self.model = model
+        self._train_fn = None
+        self._eval_fn = None
+        self._pred_fn = None
+        self._train_key = None
+
+    def _amp_level(self):
+        cfg = self.model._amp_configs
+        if not cfg:
+            return "O0", 1.0
+        if isinstance(cfg, str):
+            return cfg.upper(), 32768.0
+        return (str(cfg.get("level", "O1")).upper(),
+                float(cfg.get("init_loss_scaling", 32768.0)))
+
+    def _split_state(self):
+        net = self.model.network
+        pmap = {n: p for n, p in net.named_parameters()}
+        params = {n: p._value for n, p in pmap.items() if p.trainable}
+        from ..jit import functional_state
+        full = functional_state(net)
+        buffers = {n: v for n, v in full.items() if n not in params}
+        return pmap, params, buffers
+
+    def train_batch(self, inputs, labels=None):
+        import jax
+        import jax.numpy as jnp
+        from ..jit import functional_call
+
+        model = self.model
+        net, loss_l, opt = model.network, model._loss, model._optimizer
+        net.train()
+        pmap, params, buffers = self._split_state()
+        level, loss_scale = self._amp_level()
+        amp = level in ("O1", "O2")
+
+        # optimizer functional state, synced with the eager optimizer so
+        # state_dict()/save()/dygraph interop see the same accumulators
+        opt_states = {n: opt._param_state(pmap[n]) for n in params}
+        lrm = {n: float(pmap[n].optimize_attr.get("learning_rate", 1.0))
+               for n in params}
+        wd = {n: float(opt._decay_coef(pmap[n])) for n in params}
+
+        # step_fn closes over the optimizer/amp/decay config: retrace
+        # when prepare() swapped any of them (otherwise a stale closure
+        # would keep training with the old rule)
+        key = (id(opt), level, loss_scale, tuple(sorted(lrm.items())),
+               tuple(sorted(wd.items())))
+        if key != self._train_key:
+            self._train_fn = None
+            self._train_key = key
+        if self._train_fn is None:
+            coupled = getattr(opt, "_coupled_decay", False)
+
+            def step_fn(params, buffers, opt_states, lr, t, ins, labs):
+                def loss_of(ps):
+                    fwd_ps = ps
+                    fwd_ins = ins
+                    if amp:
+                        fwd_ps = {k: v.astype(jnp.bfloat16)
+                                  if v.dtype == jnp.float32 else v
+                                  for k, v in ps.items()}
+                        fwd_ins = [v.astype(jnp.bfloat16)
+                                   if v.dtype == jnp.float32 else v
+                                   for v in ins]
+                    out, new_state = functional_call(
+                        net, {**fwd_ps, **buffers}, *fwd_ins)
+                    outs = list(out) if isinstance(out, (list, tuple)) \
+                        else [out]
+                    lv, _ = functional_call(loss_l, {}, *(outs + labs))
+                    lv = lv[0] if isinstance(lv, (list, tuple)) else lv
+                    lv = lv.astype(jnp.float32)
+                    scaled = lv * loss_scale if amp else lv
+                    new_buf = {k: v for k, v in new_state.items()
+                               if k in buffers}
+                    return scaled, (lv, outs, new_buf)
+
+                grad_fn = jax.value_and_grad(loss_of, has_aux=True)
+                (_, (loss, outs, new_buf)), grads = grad_fn(params)
+                if amp:
+                    grads = {k: (g.astype(jnp.float32) / loss_scale)
+                             for k, g in grads.items()}
+                if opt._grad_clip is not None:
+                    names = sorted(grads)
+                    clipped = opt._grad_clip._apply(
+                        [grads[n] for n in names])
+                    grads = dict(zip(names, clipped))
+                finite = jnp.all(jnp.asarray(
+                    [jnp.all(jnp.isfinite(g)) for g in grads.values()]))
+                new_params, new_opt = {}, {}
+                for n in params:
+                    g = grads[n].astype(jnp.float32)
+                    if coupled:
+                        g = g + wd[n] * params[n].astype(jnp.float32)
+                    p2, s2 = opt._update(params[n], g, opt_states[n],
+                                         lr * lrm[n], t, wd=wd[n])
+                    p2 = p2.astype(params[n].dtype)
+                    # inf/nan grads (scaled-amp overflow): skip update
+                    new_params[n] = jnp.where(finite, p2, params[n])
+                    new_opt[n] = jax.tree_util.tree_map(
+                        lambda new, old: jnp.where(finite, new, old),
+                        s2, opt_states[n])
+                return loss, outs, new_params, new_buf, new_opt
+
+            self._train_fn = jax.jit(step_fn, donate_argnums=(0, 2))
+
+        ins = [jnp.asarray(np.asarray(v)) for v in _to_list(inputs)]
+        labs = [jnp.asarray(np.asarray(v)) for v in _to_list(labels)]
+        opt._step_count += 1
+        loss, outs, new_params, new_buf, new_opt = self._train_fn(
+            params, buffers, opt_states, jnp.float32(opt.get_lr()),
+            jnp.int32(opt._step_count), ins, labs)
+        # write back into the live layer/optimizer
+        for n, v in new_params.items():
+            pmap[n]._value = v
+        from ..jit import _named_state_tensors
+        for name, t in _named_state_tensors(net):
+            if name in new_buf:
+                t._value = new_buf[name]
+        for n in params:
+            opt._state[id(pmap[n])] = new_opt[n]
+        out_tensors = [Tensor(o) for o in outs]
+        metrics = model._update_metrics(out_tensors,
+                                        [Tensor(v) for v in labs])
+        return [float(np.asarray(loss))], metrics
+
+    def eval_batch(self, inputs, labels=None):
+        import jax
+        import jax.numpy as jnp
+        from ..jit import functional_call, functional_state
+
+        model = self.model
+        net, loss_l = model.network, model._loss
+        net.eval()
+        if self._eval_fn is None:
+            def eval_fn(state, ins, labs):
+                out, _ = functional_call(net, state, *ins)
+                outs = list(out) if isinstance(out, (list, tuple)) \
+                    else [out]
+                lv = None
+                if loss_l is not None:
+                    lv, _ = functional_call(loss_l, {}, *(outs + labs))
+                    lv = lv[0] if isinstance(lv, (list, tuple)) else lv
+                return outs, lv
+
+            self._eval_fn = jax.jit(eval_fn)
+        ins = [jnp.asarray(np.asarray(v)) for v in _to_list(inputs)]
+        labs = [jnp.asarray(np.asarray(v)) for v in _to_list(labels)]
+        outs, lv = self._eval_fn(functional_state(net), ins, labs)
+        out_tensors = [Tensor(o) for o in outs]
+        metrics = model._update_metrics(out_tensors,
+                                        [Tensor(v) for v in labs])
+        return ([float(np.asarray(lv))] if lv is not None else []), metrics
+
+    def predict_batch(self, inputs):
+        import jax
+        import jax.numpy as jnp
+        from ..jit import functional_call, functional_state
+
+        net = self.model.network
+        net.eval()
+        if self._pred_fn is None:
+            def pred_fn(state, ins):
+                out, _ = functional_call(net, state, *ins)
+                return list(out) if isinstance(out, (list, tuple)) \
+                    else [out]
+
+            self._pred_fn = jax.jit(pred_fn)
+        ins = [jnp.asarray(np.asarray(v)) for v in _to_list(inputs)]
+        return [np.asarray(o)
+                for o in self._pred_fn(functional_state(net), ins)]
+
+
 class Model:
     def __init__(self, network, inputs=None, labels=None):
         self.network = network
@@ -34,19 +219,56 @@ class Model:
         self._optimizer = None
         self._loss = None
         self._metrics = []
+        self._amp_configs = None
+        self._input_specs = inputs
+        self._label_specs = labels
+        # adapter choice mirrors the reference (hapi/model.py:Model):
+        # dynamic mode -> eager adapter; static mode -> whole-step
+        # compiled adapter
+        from ..fluid import framework as _fw
+        self._adapter = None if _fw.in_dygraph_mode() \
+            else _StaticGraphAdapter(self)
 
     def prepare(self, optimizer=None, loss=None, metrics=None,
                 amp_configs=None):
         self._optimizer = optimizer
         self._loss = loss
         self._metrics = _to_list(metrics)
+        self._amp_configs = amp_configs
         return self
 
     # -- core steps --------------------------------------------------------
     def train_batch(self, inputs, labels=None):
+        if self._adapter is not None:
+            return self._adapter.train_batch(inputs, labels)
         self.network.train()
         inputs = [to_variable(np.asarray(v)) for v in _to_list(inputs)]
         labels = [to_variable(np.asarray(v)) for v in _to_list(labels)]
+        amp_level = None
+        if self._amp_configs:
+            amp_level = (self._amp_configs if isinstance(
+                self._amp_configs, str)
+                else self._amp_configs.get("level", "O1"))
+        if amp_level and str(amp_level).upper() in ("O1", "O2"):
+            from .. import amp as pamp
+            if not hasattr(self, "_scaler"):
+                init = 32768.0
+                if isinstance(self._amp_configs, dict):
+                    init = float(self._amp_configs.get(
+                        "init_loss_scaling", init))
+                self._scaler = pamp.GradScaler(
+                    init_loss_scaling=init)
+            with pamp.auto_cast(True):
+                outputs = self.network(*inputs)
+                outs = _to_list(outputs)
+                loss = self._loss(*(outs + labels))
+            loss_val = loss if isinstance(loss, Tensor) else loss[0]
+            scaled = self._scaler.scale(loss_val)
+            scaled.backward()
+            self._scaler.minimize(self._optimizer, scaled)
+            self._optimizer.clear_grad()
+            metrics = self._update_metrics(outs, labels)
+            return [float(loss_val.numpy())], metrics
         outputs = self.network(*inputs)
         outs = _to_list(outputs)
         loss = self._loss(*(outs + labels))
@@ -58,6 +280,8 @@ class Model:
         return [float(loss_val.numpy())], metrics
 
     def eval_batch(self, inputs, labels=None):
+        if self._adapter is not None:
+            return self._adapter.eval_batch(inputs, labels)
         from ..fluid.dygraph.tracer import no_grad
 
         self.network.eval()
@@ -73,6 +297,8 @@ class Model:
         return lv, metrics
 
     def predict_batch(self, inputs):
+        if self._adapter is not None:
+            return self._adapter.predict_batch(inputs)
         from ..fluid.dygraph.tracer import no_grad
 
         self.network.eval()

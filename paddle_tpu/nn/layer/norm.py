@@ -182,6 +182,7 @@ class SpectralNorm(Layer):
             default_initializer=None)
 
     def forward(self, x):
+        import jax
         import jax.numpy as jnp
 
         from ...fluid.dygraph.tracer import trace_fn
@@ -197,6 +198,15 @@ class SpectralNorm(Layer):
                 u = wm @ v
                 u = u / (jnp.linalg.norm(u) + eps)
             sigma = u @ wm @ v
-            return w / sigma
+            return w / sigma, u, v
 
-        return trace_fn(f, {"w": x, "u": self.weight_u, "v": self.weight_v})
+        out, u_new, v_new = trace_fn(
+            f, {"w": x, "u": self.weight_u, "v": self.weight_v},
+            multi_out=True)
+        # reference SpectralNorm updates U/V in place with no grad each
+        # forward so power iteration refines across steps
+        self.weight_u._value = jax.lax.stop_gradient(
+            u_new._value if hasattr(u_new, "_value") else u_new)
+        self.weight_v._value = jax.lax.stop_gradient(
+            v_new._value if hasattr(v_new, "_value") else v_new)
+        return out

@@ -1,0 +1,53 @@
+"""paddle.onnx — model export (reference python/paddle/onnx/export.py).
+
+TPU-native descope: the reference shells out to paddle2onnx, a
+CUDA-ecosystem bridge with no counterpart in this image (no `onnx` /
+`onnxruntime` packages).  The deployment interchange format of the TPU
+stack is **StableHLO** — an MLIR dialect with stability guarantees that
+serves the same role ONNX serves for the reference (portable,
+runtime-independent serialized graphs; IREE/PJRT/XLA consumers).
+
+`export` therefore emits the StableHLO artifact via
+paddle_tpu.inference.save_inference_model.  If the `onnx` package IS
+importable at call time and format="onnx" is requested, the call raises
+NotImplementedError rather than silently producing a different format —
+this descope is explicit (README "ONNX" section).
+"""
+
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, fmt="stablehlo",
+           **configs):
+    """Export `layer` for deployment.
+
+    Contract mirror of the reference export (onnx/export.py:21): same
+    (layer, path, input_spec) signature; `path` must not carry a file
+    suffix.  Output: <path>.stablehlo + <path>.json manifest readable
+    by paddle_tpu.inference.Predictor.
+    """
+    if fmt == "onnx":
+        raise NotImplementedError(
+            "paddle_tpu exports StableHLO, not ONNX protobufs "
+            "(paddle2onnx is CUDA-ecosystem tooling; the onnx package "
+            "is not part of this image).  Use fmt='stablehlo' and an "
+            "XLA/PJRT/IREE runtime, or convert the StableHLO module "
+            "offline.")
+    from ..inference import save_inference_model
+
+    if path.endswith(".onnx"):
+        path = path[:-5]
+    spec = []
+    for item in input_spec or []:
+        if hasattr(item, "shape") and hasattr(item, "dtype"):
+            # static.InputSpec (the 2.0 export signature); -1 dims need
+            # a concrete example size for StableHLO's static shapes
+            shape = [1 if s in (None, -1) else int(s)
+                     for s in item.shape]
+            spec.append((shape, str(item.dtype)))
+        else:
+            spec.append(item)
+    save_inference_model(path, layer, spec, **configs)
+    return path + ".stablehlo"

@@ -1900,7 +1900,10 @@ def _generate_mask_labels(ctx, op, ins):
 
         masks = jax.vmap(one_roi)(roi_img, best_gt, fg,
                                   jnp.clip(lab, 0, num_classes - 1))
-        return (jnp.where(fg[:, None], roi_img, 0.0),
+        # MaskRois go back to the INPUT rois' coordinate space: the
+        # reference divides by im_scale to rasterize, then multiplies
+        # back before emitting (generate_mask_labels_op.cc:287)
+        return (jnp.where(fg[:, None], roi, 0.0),
                 fg.astype(jnp.int32), masks)
 
     mask_rois, has_mask, masks = jax.vmap(per_image)(

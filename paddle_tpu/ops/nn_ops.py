@@ -1086,7 +1086,17 @@ def _spectral_norm(ctx, op, ins):
         v = l2n(wm.T @ u)
         u = l2n(wm @ v)
     sigma = u @ wm @ v
-    return {"Out": [w / sigma]}
+    outs = {"Out": [w / sigma]}
+    # the reference mutates the persistable U/V inputs in place so the
+    # power iteration REFINES across steps (spectral_norm_op.h:77-94);
+    # the functional analogue: programs that declare U/V output slots
+    # (aliasing the input vars by name) get the updated vectors and the
+    # Executor rebinds them into the scope
+    if "U" in op.outputs:
+        outs["U"] = [u]
+    if "V" in op.outputs:
+        outs["V"] = [v]
+    return outs
 
 
 @register_op("pool3d")

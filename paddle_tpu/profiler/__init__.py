@@ -128,3 +128,38 @@ def profiler(state="All", sorted_key="total", profile_path=None,
 def reset_profiler():
     with _EVENTS_LOCK:
         _EVENTS.clear()
+
+
+# ---------------------------------------------------------------------------
+# StatRegistry counters (reference platform/monitor.h:77 StatRegistry +
+# the STAT_ADD/STAT_RESET macros, exported as core.get_int_stats)
+# ---------------------------------------------------------------------------
+
+_STATS: dict = {}
+_STATS_LOCK = threading.Lock()
+
+
+def stat_add(name: str, value: int = 1) -> None:
+    """STAT_ADD equivalent: bump a named global counter."""
+    with _STATS_LOCK:
+        _STATS[name] = _STATS.get(name, 0) + int(value)
+
+
+def stat_set(name: str, value: int) -> None:
+    with _STATS_LOCK:
+        _STATS[name] = int(value)
+
+
+def stat_reset(name: str = None) -> None:
+    """STAT_RESET: clear one counter, or all of them."""
+    with _STATS_LOCK:
+        if name is None:
+            _STATS.clear()
+        else:
+            _STATS.pop(name, None)
+
+
+def get_int_stats() -> dict:
+    """Snapshot of every counter (reference core.get_int_stats)."""
+    with _STATS_LOCK:
+        return dict(_STATS)

@@ -230,3 +230,107 @@ class TestHapiModel:
             metrics=paddle.metric.Accuracy())
         hist = m.fit(Cls(), batch_size=16, epochs=5, verbose=0)
         assert hist[-1]["acc"] > 0.6
+
+
+class TestHapiStaticAdapter:
+    """VERDICT r3 next #9: the static (whole-step-compiled) adapter
+    trains MNIST-style data to the same loss as the dygraph adapter,
+    and amp_configs are honored rather than stored."""
+
+    def _mnist_bits(self):
+        rng = np.random.RandomState(7)
+        x = rng.rand(128, 1, 28, 28).astype("float32")
+        y = rng.randint(0, 10, (128, 1)).astype("int64")
+        return x, y
+
+    def _lenet_model(self, seed):
+        paddle.seed(seed)
+        from paddle_tpu.vision.models import LeNet
+        net = LeNet()
+        m = paddle.Model(net)
+        m.prepare(
+            optimizer=paddle.optimizer.Adam(learning_rate=0.003,
+                                            parameters=net.parameters()),
+            loss=paddle.nn.CrossEntropyLoss())
+        return m
+
+    def _run_epochs(self, m, x, y, batch=32, epochs=2):
+        losses = []
+        for _ in range(epochs):
+            for i in range(0, len(x), batch):
+                (l,), _ = m.train_batch([x[i:i + batch]],
+                                        [y[i:i + batch]])
+                losses.append(l)
+        return losses
+
+    def test_static_matches_dygraph_loss(self):
+        x, y = self._mnist_bits()
+
+        paddle.disable_static()
+        m_dy = self._lenet_model(0)
+        assert m_dy._adapter is None
+        dy_losses = self._run_epochs(m_dy, x, y, epochs=4)
+
+        paddle.enable_static()
+        try:
+            m_st = self._lenet_model(0)
+            assert m_st._adapter is not None
+            st_losses = self._run_epochs(m_st, x, y, epochs=4)
+        finally:
+            paddle.disable_static()
+
+        # identical seeds + data: trajectories agree to float tolerance
+        np.testing.assert_allclose(st_losses, dy_losses, rtol=2e-2,
+                                   atol=2e-2)
+        # and the step actually optimizes (16 steps of memorizing 128
+        # random labels: expect a clear dip, not convergence)
+        assert st_losses[-1] < st_losses[0] * 0.97
+
+    def test_static_eval_and_predict(self):
+        x, y = self._mnist_bits()
+        paddle.enable_static()
+        try:
+            m = self._lenet_model(1)
+            self._run_epochs(m, x, y, epochs=1)
+            lv, _ = m.eval_batch([x[:16]], [y[:16]])
+            assert np.isfinite(lv[0])
+            (probs,) = m.predict_batch([x[:4]])
+            assert probs.shape == (4, 10)
+        finally:
+            paddle.disable_static()
+
+    def test_static_amp_trains(self):
+        x, y = self._mnist_bits()
+        paddle.enable_static()
+        try:
+            paddle.seed(2)
+            from paddle_tpu.vision.models import LeNet
+            net = LeNet()
+            m = paddle.Model(net)
+            m.prepare(
+                optimizer=paddle.optimizer.Adam(
+                    learning_rate=0.003, parameters=net.parameters()),
+                loss=paddle.nn.CrossEntropyLoss(),
+                amp_configs={"level": "O1",
+                             "init_loss_scaling": 1024.0})
+            losses = self._run_epochs(m, x, y, epochs=4)
+        finally:
+            paddle.disable_static()
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0] * 0.97
+
+    def test_dygraph_amp_configs_used(self):
+        x, y = self._mnist_bits()
+        paddle.disable_static()
+        paddle.seed(3)
+        from paddle_tpu.vision.models import LeNet
+        net = LeNet()
+        m = paddle.Model(net)
+        m.prepare(
+            optimizer=paddle.optimizer.Adam(
+                learning_rate=0.003, parameters=net.parameters()),
+            loss=paddle.nn.CrossEntropyLoss(),
+            amp_configs={"level": "O1"})
+        losses = self._run_epochs(m, x, y, epochs=1)
+        assert hasattr(m, "_scaler")  # the GradScaler actually engaged
+        assert np.isfinite(losses).all()

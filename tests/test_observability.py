@@ -1,0 +1,127 @@
+"""Observability tail (VERDICT r3 Missing #6): StatRegistry counters,
+Executor FetchHandler, fleet distributed metrics."""
+
+import time
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import profiler
+from paddle_tpu.distributed.fleet import metrics
+from paddle_tpu.fluid import framework
+from paddle_tpu.fluid.executor import (FetchHandler, Scope, scope_guard)
+
+
+def _simple_program():
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        x = fluid.data("x", [-1, 4], "float32")
+        y = fluid.layers.fc(x, 2)
+        loss = fluid.layers.reduce_mean(y)
+    return main, startup, loss
+
+
+def test_stat_registry_counters():
+    profiler.stat_reset()
+    profiler.stat_add("my_counter", 5)
+    profiler.stat_add("my_counter", 2)
+    assert profiler.get_int_stats()["my_counter"] == 7
+    # the Executor bumps run/compile counters (monitor.h STAT_ADD role)
+    main, startup, loss = _simple_program()
+    with scope_guard(Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        before = profiler.get_int_stats()
+        exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+                fetch_list=[loss])
+        exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+                fetch_list=[loss])
+        after = profiler.get_int_stats()
+    assert after["executor_run_count"] - before.get(
+        "executor_run_count", 0) == 2
+    assert after["executor_compile_count"] - before.get(
+        "executor_compile_count", 0) == 1  # second run hits the cache
+    profiler.stat_reset("my_counter")
+    assert "my_counter" not in profiler.get_int_stats()
+
+
+def test_fetch_handler_fires(tmp_path):
+    """The async monitor snapshots scope vars during a dataset loop."""
+    main, startup, loss = _simple_program()
+    seen = []
+
+    class H(FetchHandler):
+        def handler(self, res_dict):
+            seen.append(dict(res_dict))
+
+    # one MultiSlot file with 64 single-slot rows of 4 floats
+    path = str(tmp_path / "part-0.txt")
+    rng = np.random.RandomState(0)
+    with open(path, "w") as f:
+        for _ in range(64):
+            f.write("4 " + " ".join(
+                f"{v:.6f}" for v in rng.randn(4)) + "\n")
+
+    scope = Scope()
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+        ds.set_batch_size(4)
+        ds.set_use_var([main.global_block().var("x")])
+        ds.set_filelist([path])
+        ds.load_into_memory()
+        w_name = next(v.name for v in main.list_vars()
+                      if v.persistable and v.name.endswith(".w_0"))
+        handler = H(var_dict={"w": w_name}, period_secs=0.02)
+        t0 = time.time()
+        while time.time() - t0 < 0.5 and not seen:
+            exe.train_from_dataset(main, ds, fetch_list=[loss],
+                                   fetch_handler=handler)
+    assert seen, "fetch handler never fired"
+    assert any("w" in d and d["w"].shape == (4, 2) for d in seen)
+
+
+def test_fleet_metrics_match_local():
+    """Shard the data 8 ways, accumulate auc-op stats per shard, then
+    fleet.metrics.auc over the shard stats must equal the single-shot
+    auc over the full data (done-criterion of VERDICT r3 next #8)."""
+    from op_test import run_single_op
+
+    rng = np.random.RandomState(0)
+    n = 256
+    scores = rng.rand(n).astype("float32")
+    labels = (rng.rand(n) < scores).astype("int64")  # informative preds
+    pred2 = np.stack([1 - scores, scores], axis=1)
+    nt = 255
+
+    def stats(lo, hi):
+        d = run_single_op(
+            "auc",
+            {"Predict": pred2[lo:hi], "Label": labels[lo:hi, None],
+             "StatPos": np.zeros(nt + 1, "int64"),
+             "StatNeg": np.zeros(nt + 1, "int64")},
+            {"num_thresholds": nt, "slide_steps": 0},
+            ["AUC", "StatPosOut", "StatNegOut"],
+            {"StatPosOut": "int64", "StatNegOut": "int64"})
+        return d["StatPosOut"], d["StatNegOut"], float(d["AUC"])
+
+    # single shot over everything
+    _, _, local_auc = stats(0, n)
+    # 8 worker shards -> fleet reduction
+    shard_pos, shard_neg = [], []
+    for w in range(8):
+        p, ng, _ = stats(w * 32, (w + 1) * 32)
+        shard_pos.append(p)
+        shard_neg.append(ng)
+    fleet_auc = metrics.auc(shard_pos, shard_neg)
+    np.testing.assert_allclose(fleet_auc, local_auc, rtol=1e-6)
+    # sanity: the metric is informative, not degenerate
+    assert 0.6 < fleet_auc < 1.0
+
+    # the scalar helpers reduce across workers too
+    assert metrics.acc([np.array([3.0]), np.array([1.0])],
+                       [np.array([4.0]), np.array([4.0])]) == 0.5
+    np.testing.assert_allclose(
+        metrics.rmse([np.array([8.0]), np.array([10.0])],
+                     [np.array([1.0]), np.array([1.0])]), 3.0)

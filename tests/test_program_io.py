@@ -106,3 +106,32 @@ def test_load_inference_model_fresh_process(tmp_path):
                    check=True, env=env, cwd=repo_root, timeout=300)
     got = np.load(out_path)
     np.testing.assert_allclose(got, np.asarray(want), atol=1e-5)
+
+
+def test_onnx_export_descope_contract(tmp_path):
+    """paddle.onnx.export: emits the StableHLO deployment artifact
+    (explicit descope of ONNX protobufs — README); the artifact runs
+    through the Predictor and matches eager outputs.  fmt='onnx' raises
+    the documented error."""
+    import paddle_tpu as paddle
+    from paddle_tpu import onnx as ponnx
+    from paddle_tpu.inference import Predictor
+    from paddle_tpu.static import InputSpec
+    from paddle_tpu.vision.models import LeNet
+
+    paddle.disable_static()
+    net = LeNet()
+    net.eval()
+    path = str(tmp_path / "lenet")
+    out_path = ponnx.export(
+        net, path, input_spec=[InputSpec([1, 1, 28, 28], "float32")])
+    assert out_path.endswith(".stablehlo")
+    x = np.random.RandomState(0).rand(1, 1, 28, 28).astype("float32")
+    want = net(paddle.to_tensor(x)).numpy()
+    from paddle_tpu.inference import Config
+    pred = Predictor(Config(path))
+    (got,) = pred.run([x])
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-5)
+
+    with pytest.raises(NotImplementedError, match="StableHLO"):
+        ponnx.export(net, path, fmt="onnx")
