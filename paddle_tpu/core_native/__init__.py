@@ -118,3 +118,28 @@ class BlockingQueue:
             self._l.ptq_destroy(self._q)
         except Exception:
             pass
+
+
+# -- inference C ABI (c_api.cc) ---------------------------------------------
+
+def build_c_api(embed: bool = False) -> str:
+    """Compile the inference C ABI (c_api.cc -> libpaddle_tpu_c.so) and
+    return its path.  embed=True links libpython so a pure-C host can
+    run without pre-loading the interpreter."""
+    import sysconfig
+
+    src = os.path.join(_DIR, "c_api.cc")
+    so = os.path.join(_DIR, "libpaddle_tpu_c.so")
+    if (os.path.exists(so)
+            and os.path.getmtime(so) >= os.path.getmtime(src)):
+        return so
+    inc = sysconfig.get_path("include")
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+           f"-I{inc}", src, "-o", so + f".tmp.{os.getpid()}"]
+    if embed:
+        libdir = sysconfig.get_config_var("LIBDIR")
+        ver = sysconfig.get_config_var("LDVERSION")
+        cmd += [f"-L{libdir}", f"-lpython{ver}"]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(so + f".tmp.{os.getpid()}", so)
+    return so

@@ -1,0 +1,177 @@
+// C ABI over the StableHLO Predictor — the TPU-native analogue of the
+// reference's inference C API (paddle/fluid/inference/capi/c_api.cc,
+// paddle_c_api.h) that its Go and R bindings wrap.  Any FFI-capable
+// language (Go cgo, R .C, Rust, C) links this library and serves a
+// saved model with no Python in its OWN source — the Python runtime is
+// an implementation detail embedded behind the ABI, exactly as the
+// reference's C++ runtime hides behind PD_*.
+//
+// Surface (PT_ = paddle-tpu, mirroring PD_ naming):
+//   PT_Init(repo_path)            – bootstrap the embedded runtime
+//                                   (no-op when the host IS Python)
+//   PT_NewPredictor(prefix)       – load <prefix>.stablehlo + manifest
+//   PT_PredictorRun(...)          – run one f32 input -> f32 output
+//   PT_DeletePredictor, PT_GetLastError
+//
+// Build: g++ -O2 -shared -fPIC -std=c++17 c_api.cc
+//            $(python3-config --includes) -o libpaddle_tpu_c.so
+//        (link with $(python3-config --embed --ldflags) for pure-C
+//        hosts; resolved at runtime when loaded into a Python process)
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+
+namespace {
+
+std::mutex g_err_mu;
+std::string g_last_error;
+
+void set_error(const std::string& msg) {
+  std::lock_guard<std::mutex> lk(g_err_mu);
+  g_last_error = msg;
+}
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      msg = PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  set_error(msg);
+}
+
+struct GIL {
+  PyGILState_STATE st;
+  GIL() : st(PyGILState_Ensure()) {}
+  ~GIL() { PyGILState_Release(st); }
+};
+
+}  // namespace
+
+extern "C" {
+
+typedef struct PT_Predictor {
+  PyObject* pred;    // paddle_tpu.inference.Predictor
+  PyObject* bridge;  // paddle_tpu.inference.c_bridge module
+} PT_Predictor;
+
+const char* PT_GetLastError() {
+  std::lock_guard<std::mutex> lk(g_err_mu);
+  return g_last_error.c_str();
+}
+
+// Bootstrap for pure-C hosts: start the embedded interpreter and put
+// `repo_path` on sys.path.  When the host process already runs Python
+// (ctypes / Go loading into a Python service), this is a no-op.
+int PT_Init(const char* repo_path) {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+  }
+  GIL gil;
+  if (repo_path && *repo_path) {
+    PyObject* sys_path = PySys_GetObject("path");  // borrowed
+    PyObject* p = PyUnicode_FromString(repo_path);
+    if (!sys_path || !p || PyList_Insert(sys_path, 0, p) != 0) {
+      Py_XDECREF(p);
+      set_error_from_python();
+      return -1;
+    }
+    Py_DECREF(p);
+  }
+  return 0;
+}
+
+PT_Predictor* PT_NewPredictor(const char* model_prefix) {
+  GIL gil;
+  PyObject* bridge = PyImport_ImportModule("paddle_tpu.inference.c_bridge");
+  if (!bridge) {
+    set_error_from_python();
+    return nullptr;
+  }
+  PyObject* pred = PyObject_CallMethod(bridge, "new_predictor", "s",
+                                       model_prefix);
+  if (!pred) {
+    Py_DECREF(bridge);
+    set_error_from_python();
+    return nullptr;
+  }
+  auto* h = new PT_Predictor{pred, bridge};
+  return h;
+}
+
+void PT_DeletePredictor(PT_Predictor* h) {
+  if (!h) return;
+  GIL gil;
+  Py_XDECREF(h->pred);
+  Py_XDECREF(h->bridge);
+  delete h;
+}
+
+// Run one float32 input through the model.  `out_buf` must hold
+// `out_capacity` floats; the real element count lands in *out_count and
+// the shape (up to 8 dims) in out_shape/out_ndim.  Returns 0 on
+// success, -1 on error (PT_GetLastError), -2 if out_buf is too small
+// (with *out_count set to the required size).
+int PT_PredictorRun(PT_Predictor* h, const float* data,
+                    const int64_t* shape, int ndim, float* out_buf,
+                    int64_t out_capacity, int64_t* out_count,
+                    int64_t* out_shape, int* out_ndim) {
+  if (!h || !data || !shape || ndim <= 0) {
+    set_error("bad arguments");
+    return -1;
+  }
+  GIL gil;
+  PyObject* shp = PyList_New(ndim);
+  for (int i = 0; i < ndim; ++i) {
+    PyList_SET_ITEM(shp, i, PyLong_FromLongLong(shape[i]));
+  }
+  PyObject* res = PyObject_CallMethod(
+      h->bridge, "run_f32", "OKO", h->pred,
+      (unsigned long long)(uintptr_t)data, shp);
+  Py_DECREF(shp);
+  if (!res) {
+    set_error_from_python();
+    return -1;
+  }
+  // res = (bytes, [dims...])
+  PyObject* payload = PyTuple_GetItem(res, 0);   // borrowed
+  PyObject* oshape = PyTuple_GetItem(res, 1);    // borrowed
+  char* raw = nullptr;
+  Py_ssize_t nbytes = 0;
+  if (PyBytes_AsStringAndSize(payload, &raw, &nbytes) != 0) {
+    Py_DECREF(res);
+    set_error_from_python();
+    return -1;
+  }
+  int64_t count = nbytes / (Py_ssize_t)sizeof(float);
+  if (out_count) *out_count = count;
+  int nd = (int)PyList_Size(oshape);
+  if (out_ndim) *out_ndim = nd;
+  if (out_shape) {
+    for (int i = 0; i < nd && i < 8; ++i) {
+      out_shape[i] = PyLong_AsLongLong(PyList_GetItem(oshape, i));
+    }
+  }
+  if (count > out_capacity) {
+    Py_DECREF(res);
+    set_error("output buffer too small");
+    return -2;
+  }
+  std::memcpy(out_buf, raw, (size_t)nbytes);
+  Py_DECREF(res);
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,27 @@
+"""Python side of the inference C ABI (core_native/c_api.cc).
+
+The C layer hands raw pointers + shapes across the ABI; this module
+turns them into arrays, drives the Predictor, and hands back contiguous
+bytes.  It deliberately knows nothing about the C structs — the whole
+contract is (address, shape) in, (bytes, shape) out."""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from . import Config, Predictor
+
+
+def new_predictor(prefix: str) -> Predictor:
+    return Predictor(Config(prefix))
+
+
+def run_f32(pred: Predictor, addr: int, shape) -> tuple:
+    n = int(np.prod(shape))
+    buf = (ctypes.c_float * n).from_address(int(addr))
+    x = np.ctypeslib.as_array(buf).reshape([int(s) for s in shape]).copy()
+    outs = pred.run([x])
+    out = np.ascontiguousarray(np.asarray(outs[0]), dtype=np.float32)
+    return out.tobytes(), [int(s) for s in out.shape]
