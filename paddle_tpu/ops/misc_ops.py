@@ -423,8 +423,12 @@ def _sampling_id(ctx, op, ins):
 def _seed(ctx, op, ins):
     s = int(op.attr("seed", 0))
     if s == 0:
-        s = int(jax.random.randint(ctx.rng_key(op), (), 1, 2**31 - 1))
-    return {"Out": [jnp.asarray(s, jnp.int32).reshape(1)]}
+        # traced context: stay on-device, no Python int() of a tracer
+        out = jax.random.randint(ctx.rng_key(op), (1,), 1, 2**31 - 1,
+                                 dtype=jnp.int32)
+    else:
+        out = jnp.asarray(s, jnp.int32).reshape(1)
+    return {"Out": [out]}
 
 
 @register_op("shard_index")

@@ -1,0 +1,311 @@
+"""Tests for the last 15 registered-but-untested ops (VERDICT r4 weak #3):
+the interp tail (linear/trilinear/bicubic) against torch oracles, the
+dequantize family round-trips, random_crop shape/determinism,
+average_accumulates window state math, and the small creation/predicate
+ops (empty, fill, fill_zeros_like2, gaussian_random_batch_size_like,
+grad_add, is_empty, seed).
+
+Reference anchors: interpolate_v2_op.h, fake_dequantize_op.cc,
+dequantize_log_op.cc, random_crop_op.h, average_accumulates_op.h,
+fill_op.cc, empty_op.cc, seed_op.cc.
+"""
+
+import numpy as np
+import torch
+import torch.nn.functional as TF
+
+from op_test import randf, run_single_op
+
+
+def run_op(op_type, inputs, attrs, outs, dtypes=None):
+    return run_single_op(op_type, inputs, attrs, outs, dtypes)
+
+
+# ---------------------------------------------------------------------------
+# interp tail: linear (3D), trilinear (5D), bicubic (4D)
+# ---------------------------------------------------------------------------
+
+class TestLinearInterp:
+    def test_align_corners_true(self):
+        x = randf(2, 3, 8, seed=10)
+        want = TF.interpolate(torch.tensor(x), size=13, mode="linear",
+                              align_corners=True).numpy()
+        d = run_op("linear_interp", {"X": x},
+                   {"out_w": 13, "align_corners": True}, ["Out"])
+        np.testing.assert_allclose(d["Out"], want, atol=1e-5)
+        d = run_op("linear_interp_v2", {"X": x},
+                   {"out_w": 13, "align_corners": True}, ["Out"])
+        np.testing.assert_allclose(d["Out"], want, atol=1e-5)
+
+    def test_half_pixel(self):
+        # align_corners=False + align_mode=0 is torch's half-pixel map
+        x = randf(1, 2, 6, seed=11)
+        d = run_op("linear_interp_v2", {"X": x},
+                   {"out_w": 9, "align_corners": False, "align_mode": 0},
+                   ["Out"])
+        want = TF.interpolate(torch.tensor(x), size=9, mode="linear",
+                              align_corners=False).numpy()
+        np.testing.assert_allclose(d["Out"], want, atol=1e-5)
+
+    def test_downsample(self):
+        x = randf(2, 2, 12, seed=12)
+        d = run_op("linear_interp_v2", {"X": x},
+                   {"out_w": 5, "align_corners": True}, ["Out"])
+        want = TF.interpolate(torch.tensor(x), size=5, mode="linear",
+                              align_corners=True).numpy()
+        np.testing.assert_allclose(d["Out"], want, atol=1e-5)
+
+
+class TestTrilinearInterp:
+    def test_align_corners_true(self):
+        x = randf(1, 2, 3, 4, 5, seed=13)
+        want = TF.interpolate(torch.tensor(x), size=(5, 7, 3),
+                              mode="trilinear", align_corners=True).numpy()
+        attrs = {"out_d": 5, "out_h": 7, "out_w": 3, "align_corners": True}
+        d = run_op("trilinear_interp", {"X": x}, attrs, ["Out"])
+        np.testing.assert_allclose(d["Out"], want, atol=1e-5)
+        d = run_op("trilinear_interp_v2", {"X": x}, attrs, ["Out"])
+        np.testing.assert_allclose(d["Out"], want, atol=1e-5)
+
+    def test_half_pixel(self):
+        x = randf(2, 1, 4, 4, 4, seed=14)
+        d = run_op("trilinear_interp_v2", {"X": x},
+                   {"out_d": 6, "out_h": 3, "out_w": 7,
+                    "align_corners": False, "align_mode": 0}, ["Out"])
+        want = TF.interpolate(torch.tensor(x), size=(6, 3, 7),
+                              mode="trilinear",
+                              align_corners=False).numpy()
+        np.testing.assert_allclose(d["Out"], want, atol=1e-5)
+
+
+class TestBicubicInterp:
+    # torch's bicubic uses the same Keys kernel (a=-0.75) as the
+    # reference (interpolate_v2_op.h cubic_interp)
+    def test_align_corners_true(self):
+        x = randf(2, 3, 6, 7, seed=15)
+        want = TF.interpolate(torch.tensor(x), size=(11, 5),
+                              mode="bicubic", align_corners=True).numpy()
+        attrs = {"out_h": 11, "out_w": 5, "align_corners": True}
+        d = run_op("bicubic_interp", {"X": x}, attrs, ["Out"])
+        np.testing.assert_allclose(d["Out"], want, atol=1e-4)
+        d = run_op("bicubic_interp_v2", {"X": x}, attrs, ["Out"])
+        np.testing.assert_allclose(d["Out"], want, atol=1e-4)
+
+    def test_half_pixel(self):
+        x = randf(1, 1, 8, 8, seed=16)
+        d = run_op("bicubic_interp_v2", {"X": x},
+                   {"out_h": 13, "out_w": 3, "align_corners": False},
+                   ["Out"])
+        want = TF.interpolate(torch.tensor(x), size=(13, 3),
+                              mode="bicubic", align_corners=False).numpy()
+        np.testing.assert_allclose(d["Out"], want, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# dequantize family
+# ---------------------------------------------------------------------------
+
+class TestDequantize:
+    def test_dequantize_abs_max(self):
+        codes = np.random.RandomState(17).randint(
+            -127, 128, size=(4, 6)).astype("int32")
+        scale = np.asarray([0.37], "float32")
+        d = run_op("dequantize_abs_max",
+                   {"X": codes.astype("float32"), "Scale": scale},
+                   {"max_range": 127.0}, ["Out"])
+        want = codes.astype("float32") * 0.37 / 127.0
+        np.testing.assert_allclose(d["Out"], want, rtol=1e-6)
+
+    def test_dequantize_log(self):
+        # codes in [-128, 127]; x<0 reads -table[x+128], else table[x]
+        table = np.linspace(0.01, 1.0, 128).astype("float32")
+        x = np.array([[-128, -1, 0, 5], [127, -64, 32, 100]], "int32")
+        d = run_op("dequantize_log", {"X": x, "Dict": table}, {}, ["Out"])
+        want = np.where(x < 0, -table[np.clip(x + 128, 0, 127)],
+                        table[np.clip(x, 0, 127)])
+        np.testing.assert_allclose(d["Out"], want, rtol=1e-6)
+
+    def test_fake_channel_wise_dequantize_one_scale(self):
+        x = randf(3, 4, 5, seed=18)
+        s = randf(3, low=0.5, high=2.0, seed=19)
+        d = run_op("fake_channel_wise_dequantize_max_abs",
+                   {"X": x, "Scales": [s]},
+                   {"max_range": 127.0, "quant_axis": 0}, ["Out"])
+        want = x * s.reshape(3, 1, 1) / 127.0
+        np.testing.assert_allclose(d["Out"], want, rtol=1e-5)
+
+    def test_fake_channel_wise_dequantize_two_scales(self):
+        # weight scale per channel (axis 1) x activation scalar scale
+        x = randf(2, 4, 3, seed=20)
+        s1 = randf(4, low=0.5, high=2.0, seed=21)
+        s2 = np.asarray([3.0], "float32")
+        d = run_op("fake_channel_wise_dequantize_max_abs",
+                   {"X": x, "Scales": [s1, s2]},
+                   {"max_range": 127.0 * 127.0}, ["Out"])
+        want = x * s1.reshape(1, 4, 1) * 3.0 / (127.0 * 127.0)
+        np.testing.assert_allclose(d["Out"], want, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# creation / predicate ops
+# ---------------------------------------------------------------------------
+
+class TestCreationOps:
+    def test_empty(self):
+        d = run_op("empty", {}, {"shape": [2, 3], "dtype": "int32"},
+                   ["Out"], {"Out": "int32"})
+        assert d["Out"].shape == (2, 3)
+        assert d["Out"].dtype == np.int32
+
+    def test_fill(self):
+        d = run_op("fill", {},
+                   {"shape": [2, 3], "dtype": "float32",
+                    "value": [1.0, 2.0, 3.0, 4.0, 5.0, 6.0]}, ["Out"])
+        np.testing.assert_array_equal(
+            d["Out"], np.arange(1.0, 7.0, dtype="float32").reshape(2, 3))
+
+    def test_fill_zeros_like2(self):
+        x = randf(3, 4, seed=22)
+        d = run_op("fill_zeros_like2", {"X": x}, {"dtype": "float32"},
+                   ["Out"])
+        np.testing.assert_array_equal(d["Out"], np.zeros((3, 4), "float32"))
+
+    def test_gaussian_random_batch_size_like(self):
+        like = randf(7, 3, seed=23)
+        d = run_op("gaussian_random_batch_size_like", {"Input": like},
+                   {"shape": [999, 2048], "input_dim_idx": 0,
+                    "output_dim_idx": 0, "mean": 2.0, "std": 3.0,
+                    "dtype": "float32"}, ["Out"])
+        out = d["Out"]
+        assert out.shape == (7, 2048)
+        assert abs(out.mean() - 2.0) < 0.1
+        assert abs(out.std() - 3.0) < 0.1
+
+    def test_grad_add(self):
+        x, y = randf(2, 5, seed=24), randf(2, 5, seed=25)
+        d = run_op("grad_add", {"X": x, "Y": y}, {}, ["Out"])
+        np.testing.assert_allclose(d["Out"], x + y, rtol=1e-6)
+
+    def test_is_empty(self):
+        d = run_op("is_empty", {"X": np.zeros((0, 3), "float32")}, {},
+                   ["Out"], {"Out": "bool"})
+        assert bool(d["Out"])
+        d = run_op("is_empty", {"X": randf(2, 2, seed=26)}, {},
+                   ["Out"], {"Out": "bool"})
+        assert not bool(d["Out"])
+
+    def test_seed_fixed(self):
+        d = run_op("seed", {}, {"seed": 1234}, ["Out"], {"Out": "int32"})
+        np.testing.assert_array_equal(d["Out"], np.asarray([1234], "int32"))
+
+    def test_seed_random(self):
+        d = run_op("seed", {}, {"seed": 0}, ["Out"], {"Out": "int32"})
+        v = int(d["Out"][0])
+        assert 1 <= v < 2 ** 31
+
+
+# ---------------------------------------------------------------------------
+# random_crop
+# ---------------------------------------------------------------------------
+
+class TestRandomCrop:
+    def test_shape_and_membership(self):
+        # crop must be a contiguous window of x along the trailing dims
+        x = np.arange(2 * 8 * 9, dtype="float32").reshape(2, 8, 9)
+        seed = np.asarray([7], "int64")
+        d = run_op("random_crop", {"X": x, "Seed": seed},
+                   {"shape": [5, 4]}, ["Out", "SeedOut"],
+                   {"SeedOut": "int64"})
+        out = d["Out"]
+        assert out.shape == (2, 5, 4)
+        # locate the window via the first element (x values are unique)
+        flat = int(out[0, 0, 0])
+        r, c = flat // 9 % 8, flat % 9
+        np.testing.assert_array_equal(out, x[:, r:r + 5, c:c + 4])
+
+    def test_offsets_in_bounds_full_crop(self):
+        # crop size == input size must be the identity
+        x = randf(3, 4, 4, seed=27)
+        d = run_op("random_crop", {"X": x, "Seed": np.asarray([1], "int64")},
+                   {"shape": [4, 4]}, ["Out"])
+        np.testing.assert_array_equal(d["Out"], x)
+
+
+# ---------------------------------------------------------------------------
+# average_accumulates (ModelAverage window state machine)
+# ---------------------------------------------------------------------------
+
+def _avg_acc_oracle(param, s1, s2, s3, num_acc, old_num, num_upd,
+                    average_window, max_avg, min_avg):
+    """Independent numpy re-derivation of average_accumulates_op.h."""
+    k_max = 16384
+    num_upd += 1
+    num_acc += 1
+    s1 = s1 + param
+    if num_upd % k_max == 0:
+        s2, s1 = s2 + s1, np.zeros_like(s1)
+    window = min(max_avg, int(num_upd * average_window))
+    if num_acc >= min_avg and num_acc >= window:
+        s3 = s1 + s2
+        s1, s2 = np.zeros_like(s1), np.zeros_like(s2)
+        old_num, num_acc = num_acc, 0
+    return s1, s2, s3, num_acc, old_num, num_upd
+
+
+class TestAverageAccumulates:
+    def _step(self, param, state, attrs):
+        s1, s2, s3, num_acc, old_num, num_upd = state
+        d = run_op(
+            "average_accumulates",
+            {"param": param, "in_sum_1": s1, "in_sum_2": s2,
+             "in_sum_3": s3,
+             "in_num_accumulates": np.asarray([num_acc], "int64"),
+             "in_old_num_accumulates": np.asarray([old_num], "int64"),
+             "in_num_updates": np.asarray([num_upd], "int64")},
+            attrs,
+            ["out_sum_1", "out_sum_2", "out_sum_3",
+             "out_num_accumulates", "out_old_num_accumulates",
+             "out_num_updates"],
+            {"out_num_accumulates": "int64",
+             "out_old_num_accumulates": "int64",
+             "out_num_updates": "int64"})
+        return (d["out_sum_1"], d["out_sum_2"], d["out_sum_3"],
+                int(d["out_num_accumulates"][0]),
+                int(d["out_old_num_accumulates"][0]),
+                int(d["out_num_updates"][0]))
+
+    def test_accumulate_then_roll(self):
+        attrs = {"average_window": 1.0, "max_average_window": 100,
+                 "min_average_window": 3}
+        z = np.zeros((2, 3), "float32")
+        state = (z, z, z, 0, 0, 0)
+        oracle = (z, z, z, 0, 0, 0)
+        rng = np.random.RandomState(28)
+        for step in range(5):
+            param = rng.uniform(-1, 1, (2, 3)).astype("float32")
+            state = self._step(param, state, attrs)
+            oracle = _avg_acc_oracle(param, *[np.asarray(o) if i < 3
+                                              else o for i, o in
+                                              enumerate(oracle[:3])]
+                                     + list(oracle[3:]),
+                                     1.0, 100, 3)
+            for got, want in zip(state[:3], oracle[:3]):
+                np.testing.assert_allclose(got, want, rtol=1e-5,
+                                           err_msg=f"step {step}")
+            assert state[3:] == tuple(oracle[3:]), f"step {step}"
+        # with min_average_window=3 the window must have rolled at
+        # step 3 (num_acc reached 3): old_num records it
+        assert state[4] >= 3
+
+    def test_no_roll_below_min_window(self):
+        attrs = {"average_window": 1.0, "max_average_window": 100,
+                 "min_average_window": 100}
+        z = np.zeros((4,), "float32")
+        state = (z, z, z, 0, 0, 0)
+        p = np.ones((4,), "float32")
+        for _ in range(3):
+            state = self._step(p, state, attrs)
+        # never rolled: sum_1 keeps accumulating, sum_3 untouched
+        np.testing.assert_allclose(state[0], 3 * p)
+        np.testing.assert_array_equal(state[2], z)
+        assert state[3] == 3 and state[4] == 0 and state[5] == 3
