@@ -1,0 +1,71 @@
+/* Pure-C inference host over the paddle_tpu C ABI — the counterpart of
+ * the reference's Go binding (/root/reference/go/paddle/predictor.go:1,
+ * which wraps /root/reference/paddle/fluid/inference/capi/c_api.cc via
+ * cgo) and its R wrapper (/root/reference/r/example/).  The host source
+ * contains no Python: the runtime is embedded behind PT_Init.
+ *
+ * Build (libpaddle_tpu_c.so built with embed=True):
+ *   gcc -O2 predictor_demo.c -L<libdir> -lpaddle_tpu_c \
+ *       -Wl,-rpath,<libdir> $(python3-config --embed --ldflags) -o demo
+ * Run:
+ *   ./demo <repo_path> <model_prefix> <input.f32>
+ * reads a raw little-endian f32 NCHW image (1x1x28x28) and prints each
+ * output logit as "out[i] = v".
+ */
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+typedef struct PT_Predictor PT_Predictor;
+extern int PT_Init(const char* repo_path);
+extern PT_Predictor* PT_NewPredictor(const char* model_prefix);
+extern void PT_DeletePredictor(PT_Predictor* p);
+extern const char* PT_GetLastError(void);
+extern int PT_PredictorRun(PT_Predictor* p, const float* data,
+                           const int64_t* shape, int ndim, float* out_buf,
+                           int64_t out_capacity, int64_t* out_count,
+                           int64_t* out_shape, int* out_ndim);
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    fprintf(stderr, "usage: %s <repo_path> <model_prefix> <input.f32>\n",
+            argv[0]);
+    return 2;
+  }
+  if (PT_Init(argv[1]) != 0) {
+    fprintf(stderr, "PT_Init: %s\n", PT_GetLastError());
+    return 1;
+  }
+  PT_Predictor* pred = PT_NewPredictor(argv[2]);
+  if (!pred) {
+    fprintf(stderr, "PT_NewPredictor: %s\n", PT_GetLastError());
+    return 1;
+  }
+
+  const int64_t shape[4] = {1, 1, 28, 28};
+  const int64_t n_in = shape[0] * shape[1] * shape[2] * shape[3];
+  float* input = (float*)malloc((size_t)n_in * sizeof(float));
+  FILE* f = fopen(argv[3], "rb");
+  if (!f || fread(input, sizeof(float), (size_t)n_in, f) != (size_t)n_in) {
+    fprintf(stderr, "could not read %lld floats from %s\n",
+            (long long)n_in, argv[3]);
+    return 1;
+  }
+  fclose(f);
+
+  float out[4096];
+  int64_t out_count = 0, out_shape[8];
+  int out_ndim = 0;
+  int rc = PT_PredictorRun(pred, input, shape, 4, out, 4096, &out_count,
+                           out_shape, &out_ndim);
+  if (rc != 0) {
+    fprintf(stderr, "PT_PredictorRun rc=%d: %s\n", rc, PT_GetLastError());
+    return 1;
+  }
+  for (int64_t i = 0; i < out_count; ++i) {
+    printf("out[%lld] = %.6f\n", (long long)i, out[i]);
+  }
+  free(input);
+  PT_DeletePredictor(pred);
+  return 0;
+}
