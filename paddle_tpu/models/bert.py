@@ -385,7 +385,8 @@ def build_pretrain_step(model: BertForPretraining,
 
 def build_pipeline_pretrain_step(model: BertForPretraining, mesh,
                                  num_microbatches=4, axis="pp",
-                                 learning_rate=1e-3):
+                                 learning_rate=1e-3, dp_axis=None,
+                                 remat_stages=False):
     """BERT pretraining over a NON-UNIFORM pipeline: embedding stage ->
     n_stages of encoder blocks (params sharded over `axis`) -> pooler+
     heads stage (VERDICT r3 task 9; reference behavior: PipelineTrainer/
@@ -462,7 +463,8 @@ def build_pipeline_pretrain_step(model: BertForPretraining, mesh,
     from ..parallel.pipeline import gpipe_model
 
     run = gpipe_model(mesh, first_fn, block_fn, last_fn,
-                      num_microbatches, axis=axis)
+                      num_microbatches, axis=axis, dp_axis=dp_axis,
+                      remat_stages=remat_stages)
     criterion = BertPretrainingCriterion(cfg.vocab_size)
 
     def loss_fn(params, batch):
