@@ -44,6 +44,10 @@ def _build_and_load():
     lib.ptq_pop.restype = ctypes.c_long
     lib.ptq_pop.argtypes = [ctypes.c_void_p,
                             ctypes.POINTER(ctypes.POINTER(ctypes.c_char))]
+    lib.ptq_pop_timed.restype = ctypes.c_long
+    lib.ptq_pop_timed.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_char)),
+        ctypes.c_long]
     lib.ptq_free_buf.argtypes = [ctypes.POINTER(ctypes.c_char)]
     lib.ptq_close.argtypes = [ctypes.c_void_p]
     lib.ptq_size.restype = ctypes.c_int
@@ -87,11 +91,19 @@ class BlockingQueue:
         rc = self._l.ptq_push(self._q, payload, len(payload))
         return rc == 0
 
-    def pop(self):
-        """Blocks; returns the object or raises StopIteration when the
-        queue is closed and drained."""
+    def pop(self, timeout=None):
+        """Blocks; returns the object, raises StopIteration when the
+        queue is closed and drained, or TimeoutError when `timeout`
+        seconds pass with the queue still open and empty."""
         out = ctypes.POINTER(ctypes.c_char)()
-        size = self._l.ptq_pop(self._q, ctypes.byref(out))
+        if timeout is None:
+            size = self._l.ptq_pop(self._q, ctypes.byref(out))
+        else:
+            size = self._l.ptq_pop_timed(self._q, ctypes.byref(out),
+                                         int(timeout * 1000))
+            if size == -2:
+                raise TimeoutError(
+                    f"BlockingQueue.pop: no data for {timeout}s")
         if size < 0:
             raise StopIteration
         try:

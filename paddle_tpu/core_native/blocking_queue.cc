@@ -25,6 +25,7 @@
 //   int   ptq_capacity(void* q)
 //   void  ptq_destroy(void* q)
 
+#include <chrono>
 #include <condition_variable>
 #include <cstdlib>
 #include <cstring>
@@ -80,6 +81,29 @@ long ptq_pop(void* handle, char** out) {
   auto* q = static_cast<Queue*>(handle);
   std::unique_lock<std::mutex> lock(q->mu);
   q->not_empty.wait(lock, [q] { return q->closed || !q->items.empty(); });
+  if (q->items.empty()) {
+    *out = nullptr;
+    return -1;  // closed and drained
+  }
+  Buf b = q->items.front();
+  q->items.pop_front();
+  lock.unlock();
+  q->not_full.notify_one();
+  *out = b.data;
+  return b.size;
+}
+
+long ptq_pop_timed(void* handle, char** out, long timeout_ms) {
+  // like ptq_pop but bounded: -2 = timed out (queue still open)
+  auto* q = static_cast<Queue*>(handle);
+  std::unique_lock<std::mutex> lock(q->mu);
+  bool ready = q->not_empty.wait_for(
+      lock, std::chrono::milliseconds(timeout_ms),
+      [q] { return q->closed || !q->items.empty(); });
+  if (!ready) {
+    *out = nullptr;
+    return -2;
+  }
   if (q->items.empty()) {
     *out = nullptr;
     return -1;  // closed and drained

@@ -96,6 +96,18 @@ class Tensor:
         return self._value.shape[0]
 
     def __bool__(self):
+        import jax
+
+        if isinstance(self._value, jax.core.Tracer):
+            raise TypeError(
+                "bool() on a Tensor inside jit tracing: data-dependent "
+                "Python control flow cannot be traced directly. Use "
+                "paddle_tpu.jit.to_static on a source-available "
+                "function/Layer (the dy2static pass converts if/while "
+                "to lax.cond/while_loop), or build the branch with "
+                "fluid.layers.cond / fluid.layers.while_loop. Note: "
+                "dy2static needs inspect.getsource to work — code "
+                "defined in a REPL/stdin has no source to convert.")
         return bool(self.numpy())
 
     def __float__(self):

@@ -280,13 +280,27 @@ class DataLoader:
                  return_list=True, batch_sampler=None, batch_size=1,
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
-                 use_shared_memory=False, timeout=0, worker_init_fn=None):
+                 use_shared_memory=False, timeout=0, worker_init_fn=None,
+                 use_process_workers=None):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = int(num_workers)
         self.use_buffer_reader = use_buffer_reader
         self.prefetch_factor = max(2, int(prefetch_factor))
         self.worker_init_fn = worker_init_fn
+        # reference reader.py timeout semantics: 0 = block forever;
+        # >0 = a worker producing nothing for that many seconds is an
+        # error (catches ALIVE-but-wedged children, e.g. jax touched
+        # after fork, that liveness checks cannot see)
+        self.timeout = float(timeout or 0)
+        if use_process_workers is None:
+            # reference parity: num_workers>0 means worker PROCESSES
+            # (fluid/reader.py:792); threads remain the fallback where
+            # fork is unavailable
+            import multiprocessing as mp
+
+            use_process_workers = "fork" in mp.get_all_start_methods()
+        self.use_process_workers = bool(use_process_workers)
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
@@ -305,19 +319,165 @@ class DataLoader:
         return len(self.batch_sampler)
 
     # -- iteration paths ---------------------------------------------------
+    def _iterable_shard_batches(self, wid, num_workers):
+        """Collated batches of this worker's shard of an
+        IterableDataset (round-robin by sample index; the single shared
+        accumulate/flush implementation for the sync, thread and
+        process paths)."""
+        batch = []
+        for i, sample in enumerate(self.dataset):
+            if i % num_workers != wid:
+                continue
+            batch.append(sample)
+            if len(batch) == self.batch_size:
+                yield self.collate_fn(batch)
+                batch = []
+        if batch and not self.drop_last:
+            yield self.collate_fn(batch)
+
     def _batches_sync(self):
         if self._iterable_mode:
-            batch = []
-            for sample in self.dataset:
-                batch.append(sample)
-                if len(batch) == self.batch_size:
-                    yield self.collate_fn(batch)
-                    batch = []
-            if batch and not self.drop_last:
-                yield self.collate_fn(batch)
+            yield from self._iterable_shard_batches(0, 1)
         else:
             for idxs in self.batch_sampler:
                 yield self.collate_fn([self.dataset[i] for i in idxs])
+
+    def _batches_procs(self):
+        """Worker PROCESSES (reference default: fluid/reader.py:792 and
+        fluid/dataloader/dataloader_iter.py spawn _worker_loop
+        processes over index/data queues).  fork-context children
+        inherit the dataset/collate_fn by COW — no pickling of user
+        objects — compute batches in parallel free of the GIL, and send
+        them over an mp.Queue; a parent pump thread moves them into the
+        native BlockingQueue so the consumer side is identical to the
+        thread path ('processes-via-thread-pumps', core_native).
+
+        Worker code must stay host-side (numpy), like the reference's
+        workers: forking a process with a live XLA runtime is safe only
+        as long as the child never touches jax."""
+        import multiprocessing as mp
+
+        from ..core_native import BlockingQueue
+
+        ctx = mp.get_context("fork")
+        cap = self.prefetch_factor * self.num_workers
+        mpq = ctx.Queue(maxsize=cap)
+        if self._iterable_mode:
+            work = None
+        else:
+            work = list(self.batch_sampler)
+
+        def to_host(batch):
+            # mp.Queue pickling must see host arrays, not device
+            # buffers: a dataset/collate that produced jax arrays gets
+            # converted here (they are host-backed on CPU anyway)
+            import jax
+
+            return jax.tree_util.tree_map(
+                lambda x: np.asarray(x) if isinstance(x, jax.Array)
+                else x, batch)
+
+        def child(wid):
+            global _WORKER_INFO
+            _WORKER_INFO = WorkerInfo(wid, self.num_workers,
+                                      self.dataset)
+            try:
+                if self.worker_init_fn is not None:
+                    self.worker_init_fn(wid)
+                if self._iterable_mode:
+                    gen = self._iterable_shard_batches(
+                        wid, self.num_workers)
+                else:
+                    gen = (self.collate_fn(
+                        [self.dataset[i] for i in idxs])
+                        for idxs in work[wid::self.num_workers])
+                for b in gen:
+                    mpq.put(("b", to_host(b)))
+                mpq.put(("end", wid))
+            except BaseException:  # noqa: BLE001 - surface in parent
+                import traceback
+
+                mpq.put(("err", traceback.format_exc()))
+
+        procs = [ctx.Process(target=child, args=(w,), daemon=True)
+                 for w in range(self.num_workers)]
+        for p in procs:
+            p.start()
+
+        q = BlockingQueue(cap)
+        err_box = []
+
+        def pump():
+            import queue as _queue
+            import sys as _sys
+            import time as _time
+
+            ended = set()
+            idle_since = _time.monotonic()
+            warned = False
+            while len(ended) < self.num_workers:
+                try:
+                    kind, payload = mpq.get(timeout=1.0)
+                except _queue.Empty:
+                    # any worker gone without its "end"/"err" sentinel
+                    # (SIGKILL, OOM, os._exit — exitcode 0 included)
+                    # must surface as an error, not a blocked q.pop()
+                    dead = [(i, p) for i, p in enumerate(procs)
+                            if i not in ended and not p.is_alive()]
+                    if dead:
+                        err_box.append(
+                            "worker process(es) died without result: "
+                            + ", ".join(f"worker={i} pid={p.pid} "
+                                        f"exitcode={p.exitcode}"
+                                        for i, p in dead))
+                        break
+                    idle = _time.monotonic() - idle_since
+                    if self.timeout > 0 and idle > self.timeout:
+                        err_box.append(
+                            f"worker timed out: no data for "
+                            f"{self.timeout:.0f}s (DataLoader timeout=)")
+                        break
+                    if self.timeout == 0 and idle > 120 and not warned:
+                        warned = True
+                        print(
+                            "DataLoader warning: process workers alive "
+                            "but silent for 120s — if the dataset/"
+                            "collate touches jax, fork workers can "
+                            "wedge (use use_process_workers=False or "
+                            "set timeout=)", file=_sys.stderr)
+                    continue
+                idle_since = _time.monotonic()
+                if kind == "end":
+                    ended.add(payload)
+                elif kind == "err":
+                    err_box.append(payload)
+                    break
+                else:
+                    if not q.push(payload):
+                        break  # consumer gone (queue closed): stop
+            q.close()
+
+        t = threading.Thread(target=pump, daemon=True)
+        t.start()
+        try:
+            while True:
+                try:
+                    yield q.pop()
+                except StopIteration:
+                    break
+            if err_box:
+                raise RuntimeError(
+                    "DataLoader worker process failed:\n" + err_box[0])
+        finally:
+            # close FIRST: the pump's q.push fails fast on a closed
+            # queue instead of blocking on a full one (early `break`
+            # out of the loader must not stall)
+            q.close()
+            for p in procs:
+                p.terminate()
+            for p in procs:
+                p.join(timeout=5)
+            t.join(timeout=5)
 
     def _batches_workers(self):
         from ..core_native import BlockingQueue
@@ -333,16 +493,9 @@ class DataLoader:
                 self.worker_init_fn(wid)
             try:
                 if self._iterable_mode:
-                    batch = []
-                    for i, sample in enumerate(self.dataset):
-                        if i % self.num_workers != wid:
-                            continue
-                        batch.append(sample)
-                        if len(batch) == self.batch_size:
-                            q.push(self.collate_fn(batch))
-                            batch = []
-                    if batch and not self.drop_last:
-                        q.push(self.collate_fn(batch))
+                    for b in self._iterable_shard_batches(
+                            wid, self.num_workers):
+                        q.push(b)
                 else:
                     while True:
                         with lock:
@@ -363,15 +516,24 @@ class DataLoader:
             t.start()
         while True:
             try:
-                yield q.pop()
+                yield q.pop(timeout=self.timeout or None)
             except StopIteration:
                 break
+            except TimeoutError:
+                q.close()
+                raise RuntimeError(
+                    f"DataLoader worker timed out: no data for "
+                    f"{self.timeout:.0f}s (thread workers; a dataset "
+                    "__getitem__ is blocked)")
         for t in threads:
             t.join()
 
     def __iter__(self):
-        gen = (self._batches_workers() if self.num_workers > 0
-               else self._batches_sync())
+        if self.num_workers > 0:
+            gen = (self._batches_procs() if self.use_process_workers
+                   else self._batches_workers())
+        else:
+            gen = self._batches_sync()
         if not self.use_buffer_reader:
             yield from gen
             return
@@ -395,8 +557,25 @@ class DataLoader:
             yield prev
 
 
+class WorkerInfo:
+    """Per-worker metadata (reference: fluid/dataloader/worker.py
+    WorkerInfo), available inside process workers via
+    get_worker_info()."""
+
+    def __init__(self, wid, num_workers, dataset):
+        self.id = wid
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+_WORKER_INFO = None
+
+
 def get_worker_info():
-    return None  # thread workers share the dataset object
+    """Inside a worker process: that worker's WorkerInfo; in the main
+    process (and in thread workers, which share the dataset object):
+    None."""
+    return _WORKER_INFO
 
 
 def _dataloader_from_generator(feed_list=None, capacity=16,

@@ -6,10 +6,13 @@ Reference: python/paddle/fluid/dygraph/jit.py (`@declarative`,
 program_translator.py:711), whose converted programs execute via the
 `run_program` op (operators/run_program_op.cc:22).
 
-TPU-native re-design: no AST rewriting at all.  The eager engine records
-pure jax calls, so `jax.jit` IS the translator (SURVEY.md §7 step 8
-"dy2static equivalent is mostly free").  The machinery here is
-*functionalization* of stateful Layers:
+TPU-native re-design: `jax.jit` IS the translator for straight-line
+code (SURVEY.md §7 step 8 "dy2static equivalent is mostly free"); for
+data-dependent Python `if`/`while` a minimal AST pass (dy2static.py)
+rewrites the construct to dispatch through lax.cond/while_loop when
+the predicate is traced — the role of the reference's
+dygraph_to_static transformer stack.  The other half of the machinery
+is *functionalization* of stateful Layers:
 
   functional_state(layer)           -> {name: jnp value} pytree
   functional_call(layer, state, xs) -> (outputs, new_buffer_state)
@@ -108,21 +111,43 @@ class TracedLayer:
     visible without retracing.
     """
 
-    def __init__(self, layer, training=False):
+    def __init__(self, layer, training=False, convert_control_flow=True):
         import jax
 
         self._layer = layer
         self._training = training
         self._names = [n for n, _ in _named_state_tensors(layer)]
+        conv_forward = None
+        if convert_control_flow:
+            # dy2static: rewrite data-dependent Python if/while in
+            # forward to lax.cond/while_loop dispatch (dy2static.py);
+            # source-less forwards (C extensions, exec) stay trace-only
+            from .dy2static import convert_layer
+
+            try:
+                conv_forward = convert_layer(layer)
+            except (ValueError, OSError, SyntaxError):
+                pass
 
         def fwd(state, *args):
             was = layer.training
             layer.training = training
             for sub in layer.sublayers():
                 sub.training = training
+            # scope the converted forward to THIS call: plain eager use
+            # of the layer keeps the user's original code
+            had_inst_fwd = "forward" in layer.__dict__
+            prev_fwd = layer.__dict__.get("forward")
+            if conv_forward is not None:
+                layer.forward = conv_forward
             try:
                 out, _ = functional_call(layer, state, *args)
             finally:
+                if conv_forward is not None:
+                    if had_inst_fwd:
+                        layer.forward = prev_fwd
+                    else:
+                        layer.__dict__.pop("forward", None)
                 layer.training = was
                 for sub in layer.sublayers():
                     sub.training = was
@@ -162,6 +187,13 @@ def to_static(layer_or_fn=None, input_spec=None, **kwargs):
             return TracedLayer(target, training=target.training)
 
         import jax
+
+        from .dy2static import convert_to_static
+
+        try:
+            target = convert_to_static(target)
+        except (ValueError, OSError, SyntaxError):
+            pass  # trace-only fallback (no source / closure)
 
         jitted_box = {}
 
@@ -205,9 +237,29 @@ def save(layer, path, input_spec=None, **configs):
         raise ValueError("jit.save requires input_spec (shapes/dtypes or "
                          "example arrays)")
     target = layer._layer if isinstance(layer, TracedLayer) else layer
-    return save_inference_model(path, target, input_spec,
-                                fold_params=configs.get("fold_params",
-                                                        True))
+    # export traces forward under jit: scope the dy2static-converted
+    # forward over the export the same way TracedLayer.__call__ does
+    from .dy2static import convert_layer
+
+    conv = None
+    try:
+        conv = convert_layer(target)
+    except (ValueError, OSError, SyntaxError):
+        pass
+    had = "forward" in target.__dict__
+    prev = target.__dict__.get("forward")
+    if conv is not None:
+        target.forward = conv
+    try:
+        return save_inference_model(path, target, input_spec,
+                                    fold_params=configs.get("fold_params",
+                                                            True))
+    finally:
+        if conv is not None:
+            if had:
+                target.forward = prev
+            else:
+                target.__dict__.pop("forward", None)
 
 
 def load(path, **configs):

@@ -1,0 +1,614 @@
+"""dy2static: data-dependent Python control flow -> lax.cond/while_loop.
+
+Reference: the dygraph_to_static AST transpiler
+(/root/reference/python/paddle/fluid/dygraph/dygraph_to_static/
+program_translator.py:711 and the per-construct transformers in
+ifelse_transformer.py / loop_transformer.py), which rewrites Python
+`if`/`while` on tensor values into `cond` / `while` ops in a
+ProgramDesc.
+
+TPU-native re-design: the same source-rewrite idea, but the target is
+jax, not a ProgramDesc.  `convert_to_static(fn)` rewrites the
+function's AST so each `if`/`while` dispatches through a runtime
+helper; the helper checks the PREDICATE AT RUNTIME — a traced value
+takes the functional `lax.cond`/`lax.while_loop` path (compilable
+under jit), a concrete value takes ordinary Python.  So one converted
+function serves both eager and jit, like the reference's
+ProgramTranslator.enable() toggle but without a second program format.
+
+Supported subset (the reference's transformers cover more; everything
+outside the subset is left untouched — plain Python semantics, which
+under jit produces jax's standard concretization error):
+  * `if`/`elif`/`else` whose branches only bind variables
+    (Assign/AugAssign, no return/break/continue) -> branch functions
+    over the assigned-variable set.
+  * `if`/`else` where BOTH branches end in `return` (and contain no
+    other control flow) -> `return cond(pred, ...)`.
+  * `while` whose body only binds variables -> while_loop over the
+    loop-carried set.
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+import types
+
+
+class _Undef:
+    """Sentinel for names unbound before a converted branch (the
+    reference uses __py_ctrl_var sentinels the same way).  Reaching a
+    lax.cond with one branch returning _UNDEF is a structure mismatch
+    and raises there with both branch structures shown.  Any USE of the
+    sentinel (a body-local loop temp read after a traced while, etc.)
+    raises immediately instead of flowing on as a bogus value."""
+
+    def __repr__(self):
+        return "<undefined before converted branch>"
+
+    def _die(self, *a, **k):
+        raise NameError(
+            "dy2static: this variable has no defined value here — it "
+            "is bound only inside a converted branch/loop body (its "
+            "post-loop value is unavailable under jit tracing); "
+            "restructure so the value is loop-carried, or use "
+            "fluid.layers.while_loop")
+
+    __bool__ = __float__ = __int__ = __len__ = __iter__ = _die
+    __add__ = __radd__ = __sub__ = __rsub__ = __mul__ = __rmul__ = _die
+    __truediv__ = __rtruediv__ = __call__ = __getitem__ = _die
+    __lt__ = __le__ = __gt__ = __ge__ = _die
+
+    def __getattr__(self, name):
+        if name.startswith("__"):
+            raise AttributeError(name)
+        self._die()
+
+
+_UNDEF = _Undef()
+
+
+def _tensor_mod():
+    from ..fluid.dygraph import varbase
+
+    return varbase
+
+
+def _unwrap_pred(pred):
+    Tensor = _tensor_mod().Tensor
+    v = pred._value if isinstance(pred, Tensor) else pred
+    if hasattr(v, "reshape") and getattr(v, "shape", None) is not None:
+        import jax.numpy as jnp
+
+        return jnp.asarray(v).reshape(())
+    return v
+
+
+def _is_traced(v):
+    import jax
+
+    return isinstance(v, jax.core.Tracer)
+
+
+def _is_dynamic(v):
+    """A value that can ride through cond/while_loop as an operand."""
+    import jax
+    import numpy as np
+
+    return isinstance(v, (jax.Array, jax.core.Tracer, np.ndarray,
+                          np.generic))
+
+
+def _is_tensor_leaf(o):
+    return isinstance(o, _tensor_mod().Tensor)
+
+
+def _deep_unwrap(o):
+    """Tensor leaves (at any pytree depth) -> raw values."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda x: x._value if _is_tensor_leaf(x) else x, o,
+        is_leaf=_is_tensor_leaf)
+
+
+def _deep_tags(o):
+    import jax
+
+    return jax.tree_util.tree_map(_is_tensor_leaf, o,
+                                  is_leaf=_is_tensor_leaf)
+
+
+def _deep_rewrap(vals, tags):
+    import jax
+
+    Tensor = _tensor_mod().Tensor
+    return jax.tree_util.tree_map(
+        lambda v, t: Tensor(v) if t and not isinstance(v, Tensor) else v,
+        vals, tags)
+
+
+def _deep_wrap_arrays(o):
+    """Array leaves (tracer results) -> Tensors, at any depth — under
+    trace, branch results of tensor ops are Tensors in eager."""
+    import jax
+
+    Tensor = _tensor_mod().Tensor
+    return jax.tree_util.tree_map(
+        lambda x: Tensor(x)
+        if isinstance(x, (jax.Array, jax.core.Tracer)) else x, o)
+
+
+def _var_is_dynamic(deep_val):
+    """A branch variable is a cond operand iff it has at least one
+    array leaf and every leaf is traceable (arrays or numbers jax will
+    convert).  Python numbers/strings/objects stay STATIC for `if`
+    (used as shapes, ranges, flags — tracing them would break that);
+    both branches see the pre-branch value and any rebinding surfaces
+    via the branch RETURN, which jax converts."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(deep_val)
+    if not leaves or not any(_is_dynamic(v) for v in leaves):
+        return False
+    return all(_is_dynamic(v)
+               or isinstance(v, (bool, int, float, complex))
+               for v in leaves)
+
+
+def _pt_cond(pred, true_fn, false_fn, args):
+    """Runtime dispatch for a converted `if` (assignment form)."""
+    v = _unwrap_pred(pred)
+    if not _is_traced(v):
+        return true_fn(*args) if bool(v) else false_fn(*args)
+    from jax import lax
+
+    deep = [_deep_unwrap(o) for o in args]
+    tags = [_deep_tags(o) for o in args]
+    dyn_idx = [i for i, d in enumerate(deep) if _var_is_dynamic(d)]
+    dyn_vals = tuple(deep[i] for i in dyn_idx)
+    static = list(args)
+
+    def branch(fn):
+        def run(vs):
+            merged = list(static)
+            for i, val in zip(dyn_idx, vs):
+                merged[i] = _deep_rewrap(val, tags[i])
+            out = fn(*merged)
+            return tuple(_deep_unwrap(o) for o in out)
+
+        return run
+
+    out_vals = lax.cond(v, branch(true_fn), branch(false_fn), dyn_vals)
+    return tuple(_deep_wrap_arrays(o) for o in out_vals)
+
+
+def _pt_while(cond_fn, body_fn, loop_vars):
+    """Runtime dispatch for a converted `while`.
+
+    Loop vars whose initial value is _UNDEF are body-local temporaries
+    (bound on every iteration before use): the Python path just runs
+    them; the traced path keeps them OUT of the while_loop carry and
+    re-feeds _UNDEF each tick — their post-loop value is then
+    unavailable under trace, which only matters if the converted code
+    reads them after the loop (a NameError under plain Python when the
+    loop runs zero times, so no correct program relies on it)."""
+    Tensor = _tensor_mod().Tensor
+    vals = [_deep_unwrap(o) for o in loop_vars]
+    probe = _unwrap_pred(cond_fn(*loop_vars))
+    import jax
+
+    traced = _is_traced(probe) or any(
+        _is_traced(v) for d in vals for v in jax.tree_util.tree_leaves(d))
+    if not traced:
+        vars_ = tuple(loop_vars)
+        while bool(_unwrap_pred(cond_fn(*vars_))):
+            vars_ = tuple(body_fn(*vars_))
+        return vars_
+    import jax.numpy as jnp
+    from jax import lax
+
+    # loop-carried values must all be traceable (a Python-int counter
+    # is loop state, so numbers are promoted to arrays — unlike `if`)
+    tags = [_deep_tags(o) for o in loop_vars]
+    carry_idx, carried = [], []
+    for i, (o, d) in enumerate(zip(loop_vars, vals)):
+        if isinstance(o, _Undef):
+            continue  # body-local temp: not part of the carry
+        d = jax.tree_util.tree_map(
+            lambda x: jnp.asarray(x)
+            if isinstance(x, (bool, int, float, complex)) else x, d)
+        bad = [x for x in jax.tree_util.tree_leaves(d)
+               if not _is_dynamic(x)]
+        if bad:
+            raise TypeError(
+                "dy2static while: loop-carried value of type "
+                f"{type(bad[0]).__name__!r} cannot be traced; "
+                "restructure or use fluid.layers.while_loop")
+        carry_idx.append(i)
+        carried.append(d)
+
+    def expand(vs):
+        full = [_UNDEF] * len(loop_vars)
+        for i, v in zip(carry_idx, vs):
+            full[i] = _deep_rewrap(v, tags[i])
+        return full
+
+    def cond(vs):
+        return _unwrap_pred(cond_fn(*expand(vs)))
+
+    def body(vs):
+        out = body_fn(*expand(vs))
+        return tuple(
+            jax.tree_util.tree_map(
+                lambda x: jnp.asarray(x)
+                if isinstance(x, (bool, int, float, complex)) else x,
+                _deep_unwrap(out[i]))
+            for i in carry_idx)
+
+    out_vals = lax.while_loop(cond, body, tuple(carried))
+    result = [_UNDEF] * len(loop_vars)
+    for i, v in zip(carry_idx, out_vals):
+        result[i] = _deep_wrap_arrays(v)
+    return tuple(result)
+
+
+def _collect_targets(t, names, mutations):
+    """Simple-Name (and tuple/list/star destructured) targets BIND a
+    local; Attribute/Subscript targets MUTATE an object — a converted
+    branch would execute both mutations at trace time, so their
+    presence makes the construct unconvertible."""
+    if isinstance(t, ast.Name):
+        names.append(t.id)
+    elif isinstance(t, (ast.Tuple, ast.List)):
+        for e in t.elts:
+            _collect_targets(e, names, mutations)
+    elif isinstance(t, ast.Starred):
+        _collect_targets(t.value, names, mutations)
+    else:  # Attribute / Subscript
+        mutations.append(t)
+
+
+def _scan_bindings(stmts):
+    """(bound_names, has_mutation) for Assign/AugAssign/AnnAssign at
+    any depth inside `stmts`, excluding nested function/class scopes."""
+    names, mutations = [], []
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, node):  # new scope: stop
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_ClassDef(self, node):
+            pass
+
+        def visit_Lambda(self, node):
+            pass
+
+        def visit_Assign(self, node):
+            for t in node.targets:
+                _collect_targets(t, names, mutations)
+            self.generic_visit(node.value)
+
+        def visit_AugAssign(self, node):
+            _collect_targets(node.target, names, mutations)
+            self.generic_visit(node.value)
+
+        def visit_AnnAssign(self, node):
+            if node.value is not None:
+                _collect_targets(node.target, names, mutations)
+                self.generic_visit(node.value)
+
+    for s in stmts:
+        V().visit(s)
+    out = []
+    for n in names:
+        if n not in out:
+            out.append(n)
+    return out, bool(mutations)
+
+
+def _assigned_names(stmts):
+    return _scan_bindings(stmts)[0]
+
+
+def _has_disallowed_flow(stmts, allow_tail_return=False):
+    """True if `stmts` contain return/break/continue (outside nested
+    scopes).  With allow_tail_return, a single Return as the LAST
+    top-level statement is tolerated (the both-branches-return form)."""
+    flow = []
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, node):
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_ClassDef(self, node):
+            pass
+
+        def visit_Lambda(self, node):
+            pass
+
+        def visit_Return(self, node):
+            flow.append(node)
+
+        def visit_Break(self, node):
+            flow.append(node)
+
+        def visit_Continue(self, node):
+            flow.append(node)
+
+    for s in stmts:
+        V().visit(s)
+    if not flow:
+        return False
+    if allow_tail_return and len(flow) == 1 \
+            and isinstance(flow[0], ast.Return) \
+            and stmts and stmts[-1] is flow[0]:
+        return False
+    return True
+
+
+def _name(id_, ctx):
+    return ast.Name(id=id_, ctx=ctx)
+
+
+def _load(id_):
+    return _name(id_, ast.Load())
+
+
+def _store(id_):
+    return _name(id_, ast.Store())
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    """Rewrites the supported if/while forms; leaves the rest alone."""
+
+    def __init__(self, func_def=None):
+        self._n = 0
+        # names declared global/nonlocal anywhere in the function: the
+        # locals().get guard cannot see them, so constructs assigning
+        # them are left unconverted
+        self._scope_escapes = set()
+        if func_def is not None:
+            for n in ast.walk(func_def):
+                if isinstance(n, (ast.Global, ast.Nonlocal)):
+                    self._scope_escapes.update(n.names)
+
+    def _uid(self):
+        self._n += 1
+        return self._n
+
+    # -- if ---------------------------------------------------------------
+    def visit_If(self, node):
+        self.generic_visit(node)
+        body, orelse = node.body, node.orelse
+
+        both_return = (
+            body and isinstance(body[-1], ast.Return)
+            and orelse and isinstance(orelse[-1], ast.Return)
+            and not _has_disallowed_flow(body[:-1])
+            and not _has_disallowed_flow(orelse[:-1]))
+        if both_return:
+            return self._rewrite_if_return(node)
+
+        if _has_disallowed_flow(body) or _has_disallowed_flow(orelse):
+            return node  # unsupported form: leave as plain Python
+        return self._rewrite_if_assign(node)
+
+    def _rewrite_if_return(self, node):
+        k = self._uid()
+        tname, fname = f"_pt_true_{k}", f"_pt_false_{k}"
+
+        def mk(fn_name, stmts):
+            stmts = list(stmts)
+            ret = stmts.pop()
+            stmts.append(ast.Return(value=(ret.value or
+                                           ast.Constant(value=None))))
+            return ast.FunctionDef(
+                name=fn_name,
+                args=ast.arguments(posonlyargs=[], args=[], vararg=None,
+                                   kwonlyargs=[], kw_defaults=[],
+                                   kwarg=None, defaults=[]),
+                body=stmts, decorator_list=[])
+
+        call = ast.Call(
+            func=_load("_pt_cond"),
+            args=[node.test,
+                  ast.Lambda(
+                      args=ast.arguments(posonlyargs=[], args=[],
+                                         vararg=None, kwonlyargs=[],
+                                         kw_defaults=[], kwarg=None,
+                                         defaults=[]),
+                      body=ast.Tuple(
+                          elts=[ast.Call(func=_load(tname), args=[],
+                                         keywords=[])],
+                          ctx=ast.Load())),
+                  ast.Lambda(
+                      args=ast.arguments(posonlyargs=[], args=[],
+                                         vararg=None, kwonlyargs=[],
+                                         kw_defaults=[], kwarg=None,
+                                         defaults=[]),
+                      body=ast.Tuple(
+                          elts=[ast.Call(func=_load(fname), args=[],
+                                         keywords=[])],
+                          ctx=ast.Load())),
+                  ast.Tuple(elts=[], ctx=ast.Load())],
+            keywords=[])
+        ret = ast.Return(value=ast.Subscript(
+            value=call, slice=ast.Constant(value=0), ctx=ast.Load()))
+        return [mk(tname, node.body), mk(fname, node.orelse), ret]
+
+    def _rewrite_if_assign(self, node):
+        k = self._uid()
+        body_names, body_mut = _scan_bindings(node.body)
+        else_names, else_mut = _scan_bindings(node.orelse)
+        if body_mut or else_mut:
+            # attribute/subscript mutation in a branch: converting
+            # would run BOTH mutations at trace time — leave as plain
+            # Python (loud concretization error if tensor-dependent)
+            return node
+        assigned = sorted(set(body_names) | set(else_names))
+        if not assigned:
+            return node  # nothing carried: plain Python is fine
+        if self._scope_escapes & set(assigned):
+            return node  # global/nonlocal rebinding: unconvertible
+        tname, fname = f"_pt_true_{k}", f"_pt_false_{k}"
+
+        def mk(fn_name, stmts):
+            body = list(stmts) or [ast.Pass()]
+            body.append(ast.Return(value=ast.Tuple(
+                elts=[_load(n) for n in assigned], ctx=ast.Load())))
+            return ast.FunctionDef(
+                name=fn_name,
+                args=ast.arguments(
+                    posonlyargs=[],
+                    args=[ast.arg(arg=n) for n in assigned],
+                    vararg=None, kwonlyargs=[], kw_defaults=[],
+                    kwarg=None, defaults=[]),
+                body=body, decorator_list=[])
+
+        # names possibly unbound before the if: default them to _UNDEF
+        guards = [
+            ast.Assign(
+                targets=[_store(n)],
+                value=ast.Call(
+                    func=ast.Attribute(value=ast.Call(
+                        func=_load("locals"), args=[], keywords=[]),
+                        attr="get", ctx=ast.Load()),
+                    args=[ast.Constant(value=n), _load("_PT_UNDEF")],
+                    keywords=[]))
+            for n in assigned]
+        # locals().get can't see names bound later in the SAME call we
+        # generate, so guards are emitted as `n = locals().get('n',
+        # _PT_UNDEF)` BEFORE the call — safe and idempotent
+        call = ast.Call(
+            func=_load("_pt_cond"),
+            args=[node.test, _load(tname), _load(fname),
+                  ast.Tuple(elts=[_load(n) for n in assigned],
+                            ctx=ast.Load())],
+            keywords=[])
+        assign = ast.Assign(
+            targets=[ast.Tuple(elts=[_store(n) for n in assigned],
+                               ctx=ast.Store())],
+            value=call)
+        return guards + [mk(tname, node.body),
+                         mk(fname, node.orelse), assign]
+
+    # -- while ------------------------------------------------------------
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or _has_disallowed_flow(node.body):
+            return node
+        carried, has_mut = _scan_bindings(node.body)
+        if not carried or has_mut \
+                or (self._scope_escapes & set(carried)):
+            return node
+        k = self._uid()
+        # names possibly unbound before the loop (body-local temps):
+        # default to _PT_UNDEF — the runtime keeps them out of the
+        # traced carry
+        guards = [
+            ast.Assign(
+                targets=[_store(n)],
+                value=ast.Call(
+                    func=ast.Attribute(value=ast.Call(
+                        func=_load("locals"), args=[], keywords=[]),
+                        attr="get", ctx=ast.Load()),
+                    args=[ast.Constant(value=n), _load("_PT_UNDEF")],
+                    keywords=[]))
+            for n in carried]
+        cname, bname = f"_pt_wcond_{k}", f"_pt_wbody_{k}"
+        args = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=n) for n in carried],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[])
+        cfn = ast.FunctionDef(
+            name=cname, args=args,
+            body=[ast.Return(value=node.test)], decorator_list=[])
+        bfn = ast.FunctionDef(
+            name=bname, args=args,
+            body=list(node.body) + [ast.Return(value=ast.Tuple(
+                elts=[_load(n) for n in carried], ctx=ast.Load()))],
+            decorator_list=[])
+        call = ast.Call(
+            func=_load("_pt_while"),
+            args=[_load(cname), _load(bname),
+                  ast.Tuple(elts=[_load(n) for n in carried],
+                            ctx=ast.Load())],
+            keywords=[])
+        assign = ast.Assign(
+            targets=[ast.Tuple(elts=[_store(n) for n in carried],
+                               ctx=ast.Store())],
+            value=call)
+        return guards + [cfn, bfn, assign]
+
+
+def convert_to_static(fn):
+    """Rewrite `fn`'s if/while statements for tensor-predicate dispatch.
+
+    Returns a new function with the same signature.  Raises a crisp
+    error when the source is unavailable or the function closes over
+    enclosing-scope variables (the reference's ProgramTranslator caches
+    and converts whole classes; this minimal pass converts one
+    function)."""
+    if getattr(fn, "_pt_dy2static_converted", False):
+        return fn
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError) as e:
+        raise ValueError(
+            f"dy2static: source for {fn!r} is unavailable ({e}); use "
+            "paddle_tpu.fluid.layers.cond / while_loop directly") from e
+    if fn.__closure__:
+        raise ValueError(
+            f"dy2static: {fn.__name__} closes over enclosing-scope "
+            "variables; convert a module-level function or method, or "
+            "use fluid.layers.cond / while_loop")
+    tree = ast.parse(src)
+    func_def = tree.body[0]
+    assert isinstance(func_def,
+                      (ast.FunctionDef, ast.AsyncFunctionDef)), func_def
+    func_def.decorator_list = []  # do not re-apply @to_static on exec
+    # rename so exec-ing into the LIVE module globals (below) cannot
+    # shadow the original binding
+    conv_name = f"_pt_dy2static_{func_def.name}_{id(fn):x}"
+    func_def.name = conv_name
+    new_tree = _ControlFlowTransformer(func_def).visit(tree)
+    ast.fix_missing_locations(new_tree)
+    code = compile(new_tree, filename=f"<dy2static {fn.__name__}>",
+                   mode="exec")
+    # exec against fn's REAL globals dict so later module-global
+    # mutations (config flags, monkeypatches) stay visible to the
+    # converted function; only the private runtime helpers are added
+    g = fn.__globals__
+    g.setdefault("_pt_cond", _pt_cond)
+    g.setdefault("_pt_while", _pt_while)
+    g.setdefault("_PT_UNDEF", _UNDEF)
+    exec(code, g)
+    out = g.pop(conv_name)
+    out = functools.wraps(fn)(out)
+    if fn.__defaults__:
+        out.__defaults__ = fn.__defaults__
+    out._pt_dy2static_converted = True
+    return out
+
+
+def convert_layer(layer):
+    """Converted `forward` BOUND to `layer`, without mutating it — the
+    caller (TracedLayer) scopes the rebind to its own calls, so plain
+    eager use of the layer keeps running the user's original code.
+
+    An INSTANCE-assigned forward (layer.forward = fn monkeypatch) is
+    the user's explicit override: never replace it with the converted
+    class forward — raise so callers fall back to trace-only."""
+    if "forward" in layer.__dict__:
+        raise ValueError(
+            "layer has an instance-assigned forward; dy2static "
+            "conversion only applies to the class-defined forward")
+    conv = convert_to_static(type(layer).forward)
+    return types.MethodType(conv, layer)

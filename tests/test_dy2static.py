@@ -1,0 +1,409 @@
+"""dy2static control-flow conversion (VERDICT r4 missing #4 / next #9):
+Python `if`/`while` on Tensor predicates converted to
+lax.cond/while_loop by the AST pass (paddle_tpu/jit/dy2static.py),
+matching eager semantics, and a branchy layer round-tripping through
+to_static + jit.save / jit.load.
+
+Reference: dygraph_to_static ProgramTranslator
+(/root/reference/python/paddle/fluid/dygraph/dygraph_to_static/
+program_translator.py:711, ifelse_transformer.py, loop_transformer.py).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import jit, nn
+from paddle_tpu.fluid.dygraph.varbase import Tensor
+from paddle_tpu.jit.dy2static import convert_to_static
+
+
+def _t(x):
+    return Tensor(np.asarray(x, "float32"))
+
+
+# module-level functions (the pass requires source, no closures) -----------
+
+def branchy_fn(x):
+    if x.sum() > 0:
+        y = x * 2.0
+    else:
+        y = x - 1.0
+    return y
+
+
+def branchy_both_return(x):
+    if x.sum() > 0:
+        return x * 2.0
+    else:
+        return x - 1.0
+
+
+def branchy_elif(x):
+    s = x.sum()
+    if s > 10.0:
+        y = x * 3.0
+    elif s > 0.0:
+        y = x * 2.0
+    else:
+        y = -x
+    return y
+
+
+def while_fn(x):
+    i = 0
+    while x.sum() < 10.0:
+        x = x * 2.0
+        i = i + 1
+    return x, i
+
+
+def while_with_temp(x, n):
+    # body-local temporary `t` (code-review r5 finding #1): must not be
+    # treated as loop-carried input
+    i = 0
+    while i < n:
+        t = x + i
+        x = t
+        i = i + 1
+    return x
+
+
+def multi_return_branches(x):
+    if x.sum() > 0:
+        return x + 1.0, x * 2.0
+    else:
+        return x - 1.0, x * 3.0
+
+
+_GLOBAL_SCALE = 1.0
+
+
+def uses_global(x):
+    if x.sum() > 0:
+        y = x * _GLOBAL_SCALE
+    else:
+        y = -x * _GLOBAL_SCALE
+    return y
+
+
+def attr_mutation_fn(obj, x):
+    if x.sum() > 0:
+        obj.gate = 1.0
+    else:
+        obj.gate = 0.0
+    return x * obj.gate
+
+
+_COUNTER_BOX = {"n": 0}
+
+
+def global_rebinding_fn(x):
+    global _COUNTER_BOX
+    if x.sum() > 0:
+        _COUNTER_BOX = {"n": _COUNTER_BOX["n"] + 1}
+    else:
+        _COUNTER_BOX = {"n": _COUNTER_BOX["n"] - 1}
+    return x
+
+
+def while_temp_leaks_fn(x):
+    # the temp `t` is read AFTER the loop: fine in eager (loop always
+    # runs), must raise loudly under trace (post-loop temp unavailable)
+    while x.sum() < 10.0:
+        t = x * 2.0
+        x = t
+    return t
+
+
+def mixed_static_if(x, flag):
+    # `flag` is a plain Python bool: must keep working as normal Python
+    if flag:
+        y = x + 1.0
+    else:
+        y = x - 1.0
+    return y
+
+
+class BranchyLayer(nn.Layer):
+    """Data-dependent two-branch layer (the reference's dy2static demo
+    shape): route through fc_pos or fc_neg by the input's sign."""
+
+    def __init__(self):
+        super().__init__()
+        self.fc_pos = nn.Linear(4, 3)
+        self.fc_neg = nn.Linear(4, 3)
+
+    def forward(self, x):
+        if x.sum() > 0:
+            out = self.fc_pos(x)
+        else:
+            out = self.fc_neg(x)
+        return out
+
+
+class WhileLayer(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(4, 4)
+
+    def forward(self, x):
+        while x.sum() < 100.0:
+            x = self.fc(x) * x + x
+        return x
+
+
+class TestConvertFunction:
+    def test_if_assign_eager_parity_both_branches(self):
+        conv = convert_to_static(branchy_fn)
+        for sign in (1.0, -1.0):
+            x = _t(sign * np.ones((2, 3)))
+            got = conv(x)
+            want = branchy_fn(x)
+            np.testing.assert_allclose(got.numpy(), want.numpy())
+
+    def test_if_assign_under_jit(self):
+        import jax
+
+        conv = convert_to_static(branchy_fn)
+
+        @jax.jit
+        def f(v):
+            return conv(Tensor(v))._value
+
+        for sign in (1.0, -1.0):
+            x = sign * np.ones((2, 3), "float32")
+            np.testing.assert_allclose(
+                np.asarray(f(x)), branchy_fn(_t(x)).numpy())
+
+    def test_both_return_form_under_jit(self):
+        import jax
+
+        conv = convert_to_static(branchy_both_return)
+
+        @jax.jit
+        def f(v):
+            return conv(Tensor(v))._value
+
+        for sign in (1.0, -1.0):
+            x = sign * np.ones((2, 3), "float32")
+            np.testing.assert_allclose(
+                np.asarray(f(x)), branchy_both_return(_t(x)).numpy())
+
+    def test_elif_chain_under_jit(self):
+        import jax
+
+        conv = convert_to_static(branchy_elif)
+
+        @jax.jit
+        def f(v):
+            return conv(Tensor(v))._value
+
+        for fill in (3.0, 0.5, -1.0):
+            x = np.full((2, 3), fill, "float32")
+            np.testing.assert_allclose(
+                np.asarray(f(x)), branchy_elif(_t(x)).numpy(),
+                rtol=1e-6)
+
+    def test_while_eager_and_jit(self):
+        import jax
+
+        conv = convert_to_static(while_fn)
+        x = np.full((2, 2), 0.25, "float32")
+        ex, ei = while_fn(_t(x))          # original python loop
+        gx, gi = conv(_t(x))              # converted, eager
+        np.testing.assert_allclose(gx.numpy(), ex.numpy())
+        assert int(np.asarray(gi._value if isinstance(gi, Tensor)
+                              else gi)) == ei
+
+        @jax.jit
+        def f(v):
+            ox, oi = conv(Tensor(v))
+            return ox._value, oi._value if isinstance(oi, Tensor) else oi
+
+        jx, ji = f(x)
+        np.testing.assert_allclose(np.asarray(jx), ex.numpy())
+        assert int(np.asarray(ji)) == ei
+
+    def test_python_bool_branch_untouched(self):
+        conv = convert_to_static(mixed_static_if)
+        x = _t(np.ones((2, 2)))
+        np.testing.assert_allclose(conv(x, True).numpy(),
+                                   (x + _t(1.0)).numpy())
+        np.testing.assert_allclose(conv(x, False).numpy(),
+                                   (x - _t(1.0)).numpy())
+
+    def test_while_with_body_local_temp(self):
+        conv = convert_to_static(while_with_temp)
+        x = _t(np.ones((2,)) * 4.0)
+        want = while_with_temp(x, 3)
+        got = conv(_t(np.ones((2,)) * 4.0), 3)
+        np.testing.assert_allclose(got.numpy(), want.numpy())
+
+    def test_multi_value_return_branches(self):
+        import jax
+
+        conv = convert_to_static(multi_return_branches)
+        for sign in (1.0, -1.0):
+            x = sign * np.ones((2, 2), "float32")
+            ea, eb = multi_return_branches(_t(x))
+            ga, gb = conv(_t(x))
+            np.testing.assert_allclose(ga.numpy(), ea.numpy())
+            np.testing.assert_allclose(gb.numpy(), eb.numpy())
+
+            @jax.jit
+            def f(v):
+                a, b = conv(Tensor(v))
+                return a._value, b._value
+
+            ja, jb = f(x)
+            np.testing.assert_allclose(np.asarray(ja), ea.numpy())
+            np.testing.assert_allclose(np.asarray(jb), eb.numpy())
+
+    def test_module_global_mutations_stay_visible(self):
+        g = uses_global.__globals__
+        conv = convert_to_static(uses_global)
+        assert conv.__globals__ is g  # live dict, not a snapshot
+        x = _t(np.ones((2,)))
+        np.testing.assert_allclose(conv(x).numpy(), x.numpy())
+        old = g["_GLOBAL_SCALE"]
+        try:
+            g["_GLOBAL_SCALE"] = 5.0
+            np.testing.assert_allclose(conv(x).numpy(),
+                                       5.0 * x.numpy())
+        finally:
+            g["_GLOBAL_SCALE"] = old
+        # and the original module binding was not shadowed by exec
+        assert g["uses_global"] is uses_global
+
+    def test_attribute_mutation_branch_left_unconverted(self):
+        """code-review r5 round-2 finding #2: branches that MUTATE
+        (self.attr = ...) must not be converted — both branches would
+        execute at trace time.  The construct stays plain Python and
+        the predicate raises the crisp trace-time error instead."""
+        import jax
+
+        class Mut:
+            def __init__(self):
+                self.gate = 0.0
+
+        src_fn = attr_mutation_fn
+        conv = convert_to_static(src_fn)
+        m = Mut()
+        # eager still works (plain Python semantics kept)
+        out = conv(m, _t(np.ones((2,))))
+        assert m.gate == 1.0
+        np.testing.assert_allclose(out.numpy(), np.ones((2,)))
+
+        @jax.jit
+        def f(v):
+            return conv(Mut(), Tensor(v))._value
+
+        with pytest.raises(TypeError, match="bool\\(\\) on a Tensor"):
+            f(np.ones((2,), "float32"))
+
+    def test_global_rebinding_left_unconverted(self):
+        conv = convert_to_static(global_rebinding_fn)
+        x = _t(np.ones((2,)))
+        conv(x)  # eager: plain Python path, global updated normally
+        assert _COUNTER_BOX["n"] == 1
+        # and the module global was NOT clobbered with a sentinel
+        from paddle_tpu.jit.dy2static import _UNDEF
+
+        assert _COUNTER_BOX is not _UNDEF
+
+    def test_instance_forward_monkeypatch_preserved(self):
+        """code-review r5 round-2 finding #1: an instance-assigned
+        forward is the user's override; to_static must trace IT."""
+        paddle.seed(0)
+        layer = BranchyLayer()
+
+        def custom_forward(x):
+            return x * 3.0
+
+        layer.forward = custom_forward
+        static = jit.to_static(layer)
+        x = _t(np.ones((2, 4)))
+        np.testing.assert_allclose(static(x).numpy(), 3.0 * x.numpy())
+
+    def test_while_temp_read_after_traced_loop_raises(self):
+        import jax
+
+        conv = convert_to_static(while_temp_leaks_fn)
+
+        @jax.jit
+        def f(v):
+            return conv(Tensor(v))
+
+        with pytest.raises((NameError, TypeError)):
+            f(np.full((2,), 0.25, "float32"))
+
+    def test_closure_rejected_crisply(self):
+        z = 3.0
+
+        def closed(x):
+            if x.sum() > 0:
+                y = x * z
+            else:
+                y = x
+            return y
+
+        with pytest.raises(ValueError, match="closes over"):
+            convert_to_static(closed)
+
+
+class TestBranchyLayer:
+    def test_to_static_does_not_mutate_layer(self):
+        """code-review r5 finding #4: TracedLayer must not rebind the
+        user layer's forward permanently."""
+        paddle.seed(0)
+        layer = BranchyLayer()
+        jit.to_static(layer)
+        assert "forward" not in layer.__dict__
+        # eager use still runs the user's original code object
+        assert type(layer).forward.__code__.co_filename.endswith(
+            "test_dy2static.py")
+
+    def test_to_static_matches_eager(self):
+        paddle.seed(0)
+        layer = BranchyLayer()
+        xp = np.random.RandomState(0).uniform(
+            0.1, 1, (2, 4)).astype("float32")
+        xn = -xp
+        want_pos = layer(_t(xp)).numpy()
+        want_neg = layer(_t(xn)).numpy()
+
+        static = jit.to_static(layer)
+        np.testing.assert_allclose(static(_t(xp)).numpy(), want_pos,
+                                   atol=1e-6)
+        np.testing.assert_allclose(static(_t(xn)).numpy(), want_neg,
+                                   atol=1e-6)
+
+    def test_while_layer_to_static(self):
+        paddle.seed(0)
+        layer = WhileLayer()
+        x = np.full((1, 4), 0.3, "float32")
+        want = layer(_t(x)).numpy()
+        static = jit.to_static(layer)
+        np.testing.assert_allclose(static(_t(x)).numpy(), want,
+                                   rtol=1e-4, atol=1e-12)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        """The VERDICT done-criterion: branchy layer -> to_static ->
+        jit.save -> jit.load in-process, outputs match both branches."""
+        paddle.seed(0)
+        layer = BranchyLayer()
+        static = jit.to_static(layer)
+        xp = np.random.RandomState(1).uniform(
+            0.1, 1, (2, 4)).astype("float32")
+        xn = -xp
+        want_pos = layer(_t(xp)).numpy()
+        want_neg = layer(_t(xn)).numpy()
+
+        prefix = str(tmp_path / "branchy")
+        jit.save(static, prefix, input_spec=[([2, 4], "float32")])
+        loaded = jit.load(prefix)
+        np.testing.assert_allclose(np.asarray(loaded(_t(xp))), want_pos,
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(loaded(_t(xn))), want_neg,
+                                   atol=1e-5)
