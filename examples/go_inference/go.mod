@@ -1,0 +1,3 @@
+module paddletpu
+
+go 1.16

@@ -1,0 +1,115 @@
+// Package paddletpu: Go binding over the paddle_tpu inference C ABI —
+// the counterpart of the reference's Go wrapper
+// (/root/reference/go/paddle/predictor.go:1, tensor.go, config.go),
+// which wraps /root/reference/paddle/fluid/inference/capi/c_api.cc via
+// cgo exactly the same way.
+//
+// Build (no Go toolchain in the CI image — compile-tested when one is
+// present, see tests/test_c_api.py::TestGoConsumer):
+//
+//	python -c "from paddle_tpu import core_native; core_native.build_c_api(embed=True)"
+//	CGO_CFLAGS="-I." \
+//	CGO_LDFLAGS="-L<repo>/paddle_tpu/core_native -lpaddle_tpu_c \
+//	             $(python3-config --embed --ldflags)" \
+//	go build ./...
+//
+// Runtime needs PYTHONPATH to include the repo (the Python runtime is
+// embedded behind PT_Init, like the reference embeds its C++ runtime
+// behind PD_*).
+package paddletpu
+
+/*
+#include <stdint.h>
+#include <stdlib.h>
+
+typedef struct PT_Predictor PT_Predictor;
+extern int PT_Init(const char* repo_path);
+extern PT_Predictor* PT_NewPredictor(const char* model_prefix);
+extern void PT_DeletePredictor(PT_Predictor* p);
+extern const char* PT_GetLastError(void);
+extern int PT_PredictorRun(PT_Predictor* p, const float* data,
+                           const int64_t* shape, int ndim, float* out_buf,
+                           int64_t out_capacity, int64_t* out_count,
+                           int64_t* out_shape, int* out_ndim);
+*/
+import "C"
+
+import (
+	"errors"
+	"unsafe"
+)
+
+// Predictor mirrors the reference's paddle.Predictor (predictor.go:20).
+type Predictor struct {
+	handle *C.PT_Predictor
+}
+
+func lastError() error {
+	return errors.New(C.GoString(C.PT_GetLastError()))
+}
+
+// Init bootstraps the embedded runtime; repoPath goes onto sys.path
+// (empty string when the library is loaded into a Python host).
+func Init(repoPath string) error {
+	cs := C.CString(repoPath)
+	defer C.free(unsafe.Pointer(cs))
+	if C.PT_Init(cs) != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+// NewPredictor loads <prefix>.stablehlo + <prefix>.json
+// (the reference's NewPredictor over AnalysisConfig, predictor.go:28).
+func NewPredictor(modelPrefix string) (*Predictor, error) {
+	cs := C.CString(modelPrefix)
+	defer C.free(unsafe.Pointer(cs))
+	h := C.PT_NewPredictor(cs)
+	if h == nil {
+		return nil, lastError()
+	}
+	return &Predictor{handle: h}, nil
+}
+
+// Run feeds one float32 tensor and returns (data, shape)
+// (the reference's ZeroCopyRun + output tensor copy, predictor.go:93).
+// On the ABI's -2 "buffer too small" return it resizes to the reported
+// required element count and retries once.
+func (p *Predictor) Run(data []float32, shape []int64) ([]float32, []int64, error) {
+	if len(data) == 0 || len(shape) == 0 {
+		return nil, nil, errors.New("empty input tensor")
+	}
+	cshape := make([]C.int64_t, len(shape))
+	for i, s := range shape {
+		cshape[i] = C.int64_t(s)
+	}
+	out := make([]float32, 1<<16)
+	for attempt := 0; ; attempt++ {
+		var outCount C.int64_t
+		var outNdim C.int
+		outShape := make([]C.int64_t, 8)
+		rc := C.PT_PredictorRun(p.handle,
+			(*C.float)(unsafe.Pointer(&data[0])),
+			(*C.int64_t)(unsafe.Pointer(&cshape[0])), C.int(len(shape)),
+			(*C.float)(unsafe.Pointer(&out[0])), C.int64_t(len(out)),
+			&outCount, &outShape[0], &outNdim)
+		if rc == -2 && attempt == 0 {
+			out = make([]float32, int(outCount)) // reported need
+			continue
+		}
+		if rc != 0 {
+			return nil, nil, lastError()
+		}
+		resShape := make([]int64, int(outNdim))
+		for i := range resShape {
+			resShape[i] = int64(outShape[i])
+		}
+		return out[:int(outCount)], resShape, nil
+	}
+}
+
+// Delete releases the predictor (the reference's DeletePredictor).
+func (p *Predictor) Delete() {
+	C.PT_DeletePredictor(p.handle)
+	p.handle = nil
+}
