@@ -13,7 +13,7 @@ from .layer.activation import (ELU, GELU, SELU, Hardshrink, Hardsigmoid,
                                Maxout, Mish, PReLU, ReLU, ReLU6, Sigmoid,
                                Silu, Softmax, Softplus, Softshrink, Swish,
                                Tanh, Tanhshrink, ThresholdedReLU)
-from .layer.common import (Bilinear, CosineSimilarity, Dropout, Dropout2D,
+from .layer.common import (Bilinear, CosineSimilarity, Dropout, Dropout2D, SwitchMoE,
                            Embedding, Flatten, Linear, Pad1D, Pad2D, Pad3D,
                            PixelShuffle, Upsample, UpsamplingBilinear2D,
                            UpsamplingNearest2D)

@@ -204,3 +204,79 @@ class Bilinear(Layer):
         if self.bias is not None:
             ins["b"] = self.bias
         return trace_fn(f, ins)
+
+
+class SwitchMoE(Layer):
+    """Switch-Transformer feed-forward: top-1 routed mixture of expert
+    FFNs (Fedus et al. 2021).  The reference has no MoE (SURVEY.md §2.9
+    "NOT present in the reference"); this layer is the eager/model-side
+    face of the TPU-native expert-parallel design in
+    paddle_tpu.parallel.moe — the SAME dispatch algebra runs here on
+    local experts and there sharded over an `ep` mesh axis.
+
+    forward(x (B, S, H)) -> (B, S, H).  The Switch load-balance aux
+    loss: in eager, `.aux_loss` after the call is a tape-connected
+    Tensor (add `aux_weight * layer.aux_loss` to the training loss);
+    under jit/functional_call the attribute is NOT set (it would leak a
+    tracer) — the value instead rides the `moe_aux_loss` buffer through
+    functional_call's new_state, detached (jit callers that want the
+    aux gradient should use parallel.moe.build_switch_moe, whose apply
+    returns it).
+    """
+
+    def __init__(self, d_model, d_ff, num_experts, capacity_factor=1.25,
+                 weight_attr=None, name=None):
+        super().__init__()
+        self._d_model, self._d_ff = d_model, d_ff
+        self._num_experts = num_experts
+        self._capacity_factor = capacity_factor
+        self.gate_weight = self.create_parameter(
+            shape=[d_model, num_experts], attr=weight_attr,
+            default_initializer=XavierInitializer())
+        # explicit fans: the generic _fan_in_out would read the 3D
+        # stacked-expert shape as a conv kernel and under-scale by
+        # ~sqrt(d_ff) (code-review r5; per-expert fans match
+        # parallel.moe.init_moe_params)
+        self.w1 = self.create_parameter(
+            shape=[num_experts, d_model, d_ff], attr=weight_attr,
+            default_initializer=XavierInitializer(fan_in=d_model,
+                                                  fan_out=d_ff))
+        self.b1 = self.create_parameter(shape=[num_experts, d_ff],
+                                        is_bias=True)
+        self.w2 = self.create_parameter(
+            shape=[num_experts, d_ff, d_model], attr=weight_attr,
+            default_initializer=XavierInitializer(fan_in=d_ff,
+                                                  fan_out=d_model))
+        self.b2 = self.create_parameter(shape=[num_experts, d_model],
+                                        is_bias=True)
+        self.moe_aux_loss = self.register_buffer(
+            "moe_aux_loss", np.zeros([], np.float32), persistable=False)
+        self.aux_loss = None
+
+    def forward(self, x):
+        from ...fluid.dygraph.tracer import trace_fn
+        from ...parallel.moe import switch_moe_local
+
+        d_model, n_experts = self._d_model, self._num_experts
+        cf = self._capacity_factor
+
+        def f(x, wg, w1, b1, w2, b2):
+            lead = x.shape[:-1]
+            out, aux = switch_moe_local(
+                {"wg": wg, "w1": w1, "b1": b1, "w2": w2, "b2": b2},
+                x.reshape(-1, d_model), n_experts, capacity_factor=cf)
+            return out.reshape(lead + (d_model,)), aux
+
+        out, aux = trace_fn(
+            f, {"x": x, "wg": self.gate_weight, "w1": self.w1,
+                "b1": self.b1, "w2": self.w2, "b2": self.b2},
+            multi_out=True)
+        import jax
+        from jax import lax
+
+        # buffer: pure-state channel under functional_call (detached)
+        self.moe_aux_loss._value = lax.stop_gradient(aux._value)
+        # attribute: eager tape recipe only — never stash a tracer
+        self.aux_loss = (None if isinstance(aux._value, jax.core.Tracer)
+                         else aux)
+        return out
