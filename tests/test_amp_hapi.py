@@ -165,16 +165,20 @@ class _RegData(paddle.io.Dataset):
         return self.x[i], self.y[i]
 
 
+def _reg_model():
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 16),
+                               paddle.nn.ReLU(),
+                               paddle.nn.Linear(16, 1))
+    m = paddle.Model(net)
+    m.prepare(optimizer=paddle.optimizer.Adam(
+        learning_rate=0.01, parameters=net.parameters()),
+        loss=paddle.nn.MSELoss())
+    return m
+
+
 class TestHapiModel:
     def _model(self):
-        net = paddle.nn.Sequential(paddle.nn.Linear(8, 16),
-                                   paddle.nn.ReLU(),
-                                   paddle.nn.Linear(16, 1))
-        m = paddle.Model(net)
-        m.prepare(optimizer=paddle.optimizer.Adam(
-            learning_rate=0.01, parameters=net.parameters()),
-            loss=paddle.nn.MSELoss())
-        return m
+        return _reg_model()
 
     def test_fit_reduces_loss(self):
         m = self._model()
@@ -334,3 +338,16 @@ class TestHapiStaticAdapter:
         losses = self._run_epochs(m, x, y, epochs=1)
         assert hasattr(m, "_scaler")  # the GradScaler actually engaged
         assert np.isfinite(losses).all()
+
+
+class TestHapiProcessWorkers:
+    def test_fit_with_process_worker_loader(self):
+        """hapi Model.fit over the multiprocess DataLoader (fork workers
+        forked AFTER jax initialized — safe because the dataset is pure
+        numpy; the fit loop consumes the pumped native queue)."""
+        m = _reg_model()
+        loader = paddle.io.DataLoader(_RegData(), batch_size=16,
+                                      num_workers=2, timeout=60,
+                                      use_process_workers=True)
+        hist = m.fit(loader, epochs=4, verbose=0)
+        assert hist[-1]["loss"] < hist[0]["loss"]
