@@ -187,6 +187,30 @@ def main():
 
     with open(os.path.join(ART, "tpu_window_results.json"), "w") as f:
         json.dump(results, f, indent=1, default=str)
+
+    # the window may close (or the session end) at any time: persist
+    # the evidence in git immediately.  Only the distilled outputs —
+    # the raw profiler trace dir (artifacts/trace, tens of MB of
+    # .trace.json.gz) stays out of history.  Each file is added on its
+    # own so one missing path (e.g. no bench_onchip.json after a failed
+    # bench) cannot void the whole stage, and the commit is scoped to
+    # exactly these paths so unrelated staged WIP is never swept in.
+    evidence = [p for p in
+                ["bench_onchip.json",
+                 os.path.join("artifacts", "tpu_window_results.json"),
+                 os.path.join("artifacts", "bench_run.log"),
+                 os.path.join("artifacts", "tpu_lane.log"),
+                 os.path.join("artifacts", "tpu_lane_zero.log"),
+                 os.path.join("artifacts", "dimsem_ab.json"),
+                 os.path.join("artifacts", "profile_summary.json")]
+                if os.path.exists(os.path.join(REPO, p))]
+    for p in evidence:
+        run_phase(f"git_add {p}", ["git", "add", "--", p], 60)
+    run_phase(
+        "git_commit",
+        ["git", "commit", "-m",
+         "Record on-chip TPU window results (bench, lane, A/B, profile)",
+         "--"] + evidence, 60)
     return 0 if ok1 else 1
 
 
