@@ -38,6 +38,10 @@ def _full_attention(q, k, v, scale, mask=None, is_causal=False):
         # (B, S) key padding -> additive -inf on masked keys
         s = jnp.where(mask[:, None, None, :], s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    # fully-masked rows (all -inf): zero output, not NaN — same guard
+    # as ring_attention_local's m_safe/denom clamp
+    row_ok = jnp.isfinite(jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.where(row_ok, p, 0.0).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 

@@ -83,3 +83,14 @@ class TestUlysses:
         out = jax.jit(lambda q, k, v: attn(q, k, v))(q, k, v)
         shard_seq = {s.data.shape[1] for s in out.addressable_shards}
         assert shard_seq == {64 // 8}, shard_seq
+
+    def test_fully_masked_row_yields_zeros_not_nan(self):
+        mesh = make_mesh({"sp": 8})
+        q, k, v = _mk(seed=3)
+        mask = jnp.asarray(
+            np.arange(32)[None, :] < np.array([[0], [32]]))  # row 0: none
+        attn = ulysses_attention(mesh, axis="sp")
+        got = np.asarray(attn(q, k, v, mask=mask))
+        assert np.isfinite(got).all()
+        np.testing.assert_array_equal(got[0], np.zeros_like(got[0]))
+        assert np.abs(got[1]).sum() > 0
