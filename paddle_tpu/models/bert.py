@@ -249,7 +249,8 @@ def bert_param_spec(name, shape, mp_axis="mp"):
 def build_pretrain_step(model: BertForPretraining,
                         weight_decay=0.01, bf16=True, remat=False,
                         mesh=None, dp_axis="dp", mp_axis=None,
-                        sp_axis=None, use_ring_attention=False):
+                        sp_axis=None, use_ring_attention=False,
+                        use_ulysses=False):
     """One fully-fused XLA train step: fwd + bwd + AdamW.
 
     Returns (step_fn, state) where
@@ -269,6 +270,13 @@ def build_pretrain_step(model: BertForPretraining,
         raise ValueError(
             "use_ring_attention requires attention_probs_dropout_prob=0 "
             "(attention dropout is not supported by the ring path yet)")
+    if use_ulysses and model.bert.config.attention_probs_dropout_prob:
+        raise ValueError(
+            "use_ulysses requires attention_probs_dropout_prob=0 "
+            "(attention dropout is not supported by the all-to-all "
+            "path)")
+    if use_ulysses and use_ring_attention:
+        raise ValueError("choose ONE of use_ulysses/use_ring_attention")
     criterion = BertPretrainingCriterion(model.bert.config.vocab_size)
     # copy: the jitted step donates state buffers; the model's live
     # weights must not alias them
@@ -288,20 +296,27 @@ def build_pretrain_step(model: BertForPretraining,
         def fwd(p, b):
             import contextlib
 
-            from ..ops.pallas.attention import ring_attention_scope
+            from ..ops.pallas.attention import (ring_attention_scope,
+                                                ulysses_attention_scope)
 
             ring_active = (use_ring_attention and mesh is not None
                            and sp_axis is not None)
-            ring = (ring_attention_scope(mesh, sp_axis) if ring_active
-                    else contextlib.nullcontext())
+            uly_active = (use_ulysses and mesh is not None
+                          and sp_axis is not None)
+            if ring_active:
+                sp_scope = ring_attention_scope(mesh, sp_axis)
+            elif uly_active:
+                sp_scope = ulysses_attention_scope(mesh, sp_axis)
+            else:
+                sp_scope = contextlib.nullcontext()
             am = b.get("attention_mask")
             if am is not None and not ring_active:
-                # (B, S) int -> (B, 1, 1, S) bool; the flash kernel
-                # runs this key-padding form in-kernel as a key bias
+                # (B, S) int -> (B, 1, 1, S) bool; the flash kernel and
+                # the ulysses path both take this key-padding form
                 am = (am != 0)[:, None, None, :]
             else:
                 am = None  # ring path has no mask support yet
-            with rng_key_scope(key), ring:
+            with rng_key_scope(key), sp_scope:
                 return functional_call(
                     model, p, b["input_ids"], b["token_type_ids"],
                     attention_mask=am,

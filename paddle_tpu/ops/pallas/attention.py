@@ -765,6 +765,24 @@ def ring_attention_scope(mesh, axis="sp"):
         _RING_CTX.mesh, _RING_CTX.axis = old
 
 
+_ULYSSES_CTX = threading.local()
+
+
+@contextlib.contextmanager
+def ulysses_attention_scope(mesh, axis="sp"):
+    """Route subsequent attention calls through Ulysses all-to-all
+    sequence parallelism (parallel/ulysses.py).  Unlike the ring scope,
+    key-padding masks ARE supported (each device sees the full key axis
+    for its head group); attention dropout is not."""
+    old = (getattr(_ULYSSES_CTX, "mesh", None),
+           getattr(_ULYSSES_CTX, "axis", None))
+    _ULYSSES_CTX.mesh, _ULYSSES_CTX.axis = mesh, axis
+    try:
+        yield
+    finally:
+        _ULYSSES_CTX.mesh, _ULYSSES_CTX.axis = old
+
+
 def _seed_from_key(key):
     """Fold a jax PRNG key into a (1,) int32 kernel seed."""
     if key is None:
@@ -784,6 +802,26 @@ def scaled_dot_product_attention(q, k, v, mask=None, is_causal=False,
     attention dropout run in-kernel), XLA path otherwise (arbitrary
     dense masks, tiny shapes, non-TPU backends).
     q/k/v: (batch, seq, heads, head_dim)."""
+    uly_mesh = getattr(_ULYSSES_CTX, "mesh", None)
+    if uly_mesh is not None:
+        if dropout_p != 0.0:
+            raise ValueError(
+                "ulysses_attention_scope is active but attention "
+                "dropout is not supported by the all-to-all path; set "
+                "attention dropout to 0 or exit the scope.")
+        # same normalization as the flash path: any key-padding form
+        # (ndim 2/3/4, bool or additive float) -> (B, S) additive bias;
+        # query/head-varying masks are not expressible over all-to-all
+        key_mask = _mask_as_key_bias(mask, q.shape[0], k.shape[1])
+        if mask is not None and key_mask is None:
+            raise ValueError(
+                "ulysses_attention_scope supports key-padding masks "
+                "(constant over query/head dims); got mask shape "
+                f"{mask.shape}")
+        from ...parallel.ulysses import ulysses_attention
+
+        return ulysses_attention(uly_mesh, _ULYSSES_CTX.axis)(
+            q, k, v, mask=key_mask, is_causal=is_causal, scale=scale)
     ring_mesh = getattr(_RING_CTX, "mesh", None)
     if ring_mesh is not None:
         if mask is not None or dropout_p != 0.0:

@@ -35,8 +35,13 @@ def _full_attention(q, k, v, scale, mask=None, is_causal=False):
         cm = jnp.tril(jnp.ones((S, S), bool))
         s = jnp.where(cm[None, None], s, -jnp.inf)
     if mask is not None:
-        # (B, S) key padding -> additive -inf on masked keys
-        s = jnp.where(mask[:, None, None, :], s, -jnp.inf)
+        if mask.dtype == jnp.bool_:
+            # (B, S) keep-mask -> -inf on masked keys
+            s = jnp.where(mask[:, None, None, :], s, -jnp.inf)
+        else:
+            # (B, S) ADDITIVE key bias (0 keep / large-negative mask),
+            # the dispatcher's _mask_as_key_bias convention
+            s = s + mask[:, None, None, :].astype(s.dtype)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
     # fully-masked rows (all -inf): zero output, not NaN — same guard
     # as ring_attention_local's m_safe/denom clamp
@@ -56,7 +61,7 @@ def ulysses_attention(mesh, axis="sp"):
     from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
-    def attn(q, k, v, mask=None, is_causal=False):
+    def attn(q, k, v, mask=None, is_causal=False, scale=None):
         n = mesh.shape[axis]
         assert q.shape[2] % n == 0, (
             f"ulysses needs num_heads {q.shape[2]} divisible by the "
@@ -64,7 +69,8 @@ def ulysses_attention(mesh, axis="sp"):
 
         def local(q, k, v, mask):
             return ulysses_attention_local(q, k, v, axis, mask=mask,
-                                           is_causal=is_causal)
+                                           is_causal=is_causal,
+                                           scale=scale)
 
         spec = P(None, axis)
         mask_spec = P()
@@ -76,7 +82,8 @@ def ulysses_attention(mesh, axis="sp"):
     return attn
 
 
-def ulysses_attention_local(q, k, v, axis, mask=None, is_causal=False):
+def ulysses_attention_local(q, k, v, axis, mask=None, is_causal=False,
+                            scale=None):
     """Per-device body: q/k/v (B, S/n, H, D) local shards; mask (B, S)
     full (replicated).  Returns the local (B, S/n, H, D) output."""
     import math
@@ -93,7 +100,8 @@ def ulysses_attention_local(q, k, v, axis, mask=None, is_causal=False):
                               tiled=True)
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    scale = 1.0 / math.sqrt(q.shape[-1])
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
     out = _full_attention(qh, kh, vh, scale, mask=mask,
                           is_causal=is_causal)
     return heads_to_seq(out)

@@ -80,6 +80,17 @@ class TestShardedEqualsSingle:
         np.testing.assert_allclose(got, single_device_losses_nodrop,
                                    **TOL)
 
+    def test_sp_ulysses_attention(self, single_device_losses_nodrop):
+        """The OTHER sequence-parallel path (all-to-all head
+        re-sharding, parallel/ulysses.py) on the same dp x sp mesh —
+        and unlike ring, the key-padding attention_mask stays active
+        (Ulysses supports it), so this exercises the masked path too."""
+        mesh = make_mesh({"dp": 2, "sp": 4})
+        got = _bert_losses(mesh=mesh, dp_axis="dp", sp_axis="sp",
+                           use_ulysses=True, dropout=False)
+        np.testing.assert_allclose(got, single_device_losses_nodrop,
+                                   **TOL)
+
     def test_zero_sharding(self, single_device_losses):
         """ZeRO: params + adam moments sharded over the data axis.
         Numerics must be identical — sharding only changes layout."""
