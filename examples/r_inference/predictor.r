@@ -1,0 +1,39 @@
+#!/usr/bin/env Rscript
+# R inference over paddle_tpu — the counterpart of the reference's R
+# example (/root/reference/r/example/mobilenet.r), which likewise uses
+# reticulate to drive the Python inference API (the reference's R story
+# is reticulate over paddle.fluid.core, not a native binding).
+#
+# Usage:
+#   Rscript predictor.r <model_prefix> [python_path]
+# where <model_prefix> points at a model saved with
+# paddle_tpu.inference.save_inference_model (<prefix>.stablehlo +
+# <prefix>.json).  Set PYTHONPATH to include the repo.
+#
+# (No R toolchain ships in the CI image — this example is committed and
+# documented, like the reference's, and exercises the same Predictor
+# path the tested C/ctypes consumers use.)
+
+library(reticulate)
+
+args <- commandArgs(trailingOnly = TRUE)
+if (length(args) < 1) {
+    stop("usage: Rscript predictor.r <model_prefix> [python_path]")
+}
+if (length(args) >= 2) {
+    use_python(args[2])
+}
+
+np <- import("numpy")
+inference <- import("paddle_tpu.inference")
+
+config <- inference$Config(args[1])
+predictor <- inference$create_predictor(config)
+
+# LeNet-shaped demo input (1x1x28x28 f32); swap for your model's shape
+x <- np$asarray(array(runif(28 * 28), dim = c(1L, 1L, 28L, 28L)),
+                dtype = "float32")
+outs <- predictor$run(list(x))
+logits <- outs[[1]]
+cat("output shape:", paste(dim(logits), collapse = "x"), "\n")
+cat("argmax class:", which.max(logits) - 1, "\n")
