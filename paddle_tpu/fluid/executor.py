@@ -372,8 +372,28 @@ class Executor:
                         f"{info.dtype} range (max {arr.max()}); TPU "
                         f"indices are 32-bit — shard the table or "
                         f"rebase the ids")
-            if block.has_var(name) and arr.dtype != want:
-                arr = arr.astype(want)
+            if block.has_var(name):
+                # rank/shape contract: reference feed checks
+                # (executor.py feed_data shape validation).  A rank
+                # mismatch otherwise surfaces later as a raw jax
+                # broadcasting error deep inside the lowered block —
+                # name the var and the declared shape HERE instead.
+                declared = list(block.var(name).shape or [])
+                if declared and len(declared) != arr.ndim:
+                    raise ValueError(
+                        f"feed {name!r}: rank mismatch — variable "
+                        f"declared with shape {declared} "
+                        f"(rank {len(declared)}), fed array has shape "
+                        f"{list(arr.shape)} (rank {arr.ndim})")
+                if declared and any(
+                        d != -1 and d != s
+                        for d, s in zip(declared, arr.shape)):
+                    raise ValueError(
+                        f"feed {name!r}: shape mismatch — variable "
+                        f"declared {declared} (-1 = any), fed "
+                        f"{list(arr.shape)}")
+                if arr.dtype != want:
+                    arr = arr.astype(want)
             out[name] = arr
         return out
 

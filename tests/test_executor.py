@@ -170,3 +170,23 @@ def test_program_cache_is_bounded_lru(fresh_programs):
         exe.run(main, feed={"x": hot}, fetch_list=[y])
     assert len(exe._cache) <= cap
     assert hot_key in exe._cache  # LRU retained the re-touched entry
+
+
+def test_feed_rank_and_shape_mismatch_raise_crisply(fresh_programs):
+    """Feed-boundary contract (reference executor feed checks): a wrong
+    rank/shape must name the variable and both shapes, not surface as a
+    raw jax broadcasting error mid-block."""
+    main, startup, scope = fresh_programs
+    x = fluid.data("x", [-1, 4], "float32")
+    y = fluid.layers.scale(x, 2.0)
+    exe = fluid.Executor()
+    with pytest.raises(ValueError, match=r"rank mismatch.*'x'|'x'.*rank"):
+        exe.run(main, feed={"x": np.ones((8,), "float32")},
+                fetch_list=[y])
+    with pytest.raises(ValueError, match="shape mismatch"):
+        exe.run(main, feed={"x": np.ones((8, 5), "float32")},
+                fetch_list=[y])
+    # -1 dims accept anything
+    (out,) = exe.run(main, feed={"x": np.ones((3, 4), "float32")},
+                     fetch_list=[y])
+    assert np.asarray(out).shape == (3, 4)
