@@ -28,7 +28,8 @@ class BertConfig:
                  intermediate_size=3072, hidden_act="gelu",
                  hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1,
                  max_position_embeddings=512, type_vocab_size=2,
-                 initializer_range=0.02):
+                 initializer_range=0.02, moe_experts=0,
+                 moe_capacity_factor=1.25, moe_aux_weight=0.01):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_hidden_layers = num_hidden_layers
@@ -40,6 +41,10 @@ class BertConfig:
         self.max_position_embeddings = max_position_embeddings
         self.type_vocab_size = type_vocab_size
         self.initializer_range = initializer_range
+        # 0 = dense FFN; >0 = Switch-MoE FFN in every encoder layer
+        self.moe_experts = moe_experts
+        self.moe_capacity_factor = moe_capacity_factor
+        self.moe_aux_weight = moe_aux_weight
 
     @staticmethod
     def base(**kw):
@@ -113,7 +118,10 @@ class BertModel(nn.Layer):
             cfg.hidden_size, cfg.num_attention_heads, cfg.intermediate_size,
             dropout=cfg.hidden_dropout_prob, activation=cfg.hidden_act,
             attn_dropout=cfg.attention_probs_dropout_prob,
-            weight_attr=_init_attr(cfg))
+            weight_attr=_init_attr(cfg),
+            moe_experts=getattr(cfg, "moe_experts", 0) or None,
+            moe_capacity_factor=getattr(cfg, "moe_capacity_factor",
+                                        1.25))
         self.encoder = nn.TransformerEncoder(enc_layer,
                                              cfg.num_hidden_layers)
         self.pooler = BertPooler(cfg)
@@ -316,20 +324,38 @@ def build_pretrain_step(model: BertForPretraining,
                 am = (am != 0)[:, None, None, :]
             else:
                 am = None  # ring path has no mask support yet
+            moe_on = getattr(model.bert.config, "moe_experts", 0)
             with rng_key_scope(key), sp_scope:
-                return functional_call(
+                if moe_on:
+                    # Switch-MoE encoder: the per-layer differentiable
+                    # router aux losses are collected INSIDE fwd and
+                    # returned as an output, so jax.checkpoint sees
+                    # them as values, not escaping side effects
+                    from ..nn.layer.common import moe_aux_scope
+
+                    with moe_aux_scope() as aux_items:
+                        (mlm, nsp), _ = functional_call(
+                            model, p, b["input_ids"],
+                            b["token_type_ids"], attention_mask=am,
+                            masked_positions=b["masked_positions"])
+                    aux = sum(a._value.astype(jnp.float32)
+                              for a in list(aux_items))
+                    return mlm, nsp, aux
+                (mlm, nsp), _ = functional_call(
                     model, p, b["input_ids"], b["token_type_ids"],
                     attention_mask=am,
-                    masked_positions=b["masked_positions"])[0]
+                    masked_positions=b["masked_positions"])
+                return mlm, nsp, jnp.float32(0.0)
 
         if remat:
             fwd = jax.checkpoint(fwd)
-        mlm, nsp = fwd(cast, batch)
+        mlm, nsp, aux = fwd(cast, batch)
         loss = criterion(
             nn.layer.layers.Tensor(mlm), nn.layer.layers.Tensor(nsp),
             nn.layer.layers.Tensor(batch["masked_labels"]),
             nn.layer.layers.Tensor(batch["nsp_labels"]))
-        return loss._value
+        aux_w = getattr(model.bert.config, "moe_aux_weight", 0.01)
+        return loss._value + aux_w * aux
 
     b1, b2, eps = 0.9, 0.999, 1e-8
 
@@ -352,7 +378,10 @@ def build_pretrain_step(model: BertForPretraining,
             mhat = m / (1 - jnp.power(b1, tf))
             vhat = v / (1 - jnp.power(b2, tf))
             upd = mhat / (jnp.sqrt(vhat) + eps)
-            if weight_decay and p.ndim > 1:  # no decay on bias/LN
+            # no decay on bias/LN; stacked per-expert MoE biases are 2D
+            # ([E, d]) but still biases — exempt by name
+            is_bias = p.ndim <= 1 or k.endswith((".b1", ".b2"))
+            if weight_decay and not is_bias:
                 upd = upd + weight_decay * p
             new_p[k] = p - lr_s * upd
             new_m[k] = m

@@ -206,6 +206,27 @@ class Bilinear(Layer):
         return trace_fn(f, ins)
 
 
+import contextlib
+import threading
+
+_MOE_AUX = threading.local()
+
+
+@contextlib.contextmanager
+def moe_aux_scope():
+    """Collect the DIFFERENTIABLE Switch aux losses of every SwitchMoE
+    forward in the scope (works under jit tracing, where the layer
+    attribute channel is deliberately detached): yields a list that
+    fills with one aux Tensor per routed call — sum them into the
+    training loss."""
+    prev = getattr(_MOE_AUX, "items", None)
+    _MOE_AUX.items = []
+    try:
+        yield _MOE_AUX.items
+    finally:
+        _MOE_AUX.items = prev
+
+
 class SwitchMoE(Layer):
     """Switch-Transformer feed-forward: top-1 routed mixture of expert
     FFNs (Fedus et al. 2021).  The reference has no MoE (SURVEY.md §2.9
@@ -279,4 +300,8 @@ class SwitchMoE(Layer):
         # attribute: eager tape recipe only — never stash a tracer
         self.aux_loss = (None if isinstance(aux._value, jax.core.Tracer)
                          else aux)
+        # scope: the differentiable channel (eager AND traced)
+        items = getattr(_MOE_AUX, "items", None)
+        if items is not None:
+            items.append(aux)
         return out

@@ -104,22 +104,36 @@ class MultiHeadAttention(Layer):
 class TransformerEncoderLayer(Layer):
     def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
                  activation="relu", attn_dropout=None, act_dropout=None,
-                 normalize_before=False, weight_attr=None, bias_attr=None):
+                 normalize_before=False, weight_attr=None, bias_attr=None,
+                 moe_experts=None, moe_capacity_factor=1.25):
         super().__init__()
         self._config = (d_model, nhead, dim_feedforward, dropout,
                         activation, attn_dropout, act_dropout,
-                        normalize_before, weight_attr, bias_attr)
+                        normalize_before, weight_attr, bias_attr,
+                        moe_experts, moe_capacity_factor)
         attn_dropout = dropout if attn_dropout is None else attn_dropout
         act_dropout = dropout if act_dropout is None else act_dropout
         self.normalize_before = normalize_before
         self.self_attn = MultiHeadAttention(
             d_model, nhead, dropout=attn_dropout,
             weight_attr=weight_attr, bias_attr=bias_attr)
-        self.linear1 = Linear(d_model, dim_feedforward, weight_attr,
-                              bias_attr)
+        if moe_experts:
+            # Switch-Transformer layer: the dense FFN becomes a top-1
+            # routed expert mixture (nn.SwitchMoE; the reference has no
+            # MoE — SURVEY.md §2.9)
+            from .common import SwitchMoE
+
+            self.moe = SwitchMoE(d_model, dim_feedforward, moe_experts,
+                                 capacity_factor=moe_capacity_factor,
+                                 weight_attr=weight_attr)
+            self.linear1 = self.linear2 = None
+        else:
+            self.moe = None
+            self.linear1 = Linear(d_model, dim_feedforward, weight_attr,
+                                  bias_attr)
+            self.linear2 = Linear(dim_feedforward, d_model, weight_attr,
+                                  bias_attr)
         self.dropout = Dropout(act_dropout, mode="upscale_in_train")
-        self.linear2 = Linear(dim_feedforward, d_model, weight_attr,
-                              bias_attr)
         self.norm1 = LayerNorm(d_model)
         self.norm2 = LayerNorm(d_model)
         self.dropout1 = Dropout(dropout, mode="upscale_in_train")
@@ -141,7 +155,17 @@ class TransformerEncoderLayer(Layer):
         residual = src
         if self.normalize_before:
             src = self.norm2(src)
-        src = self.linear2(self.dropout(self.activation(self.linear1(src))))
+        if self.moe is not None:
+            # dropped (over-capacity) tokens ride the residual — the
+            # standard Switch semantics.  The dense path's activation
+            # dropout (inside the FFN at d_ff) is applied at the expert
+            # OUTPUT instead: in-expert dropout isn't expressible in
+            # the batched dispatch einsums, and Switch's expert dropout
+            # regularizes the same signal path
+            src = self.dropout(self.moe(src))
+        else:
+            src = self.linear2(
+                self.dropout(self.activation(self.linear1(src))))
         src = residual + self.dropout2(src)
         if not self.normalize_before:
             src = self.norm2(src)

@@ -185,3 +185,96 @@ class TestSwitchMoELayer:
         # eager call set the attribute; values agree with the buffer
         np.testing.assert_allclose(float(layer.aux_loss._value),
                                    aux_from_state, rtol=1e-5)
+
+
+class TestMoEBert:
+    """MoE-BERT (cfg.moe_experts>0: every encoder FFN becomes a
+    SwitchMoE — the Switch-Transformer architecture on the BERT family).
+    """
+
+    def _cfg(self, experts=4):
+        from paddle_tpu.models import bert
+
+        cfg = bert.BertConfig.tiny(num_hidden_layers=2)
+        cfg.moe_experts = experts
+        cfg.moe_capacity_factor = 2.0
+        return cfg
+
+    def test_pretrain_step_converges_with_aux(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.models import bert
+
+        cfg = self._cfg()
+        paddle.seed(0)
+        model = bert.BertForPretraining(cfg)
+        step, state = bert.build_pretrain_step(model, bf16=False)
+        b = bert.fake_batch(cfg, 8, 64, num_masked=8, seed=3)
+        losses = []
+        for _ in range(8):
+            state, l = step(state, b, jnp.float32(1e-3))
+            losses.append(float(l))
+        assert np.isfinite(losses[-1])
+        assert losses[-1] < losses[0]
+
+    def test_router_params_receive_gradient(self):
+        """The aux loss is differentiable through the collector scope:
+        after steps, the gate weights must have moved (a detached aux
+        would leave the router frozen under pure-MLM gradients only in
+        degenerate inits — compare directly)."""
+        import paddle_tpu as paddle
+        from paddle_tpu.models import bert
+
+        cfg = self._cfg()
+        cfg.moe_aux_weight = 1.0  # exaggerate for the movement check
+        paddle.seed(0)
+        model = bert.BertForPretraining(cfg)
+        step, state = bert.build_pretrain_step(model, bf16=False)
+        gate_keys = [k for k in state["params"] if "gate_weight" in k]
+        assert gate_keys, list(state["params"])[:8]
+        before = np.asarray(state["params"][gate_keys[0]]).copy()
+        b = bert.fake_batch(cfg, 8, 64, num_masked=8, seed=3)
+        for _ in range(3):
+            state, _ = step(state, b, jnp.float32(1e-2))
+        after = np.asarray(state["params"][gate_keys[0]])
+        assert np.abs(after - before).max() > 1e-6
+
+    def test_dp_sharded_matches_single_device(self):
+        """GSPMD dp sharding of the MoE-BERT step: routing/capacity are
+        computed GLOBALLY under pjit (unlike the shard_map ep path), so
+        the sharded trajectory must be numerically identical."""
+        import paddle_tpu as paddle
+        from paddle_tpu.models import bert
+
+        def run(mesh=None):
+            cfg = self._cfg()
+            paddle.seed(0)
+            model = bert.BertForPretraining(cfg)
+            step, state = bert.build_pretrain_step(
+                model, bf16=False, mesh=mesh,
+                dp_axis="dp" if mesh else None)
+            b = bert.fake_batch(cfg, 8, 64, num_masked=8, seed=3)
+            out = []
+            for _ in range(4):
+                state, l = step(state, b, jnp.float32(1e-3))
+                out.append(float(l))
+            return out
+
+        single = run()
+        sharded = run(make_mesh({"dp": 8}))
+        np.testing.assert_allclose(sharded, single, rtol=2e-4)
+
+    def test_remat_composes_with_moe(self):
+        """code-review r5: the aux losses are outputs of the
+        checkpointed fwd, so remat + MoE must trace and train."""
+        import paddle_tpu as paddle
+        from paddle_tpu.models import bert
+
+        cfg = self._cfg()
+        paddle.seed(0)
+        model = bert.BertForPretraining(cfg)
+        step, state = bert.build_pretrain_step(model, bf16=False,
+                                               remat=True)
+        b = bert.fake_batch(cfg, 8, 64, num_masked=8, seed=3)
+        state, l0 = step(state, b, jnp.float32(1e-3))
+        state, l1 = step(state, b, jnp.float32(1e-3))
+        assert np.isfinite(float(l1)) and float(l1) < float(l0)
