@@ -557,21 +557,20 @@ def test_zero_sharding_actually_shards_memory(fresh_programs):
             "moment math mostly runs at full shape — replicated update")
 
 
-@pytest.mark.tpu
 def test_zero_reduce_scatter_hlo_on_tpu_topology():
-    """Single-chip TPU lane evidence for ZeRO stage>=2 (VERDICT r4 next
-    #3): AOT-compile a dp-sharded grad+update step for an 8-chip v5e
-    TOPOLOGY (no 8 real chips needed — jax topology AOT) and assert the
-    TPU SPMD partitioner emits reduce-scatter for the sharded
-    optimizer-state update, the pattern the reference's sharding
-    optimizer hand-writes (sharding_optimizer.py:93-96)."""
+    """On-TPU-compiler evidence for ZeRO stage>=2 (VERDICT r4 next #3):
+    AOT-compile a dp-sharded grad+update step for an 8-chip v5e
+    TOPOLOGY — no chips needed at all: the TPU PJRT plugin's topology
+    API works even when the device tunnel is down, so this runs in the
+    regular CPU-mesh lane — and assert the TPU SPMD partitioner emits
+    reduce-scatter for the sharded optimizer-state update, the pattern
+    the reference's sharding optimizer hand-writes
+    (sharding_optimizer.py:93-96)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding
     from jax.sharding import PartitionSpec as P
 
-    if jax.default_backend() != "tpu":
-        pytest.skip("TPU lane only")
     try:
         from jax.experimental import topologies
 

@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""AOT v5e compiler analysis of the bench BERT step — no chips needed.
+
+The TPU PJRT plugin's topology API works even when the device tunnel is
+wedged, so the EXACT bench computation (BERT-base, batch 32, seq 512,
+bf16, fused fwd+bwd+AdamW) can be compiled FOR v5e and interrogated:
+XLA's cost model (flops, bytes accessed), executable memory stats, and
+the optimized-HLO structure.  Output: artifacts/aot_v5e_analysis.json
+plus a roofline summary against the 197 TFLOP/s / ~819 GB/s v5e chip —
+the compiler-backed half of the 40%→45% MFU analysis (VERDICT r4 next
+#2) usable while the tunnel is down.
+
+Caveat recorded in the output: the flash-attention Pallas kernel is
+force-disabled here (its availability probes compile against the
+default backend, which wedges with the tunnel), so attention appears as
+plain XLA ops; on chip the Pallas kernel strictly reduces the reported
+attention bytes.
+
+Usage: JAX_PLATFORMS=cpu python tools/aot_analysis.py
+           [--tiny] [--remat] [--flash]
+--flash bypasses the availability probe and compiles the Pallas kernel
+into the AOT executable (Mosaic runs inside the AOT pipeline).
+"""
+
+import collections
+import json
+import os
+import re
+import sys
+import time
+
+V5E_PEAK_FLOPS = 197e12
+V5E_HBM_BW = 819e9  # bytes/s
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ART = os.path.join(REPO, "artifacts")
+sys.path.insert(0, REPO)  # run from anywhere
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # default backend: no axon
+    import numpy as np
+
+    import jax.numpy as jnp
+    from jax.experimental import topologies
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.ops.pallas import attention as att
+
+    flash = "--flash" in sys.argv
+    if flash:
+        # force the Pallas path WITHOUT the availability probe (the
+        # probe compiles against the default backend, which wedges with
+        # the tunnel); Mosaic compiles inside the AOT pipeline instead.
+        # cost_analysis then counts the kernel's operand/result bytes —
+        # exactly its true HBM traffic, since flash never spills
+        # internals.
+        att._flash_ok = lambda *a, **k: True
+        att._probe_exact = lambda *a, **k: True
+    else:
+        att.disable_flash(
+            "aot topology analysis: default-backend probes would wedge")
+
+    from paddle_tpu.models import bert
+
+    import bench as bench_mod
+
+    tiny = "--tiny" in sys.argv
+    remat = "--remat" in sys.argv
+    if tiny:
+        cfg = bert.BertConfig.tiny()
+        batch, seq, n_masked = 8, 128, 20
+    else:
+        cfg = bert.BertConfig.base()
+        batch, seq, n_masked = 32, 512, 76
+
+    topo = topologies.get_topology_desc(platform="tpu",
+                                        topology_name="v5e:2x4")
+    model = bert.BertForPretraining(cfg)
+    step, state = bert.build_pretrain_step(model, bf16=True,
+                                           remat=remat)
+    b = bert.fake_batch(cfg, batch, seq, num_masked=n_masked)
+    lr = jnp.float32(1e-4)
+
+    mesh = Mesh(np.array(topo.devices[:1]), ("d",))
+    sh = NamedSharding(mesh, P())
+    shardings = jax.tree_util.tree_map(lambda _: sh, (state, b, lr))
+    fn = step.__wrapped__ if hasattr(step, "__wrapped__") else step
+    t0 = time.time()
+    comp = jax.jit(fn, in_shardings=shardings).lower(state, b, lr) \
+        .compile()
+    compile_s = time.time() - t0
+
+    ca = comp.cost_analysis() or {}
+    ma = comp.memory_analysis()
+    model_flops = bench_mod.bert_step_flops(cfg, batch, seq, n_masked)
+    xla_flops = float(ca.get("flops", 0.0))
+    xla_bytes = float(ca.get("bytes accessed", 0.0))
+
+    # HLO structure: op-kind histogram + the fattest fusions by their
+    # declared output bytes (a cheap proxy for HBM traffic per fusion)
+    txt = comp.as_text()
+    kinds = collections.Counter(
+        m.group(1) for m in re.finditer(
+            r"^\s*(?:ROOT )?%?[\w.\-]+ = .*? (\w[\w\-]*)\(",
+            txt, re.M))
+    top_kinds = kinds.most_common(20)
+
+    compute_s = model_flops / V5E_PEAK_FLOPS
+    hbm_s = xla_bytes / V5E_HBM_BW
+    roofline_s = max(compute_s, hbm_s)
+    # the last on-chip measurement applies only to the bench config
+    # (bert-base, no remat): headroom is meaningless for other variants
+    measured_ms = 122.1 if (not tiny and not remat) else None
+    result = {
+        "config": {"model": "bert-base" if not tiny else "bert-tiny",
+                   "batch": batch, "seq": seq, "bf16": True,
+                   "remat": remat,
+                   "flash_attention": flash,
+                   "note": (
+                       "Pallas flash kernel compiled into the AOT "
+                       "executable (probe bypassed); bytes counted at "
+                       "the custom-call boundary = its true HBM traffic"
+                       if flash else
+                       "flash disabled for AOT (probe would wedge on "
+                       "the tunnel); on chip Pallas replaces the XLA "
+                       "attention ops and reduces bytes")},
+        "compile_seconds": round(compile_s, 1),
+        "model_flops_per_step": model_flops,
+        "xla_counted_flops": xla_flops,
+        "xla_bytes_accessed": xla_bytes,
+        "roofline": {
+            "compute_bound_ms": round(compute_s * 1e3, 2),
+            "hbm_bound_ms": round(hbm_s * 1e3, 2),
+            "roofline_ms": round(roofline_s * 1e3, 2),
+            "mfu_at_roofline_pct": round(
+                model_flops / roofline_s / V5E_PEAK_FLOPS * 100, 2),
+            "last_measured_ms": measured_ms,
+            "headroom_vs_measured_ms": (
+                round(measured_ms - roofline_s * 1e3, 2)
+                if measured_ms else None),
+        },
+        "hlo_op_kinds_top20": top_kinds,
+        "memory": {
+            "argument_mb": round(ma.argument_size_in_bytes / 1e6, 1),
+            "output_mb": round(ma.output_size_in_bytes / 1e6, 1),
+            "temp_mb": round(ma.temp_size_in_bytes / 1e6, 1),
+            "generated_code_mb": round(
+                ma.generated_code_size_in_bytes / 1e6, 1),
+        },
+    }
+    os.makedirs(ART, exist_ok=True)
+    suffix = ("_remat" if remat else "") + ("_flash" if flash else "")
+    out = os.path.join(ART, f"aot_v5e_analysis{suffix}.json")
+    with open(out, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result["roofline"]))
+    print(f"written: {out}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())
