@@ -99,14 +99,57 @@ def main():
     xla_flops = float(ca.get("flops", 0.0))
     xla_bytes = float(ca.get("bytes accessed", 0.0))
 
-    # HLO structure: op-kind histogram + the fattest fusions by their
-    # declared output bytes (a cheap proxy for HBM traffic per fusion)
+    # HLO structure: op-kind histogram + the fattest top-level ops by
+    # their declared output bytes (a proxy for HBM traffic per fusion:
+    # every fusion result is an HBM write, and an HBM read at each use)
     txt = comp.as_text()
     kinds = collections.Counter(
         m.group(1) for m in re.finditer(
             r"^\s*(?:ROOT )?%?[\w.\-]+ = .*? (\w[\w\-]*)\(",
             txt, re.M))
     top_kinds = kinds.most_common(20)
+
+    DT_BYTES = {"pred": 1, "s8": 1, "u8": 1, "bf16": 2, "f16": 2,
+                "s16": 2, "u16": 2, "f32": 4, "s32": 4, "u32": 4,
+                "f64": 8, "s64": 8, "u64": 8}
+
+    def shape_bytes(sig):
+        total = 0
+        for dt, dims in re.findall(r"(\w+)\[([\d,]*)\]", sig):
+            if dt not in DT_BYTES:
+                continue
+            n = 1
+            for d in dims.split(","):
+                if d:
+                    n *= int(d)
+            total += n * DT_BYTES[dt]
+        return total
+
+    fusions = []
+    line_re = re.compile(
+        r"^\s*(?:ROOT )?%?([\w.\-]+) = (.*?) "
+        r"(fusion|custom-call|convolution|dot|all-reduce|copy)\(")
+    meta_re = re.compile(r'op_name="([^"]*)"')
+    for line in txt.splitlines():
+        m = line_re.match(line)
+        if not m:
+            continue
+        name, sig, kind = m.groups()
+        nbytes = shape_bytes(sig)
+        if not nbytes:
+            continue
+        mm = meta_re.search(line)
+        fusions.append((nbytes, kind, name,
+                        (mm.group(1) if mm else "")[:90]))
+    fusions.sort(reverse=True)
+    grouped = collections.Counter()
+    for nbytes, kind, name, op_name in fusions:
+        # aggregate repeated per-layer instances by op_name stem
+        stem = re.sub(r"\d+", "N", op_name or name)
+        grouped[stem] += nbytes
+    top_fusions = [
+        {"group": g, "output_gb": round(v / 1e9, 3)}
+        for g, v in grouped.most_common(25)]
 
     compute_s = model_flops / V5E_PEAK_FLOPS
     hbm_s = xla_bytes / V5E_HBM_BW
@@ -143,6 +186,7 @@ def main():
                 if measured_ms else None),
         },
         "hlo_op_kinds_top20": top_kinds,
+        "top_output_byte_groups": top_fusions,
         "memory": {
             "argument_mb": round(ma.argument_size_in_bytes / 1e6, 1),
             "output_mb": round(ma.output_size_in_bytes / 1e6, 1),
