@@ -162,7 +162,42 @@ def _kernel_preflight(jax, jnp):
 
             _att.disable_flash(f"preflight mismatch {err:.3g}")
             return False, f"flash/XLA mismatch {err:.3g}; disabled"
-        return True, f"flash vs XLA max err {err:.2e}"
+
+        # same discipline for the fused FFN kernel (ops/pallas/ffn.py),
+        # ISOLATED: an FFN preflight failure must disable that kernel
+        # (never time an unvalidated kernel) without misreporting the
+        # already-validated flash result
+        try:
+            from paddle_tpu.ops.pallas import ffn as _ffn
+
+            r = np.random.RandomState(1)
+            fx = jnp.asarray(r.randn(1024, 256) * 0.5, jnp.bfloat16)
+            fw1 = jnp.asarray(r.randn(256, 512) * 0.05, jnp.bfloat16)
+            fb1 = jnp.asarray(r.randn(512) * 0.01, jnp.bfloat16)
+            fw2 = jnp.asarray(r.randn(512, 256) * 0.05, jnp.bfloat16)
+            fb2 = jnp.asarray(r.randn(256) * 0.01, jnp.bfloat16)
+            k_out = _ffn.fused_ffn(fx, fw1, fb1, fw2, fb2) \
+                .astype(jnp.float32)
+            x32 = fx.astype(jnp.float32)
+            ref2 = (jax.nn.gelu(x32 @ fw1.astype(jnp.float32)
+                                + fb1.astype(jnp.float32),
+                                approximate=False)
+                    @ fw2.astype(jnp.float32)) \
+                + fb2.astype(jnp.float32)
+            ferr = float(jnp.max(jnp.abs(k_out - ref2)))
+            if ferr > 5e-2:
+                _ffn.disable_fused_ffn(f"preflight mismatch {ferr:.3g}")
+                note_ffn = f"; ffn mismatch {ferr:.3g}, disabled"
+            else:
+                note_ffn = f"; ffn max err {ferr:.2e}"
+        except Exception as fe:  # noqa: BLE001
+            try:
+                _ffn.disable_fused_ffn(f"preflight error: {fe}")
+            except Exception:  # noqa: BLE001 - import itself failed
+                pass
+            note_ffn = (f"; ffn preflight error "
+                        f"{type(fe).__name__}, disabled")
+        return True, f"flash vs XLA max err {err:.2e}{note_ffn}"
     except Exception as e:  # noqa: BLE001
         return False, f"preflight error: {type(e).__name__}: {e}"
 

@@ -164,8 +164,30 @@ class TransformerEncoderLayer(Layer):
             # regularizes the same signal path
             src = self.dropout(self.moe(src))
         else:
-            src = self.linear2(
-                self.dropout(self.activation(self.linear1(src))))
+            # fused FFN (Pallas on TPU, XLA elsewhere; ops/pallas/
+            # ffn.py): act + dropout + both matmuls in one call, d_ff
+            # intermediates off HBM.  Non-gelu/relu activations keep
+            # the layer-by-layer path
+            if isinstance(self.activation, GELU):
+                act_name = ("gelu_tanh" if self.activation._approximate
+                            else "gelu")
+            elif isinstance(self.activation, ReLU):
+                act_name = "relu"
+            else:
+                act_name = None
+            if act_name is not None and self.linear1.bias is not None \
+                    and self.linear2.bias is not None:
+                from .. import functional as F
+
+                src = F.fused_feedforward(
+                    src, self.linear1.weight, self.linear1.bias,
+                    self.linear2.weight, self.linear2.bias,
+                    activation=act_name,
+                    act_dropout=self.dropout.p,
+                    training=self.training)
+            else:
+                src = self.linear2(
+                    self.dropout(self.activation(self.linear1(src))))
         src = residual + self.dropout2(src)
         if not self.normalize_before:
             src = self.norm2(src)

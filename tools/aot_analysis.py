@@ -59,6 +59,9 @@ def main():
         # internals.
         att._flash_ok = lambda *a, **k: True
         att._probe_exact = lambda *a, **k: True
+        from paddle_tpu.ops.pallas import ffn as ffn_mod
+
+        ffn_mod._FORCE_KERNEL = True
     else:
         att.disable_flash(
             "aot topology analysis: default-backend probes would wedge")
@@ -196,7 +199,8 @@ def main():
         },
     }
     os.makedirs(ART, exist_ok=True)
-    suffix = ("_remat" if remat else "") + ("_flash" if flash else "")
+    suffix = ("_tiny" if tiny else "") + ("_remat" if remat else "") \
+        + ("_flash" if flash else "")
     out = os.path.join(ART, f"aot_v5e_analysis{suffix}.json")
     with open(out, "w") as f:
         json.dump(result, f, indent=1)
