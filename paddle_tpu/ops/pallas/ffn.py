@@ -400,16 +400,15 @@ def _ffn_ok(T, H, F, dtype, activation, dropout_p, block_t, block_f):
 
     # own probe (NOT attention._try_compile: its recovery path flips
     # the process-wide dimension-semantics flag, which must never be
-    # collateral of an FFN probe)
-    import warnings
-
+    # collateral of an FFN probe).  Silent per rung — the CALLER warns
+    # once if the whole ladder exhausts, so a successful smaller rung
+    # never logs a misleading "falling back" message.
     try:
         _PROBE_CACHE[key] = bool(compile_probe())
+        _PROBE_CACHE[(key, "err")] = None
     except Exception as e:  # noqa: BLE001 - degrade to XLA
-        warnings.warn(
-            f"fused FFN kernel rejected ({type(e).__name__}: {e}); "
-            "falling back to XLA ops", RuntimeWarning, stacklevel=2)
         _PROBE_CACHE[key] = False
+        _PROBE_CACHE[(key, "err")] = f"{type(e).__name__}: {e}"
     return _PROBE_CACHE[key]
 
 
@@ -429,14 +428,39 @@ def fused_ffn(x, w1, b1, w2, b2, activation="gelu", dropout_p=0.0,
         T *= d
     xt = x.reshape(T, H)
 
-    block_t = min(512, round_up(T, 128))
-    block_f = min(512, round_up(F, 128))
-    usable = (T % block_t == 0 and F % block_f == 0
-              and H % 128 == 0
-              and (interpret or _FORCE_KERNEL
-                   or (jax.default_backend() == "tpu"
-                       and _ffn_ok(T, H, F, x.dtype, activation,
-                                   dropout_p, block_t, block_f))))
+    # block ladder: prefer big tiles (fewer grid steps, better MXU
+    # shapes); if Mosaic rejects a rung (VMEM pressure at large
+    # d_model), probe the next before giving up the kernel.  Three
+    # rungs bound the worst-case probe cost for shapes that can never
+    # compile.
+    bt0 = min(512, round_up(T, 128))
+    bf0 = min(512, round_up(F, 128))
+    ladder = list(dict.fromkeys(
+        (bt, bf) for bt, bf in
+        [(bt0, bf0), (min(bt0, 256), bf0), (min(bt0, 256),
+                                            min(bf0, 256))]
+        if T % bt == 0 and F % bf == 0))
+    block_t = block_f = None
+    if H % 128 == 0 and ladder:
+        if interpret or _FORCE_KERNEL:
+            block_t, block_f = ladder[0]
+        elif jax.default_backend() == "tpu":
+            for bt, bf in ladder:
+                if _ffn_ok(T, H, F, x.dtype, activation, dropout_p,
+                           bt, bf):
+                    block_t, block_f = bt, bf
+                    break
+            if block_t is None:
+                import warnings
+
+                last_key = (T, H, F, jnp.dtype(x.dtype).name,
+                            activation, dropout_p) + ladder[-1]
+                warnings.warn(
+                    "fused FFN kernel unavailable for this shape "
+                    f"(last rung: {_PROBE_CACHE.get((last_key, 'err'))})"
+                    "; falling back to XLA ops", RuntimeWarning,
+                    stacklevel=2)
+    usable = block_t is not None
     if not usable:
         h = _act(jnp.dot(xt, w1, preferred_element_type=jnp.float32)
                  .astype(x.dtype) + b1, activation)
