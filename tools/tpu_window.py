@@ -31,9 +31,12 @@ import jax, jax.numpy as jnp
 assert jax.default_backend() == "tpu"
 from paddle_tpu.models import bert
 from paddle_tpu.ops.pallas import attention as att
+from paddle_tpu.ops.pallas import ffn as ffn_mod
 
-mode = sys.argv[1]  # "on" | "off"
-att._USE_DIM_SEMANTICS = (mode == "on")
+mode = sys.argv[1]  # "base" | "nodimsem" | "noffn"
+att._USE_DIM_SEMANTICS = (mode != "nodimsem")
+if mode == "noffn":
+    ffn_mod.disable_fused_ffn("A/B control arm")
 
 cfg = bert.BertConfig.base()
 model = bert.BertForPretraining(cfg)
@@ -51,7 +54,8 @@ for _ in range(3):
     float(loss)
     best = min(best, (time.perf_counter() - t0) / 10)
 print(json.dumps({"mode": mode, "step_ms": best * 1e3,
-                  "flash": att._FLASH_DISABLED is None}))
+                  "flash": att._FLASH_DISABLED is None,
+                  "ffn": ffn_mod._FFN_DISABLED is None}))
 """
 
 PROFILE_SCRIPT = r"""
@@ -160,11 +164,12 @@ def main():
         log_path=os.path.join(ART, "tpu_lane_zero.log"))
     results["tpu_lane_ok"] = ok2a and ok2b
 
-    # 3. dimension_semantics A/B
+    # 3. A/B: dimension_semantics grid hint and the fused FFN kernel,
+    # each against the full default ("base") configuration
     ab = {}
-    for mode in ("on", "off"):
+    for mode in ("base", "nodimsem", "noffn"):
         okm, outm, _ = run_phase(
-            f"dimsem_{mode}", [py, "-c", AB_SCRIPT, mode], 1200)
+            f"ab_{mode}", [py, "-c", AB_SCRIPT, mode], 1200)
         if okm:
             line = [l for l in outm.splitlines() if l.startswith("{")]
             if line:
