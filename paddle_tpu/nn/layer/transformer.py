@@ -101,6 +101,30 @@ class MultiHeadAttention(Layer):
         return out
 
 
+def _dense_ffn_block(layer, x):
+    """linear2(dropout(act(linear1(x)))) for encoder AND decoder
+    layers — routed through the fused FFN (Pallas on TPU, XLA
+    elsewhere; ops/pallas/ffn.py) when the activation is gelu/relu and
+    biases exist, keeping the d_ff intermediates off HBM; otherwise
+    the layer-by-layer path."""
+    if isinstance(layer.activation, GELU):
+        act_name = ("gelu_tanh" if layer.activation._approximate
+                    else "gelu")
+    elif isinstance(layer.activation, ReLU):
+        act_name = "relu"
+    else:
+        act_name = None
+    if act_name is not None and layer.linear1.bias is not None \
+            and layer.linear2.bias is not None:
+        return F.fused_feedforward(
+            x, layer.linear1.weight, layer.linear1.bias,
+            layer.linear2.weight, layer.linear2.bias,
+            activation=act_name, act_dropout=layer.dropout.p,
+            training=layer.training)
+    return layer.linear2(layer.dropout(layer.activation(
+        layer.linear1(x))))
+
+
 class TransformerEncoderLayer(Layer):
     def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
                  activation="relu", attn_dropout=None, act_dropout=None,
@@ -164,30 +188,7 @@ class TransformerEncoderLayer(Layer):
             # regularizes the same signal path
             src = self.dropout(self.moe(src))
         else:
-            # fused FFN (Pallas on TPU, XLA elsewhere; ops/pallas/
-            # ffn.py): act + dropout + both matmuls in one call, d_ff
-            # intermediates off HBM.  Non-gelu/relu activations keep
-            # the layer-by-layer path
-            if isinstance(self.activation, GELU):
-                act_name = ("gelu_tanh" if self.activation._approximate
-                            else "gelu")
-            elif isinstance(self.activation, ReLU):
-                act_name = "relu"
-            else:
-                act_name = None
-            if act_name is not None and self.linear1.bias is not None \
-                    and self.linear2.bias is not None:
-                from .. import functional as F
-
-                src = F.fused_feedforward(
-                    src, self.linear1.weight, self.linear1.bias,
-                    self.linear2.weight, self.linear2.bias,
-                    activation=act_name,
-                    act_dropout=self.dropout.p,
-                    training=self.training)
-            else:
-                src = self.linear2(
-                    self.dropout(self.activation(self.linear1(src))))
+            src = _dense_ffn_block(self, src)
         src = residual + self.dropout2(src)
         if not self.normalize_before:
             src = self.norm2(src)
@@ -288,7 +289,7 @@ class TransformerDecoderLayer(Layer):
         residual = tgt
         if self.normalize_before:
             tgt = self.norm3(tgt)
-        tgt = self.linear2(self.dropout(self.activation(self.linear1(tgt))))
+        tgt = _dense_ffn_block(self, tgt)
         tgt = residual + self.dropout3(tgt)
         if not self.normalize_before:
             tgt = self.norm3(tgt)
