@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Babysit the axon TPU tunnel and fire the window protocol on open.
+
+The tunnel is healthy only in unpredictable windows (VERDICT r4 next
+#1: "probe the tunnel ... repeatedly after each task").  This loop
+makes that stance mechanical: a cheap throwaway-subprocess probe every
+few minutes; the moment one succeeds, run the full on-chip agenda
+(tools/tpu_window.py --skip-probe, which itself bails early if the
+window closes and commits whatever evidence it banked).
+
+Stops when the agenda is COMPLETE (bench_onchip.json exists and the
+last tpu_window_results.json shows lane + A/B + profile done) or after
+--max-hours.  State goes to artifacts/babysit.log.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ART = os.path.join(REPO, "artifacts")
+LOG = os.path.join(ART, "babysit.log")
+
+sys.path.insert(0, os.path.join(REPO, "tools"))
+from tpu_window import probe_ok  # noqa: E402 - single probe definition
+
+
+def log(msg):
+    line = f"[{time.strftime('%H:%M:%S')}] {msg}"
+    print(line, file=sys.stderr)
+    with open(LOG, "a") as f:
+        f.write(line + "\n")
+
+
+def agenda_complete():
+    if not os.path.exists(os.path.join(REPO, "bench_onchip.json")):
+        return False
+    try:
+        with open(os.path.join(ART, "tpu_window_results.json")) as f:
+            res = json.load(f)
+    except (OSError, ValueError):
+        return False
+    return (res.get("bench_ok") and res.get("tpu_lane_ok")
+            and len(res.get("dimsem_ab") or {}) >= 3
+            and res.get("profile_ok"))
+
+
+def main():
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--max-hours", type=float, default=10.0)
+    ap.add_argument("--interval", type=float, default=300.0)
+    args = ap.parse_args()
+    max_hours, interval_s = args.max_hours, args.interval
+    os.makedirs(ART, exist_ok=True)
+    deadline = time.time() + max_hours * 3600
+    attempt = 0
+    while time.time() < deadline:
+        if agenda_complete():
+            log("agenda complete; babysitter exiting")
+            return 0
+        if probe_ok():
+            attempt += 1
+            log(f"window OPEN; launching tpu_window (attempt {attempt})")
+            p = subprocess.Popen(
+                [sys.executable,
+                 os.path.join(REPO, "tools", "tpu_window.py"),
+                 "--skip-probe"],
+                cwd=REPO, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True,
+                start_new_session=True)
+            try:
+                _, errout = p.communicate(timeout=4 * 3600)
+                log(f"tpu_window exited rc={p.returncode}; tail: "
+                    f"{(errout or '')[-500:]}")
+            except subprocess.TimeoutExpired:
+                # kill the whole process GROUP: an orphaned phase
+                # grandchild blocked in the TPU driver would hold the
+                # chip and wedge every later probe
+                import signal
+
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except OSError:
+                    pass
+                log("tpu_window hit the babysitter hard timeout; "
+                    "process group killed; re-arming")
+            if agenda_complete():
+                log("agenda complete; babysitter exiting")
+                return 0
+        else:
+            log("tunnel wedged; sleeping")
+        time.sleep(interval_s)
+    log("max-hours reached; babysitter exiting")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

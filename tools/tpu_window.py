@@ -100,16 +100,70 @@ print(json.dumps({"top_ops_us_total": [
 """
 
 
+# every phase shares the persistent XLA compile cache: a window that
+# closes mid-run still banks its compiles for the next attempt
+CACHE_ENV = {
+    "JAX_COMPILATION_CACHE_DIR": os.path.join(REPO, ".jax_cache"),
+    "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0",
+}
+
+
+def probe_ok(timeout_s=60):
+    """Cheap throwaway-subprocess tunnel probe (bench.py's trick)."""
+    code = ("import jax\nassert jax.default_backend()=='tpu'\n"
+            "import jax.numpy as jnp\n"
+            "print(float(jnp.sum(jnp.ones((2,2)))))\n")
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, timeout=timeout_s)
+        return r.returncode == 0 and b"4.0" in r.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def product_rev():
+    """Last commit touching the code whose performance/correctness the
+    banked evidence certifies.  Doc/tool/test commits between windows
+    must NOT invalidate banked phases; paddle_tpu or bench.py changes
+    must (an A/B whose arms ran on different product code is wrong)."""
+    try:
+        r = subprocess.run(
+            ["git", "log", "-1", "--format=%H", "--",
+             "paddle_tpu", "bench.py"],
+            capture_output=True, text=True, cwd=REPO, timeout=30)
+        return r.stdout.strip() or "unknown"
+    except Exception:  # noqa: BLE001
+        return "unknown"
+
+
 def run_phase(name, cmd, timeout_s, env=None, log_path=None):
     print(f"[tpu_window] {name}: {' '.join(cmd[:4])}... "
           f"(timeout {timeout_s}s)", file=sys.stderr)
     t0 = time.time()
     try:
-        r = subprocess.run(cmd, capture_output=True, text=True,
-                           timeout=timeout_s,
-                           env={**os.environ, **(env or {})}, cwd=REPO)
-        ok = r.returncode == 0
-        out, err = r.stdout, r.stderr
+        # own process group: on timeout, kill the whole tree — a phase
+        # grandchild left blocked inside the TPU driver would otherwise
+        # hold the chip and wedge every later probe
+        p = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                             stderr=subprocess.PIPE, text=True,
+                             env={**os.environ, **CACHE_ENV,
+                                  **(env or {})}, cwd=REPO,
+                             start_new_session=True)
+        try:
+            out, err = p.communicate(timeout=timeout_s)
+            ok = p.returncode == 0
+        except subprocess.TimeoutExpired:
+            import signal
+
+            try:
+                os.killpg(p.pid, signal.SIGKILL)
+            except OSError:
+                pass
+            try:
+                out, _ = p.communicate(timeout=30)
+            except Exception:  # noqa: BLE001
+                out = ""
+            ok, err = False, f"TIMEOUT after {timeout_s}s"
     except subprocess.TimeoutExpired as e:
         ok, out = False, (e.stdout or b"")
         out = out.decode() if isinstance(out, bytes) else out
@@ -127,70 +181,145 @@ def run_phase(name, cmd, timeout_s, env=None, log_path=None):
 def main():
     os.makedirs(ART, exist_ok=True)
     py = sys.executable
-    results = {"started_at": time.time()}
 
-    if "--skip-probe" not in sys.argv:
-        code = ("import jax\nassert jax.default_backend()=='tpu'\n"
-                "import jax.numpy as jnp\n"
-                "print(float(jnp.sum(jnp.ones((2,2)))))\n")
-        ok, out, _ = run_phase("probe", [py, "-c", code], 90)
-        if not ok or "4.0" not in out:
-            print("[tpu_window] tunnel not healthy; aborting",
-                  file=sys.stderr)
-            return 2
+    # INCREMENTAL windows: results merge across runs, so each window
+    # only has to get through the phases not yet banked, and a wedge
+    # mid-run can never clobber earlier evidence.  Once a wedge is
+    # detected the run hard-aborts (no further probes — wedges last
+    # hours; recovery is the babysit loop's job, re-armed cheaply by
+    # the persistent compile cache).
+    res_path = os.path.join(ART, "tpu_window_results.json")
+    try:
+        with open(res_path) as f:
+            banked = json.load(f)
+    except (OSError, ValueError):
+        banked = {}
+    rev = product_rev()
+    if banked.get("product_rev") != rev:
+        # product code changed since the bank was recorded: every
+        # banked phase is stale evidence — start over
+        banked = {}
+        try:
+            os.remove(os.path.join(ART, "dimsem_ab.json"))
+        except OSError:
+            pass
+    results = dict(banked)
+    results.pop("aborted_wedged_at", None)
+    results["product_rev"] = rev
+    results["started_at"] = time.time()
+    fails = results.setdefault("phase_failures", {})
 
-    # 1. the bench (persists bench_onchip.json itself)
-    ok1, out, err = run_phase(
-        "bench", [py, "bench.py"], 1500,
-        log_path=os.path.join(ART, "bench_run.log"))
-    results["bench_ok"] = ok1
+    def too_many(phase, limit=3):
+        """A phase that keeps failing with a HEALTHY tunnel is a real
+        bug, not a wedge; stop burning windows on it."""
+        if fails.get(phase, 0) < limit:
+            return False
+        print(f"[tpu_window] {phase}: {fails[phase]} healthy-tunnel "
+              "failures banked; skipping", file=sys.stderr)
+        return True
+
+    def note_fail(phase, wedged_now):
+        if not wedged_now:
+            fails[phase] = fails.get(phase, 0) + 1
+
+    if "--skip-probe" not in sys.argv and not probe_ok(90):
+        print("[tpu_window] tunnel not healthy; aborting",
+              file=sys.stderr)
+        return 2
+
+    def window_closed(label):
+        if probe_ok(60):
+            return False
+        print(f"[tpu_window] tunnel wedged ({label}); aborting "
+              "remaining phases", file=sys.stderr)
+        results["aborted_wedged_at"] = label
+        return True
+
+    wedged = False
+
+    # 1. the bench (persists bench_onchip.json itself) — always rerun:
+    # fresh numbers are the point, and the compile cache makes it cheap
+    ok1 = False
+    if not too_many("bench"):
+        ok1, out, err = run_phase(
+            "bench", [py, "bench.py"], 1500,
+            log_path=os.path.join(ART, "bench_run.log"))
+    results["bench_ok"] = ok1 or banked.get("bench_ok", False)
     if ok1:
         line = [l for l in out.splitlines() if l.startswith("{")]
         results["bench_line"] = json.loads(line[-1]) if line else None
+    else:
+        wedged = window_closed("after bench")
+        note_fail("bench", wedged)
 
     # 2. TPU test lane — two invocations: the `-m tpu` marker filter
     # would silently DESELECT the unmarked ZeRO node id if combined
-    ok2a, _, _ = run_phase(
-        "tpu_lane_kernels",
-        [py, "-m", "pytest", "-q", "-m", "tpu", "tests/"],
-        1500, env={"PADDLE_TPU_TEST_LANE": "1"},
-        log_path=os.path.join(ART, "tpu_lane.log"))
-    ok2b, _, _ = run_phase(
-        "tpu_lane_zero",
-        [py, "-m", "pytest", "-q",
-         "tests/test_distributed.py::"
-         "test_zero_sharding_actually_shards_memory"],
-        900, env={"PADDLE_TPU_TEST_LANE": "1"},
-        log_path=os.path.join(ART, "tpu_lane_zero.log"))
-    results["tpu_lane_ok"] = ok2a and ok2b
+    if (not wedged and not banked.get("tpu_lane_ok")
+            and not too_many("tpu_lane")):
+        ok2a, _, _ = run_phase(
+            "tpu_lane_kernels",
+            [py, "-m", "pytest", "-q", "-m", "tpu", "tests/"],
+            1500, env={"PADDLE_TPU_TEST_LANE": "1"},
+            log_path=os.path.join(ART, "tpu_lane.log"))
+        ok2b = False
+        if not ok2a:
+            wedged = window_closed("after tpu_lane_kernels")
+        if not wedged:
+            ok2b, _, _ = run_phase(
+                "tpu_lane_zero",
+                [py, "-m", "pytest", "-q",
+                 "tests/test_distributed.py::"
+                 "test_zero_sharding_actually_shards_memory"],
+                900, env={"PADDLE_TPU_TEST_LANE": "1"},
+                log_path=os.path.join(ART, "tpu_lane_zero.log"))
+            if not ok2b:
+                wedged = window_closed("after tpu_lane_zero")
+        results["tpu_lane_ok"] = ok2a and ok2b
+        if not (ok2a and ok2b):
+            note_fail("tpu_lane", wedged)
 
     # 3. A/B: dimension_semantics grid hint and the fused FFN kernel,
-    # each against the full default ("base") configuration
-    ab = {}
+    # each against the full default ("base") configuration.  Banked
+    # modes are skipped; fresh results merge into dimsem_ab.json.
+    ab_path = os.path.join(ART, "dimsem_ab.json")
+    try:
+        with open(ab_path) as f:
+            ab = json.load(f)
+    except (OSError, ValueError):
+        ab = {}
     for mode in ("base", "nodimsem", "noffn"):
+        if wedged or mode in ab or too_many(f"ab_{mode}"):
+            continue
         okm, outm, _ = run_phase(
             f"ab_{mode}", [py, "-c", AB_SCRIPT, mode], 1200)
         if okm:
             line = [l for l in outm.splitlines() if l.startswith("{")]
             if line:
                 ab[mode] = json.loads(line[-1])
+        else:
+            wedged = window_closed(f"after ab_{mode}")
+            note_fail(f"ab_{mode}", wedged)
     results["dimsem_ab"] = ab
-    with open(os.path.join(ART, "dimsem_ab.json"), "w") as f:
+    with open(ab_path, "w") as f:
         json.dump(ab, f, indent=1)
 
     # 4. profile
-    prof_dir = os.path.join(ART, "trace")
-    ok4, out4, _ = run_phase(
-        "profile", [py, "-c", PROFILE_SCRIPT, prof_dir], 1200)
-    if ok4:
-        line = [l for l in out4.splitlines() if l.startswith("{")]
-        if line:
-            with open(os.path.join(ART, "profile_summary.json"),
-                      "w") as f:
-                f.write(line[-1])
-    results["profile_ok"] = ok4
+    if (not wedged and not banked.get("profile_ok")
+            and not too_many("profile")):
+        prof_dir = os.path.join(ART, "trace")
+        ok4, out4, _ = run_phase(
+            "profile", [py, "-c", PROFILE_SCRIPT, prof_dir], 1200)
+        if ok4:
+            line = [l for l in out4.splitlines() if l.startswith("{")]
+            if line:
+                with open(os.path.join(ART, "profile_summary.json"),
+                          "w") as f:
+                    f.write(line[-1])
+        else:
+            note_fail("profile", window_closed("after profile"))
+        results["profile_ok"] = ok4
 
-    with open(os.path.join(ART, "tpu_window_results.json"), "w") as f:
+    with open(res_path, "w") as f:
         json.dump(results, f, indent=1, default=str)
 
     # the window may close (or the session end) at any time: persist
