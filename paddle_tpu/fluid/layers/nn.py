@@ -31,6 +31,7 @@ __all__ = [
     "logical_xor", "maximum", "minimum", "cumsum", "isfinite",
     "interpolate", "py_func", "auc", "warpctc",
     "ctc_greedy_decoder", "edit_distance",
+    "linear_chain_crf", "crf_decoding",
 ]
 
 
@@ -935,3 +936,57 @@ def edit_distance(input, label, normalized=True, input_length=None,
                      outputs={"Out": [out], "SequenceNum": [seq_num]},
                      attrs={"normalized": normalized}, infer_shape=False)
     return out, seq_num
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None):
+    """Linear-chain CRF negative log-likelihood (reference
+    layers/nn.py linear_chain_crf over linear_chain_crf_op.cc).
+    `input` is dense emissions (B, T, D) — ragged batches pass
+    `length` (B,) instead of LoD.  Creates the (D+2, D) transition
+    parameter (row 0 start, row 1 end, 2.. tag->tag) and returns the
+    per-sequence NLL (B, 1); crf_decoding shares the transition by
+    ParamAttr name."""
+    helper = LayerHelper("linear_chain_crf")
+    size = int(input.shape[-1])
+    transition = helper.create_parameter(param_attr, [size + 2, size],
+                                         dtype=input.dtype)
+    alpha = helper.create_variable_for_type_inference(dtype=input.dtype)
+    emission_exps = helper.create_variable_for_type_inference(
+        dtype=input.dtype)
+    transition_exps = helper.create_variable_for_type_inference(
+        dtype=input.dtype)
+    log_likelihood = helper.create_variable_for_type_inference(
+        dtype=input.dtype)
+    ins = {"Emission": [input], "Transition": [transition],
+           "Label": [label]}
+    if length is not None:
+        ins["Length"] = [length]
+    helper.append_op("linear_chain_crf", inputs=ins,
+                     outputs={"LogLikelihood": [log_likelihood],
+                              "Alpha": [alpha],
+                              "EmissionExps": [emission_exps],
+                              "TransitionExps": [transition_exps]},
+                     infer_shape=False)
+    return log_likelihood
+
+
+def crf_decoding(input, param_attr, label=None, length=None):
+    """Viterbi decode against a linear_chain_crf-trained transition
+    (reference layers/nn.py crf_decoding over crf_decoding_op.h).
+    `param_attr.name` must name the transition parameter created by
+    linear_chain_crf.  With `label`, returns the 0/1 per-position
+    correctness mask instead of the path."""
+    from ..param_attr import ParamAttr
+
+    helper = LayerHelper("crf_decoding")
+    attr = ParamAttr._to_attr(param_attr)
+    transition = helper.get_parameter(attr.name)
+    out = helper.create_variable_for_type_inference(dtype="int64")
+    ins = {"Emission": [input], "Transition": [transition]}
+    if label is not None:
+        ins["Label"] = [label]
+    if length is not None:
+        ins["Length"] = [length]
+    helper.append_op("crf_decoding", inputs=ins,
+                     outputs={"ViterbiPath": [out]}, infer_shape=False)
+    return out

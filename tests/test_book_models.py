@@ -163,3 +163,81 @@ def test_understand_sentiment_conv(fresh):
         last = float(l)
     assert np.isfinite(last)
     assert last < first * 0.5, (first, last)
+
+
+def test_label_semantic_roles_crf(fresh):
+    """SRL book chapter (/root/reference/python/paddle/fluid/tests/
+    book/test_label_semantic_roles.py:1): word/predicate/mark feature
+    embeddings -> summed fc projections -> a forward+reverse
+    dynamic_lstm pair -> fc emissions -> linear_chain_crf loss, with
+    crf_decoding sharing the transition parameter by name ('crfw').
+    Reduced depth (the reference stacks 8 LSTMs) but the same graph
+    shape: ragged batches ride a Length feed, train drops the NLL, and
+    Viterbi decode recovers the synthetic tag structure."""
+    main, startup, scope = fresh
+    DICT, MARK, EMB, HID, LABELS, T = 40, 2, 16, 16, 5, 10
+
+    word = fluid.data("word", [-1, T], "int64")
+    pred = fluid.data("predicate", [-1, T], "int64")
+    mark = fluid.data("mark", [-1, T], "int64")
+    target = fluid.data("target", [-1, T], "int64")
+    length = fluid.data("length", [-1], "int64")
+
+    feats = [
+        fluid.layers.embedding(word, size=[DICT, EMB]),
+        fluid.layers.embedding(pred, size=[DICT, EMB]),
+        fluid.layers.embedding(mark, size=[MARK, EMB]),
+    ]
+    proj = [fluid.layers.fc(f, 4 * HID, num_flatten_dims=2)
+            for f in feats]
+    mix = proj[0]
+    for p in proj[1:]:
+        mix = fluid.layers.elementwise_add(mix, p)
+    h_fwd, _ = fluid.layers.dynamic_lstm(mix, 4 * HID)
+    h_rev, _ = fluid.layers.dynamic_lstm(mix, 4 * HID, is_reverse=True)
+    both = fluid.layers.concat([h_fwd, h_rev], axis=2)
+    emission = fluid.layers.fc(both, LABELS, num_flatten_dims=2)
+
+    crf_cost = fluid.layers.linear_chain_crf(
+        emission, target, param_attr=fluid.ParamAttr(name="crfw"),
+        length=length)
+    avg_cost = fluid.layers.reduce_mean(crf_cost)
+    # reference uses SGD with mixed lr on crfw; Adam converges in the
+    # synthetic-data CI budget with the same graph
+    fluid.optimizer.Adam(0.05).minimize(avg_cost)
+
+    decode = fluid.layers.crf_decoding(
+        emission, param_attr=fluid.ParamAttr(name="crfw"),
+        length=length)
+
+    # ONE shared transition parameter, created once
+    crfw = [v for v in main.global_block().vars.values()
+            if getattr(v, "persistable", False) and v.name == "crfw"]
+    assert len(crfw) == 1
+    assert tuple(crfw[0].shape) == (LABELS + 2, LABELS)
+
+    exe = fluid.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(7)
+    B = 32
+    w = rng.randint(0, DICT, (B, T)).astype("int64")
+    p = np.repeat(rng.randint(0, DICT, (B, 1)), T, axis=1).astype("int64")
+    m = (w % 2).astype("int64")
+    # learnable tagging: the gold tag is a function of word and mark
+    y = ((w + m) % LABELS).astype("int64")
+    lens = rng.randint(T // 2, T + 1, B).astype("int64")
+    feed = {"word": w, "predicate": p, "mark": m, "target": y,
+            "length": lens}
+    first = last = None
+    for _ in range(120):
+        (l,) = exe.run(main, feed=feed, fetch_list=[avg_cost])
+        first = float(l) if first is None else first
+        last = float(l)
+    assert np.isfinite(last)
+    assert last < first * 0.5, (first, last)
+
+    (path,) = exe.run(main, feed=feed, fetch_list=[decode])
+    assert path.shape == (B, T)
+    live = np.arange(T)[None, :] < lens[:, None]
+    acc = (path == y)[live].mean()
+    assert acc > 0.8, acc

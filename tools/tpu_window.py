@@ -131,7 +131,17 @@ def product_rev():
             ["git", "log", "-1", "--format=%H", "--",
              "paddle_tpu", "bench.py"],
             capture_output=True, text=True, cwd=REPO, timeout=30)
-        return r.stdout.strip() or "unknown"
+        rev = r.stdout.strip() or "unknown"
+        # uncommitted product edits must ALSO invalidate the bank
+        d = subprocess.run(
+            ["git", "diff", "HEAD", "--", "paddle_tpu", "bench.py"],
+            capture_output=True, text=True, cwd=REPO, timeout=30)
+        if d.stdout.strip():
+            import hashlib
+
+            rev += "+dirty-" + hashlib.sha1(
+                d.stdout.encode()).hexdigest()[:10]
+        return rev
     except Exception:  # noqa: BLE001
         return "unknown"
 
@@ -140,34 +150,29 @@ def run_phase(name, cmd, timeout_s, env=None, log_path=None):
     print(f"[tpu_window] {name}: {' '.join(cmd[:4])}... "
           f"(timeout {timeout_s}s)", file=sys.stderr)
     t0 = time.time()
+    # own process group: on timeout, kill the whole tree — a phase
+    # grandchild left blocked inside the TPU driver would otherwise
+    # hold the chip and wedge every later probe
+    p = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                         stderr=subprocess.PIPE, text=True,
+                         env={**os.environ, **CACHE_ENV,
+                              **(env or {})}, cwd=REPO,
+                         start_new_session=True)
     try:
-        # own process group: on timeout, kill the whole tree — a phase
-        # grandchild left blocked inside the TPU driver would otherwise
-        # hold the chip and wedge every later probe
-        p = subprocess.Popen(cmd, stdout=subprocess.PIPE,
-                             stderr=subprocess.PIPE, text=True,
-                             env={**os.environ, **CACHE_ENV,
-                                  **(env or {})}, cwd=REPO,
-                             start_new_session=True)
-        try:
-            out, err = p.communicate(timeout=timeout_s)
-            ok = p.returncode == 0
-        except subprocess.TimeoutExpired:
-            import signal
+        out, err = p.communicate(timeout=timeout_s)
+        ok = p.returncode == 0
+    except subprocess.TimeoutExpired:
+        import signal
 
-            try:
-                os.killpg(p.pid, signal.SIGKILL)
-            except OSError:
-                pass
-            try:
-                out, _ = p.communicate(timeout=30)
-            except Exception:  # noqa: BLE001
-                out = ""
-            ok, err = False, f"TIMEOUT after {timeout_s}s"
-    except subprocess.TimeoutExpired as e:
-        ok, out = False, (e.stdout or b"")
-        out = out.decode() if isinstance(out, bytes) else out
-        err = f"TIMEOUT after {timeout_s}s"
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        try:
+            out, _ = p.communicate(timeout=30)
+        except Exception:  # noqa: BLE001
+            out = ""
+        ok, err = False, f"TIMEOUT after {timeout_s}s"
     dt = time.time() - t0
     if log_path:
         with open(log_path, "w") as f:
@@ -240,7 +245,8 @@ def main():
     # 1. the bench (persists bench_onchip.json itself) — always rerun:
     # fresh numbers are the point, and the compile cache makes it cheap
     ok1 = False
-    if not too_many("bench"):
+    ran_bench = not too_many("bench")
+    if ran_bench:
         ok1, out, err = run_phase(
             "bench", [py, "bench.py"], 1500,
             log_path=os.path.join(ART, "bench_run.log"))
@@ -248,7 +254,7 @@ def main():
     if ok1:
         line = [l for l in out.splitlines() if l.startswith("{")]
         results["bench_line"] = json.loads(line[-1]) if line else None
-    else:
+    elif ran_bench:
         wedged = window_closed("after bench")
         note_fail("bench", wedged)
 
@@ -345,7 +351,7 @@ def main():
         ["git", "commit", "-m",
          "Record on-chip TPU window results (bench, lane, A/B, profile)",
          "--"] + evidence, 60)
-    return 0 if ok1 else 1
+    return 0 if results.get("bench_ok") else 1
 
 
 if __name__ == "__main__":
