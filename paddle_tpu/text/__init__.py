@@ -4,4 +4,5 @@ local file formats; FakeTextDataset synthesizes token streams for
 tests."""
 
 from . import datasets  # noqa: F401
-from .datasets import Imdb, UCIHousing, FakeTextDataset  # noqa: F401
+from .datasets import (Conll05st, FakeTextDataset, Imdb,  # noqa: F401
+                       Imikolov, Movielens, UCIHousing, WMT14, WMT16)

@@ -221,3 +221,290 @@ class TestVisionModels:
             net = vm.resnet50(num_classes=3)
             # bottleneck expansion: final fc consumes 2048 features
             assert net.fc.weight.shape[0] == 2048
+
+
+class TestTextDatasetTail:
+    """Imikolov / Movielens / WMT14 / WMT16 / Conll05st against tiny
+    archives written in the REAL formats (reference:
+    python/paddle/text/datasets/*)."""
+
+    def _ptb_tar(self, tmp_path):
+        import io, tarfile as tl
+        buf = {}
+        buf["train"] = b"the cat sat\nthe dog sat\nthe cat ran\n"
+        buf["valid"] = b"the cat sat\n"
+        buf["test"] = b"a dog ran\n"
+        p = tmp_path / "simple-examples.tgz"
+        with tl.open(p, "w") as tf:
+            for split, body in buf.items():
+                info = tl.TarInfo(
+                    f"./simple-examples/data/ptb.{split}.txt")
+                info.size = len(body)
+                tf.addfile(info, io.BytesIO(body))
+        return str(p)
+
+    def test_imikolov_ngram_and_seq(self, tmp_path):
+        from paddle_tpu.text import Imikolov
+
+        d = Imikolov(self._ptb_tar(tmp_path), data_type="NGRAM",
+                     window_size=3, mode="train", min_word_freq=0)
+        # every line is <s> w w w <e> -> 3 trigrams per 3-word line
+        assert len(d) == 9
+        s = d[0]
+        assert len(s) == 3 and all(a.dtype == np.int64 for a in s)
+        # <s>/<e> tie with 'the' at freq 4 (the reference counts the
+        # markers in the same dict); ties break lexicographically
+        assert d.word_idx["<e>"] == 0 and d.word_idx["<s>"] == 1
+        assert d.word_idx["the"] == 2
+        assert "<unk>" in d.word_idx
+
+        seq = Imikolov(self._ptb_tar(tmp_path), data_type="SEQ",
+                       mode="valid", min_word_freq=0)
+        src, trg = seq[0]
+        assert src[0] == seq.word_idx["<s>"]
+        assert trg[-1] == seq.word_idx["<e>"]
+        np.testing.assert_array_equal(src[1:], trg[:-1])
+
+    def test_movielens(self, tmp_path):
+        import zipfile
+
+        p = tmp_path / "ml-1m.zip"
+        with zipfile.ZipFile(p, "w") as z:
+            z.writestr("ml-1m/movies.dat",
+                       "1::Toy Story (1995)::Animation|Comedy\n"
+                       "2::Heat (1995)::Action\n")
+            z.writestr("ml-1m/users.dat",
+                       "1::F::1::10::48067\n2::M::25::16::70072\n")
+            z.writestr("ml-1m/ratings.dat",
+                       "1::1::5::978300760\n2::2::3::978301968\n"
+                       "1::2::4::978302268\n2::1::1::978300275\n")
+        from paddle_tpu.text import Movielens
+
+        train = Movielens(str(p), mode="train", test_ratio=0.25,
+                          rand_seed=3)
+        test = Movielens(str(p), mode="test", test_ratio=0.25,
+                         rand_seed=3)
+        assert len(train) + len(test) == 4
+        uid, gender, age, job, mid, cats, title, rating = train[0]
+        assert gender[0] in (0, 1) and rating.dtype == np.float64
+        assert -5.0 <= rating[0] <= 5.0
+        # categories/title ids index the shared dicts
+        assert all(c in train.categories_dict.values() for c in cats)
+        assert all(t in train.movie_title_dict.values() for t in title)
+
+    def _wmt14_tar(self, tmp_path):
+        import io, tarfile as tl
+
+        p = tmp_path / "wmt14.tgz"
+        src_dict = b"<s>\n<e>\n<unk>\nhello\nworld\n"
+        trg_dict = b"<s>\n<e>\n<unk>\nbonjour\nmonde\n"
+        train = b"hello world\tbonjour monde\nhello\tbonjour\n"
+        with tl.open(p, "w") as tf:
+            for name, body in (("wmt14/src.dict", src_dict),
+                               ("wmt14/trg.dict", trg_dict),
+                               ("wmt14/train/train", train),
+                               ("wmt14/test/test", train[:20])):
+                info = tl.TarInfo(name)
+                info.size = len(body)
+                tf.addfile(info, io.BytesIO(body))
+        return str(p)
+
+    def test_wmt14(self, tmp_path):
+        from paddle_tpu.text import WMT14
+
+        d = WMT14(self._wmt14_tar(tmp_path), mode="train", dict_size=5)
+        assert len(d) == 2
+        src, trg, nxt = d[0]
+        assert src[0] == d.src_dict["<s>"] and src[-1] == d.src_dict["<e>"]
+        assert trg[0] == d.trg_dict["<s>"]
+        assert nxt[-1] == d.trg_dict["<e>"]
+        np.testing.assert_array_equal(trg[1:], nxt[:-1])
+        sd, td = d.get_dict()
+        rd, _ = d.get_dict(reverse=True)
+        assert rd[sd["hello"]] == "hello"
+
+    def test_wmt16(self, tmp_path):
+        import io, tarfile as tl
+
+        p = tmp_path / "wmt16.tgz"
+        body = ("hello world\thallo welt\n"
+                "world\twelt\n").encode()
+        with tl.open(p, "w") as tf:
+            for name in ("wmt16/train", "wmt16/val", "wmt16/test"):
+                info = tl.TarInfo(name)
+                info.size = len(body)
+                tf.addfile(info, io.BytesIO(body))
+        from paddle_tpu.text import WMT16
+
+        d = WMT16(str(p), mode="val", src_dict_size=-1,
+                  trg_dict_size=-1, lang="en")
+        assert d.src_dict["<s>"] == 0 and d.src_dict["<e>"] == 1 \
+            and d.src_dict["<unk>"] == 2
+        src, trg, nxt = d[0]
+        assert src[0] == 0 and src[-1] == 1
+        # 'world' appears twice in train -> first corpus word id (3)
+        assert d.src_dict["world"] == 3
+        de = WMT16(str(p), mode="val", lang="de")
+        assert de.src_dict["welt"] == 3
+
+    def test_conll05st(self, tmp_path):
+        import gzip as gz
+        import io, tarfile as tl
+
+        words = "The\ncat\nate\nfish\n.\n\n"
+        props = ("-\t(A0*\n-\t*)\neat\t(V*)\n-\t(A1*)\n-\t*\n\n")
+        p = tmp_path / "conll05st.tar"
+        with tl.open(p, "w") as tf:
+            for name, body in (
+                    ("conll05st-release/test.wsj/words/"
+                     "test.wsj.words.gz", gz.compress(words.encode())),
+                    ("conll05st-release/test.wsj/props/"
+                     "test.wsj.props.gz", gz.compress(props.encode()))):
+                info = tl.TarInfo(name)
+                info.size = len(body)
+                tf.addfile(info, io.BytesIO(body))
+        wd = tmp_path / "word.dict"
+        wd.write_text("The\ncat\nate\nfish\n.\nbos\neos\n")
+        vd = tmp_path / "verb.dict"
+        vd.write_text("eat\n")
+        td = tmp_path / "target.dict"
+        td.write_text("B-A0\nI-A0\nB-A1\nB-V\nO\n")
+        from paddle_tpu.text import Conll05st
+
+        d = Conll05st(str(p), str(wd), str(vd), str(td))
+        assert len(d) == 1
+        sample = d[0]
+        assert len(sample) == 9
+        word, n2, n1, c0, p1, p2, pred, mark, label = sample
+        assert word.shape == (5,)
+        # verb at position 2: mark window covers 0..4
+        np.testing.assert_array_equal(mark, [1, 1, 1, 1, 1])
+        assert (pred == 0).all()
+        wdict, vdict, ldict = d.get_dict()
+        assert label[2] == ldict["B-V"]
+        assert label[0] == ldict["B-A0"] and label[1] == ldict["I-A0"]
+        assert label[3] == ldict["B-A1"] and label[4] == ldict["O"]
+        # context features broadcast the verb neighborhood
+        assert (c0 == wdict["ate"]).all()
+        assert (n1 == wdict["cat"]).all()
+        assert (n2 == wdict["The"]).all()
+        assert (p1 == wdict["fish"]).all()
+        assert (p2 == wdict["."]).all()
+
+
+class TestVisionDatasetTail:
+    """Cifar100 / folder datasets / Flowers / VOC2012."""
+
+    def test_cifar100(self, tmp_path):
+        n = 4
+        data = np.arange(n * 3072, dtype=np.uint8).reshape(n, 3072)
+        for name, labels in (("train", [1, 2, 3, 4]),
+                             ("test", [5, 6, 7, 8])):
+            with open(tmp_path / name, "wb") as f:
+                pickle.dump({b"data": data,
+                             b"fine_labels": labels}, f)
+        from paddle_tpu.vision.datasets import Cifar100
+
+        d = Cifar100([str(tmp_path / "train"), str(tmp_path / "test")],
+                     mode="test")
+        assert len(d) == n
+        img, lab = d[0]
+        assert img.shape == (32, 32, 3) and lab == 5
+
+    def test_dataset_folder_and_image_folder(self, tmp_path):
+        from PIL import Image
+
+        for cls, px in (("ants", 10), ("bees", 200)):
+            os.makedirs(tmp_path / "root" / cls)
+            for i in range(2):
+                Image.fromarray(
+                    np.full((4, 4, 3), px + i, "uint8")).save(
+                    tmp_path / "root" / cls / f"{i}.png")
+        np.save(tmp_path / "root" / "ants" / "extra.npy",
+                np.zeros((4, 4, 3), "uint8"))
+        from paddle_tpu.vision.datasets import (DatasetFolder,
+                                                ImageFolder)
+
+        d = DatasetFolder(str(tmp_path / "root"))
+        assert d.classes == ["ants", "bees"]
+        assert len(d) == 5
+        img, lab = d[0]
+        assert img.shape == (4, 4, 3)
+        labs = sorted(int(l) for _, l in
+                      (d[i] for i in range(len(d))))
+        assert labs == [0, 0, 0, 1, 1]
+
+        f = ImageFolder(str(tmp_path / "root"))
+        assert len(f) == 5
+        (img,) = f[0]
+        assert img.shape == (4, 4, 3)
+
+    def test_flowers(self, tmp_path):
+        import io, tarfile as tl
+
+        from PIL import Image
+        from scipy.io import savemat
+
+        n = 4
+        p = tmp_path / "102flowers.tgz"
+        with tl.open(p, "w:gz") as tf:
+            for i in range(1, n + 1):
+                b = io.BytesIO()
+                Image.fromarray(
+                    np.full((6, 6, 3), 10 * i, "uint8")).save(
+                    b, format="JPEG")
+                body = b.getvalue()
+                info = tl.TarInfo(f"jpg/image_{i:05d}.jpg")
+                info.size = len(body)
+                tf.addfile(info, io.BytesIO(body))
+        savemat(tmp_path / "imagelabels.mat",
+                {"labels": np.array([[3, 1, 2, 1]], "float64")})
+        savemat(tmp_path / "setid.mat",
+                {"trnid": np.array([[1, 2]], "float64"),
+                 "valid": np.array([[3]], "float64"),
+                 "tstid": np.array([[4]], "float64")})
+        from paddle_tpu.vision.datasets import Flowers
+
+        d = Flowers(str(p), str(tmp_path / "imagelabels.mat"),
+                    str(tmp_path / "setid.mat"), mode="train")
+        assert len(d) == 2
+        img, lab = d[0]
+        assert img.shape == (6, 6, 3)
+        assert lab == 2  # 1-based 3 -> 0-based 2
+        v = Flowers(str(p), str(tmp_path / "imagelabels.mat"),
+                    str(tmp_path / "setid.mat"), mode="valid")
+        assert len(v) == 1 and v[0][1] == 1
+
+    def test_voc2012(self, tmp_path):
+        import io, tarfile as tl
+
+        from PIL import Image
+
+        p = tmp_path / "voc.tar"
+        with tl.open(p, "w") as tf:
+            def add(name, body):
+                info = tl.TarInfo("VOCdevkit/VOC2012/" + name)
+                info.size = len(body)
+                tf.addfile(info, io.BytesIO(body))
+
+            add("ImageSets/Segmentation/train.txt", b"img1\n")
+            b = io.BytesIO()
+            Image.fromarray(
+                np.full((5, 7, 3), 9, "uint8")).save(b, format="JPEG")
+            add("JPEGImages/img1.jpg", b.getvalue())
+            mask = Image.fromarray(
+                np.arange(35, dtype="uint8").reshape(5, 7) % 21,
+                mode="P")
+            mask.putpalette([0] * 768)
+            b2 = io.BytesIO()
+            mask.save(b2, format="PNG")
+            add("SegmentationClass/img1.png", b2.getvalue())
+        from paddle_tpu.vision.datasets import VOC2012
+
+        d = VOC2012(str(p), mode="train")
+        assert len(d) == 1
+        img, mask = d[0]
+        assert img.shape == (5, 7, 3)
+        assert mask.shape == (5, 7) and mask.dtype == np.int64
+        np.testing.assert_array_equal(
+            mask, np.arange(35).reshape(5, 7) % 21)

@@ -132,15 +132,20 @@ def product_rev():
              "paddle_tpu", "bench.py"],
             capture_output=True, text=True, cwd=REPO, timeout=30)
         rev = r.stdout.strip() or "unknown"
-        # uncommitted product edits must ALSO invalidate the bank
+        # uncommitted product edits must ALSO invalidate the bank;
+        # porcelain (not diff) so UNTRACKED new product files count too
+        s = subprocess.run(
+            ["git", "status", "--porcelain", "--", "paddle_tpu",
+             "bench.py"],
+            capture_output=True, text=True, cwd=REPO, timeout=30)
         d = subprocess.run(
             ["git", "diff", "HEAD", "--", "paddle_tpu", "bench.py"],
             capture_output=True, text=True, cwd=REPO, timeout=30)
-        if d.stdout.strip():
+        if s.stdout.strip() or d.stdout.strip():
             import hashlib
 
             rev += "+dirty-" + hashlib.sha1(
-                d.stdout.encode()).hexdigest()[:10]
+                (s.stdout + d.stdout).encode()).hexdigest()[:10]
         return rev
     except Exception:  # noqa: BLE001
         return "unknown"
