@@ -1,1 +1,3 @@
 """fluid.incubate — incubating APIs (reference fluid/incubate/)."""
+
+from . import checkpoint, data_generator  # noqa: F401

@@ -122,3 +122,90 @@ class TestQueueDataset:
         ds = fluid.DatasetFactory().create_dataset("QueueDataset")
         with pytest.raises(NotImplementedError):
             ds.set_pipe_command("cat")
+
+
+class TestDataGenerator:
+    """fluid.incubate.data_generator -> MultiSlot wire format ->
+    QueueDataset round trip (reference incubate/data_generator/
+    __init__.py: the ETL half of the train_from_dataset path)."""
+
+    def test_string_generator_wire_format(self):
+        import io
+
+        from paddle_tpu.fluid.incubate.data_generator import \
+            MultiSlotStringDataGenerator
+
+        class G(MultiSlotStringDataGenerator):
+            def generate_sample(self, line):
+                def it():
+                    yield [("words", ["1926", "08", "17"]),
+                           ("label", ["1"])]
+                return it
+
+        g = G()
+        out = io.StringIO()
+        g._run([None], out)
+        assert out.getvalue() == "3 1926 08 17 1 1\n"
+
+    def test_typed_generator_proto_checks(self):
+        import io
+
+        from paddle_tpu.fluid.incubate.data_generator import \
+            MultiSlotDataGenerator
+
+        class G(MultiSlotDataGenerator):
+            def generate_sample(self, line):
+                def it():
+                    yield [("x", [1, 2]), ("y", [0.5])]
+                return it
+
+        g = G()
+        out = io.StringIO()
+        g._run([None], out)
+        assert out.getvalue() == "2 1 2 1 0.5\n"
+        # slot-name mismatch after the first record is an error
+        with pytest.raises(ValueError):
+            g._gen_str([("z", [1, 2]), ("y", [0.5])])
+        with pytest.raises(ValueError):
+            g._gen_str([("x", [1, 2])])
+        # int slot later emitting floats silently promotes (reference
+        # proto_info behavior), and strings are rejected
+        g._gen_str([("x", [1.5, 2.0]), ("y", [0.5])])
+        with pytest.raises(ValueError):
+            g._gen_str([("x", ["nope"]), ("y", [0.5])])
+
+    def test_generator_feeds_train_from_dataset(self, tmp_path,
+                                                fresh_programs):
+        from paddle_tpu.fluid.incubate.data_generator import \
+            MultiSlotDataGenerator
+
+        rng = np.random.RandomState(11)
+        W = np.arange(1, 9, dtype="float32").reshape(8, 1) / 10.0
+
+        class G(MultiSlotDataGenerator):
+            def generate_sample(self, line):
+                def it():
+                    x = rng.randn(8).astype("float32")
+                    y = float((x @ W).item())
+                    yield [("x", [round(float(v), 6) for v in x]),
+                           ("y", [round(y, 6)])]
+                return it
+
+        path = str(tmp_path / "gen-part-0.txt")
+        g = G()
+        with open(path, "w") as f:
+            g._run([None] * 60, f)
+
+        main, startup, scope = fresh_programs
+        x, y, loss = _build_program()
+        ds = fluid.DatasetFactory().create_dataset("QueueDataset")
+        ds.set_batch_size(10)
+        ds.set_use_var([x, y])
+        ds.set_filelist([path])
+        exe = fluid.Executor()
+        exe.run(startup)
+        losses = []
+        for _ in range(12):
+            out = exe.train_from_dataset(main, ds, fetch_list=[loss])
+            losses.append(float(out[0]))
+        assert losses[-1] < losses[0] * 0.5
