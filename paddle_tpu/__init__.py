@@ -36,10 +36,12 @@ from .framework_io import load, save
 from . import distribution
 from . import vision
 from . import text
+from . import dataset
 from . import inference
 from . import profiler
 from . import utils
 from . import reader
+from .batch import batch
 from . import static
 from . import onnx
 from .fluid.flags import get_flags, set_flags

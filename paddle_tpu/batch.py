@@ -1,0 +1,22 @@
+"""paddle.batch (reference python/paddle/batch.py): wrap a sample
+reader into a batched reader."""
+
+__all__ = ["batch"]
+
+
+def batch(reader, batch_size, drop_last=False):
+    if batch_size <= 0:
+        raise ValueError(f"batch_size must be positive, got "
+                         f"{batch_size}")
+
+    def batch_reader():
+        b = []
+        for sample in reader():
+            b.append(sample)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return batch_reader

@@ -1,0 +1,17 @@
+"""paddle.dataset — the classic reader-creator API.
+
+Reference: /root/reference/python/paddle/dataset/ (mnist.py:96 train(),
+uci_housing.py:91, common.py:132 split, image.py).  The reference
+itself deprecates these in favor of the class datasets ("Please use
+new dataset API"); here each module is a thin reader shim over the
+paddle_tpu.vision/text Dataset classes, so legacy `for sample in
+paddle.dataset.mnist.train(...)():` loops keep working.  Zero-egress:
+readers take the local archive paths the class datasets take —
+`common.download` raises with instructions instead of fetching.
+"""
+
+from . import (cifar, common, conll05, flowers, image, imdb,  # noqa: F401
+               imikolov, mnist, movielens, uci_housing, voc2012,
+               wmt14, wmt16)
+
+__all__ = []

@@ -130,7 +130,9 @@ class MultiSlotDataGenerator(DataGenerator):
     def _gen_str(self, record):
         _check_record(record)
         if self._proto_info is None:
-            self._proto_info = []
+            # build locally; assign only after the WHOLE record
+            # validates, so a mid-record error leaves no partial state
+            proto = []
             for name, elements in record:
                 if not isinstance(name, str):
                     raise ValueError(
@@ -148,7 +150,8 @@ class MultiSlotDataGenerator(DataGenerator):
                         raise ValueError(
                             f"slot {name!r}: values must be int or "
                             f"float, got {type(e).__name__}")
-                self._proto_info.append((name, tp))
+                proto.append((name, tp))
+            self._proto_info = proto
         else:
             if len(record) != len(self._proto_info):
                 raise ValueError(

@@ -28,6 +28,27 @@ class Imdb(Dataset):
     aclImdb tar archive; builds a frequency-ranked vocab; samples are
     (token_ids int64 array, label 0/1)."""
 
+    _PAT = re.compile(r"aclImdb/(train|test)/(pos|neg)/.*\.txt$")
+
+    @classmethod
+    def build_dict(cls, data_path, cutoff=150):
+        """Vocab only — tokenizes both splits (reference imdb.py
+        build_dict) without materializing document samples."""
+        freq = {}
+        with tarfile.open(data_path) as tf:
+            for m in tf.getmembers():
+                if cls._PAT.match(m.name):
+                    body = tf.extractfile(m).read().decode(
+                        "utf-8", "ignore").lower()
+                    for t in re.findall(r"[a-z']+", body):
+                        freq[t] = freq.get(t, 0) + 1
+        vocab = [w for w, c in sorted(freq.items(),
+                                      key=lambda kv: (-kv[1], kv[0]))
+                 if c >= cutoff]
+        word_idx = {w: i for i, w in enumerate(vocab)}
+        word_idx["<unk>"] = len(word_idx)
+        return word_idx
+
     def __init__(self, data_path=None, mode="train", cutoff=150,
                  download=False):
         if download or data_path is None:
@@ -37,12 +58,11 @@ class Imdb(Dataset):
         # build_dict tokenizes train+test) so train- and test-mode
         # datasets agree on every word id; only `mode`'s documents
         # become samples
-        pat = re.compile(r"aclImdb/(train|test)/(pos|neg)/.*\.txt$")
         self._docs, self._labels = [], []
         texts, freq = [], {}
         with tarfile.open(data_path) as tf:
             for m in tf.getmembers():
-                mm = pat.match(m.name)
+                mm = self._PAT.match(m.name)
                 if mm:
                     body = tf.extractfile(m).read().decode(
                         "utf-8", "ignore").lower()
@@ -121,35 +141,47 @@ class Imikolov(Dataset):
     '<unk>' last; samples are `window_size`-grams (data_type='NGRAM')
     or (<s>+sent, sent+<e>) id pairs (data_type='SEQ')."""
 
+    _BASE = "./simple-examples/data/ptb.{}.txt"
+
+    @classmethod
+    def _read_lines(cls, tf, split):
+        f = tf.extractfile(cls._BASE.format(split))
+        return [l.decode("utf-8", "ignore") for l in f]
+
+    @classmethod
+    def build_dict(cls, data_path, min_word_freq=50):
+        """Vocab only — no sample materialization (the classic
+        imikolov.build_dict path)."""
+        freq = {}
+        with tarfile.open(data_path) as tf:
+            for split in ("train", "valid"):
+                for l in cls._read_lines(tf, split):
+                    for w in l.strip().split():
+                        freq[w] = freq.get(w, 0) + 1
+                    freq["<s>"] = freq.get("<s>", 0) + 1
+                    freq["<e>"] = freq.get("<e>", 0) + 1
+        freq.pop("<unk>", None)
+        vocab = [w for w, c in sorted(freq.items(),
+                                      key=lambda kv: (-kv[1], kv[0]))
+                 if c > min_word_freq]
+        word_idx = {w: i for i, w in enumerate(vocab)}
+        word_idx["<unk>"] = len(word_idx)
+        return word_idx
+
     def __init__(self, data_path=None, data_type="NGRAM", window_size=-1,
-                 mode="train", min_word_freq=50, download=False):
+                 mode="train", min_word_freq=50, word_idx=None,
+                 download=False):
         if download or data_path is None:
             raise ValueError(f"Imikolov: data_path to the simple-examples "
                              f"tar required ({_NO_DOWNLOAD})")
         if data_type not in ("NGRAM", "SEQ"):
             raise ValueError(f"Imikolov: unknown data_type {data_type!r}")
-        base = "./simple-examples/data/ptb.{}.txt"
-        freq = {}
+        # honor a caller-built dict (classic API passes build_dict's
+        # result) — ids must agree with the dict the user embeds with
+        self.word_idx = word_idx if word_idx is not None \
+            else self.build_dict(data_path, min_word_freq)
         with tarfile.open(data_path) as tf:
-            def lines(split):
-                f = tf.extractfile(base.format(split))
-                return [l.decode("utf-8", "ignore") for l in f]
-
-            corpora = {s: lines(s) for s in ("train", "valid")}
-            if mode not in corpora:
-                corpora[mode] = lines(mode)
-        for split in ("train", "valid"):
-            for l in corpora[split]:
-                for w in l.strip().split():
-                    freq[w] = freq.get(w, 0) + 1
-                freq["<s>"] = freq.get("<s>", 0) + 1
-                freq["<e>"] = freq.get("<e>", 0) + 1
-        freq.pop("<unk>", None)
-        vocab = [w for w, c in sorted(freq.items(),
-                                      key=lambda kv: (-kv[1], kv[0]))
-                 if c > min_word_freq]
-        self.word_idx = {w: i for i, w in enumerate(vocab)}
-        self.word_idx["<unk>"] = len(self.word_idx)
+            corpora = {mode: self._read_lines(tf, mode)}
         unk = self.word_idx["<unk>"]
         self.data = []
         for l in corpora[mode]:

@@ -265,6 +265,17 @@ class TestTextDatasetTail:
         assert trg[-1] == seq.word_idx["<e>"]
         np.testing.assert_array_equal(src[1:], trg[:-1])
 
+        # a caller-built dict is HONORED (classic build_dict -> train
+        # flow): ids come from the passed dict, not a rebuilt one
+        wd = {w: i + 100 for i, w in enumerate(
+            ["<s>", "<e>", "the", "cat", "sat"])}
+        wd["<unk>"] = 999
+        d2 = Imikolov(self._ptb_tar(tmp_path), data_type="SEQ",
+                      mode="valid", word_idx=wd)
+        assert d2.word_idx is wd
+        src2, _ = d2[0]
+        assert src2[0] == 100  # <s> under the caller's ids
+
     def test_movielens(self, tmp_path):
         import zipfile
 
@@ -508,3 +519,80 @@ class TestVisionDatasetTail:
         assert mask.shape == (5, 7) and mask.dtype == np.int64
         np.testing.assert_array_equal(
             mask, np.arange(35).reshape(5, 7) % 21)
+
+
+class TestClassicDatasetReaders:
+    """paddle.dataset classic reader shims (reference
+    python/paddle/dataset/): `train()()` generator loops over the same
+    archives the class datasets parse, with the classic
+    normalizations."""
+
+    def _idx_files(self, tmp_path, n=6):
+        imgs = np.arange(n * 784, dtype="uint8").reshape(n, 784) % 255
+        ip = tmp_path / "images.idx"
+        with open(ip, "wb") as f:
+            f.write(struct.pack(">IIII", 2051, n, 28, 28))
+            f.write(imgs.tobytes())
+        lp = tmp_path / "labels.idx"
+        with open(lp, "wb") as f:
+            f.write(struct.pack(">II", 2049, n))
+            f.write(np.arange(n, dtype="uint8").tobytes())
+        return str(ip), str(lp)
+
+    def test_mnist_reader_normalization(self, tmp_path):
+        from paddle_tpu.dataset import mnist
+
+        ip, lp = self._idx_files(tmp_path)
+        samples = list(mnist.train(ip, lp)())
+        assert len(samples) == 6
+        vec, label = samples[3]
+        assert vec.shape == (784,) and vec.dtype == np.float32
+        assert -1.0 <= vec.min() and vec.max() <= 1.0
+        assert label == 3
+
+    def test_uci_housing_reader(self, tmp_path):
+        p = tmp_path / "housing.data"
+        rng = np.random.RandomState(0)
+        np.savetxt(p, rng.rand(20, 14).astype("float32"))
+        from paddle_tpu.dataset import uci_housing
+
+        tr = list(uci_housing.train(str(p))())
+        te = list(uci_housing.test(str(p))())
+        assert len(tr) == 16 and len(te) == 4
+        x, y = tr[0]
+        assert x.shape == (13,) and y.shape == (1,)
+
+    def test_common_split_and_cluster_reader(self, tmp_path):
+        from paddle_tpu.dataset import common
+
+        def reader():
+            for i in range(10):
+                yield (i, i * i)
+
+        suffix = str(tmp_path / "part-%05d.pickle")
+        common.split(reader, 4, suffix=suffix)
+        import glob
+
+        assert len(glob.glob(str(tmp_path / "part-*.pickle"))) == 3
+        shard0 = list(common.cluster_files_reader(
+            str(tmp_path / "part-*.pickle"), 2, 0)())
+        shard1 = list(common.cluster_files_reader(
+            str(tmp_path / "part-*.pickle"), 2, 1)())
+        got = sorted(shard0 + shard1)
+        assert got == [(i, i * i) for i in range(10)]
+        with pytest.raises(RuntimeError, match="zero-egress"):
+            common.download("http://x", "mnist", "0")
+
+    def test_image_helpers(self):
+        from paddle_tpu.dataset import image as dimg
+
+        im = np.arange(12 * 16 * 3, dtype="uint8").reshape(12, 16, 3)
+        r = dimg.resize_short(im, 6)
+        assert min(r.shape[:2]) == 6
+        c = dimg.center_crop(r, 6)
+        assert c.shape[:2] == (6, 6)
+        t = dimg.simple_transform(im, 8, 6, is_train=False,
+                                  mean=[1.0, 2.0, 3.0])
+        assert t.shape == (3, 6, 6) and t.dtype == np.float32
+        f = dimg.left_right_flip(im)
+        np.testing.assert_array_equal(f, im[:, ::-1, :])
