@@ -131,8 +131,8 @@ def _init_backend(timeout_s=240.0):
     _cpu_reexec()
 
 
-def _enable_compile_cache(jax):
-    """Persistent XLA compilation cache (round 5).
+def _enable_compile_cache(jax, backend):
+    """Persistent XLA compilation cache (round 5), TPU ONLY.
 
     Over the flaky axon tunnel a window can close mid-run; the compile
     of the fused train step is the expensive prefix (minutes).  With
@@ -140,7 +140,20 @@ def _enable_compile_cache(jax):
     pays it once, and every later attempt deserializes in seconds —
     so even a short window can produce the on-chip number.  Best
     effort: if the PJRT plugin cannot serialize executables jax warns
-    and runs uncached."""
+    and runs uncached.
+
+    NOT enabled on CPU: XLA:CPU AOT cache entries pin host machine
+    features, and reloading under a slightly different feature set
+    both warns about SIGILL and deoptimizes (observed: 92 -> 405 ms
+    fallback step)."""
+    if backend != "tpu":
+        try:
+            # also override a JAX_COMPILATION_CACHE_DIR inherited from
+            # tools/tpu_window.py when we fell back to CPU mid-window
+            jax.config.update("jax_compilation_cache_dir", None)
+        except Exception:  # noqa: BLE001
+            pass
+        return
     try:
         cache_dir = os.path.join(
             os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
@@ -419,7 +432,7 @@ def main():
         print("bench: TPU unreachable; pinning to CPU", file=sys.stderr)
         os.environ["JAX_PLATFORMS"] = "cpu"
     jax, backend = _init_backend()
-    _enable_compile_cache(jax)
+    _enable_compile_cache(jax, backend)
     import jax.numpy as jnp
 
     from paddle_tpu.models import bert
@@ -438,6 +451,20 @@ def main():
     if on_tpu:
         cfg = bert.BertConfig.base()
         batch, seq, n_masked = 32, 512, 76
+        # a window-measured batch override (tools/tpu_window.py writes
+        # artifacts/bench_tuning.json when a batch arm beats base by
+        # >2% tokens/sec on chip); never trusted blindly — _time_step
+        # failures fall back to batch 32 below
+        tuning_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "artifacts",
+            "bench_tuning.json")
+        try:
+            with open(tuning_path) as f:
+                tuned = int(json.load(f)["batch"])
+            if 1 <= tuned <= 512:
+                batch = tuned
+        except (OSError, ValueError, KeyError, TypeError):
+            pass
         steps, reps, peak = 10, 3, TPU_V5E_PEAK_FLOPS
     else:
         cfg = bert.BertConfig.tiny()
@@ -448,17 +475,29 @@ def main():
                                 else (False, "cpu"))
 
     model = bert.BertForPretraining(cfg)
-    step, state = bert.build_pretrain_step(model, bf16=True)
-    b = bert.fake_batch(cfg, batch, seq, num_masked=n_masked)
-    lr = jnp.float32(1e-4)
 
-    holder = {"state": state}
+    def timed_run(batch_n):
+        step, state = bert.build_pretrain_step(model, bf16=True)
+        b = bert.fake_batch(cfg, batch_n, seq, num_masked=n_masked)
+        lr = jnp.float32(1e-4)
+        holder = {"state": state}
 
-    def run_once():
-        holder["state"], loss = step(holder["state"], b, lr)
-        return loss
+        def run_once():
+            holder["state"], loss = step(holder["state"], b, lr)
+            return loss
 
-    dt, final_loss = _time_step(run_once, steps, reps)
+        return _time_step(run_once, steps, reps)
+
+    try:
+        dt, final_loss = timed_run(batch)
+    except Exception as e:  # noqa: BLE001 - tuned batch may OOM
+        if batch == 32:
+            raise
+        print(f"bench: tuned batch {batch} failed "
+              f"({type(e).__name__}); falling back to 32",
+              file=sys.stderr)
+        batch = 32
+        dt, final_loss = timed_run(batch)
 
     flops = bert_step_flops(cfg, batch, seq, n_masked)
     mfu = flops / dt / peak * 100.0

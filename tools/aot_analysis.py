@@ -85,6 +85,14 @@ def main():
     else:
         cfg = bert.BertConfig.base()
         batch, seq, n_masked = 32, 512, 76
+    if "--batch" in sys.argv:
+        # n_masked is PER SAMPLE (fake_batch masked_positions is
+        # (batch, num_masked)): unchanged when batch scales
+        try:
+            batch = int(sys.argv[sys.argv.index("--batch") + 1])
+        except (IndexError, ValueError):
+            sys.exit("usage: aot_analysis.py [--flash] [--remat] "
+                     "[--tiny] [--rbg] [--batch N]")
 
     topo = topologies.get_topology_desc(platform="tpu",
                                         topology_name="v5e:2x4")
@@ -164,10 +172,11 @@ def main():
     compute_s = model_flops / V5E_PEAK_FLOPS
     hbm_s = xla_bytes / V5E_HBM_BW
     roofline_s = max(compute_s, hbm_s)
-    # the last on-chip measurement (r3: bert-base, flash on, no remat,
-    # BEFORE the fused-FFN kernel) only compares against flash
-    # variants of the bench config; headroom is meaningless elsewhere
-    measured_ms = 122.1 if (not tiny and not remat and flash) else None
+    # the last on-chip measurement (r3: bert-base batch 32, flash on,
+    # no remat, BEFORE the fused-FFN kernel) only compares against
+    # flash variants of that config; headroom is meaningless elsewhere
+    measured_ms = 122.1 if (not tiny and not remat and flash
+                            and batch == 32) else None
     result = {
         "config": {"model": "bert-base" if not tiny else "bert-tiny",
                    "batch": batch, "seq": seq, "bf16": True,
@@ -209,7 +218,8 @@ def main():
     }
     os.makedirs(ART, exist_ok=True)
     suffix = ("_tiny" if tiny else "") + ("_remat" if remat else "") \
-        + ("_flash" if flash else "") + ("_rbg" if rbg else "")
+        + ("_flash" if flash else "") + ("_rbg" if rbg else "") \
+        + (f"_b{batch}" if "--batch" in sys.argv else "")
     out = os.path.join(ART, f"aot_v5e_analysis{suffix}.json")
     with open(out, "w") as f:
         json.dump(result, f, indent=1)
