@@ -203,6 +203,11 @@ def _kernel_preflight(jax, jnp):
         try:
             from paddle_tpu.ops.pallas import ffn as _ffn
 
+            if _ffn._FFN_DISABLED is not None:
+                # kernel is opt-in since the 2026-07-31 A/B (XLA FFN
+                # path measured faster); nothing to validate
+                return True, (f"flash vs XLA max err {err:.2e}; "
+                              f"ffn kernel off ({_ffn._FFN_DISABLED})")
             r = np.random.RandomState(1)
             fx = jnp.asarray(r.randn(1024, 256) * 0.5, jnp.bfloat16)
             fw1 = jnp.asarray(r.randn(256, 512) * 0.05, jnp.bfloat16)
@@ -236,15 +241,22 @@ def _kernel_preflight(jax, jnp):
 
 
 def _flash_really_active():
-    """Post-run truth: flash was used iff every kernel probe the model
-    triggered passed and nothing force-disabled the path."""
+    """Post-run truth: flash was used iff nothing force-disabled the
+    path and at least one kernel instance both probed OK.  The exact
+    probe cache legitimately holds False entries for rejected
+    head-block ladder rungs (the ladder intentionally oversizes
+    block_h), so `all(...)` would misreport a run where a smaller rung
+    compiled and the kernel really ran; a True exact-probe entry means
+    flash_attention committed the traced graph to that instance."""
     try:
         from paddle_tpu.ops.pallas import attention as att
 
-        probes = (list(att._PROBE_CACHE.values())
-                  + list(att._EXACT_PROBE_CACHE.values()))
-        return (att._FLASH_DISABLED is None and len(probes) > 0
-                and all(probes))
+        exact = list(att._EXACT_PROBE_CACHE.values())
+        generic = list(att._PROBE_CACHE.values())
+        return (att._FLASH_DISABLED is None
+                and (any(v is True for v in exact)
+                     or (not exact and len(generic) > 0
+                         and all(generic))))
     except Exception:  # noqa: BLE001
         return False
 

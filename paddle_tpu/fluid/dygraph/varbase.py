@@ -17,6 +17,12 @@ import numpy as np
 from .. import core
 
 
+def _in_dygraph_mode():
+    from .. import framework
+
+    return framework.in_dygraph_mode()
+
+
 def _as_jax(value, dtype=None):
     import jax.numpy as jnp
 
@@ -151,6 +157,19 @@ class Tensor:
     def backward(self, grad_tensor=None, retain_graph=False):
         from .engine import run_backward
 
+        if self._grad_node is None and not _in_dygraph_mode():
+            # Outside dygraph mode the tracer records nothing, so
+            # backward() would silently leave every .grad None — the
+            # reference cannot hit this state because it enables
+            # dygraph at import (python/paddle/__init__.py:281) and
+            # its to_variable refuses to run outside a guard.  Loud
+            # beats silent (found by an end-to-end verify drive).
+            raise RuntimeError(
+                "backward() on a tensor with no autograd graph while "
+                "dygraph mode is off: ops run outside "
+                "paddle.disable_static() / fluid.dygraph.guard() are "
+                "not recorded on the tape. Enable dygraph mode before "
+                "building the graph.")
         run_backward([self], [grad_tensor] if grad_tensor is not None else None,
                      retain_graph=retain_graph)
 

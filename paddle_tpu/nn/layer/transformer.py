@@ -103,10 +103,9 @@ class MultiHeadAttention(Layer):
 
 def _dense_ffn_block(layer, x):
     """linear2(dropout(act(linear1(x)))) for encoder AND decoder
-    layers — routed through the fused FFN (Pallas on TPU, XLA
-    elsewhere; ops/pallas/ffn.py) when the activation is gelu/relu and
-    biases exist, keeping the d_ff intermediates off HBM; otherwise
-    the layer-by-layer path."""
+    layers — routed through F.fused_feedforward (ops/pallas/ffn.py:
+    XLA path by default, opt-in Pallas kernel) when the activation is
+    gelu/relu and biases exist; otherwise the layer-by-layer path."""
     if isinstance(layer.activation, GELU):
         act_name = ("gelu_tanh" if layer.activation._approximate
                     else "gelu")

@@ -31,6 +31,7 @@ fallback, so a kernel regression degrades to slower-but-correct.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -358,7 +359,18 @@ _fused_ffn.defvjp(_fused_ffn_fwd, _fused_ffn_bwd)
 # -- public API + dispatcher --------------------------------------------------
 
 _PROBE_CACHE = {}
-_FFN_DISABLED = None
+# OPT-IN since the 2026-07-31 on-chip A/B (the "noffn" arm in the git
+# history of artifacts/dimsem_ab.json — the live file holds newer arms):
+# the AOT byte model said the kernel saves 15.5 GB/step, but measured
+# v5e steps are 120.9 ms on the XLA FFN path vs 136.6 ms with the
+# kernel — the in-kernel backward recompute costs more wall time than
+# the HBM traffic it saves (profile: ~2 ms x 12 layers in
+# ffn_backward pallas calls).  Enable via PADDLE_TPU_FUSED_FFN=1 or
+# enable_fused_ffn() for memory-limited configs where VMEM-resident
+# d_ff intermediates matter more than step time.
+_FFN_DISABLED = (
+    None if os.environ.get("PADDLE_TPU_FUSED_FFN") == "1"
+    else "opt-in (on-chip A/B 2026-07-31: XLA FFN path faster)")
 # AOT-analysis/test hook: True skips the backend + Mosaic-probe gating
 # (tools/aot_analysis.py compiles for a TPU topology from a CPU-default
 # process, where the probe would target the wrong backend)
@@ -368,6 +380,11 @@ _FORCE_KERNEL = False
 def disable_fused_ffn(reason):
     global _FFN_DISABLED
     _FFN_DISABLED = reason
+
+
+def enable_fused_ffn():
+    global _FFN_DISABLED
+    _FFN_DISABLED = None
 
 
 def _ffn_ok(T, H, F, dtype, activation, dropout_p, block_t, block_f):
@@ -444,7 +461,7 @@ def fused_ffn(x, w1, b1, w2, b2, activation="gelu", dropout_p=0.0,
     if H % 128 == 0 and ladder:
         if interpret or _FORCE_KERNEL:
             block_t, block_f = ladder[0]
-        elif jax.default_backend() == "tpu":
+        elif _FFN_DISABLED is None and jax.default_backend() == "tpu":
             for bt, bf in ladder:
                 if _ffn_ok(T, H, F, x.dtype, activation, dropout_p,
                            bt, bf):

@@ -157,3 +157,29 @@ class TestLocalFS:
 
         with pytest.raises(NotImplementedError, match="LocalFS"):
             HDFSClient("/opt/hadoop", None)
+
+
+class TestBackwardOutsideDygraph:
+    def test_backward_without_mode_raises_loudly(self):
+        """Eager ops run outside dygraph.guard() record no tape; the
+        reference can't reach this state (dygraph enabled at import,
+        python/paddle/__init__.py:281) — here backward() must raise
+        rather than silently leave every .grad None."""
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+
+        assert not paddle.in_dygraph_mode()
+        lin = nn.Linear(4, 2)
+        loss = paddle.mean(lin(paddle.to_tensor(
+            np.ones((3, 4), np.float32))) ** 2)
+        with pytest.raises(RuntimeError, match="dygraph"):
+            loss.backward()
+        # and the same flow inside the guard produces real grads
+        from paddle_tpu.fluid import dygraph
+
+        with dygraph.guard():
+            lin2 = nn.Linear(4, 2)
+            loss2 = paddle.mean(lin2(paddle.to_tensor(
+                np.ones((3, 4), np.float32))) ** 2)
+            loss2.backward()
+            assert lin2.weight.grad is not None
