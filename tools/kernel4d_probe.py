@@ -19,14 +19,11 @@ Usage: python tools/kernel4d_probe.py          # auto: CPU->validate,
                                                # TPU->compile+time
 """
 
-import functools
 import json
 import sys
 import time
 
 import numpy as np
-
-sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 
 def build(B, S, H, D, block_q, interpret):
@@ -162,19 +159,29 @@ def main():
     N = 8
 
     def chain4(q4, k4, v4):
+        # same per-iteration k/v perturbation as chain3 so both arms
+        # carry identical non-kernel work
         acc = q4
+        eps = jnp.bfloat16(1e-8)
         for _ in range(N):
-            acc = run(acc, k4, v4)
+            acc = run(acc, k4 + acc * eps, v4 + acc * eps)
         return acc
 
     def chain3(q4, k4, v4):
-        # INCLUDES the merge transposes — this is today's path
+        # INCLUDES the merge transposes PER CALL — the real bench pays
+        # them per layer (q, k, v in; out back), so each iteration
+        # re-merges from the 4D layout.  k/v are perturbed by the
+        # running value so XLA cannot hoist their merges out of the
+        # unrolled loop as loop-invariant.
         merge = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
-        qm = merge(q4)
-        km, vm = merge(k4), merge(v4)
+        unmerge = lambda x: x.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+        acc = q4
+        eps = jnp.bfloat16(1e-8)
         for _ in range(N):
-            qm = run3(qm, km, vm)
-        return qm.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+            out = run3(merge(acc), merge(k4 + acc * eps),
+                       merge(v4 + acc * eps))
+            acc = unmerge(out)
+        return acc
 
     def timed(f):
         g = jax.jit(f)

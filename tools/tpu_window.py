@@ -79,6 +79,16 @@ print(json.dumps({"mode": mode, "step_ms": best * 1e3,
                   "ffn": ffn_ran}))
 """
 
+RESNET_AB_SCRIPT = r"""
+import json, sys
+import jax, jax.numpy as jnp
+assert jax.default_backend() == "tpu"
+sys.path.insert(0, ".")
+import bench
+out = bench.bench_resnet50(jax, jnp, True, batch=int(sys.argv[1]))
+print(json.dumps(out))
+"""
+
 PROFILE_SCRIPT = r"""
 import glob, gzip, json, os, sys, time
 import jax, jax.numpy as jnp
@@ -350,26 +360,97 @@ def main():
     # are retired (2026-07-31: both were >2% WORSE tokens/sec than
     # batch 32), so today this only clears stale overrides; the arm
     # list is kept data-driven should batch arms return.
-    batch_arms = {m: ab[m] for m in ("base", "b48", "b64") if m in ab
-                  and ab[m].get("tokens_per_sec")}
-    if "base" in batch_arms:
-        tuning_path = os.path.join(ART, "bench_tuning.json")
-        best_mode = max(batch_arms,
-                        key=lambda m: batch_arms[m]["tokens_per_sec"])
-        base_tps = batch_arms["base"]["tokens_per_sec"]
-        if batch_arms[best_mode]["tokens_per_sec"] > base_tps * 1.02:
+    tuning_path = os.path.join(ART, "bench_tuning.json")
+
+    def update_tuning(mutate):
+        """Read-modify-write: the file holds independent overrides
+        (BERT `batch`, `resnet_batch`), so a writer must merge, not
+        clobber; an emptied dict removes the file."""
+        try:
+            with open(tuning_path) as f:
+                cur = json.load(f)
+        except (OSError, ValueError):
+            cur = {}
+        mutate(cur)
+        if cur:
             with open(tuning_path, "w") as f:
-                json.dump({"batch": batch_arms[best_mode]["batch"],
-                           "from_arm": best_mode,
-                           "tokens_per_sec": batch_arms[best_mode]
-                           ["tokens_per_sec"],
-                           "base_tokens_per_sec": base_tps}, f)
+                json.dump(cur, f)
         else:
-            # fresh measurements say base wins: drop any older override
             try:
                 os.remove(tuning_path)
             except OSError:
                 pass
+
+    batch_arms = {m: ab[m] for m in ("base", "b48", "b64") if m in ab
+                  and ab[m].get("tokens_per_sec")}
+    if "base" in batch_arms:
+        best_mode = max(batch_arms,
+                        key=lambda m: batch_arms[m]["tokens_per_sec"])
+        base_tps = batch_arms["base"]["tokens_per_sec"]
+
+        def mut(cur, best=best_mode, base=base_tps):
+            if batch_arms[best]["tokens_per_sec"] > base * 1.02:
+                cur.update(batch=batch_arms[best]["batch"],
+                           from_arm=best,
+                           tokens_per_sec=batch_arms[best]
+                           ["tokens_per_sec"],
+                           base_tokens_per_sec=base)
+            else:
+                # fresh measurements say base wins: drop older override
+                for k in ("batch", "from_arm", "tokens_per_sec",
+                          "base_tokens_per_sec"):
+                    cur.pop(k, None)
+
+        update_tuning(mut)
+
+    # 3b. ResNet batch arm (BASELINE row 1 is also scored on MFU; the
+    # bench default 128 runs at 29% — probe whether a bigger batch
+    # amortizes better).  The challenger is whichever batch the fresh
+    # bench record did NOT run (self-comparison would wrongly clear an
+    # active override); >2% images/sec win flips the bench default via
+    # the merged tuning file, a loss clears any override.
+    base_rec = (results.get("bench_line") or {}).get("detail", {}) \
+        .get("resnet50", {})
+    base_batch = base_rec.get("detail", {}).get("batch")
+    challenger = 128 if base_batch == 256 else 256
+    rb = results.get("resnet_ab") or {}
+    arm_key = f"rb{challenger}"
+    fresh_arm = False
+    if (not wedged and base_rec.get("value") and arm_key not in rb
+            and not too_many(f"ab_{arm_key}")):
+        okr, outr, _ = run_phase(
+            f"ab_{arm_key}", [py, "-c", RESNET_AB_SCRIPT,
+                              str(challenger)], 1200)
+        if okr:
+            line = [l for l in outr.splitlines() if l.startswith("{")]
+            if line:
+                rb[arm_key] = json.loads(line[-1])
+                fresh_arm = True
+        else:
+            wedged = window_closed(f"after ab_{arm_key}")
+            note_fail(f"ab_{arm_key}", wedged)
+    arm = rb.get(arm_key, {})
+    # override decisions come ONLY from an arm measured THIS window
+    # against THIS window's bench record — a banked arm vs a fresh
+    # base is two different product states, and re-deciding from it
+    # would oscillate the override every window on zero new data
+    if fresh_arm and arm.get("value") and base_rec.get("value"):
+        def mut_r(cur, arm=arm, base=base_rec):
+            a_batch = arm["detail"]["batch"]
+            if arm["value"] > base["value"] * 1.02:
+                if a_batch != 128:
+                    cur["resnet_batch"] = a_batch
+                else:  # the 128 challenger beat an override: clear it
+                    cur.pop("resnet_batch", None)
+            elif a_batch != 128:
+                # challenger lost: the default (bench's batch) stands
+                cur.pop("resnet_batch", None)
+
+        update_tuning(mut_r)
+        # a decision supersedes every banked arm: drop the others so a
+        # future window re-measures against its own fresh base
+        rb = {arm_key: arm}
+    results["resnet_ab"] = rb
 
     # 4. profile
     if (not wedged and not banked.get("profile_ok")
