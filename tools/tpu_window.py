@@ -409,8 +409,18 @@ def main():
     # bench record did NOT run (self-comparison would wrongly clear an
     # active override); >2% images/sec win flips the bench default via
     # the merged tuning file, a loss clears any override.
+    # the base must be THIS window's bench record (ok1) — a banked
+    # prior-window bench vs a fresh arm is a cross-window comparison,
+    # and normal window-to-window variance would flip the override on
+    # zero same-window data
     base_rec = (results.get("bench_line") or {}).get("detail", {}) \
-        .get("resnet50", {})
+        .get("resnet50", {}) if ok1 else {}
+    if base_rec.get("detail", {}).get("batch_fallback_from"):
+        # the override OOM'd inside the real two-metric bench (even if
+        # it runs standalone): that is in-situ evidence against it —
+        # clear it and skip the challenger, which would just re-pin it
+        update_tuning(lambda cur: cur.pop("resnet_batch", None))
+        base_rec = {}
     base_batch = base_rec.get("detail", {}).get("batch")
     challenger = 128 if base_batch == 256 else 256
     rb = results.get("resnet_ab") or {}
