@@ -66,6 +66,46 @@ from .tensor import (to_tensor, zeros, ones, full, zeros_like, ones_like,
                      cos, tan, tanh, reciprocal, square, sign, erf,
                      maximum, minimum)
 from .tensor import max, min  # noqa: A004 (paddle API shadows builtins)
+# 2.0 top-level API tail (reference python/paddle/__init__.py
+# DEFINE_ALIAS set): re-exports of existing lowerings + the small
+# additions at the end of paddle_tpu/tensor
+from .tensor import (acos, asin, atan, cosh, sinh, log1p, log2, log10,
+                     mod, remainder, floor_divide, floor_mod, trace,
+                     cross, cholesky, histogram, increment, is_empty,
+                     empty, empty_like, chunk, stanh, shard_index,
+                     unstack, strided_slice, add_n, addcmul,
+                     broadcast_shape, einsum, has_inf, has_nan,
+                     inverse, is_tensor, mm, multiplex, rank,
+                     scatter_nd, tensordot, unbind, set_default_dtype,
+                     get_default_dtype, set_printoptions,
+                     get_tensor_from_selected_rows)
+from .tensor import all, any, slice  # noqa: A004 (shadows builtins)
+from .fluid import (CUDAPinnedPlace, LoDTensor, LoDTensorArray,
+                    is_compiled_with_cuda)
+from .fluid.layers import (create_global_var, create_parameter,
+                           elementwise_add, elementwise_sub,
+                           elementwise_mul, elementwise_div,
+                           elementwise_floordiv, elementwise_mod,
+                           elementwise_pow, fill_constant, reduce_max,
+                           reduce_mean, reduce_min, reduce_prod,
+                           reduce_sum, shape)
+from .fluid.dygraph.parallel import DataParallel
+
+
+def get_cuda_rng_state():
+    """No CUDA generators on this build (TPU-first; RNG is stateless
+    jax keys / the TPU hardware generator) — the reference returns a
+    list of per-device generator states, so the TPU answer is the
+    empty list."""
+    return []
+
+
+def set_cuda_rng_state(state_list):
+    if state_list:
+        raise ValueError(
+            "set_cuda_rng_state: this build has no CUDA generators "
+            "(TPU-first, stateless jax PRNG); only an empty state list "
+            "is accepted.")
 from .fluid.dygraph.base import enable_dygraph as disable_static_mode
 from .fluid.dygraph import to_variable, no_grad, grad
 from .fluid.dygraph.varbase import Tensor

@@ -3,6 +3,8 @@ re-designed TPU-native (see SURVEY.md §7 and per-module docstrings)."""
 
 from __future__ import annotations
 
+import numpy as np
+
 from . import core, unique_name
 from . import dataset
 from .dataset import DatasetFactory, InMemoryDataset, QueueDataset
@@ -39,6 +41,55 @@ class TPUPlace:
 # CUDAPlace name kept as an alias so reference scripts run unchanged: on
 # this framework "the accelerator" is the TPU.
 CUDAPlace = TPUPlace
+
+
+class CUDAPinnedPlace:
+    """Pinned-host place (place.h:52).  On TPU, host staging is managed
+    by the runtime (jax.device_put handles transfer layout), so this is
+    an identity marker for API compatibility — feeds placed 'pinned'
+    behave exactly like CPUPlace feeds."""
+
+    def __repr__(self):
+        return "CUDAPinnedPlace"
+
+
+class LoDTensor:
+    """Feed/fetch-side compat shim for the reference's LoDTensor
+    (lod_tensor.h:114).  The TPU redesign carries dense arrays +
+    explicit lengths/masks instead of LoD metadata (SURVEY.md §2.4 LoD
+    N/A family); executors here feed/fetch numpy arrays directly.  This
+    class keeps `t = fluid.LoDTensor(); t.set(arr, place)` scripts
+    working: it wraps the array and preserves any recursive sequence
+    lengths the caller attaches (for their own bookkeeping)."""
+
+    def __init__(self):
+        self._array = None
+        self._lengths = []
+
+    def set(self, array, place=None):
+        self._array = np.asarray(array)
+
+    def set_recursive_sequence_lengths(self, lengths):
+        self._lengths = [list(l) for l in lengths]
+
+    set_lod = set_recursive_sequence_lengths
+
+    def recursive_sequence_lengths(self):
+        return self._lengths
+
+    lod = recursive_sequence_lengths
+
+    def shape(self):
+        return [] if self._array is None else list(self._array.shape)
+
+    def __array__(self, dtype=None):
+        a = self._array if self._array is not None else np.empty((0,))
+        return a.astype(dtype) if dtype is not None else a
+
+
+class LoDTensorArray(list):
+    """Compat alias for the reference's LoDTensorArray (a vector of
+    LoDTensor) — a plain list of arrays here."""
 
 
 def tpu_places(device_ids=None):

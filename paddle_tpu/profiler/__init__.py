@@ -27,6 +27,12 @@ _EVENTS = defaultdict(lambda: {"calls": 0, "total": 0.0, "min": None,
                                "max": 0.0})
 _EVENTS_LOCK = threading.Lock()
 _TRACE_DIR = [None]
+# per-event timeline for chrome://tracing export (the reference's
+# tools/timeline.py path); bounded so a long profiled run cannot grow
+# host memory without limit — overflow is counted, not silently lost
+_TIMELINE: list = []
+_TIMELINE_CAP = 200_000
+_TIMELINE_DROPPED = [0]
 
 
 class RecordEvent:
@@ -61,6 +67,11 @@ class RecordEvent:
                 e["total"] += dt
                 e["min"] = dt if e["min"] is None else min(e["min"], dt)
                 e["max"] = max(e["max"], dt)
+                if len(_TIMELINE) < _TIMELINE_CAP:
+                    _TIMELINE.append((self.name, self._t0, dt,
+                                      threading.get_ident()))
+                else:
+                    _TIMELINE_DROPPED[0] += 1
         self._t0 = None
 
     def __enter__(self):
@@ -77,6 +88,9 @@ def start_profiler(state="All", tracer_option="Default", trace_dir=None):
     _ENABLED[0] = True
     with _EVENTS_LOCK:
         _EVENTS.clear()
+        # a fresh session must not export the previous session's spans
+        del _TIMELINE[:]
+        _TIMELINE_DROPPED[0] = 0
     if trace_dir is not None:
         import jax
 
@@ -128,6 +142,38 @@ def profiler(state="All", sorted_key="total", profile_path=None,
 def reset_profiler():
     with _EVENTS_LOCK:
         _EVENTS.clear()
+        del _TIMELINE[:]
+        _TIMELINE_DROPPED[0] = 0
+
+
+def export_chrome_tracing(path):
+    """Write the recorded host events as a chrome://tracing /
+    Perfetto-loadable JSON file — the reference's tools/timeline.py
+    (profiler proto -> chrome trace) re-designed over the host event
+    buffer.  Device-side events live in the XLA trace jax.profiler
+    writes to `trace_dir` (TensorBoard/perfetto format); this export
+    covers the RecordEvent host phases, one track per thread.
+
+    Returns the number of events written."""
+    import json
+
+    with _EVENTS_LOCK:
+        events = list(_TIMELINE)
+        dropped = _TIMELINE_DROPPED[0]
+    tids = {}
+    trace = []
+    for name, t0, dt, tid in events:
+        tids.setdefault(tid, len(tids))
+        trace.append({"ph": "X", "cat": "host", "name": name,
+                      "ts": t0 * 1e6, "dur": dt * 1e6,
+                      "pid": 0, "tid": tids[tid]})
+    doc = {"traceEvents": trace,
+           "displayTimeUnit": "ms",
+           "otherData": {"producer": "paddle_tpu.profiler",
+                         "dropped_events": dropped}}
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return len(trace)
 
 
 # ---------------------------------------------------------------------------

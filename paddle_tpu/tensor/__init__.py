@@ -23,25 +23,42 @@ def _jnp():
     return jnp
 
 
+# reference framework.py set_default_dtype: the float type that dtype-
+# less float creation (to_tensor on float data, zeros/ones/full/empty)
+# resolves to.  NOTE x64 stays disabled in jax by default, so float64
+# here yields f32 on device — matching get_default_dtype still lets
+# reference scripts run; setters/getters live near the API tail below.
+_DEFAULT_DTYPE = ["float32"]
+
+
 # -- creation -----------------------------------------------------------------
 
 def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    if dtype is None and _DEFAULT_DTYPE[0] != "float32":
+        # reference semantics: float data without an explicit dtype
+        # lands in the configured default float type
+        probe = np.asarray(data)
+        if probe.dtype.kind == "f":
+            dtype = _DEFAULT_DTYPE[0]
     return Tensor(data, dtype=dtype, stop_gradient=stop_gradient)
 
 
-def zeros(shape, dtype="float32", name=None):
+def zeros(shape, dtype=None, name=None):
     return trace_op("fill_constant", {},
-                    {"shape": list(shape), "dtype": dtype, "value": 0.0})
+                    {"shape": list(shape),
+                     "dtype": dtype or _DEFAULT_DTYPE[0], "value": 0.0})
 
 
-def ones(shape, dtype="float32", name=None):
+def ones(shape, dtype=None, name=None):
     return trace_op("fill_constant", {},
-                    {"shape": list(shape), "dtype": dtype, "value": 1.0})
+                    {"shape": list(shape),
+                     "dtype": dtype or _DEFAULT_DTYPE[0], "value": 1.0})
 
 
-def full(shape, fill_value, dtype="float32", name=None):
+def full(shape, fill_value, dtype=None, name=None):
     return trace_op("fill_constant", {},
-                    {"shape": list(shape), "dtype": dtype,
+                    {"shape": list(shape),
+                     "dtype": dtype or _DEFAULT_DTYPE[0],
                      "value": float(fill_value)})
 
 
@@ -86,7 +103,7 @@ def diag(x, offset=0, padding_value=0, name=None):
                     {"offset": offset, "padding_value": padding_value})
 
 
-def empty(shape, dtype="float32", name=None):
+def empty(shape, dtype=None, name=None):
     return zeros(shape, dtype)
 
 
@@ -674,3 +691,150 @@ def histogram(input, bins=100, min=0, max=0, name=None):
     """reference tensor/linalg.py histogram."""
     return trace_op("histogram", {"X": input},
                     {"bins": bins, "min": min, "max": max})
+
+
+# -- 2.0 top-level API tail (reference python/paddle/__init__.py
+# DEFINE_ALIAS set; each maps to one op lowering or one fused jax fn) --
+
+def add_n(inputs, name=None):
+    """reference tensor/math.py add_n (the `sum` op)."""
+    if isinstance(inputs, Tensor):
+        return inputs
+    out = inputs[0]
+    for x in inputs[1:]:
+        out = trace_op("elementwise_add", {"X": out, "Y": x})
+    return out
+
+
+def addcmul(input, tensor1, tensor2, value=1.0, name=None):
+    def f(a, t1, t2):
+        return a + value * t1 * t2
+
+    return trace_fn(f, {"a": input, "t1": tensor1, "t2": tensor2})
+
+
+def broadcast_shape(x_shape, y_shape):
+    """Pure shape math (reference tensor/manipulation.py)."""
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def einsum(equation, *operands):
+    jnp = _jnp()
+
+    ins = {f"x{i}": op for i, op in enumerate(operands)}
+
+    def f(**kw):
+        return jnp.einsum(equation,
+                          *[kw[f"x{i}"] for i in range(len(operands))])
+
+    return trace_fn(f, ins)
+
+
+floor_mod = mod  # same elementwise_mod lowering (reference alias)
+
+
+def has_inf(x, name=None):
+    jnp = _jnp()
+    return trace_fn(lambda x: jnp.any(jnp.isinf(x)), {"x": x})
+
+
+def has_nan(x, name=None):
+    jnp = _jnp()
+    return trace_fn(lambda x: jnp.any(jnp.isnan(x)), {"x": x})
+
+
+def inverse(x, name=None):
+    jnp = _jnp()
+    return trace_fn(lambda x: jnp.linalg.inv(x), {"x": x})
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def mm(input, mat2, name=None):
+    return trace_op("matmul_v2", {"X": input, "Y": mat2})
+
+
+def multiplex(inputs, index, name=None):
+    return trace_op("multiplex", {"X": list(inputs), "Ids": index})
+
+
+def rank(input):
+    return to_tensor(np.asarray(len(input.shape), "int32"))
+
+
+def scatter_nd(index, updates, shape, name=None):
+    jnp = _jnp()
+
+    def f(index, updates):
+        z = jnp.zeros(tuple(shape), updates.dtype)
+        return z.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
+
+    return trace_fn(f, {"index": index, "updates": updates})
+
+
+def tensordot(x, y, axes=2, name=None):
+    jnp = _jnp()
+
+    def f(x, y):
+        ax = axes
+        if isinstance(ax, (list, tuple)):
+            ax = tuple(tuple(a) if isinstance(a, (list, tuple)) else a
+                       for a in ax)
+        return jnp.tensordot(x, y, axes=ax)
+
+    return trace_fn(f, {"x": x, "y": y})
+
+
+def unbind(input, axis=0):
+    outs = trace_op("unbind", {"X": input}, {"axis": axis},
+                    multi_out=True)
+    return outs["Out"] if isinstance(outs, dict) else list(outs)
+
+
+def set_default_dtype(d):
+    """reference framework.py set_default_dtype (float16/32/64).
+    Consumed by dtype-less float creation: to_tensor on float data,
+    zeros/ones/full/empty (the _DEFAULT_DTYPE cell near the top)."""
+    name = core.convert_dtype(d)
+    if name not in ("float16", "bfloat16", "float32", "float64"):
+        raise TypeError(
+            f"set_default_dtype only accepts float types, got {d}")
+    _DEFAULT_DTYPE[0] = name
+
+
+def get_default_dtype():
+    return _DEFAULT_DTYPE[0]
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """reference tensor/to_string.py — Tensor repr goes through numpy
+    here, so this bridges straight onto numpy's printoptions."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    """reference operators/get_tensor_from_selected_rows_op.cc:
+    SelectedRows -> dense tensor.  This build never materializes
+    SelectedRows (sparse grads are dense on TPU — SURVEY.md §2.4 LoD/
+    SelectedRows N/A family), so anything tensor-like passes through
+    and anything else fails loudly."""
+    if isinstance(x, Tensor):
+        return x
+    raise TypeError(
+        "get_tensor_from_selected_rows: SelectedRows does not exist on "
+        "this build (gradients are dense); got "
+        f"{type(x).__name__}")

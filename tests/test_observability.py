@@ -125,3 +125,53 @@ def test_fleet_metrics_match_local():
     np.testing.assert_allclose(
         metrics.rmse([np.array([8.0]), np.array([10.0])],
                      [np.array([1.0]), np.array([1.0])]), 3.0)
+
+
+class TestChromeTracingExport:
+    def test_export_chrome_tracing(self, tmp_path):
+        """RecordEvent host phases round-trip to a chrome://tracing
+        JSON (the reference's tools/timeline.py conversion path)."""
+        import json
+
+        from paddle_tpu import profiler as prof
+
+        prof.reset_profiler()
+        prof.start_profiler()
+        with prof.RecordEvent("forward"):
+            with prof.RecordEvent("attention"):
+                pass
+        with prof.RecordEvent("forward"):
+            pass
+        prof.stop_profiler(profile_path=None)
+        out = tmp_path / "trace.json"
+        n = prof.export_chrome_tracing(str(out))
+        assert n == 3
+        doc = json.loads(out.read_text())
+        evs = doc["traceEvents"]
+        assert {e["name"] for e in evs} == {"forward", "attention"}
+        assert all(e["ph"] == "X" and e["dur"] >= 0 for e in evs)
+        # nesting: attention lies within one forward span
+        att = next(e for e in evs if e["name"] == "attention")
+        fwd = [e for e in evs if e["name"] == "forward"]
+        assert any(f["ts"] <= att["ts"] and
+                   att["ts"] + att["dur"] <= f["ts"] + f["dur"] + 1e-3
+                   for f in fwd)
+        assert doc["otherData"]["dropped_events"] == 0
+
+    def test_timeline_cap_counts_drops(self):
+        from paddle_tpu import profiler as prof
+
+        prof.reset_profiler()
+        old_cap = prof._TIMELINE_CAP
+        prof._TIMELINE_CAP = 2
+        try:
+            prof.start_profiler()
+            for _ in range(5):
+                with prof.RecordEvent("e"):
+                    pass
+            prof.stop_profiler(profile_path=None)
+            assert len(prof._TIMELINE) == 2
+            assert prof._TIMELINE_DROPPED[0] == 3
+        finally:
+            prof._TIMELINE_CAP = old_cap
+            prof.reset_profiler()
