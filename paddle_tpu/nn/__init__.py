@@ -34,3 +34,19 @@ from .layer.rnn import (RNN, BiRNN, GRU, GRUCell, LSTM, LSTMCell,
 from .layer.transformer import (MultiHeadAttention, Transformer,
                                 TransformerDecoder, TransformerDecoderLayer,
                                 TransformerEncoder, TransformerEncoderLayer)
+
+# 2.0 nn tail (reference nn/__init__.py uncommented DEFINE_ALIAS set)
+from .layer import conv, loss  # noqa: F401 - submodule aliases
+from .layer import vision  # noqa: F401
+from .layer.extra_layers import (AdaptiveAvgPool1D, AdaptiveAvgPool3D,
+                                 AdaptiveMaxPool1D, AdaptiveMaxPool3D,
+                                 AlphaDropout, AvgPool3D,
+                                 BilinearTensorProduct, CTCLoss,
+                                 Conv1DTranspose, Conv3DTranspose,
+                                 Dropout3D, HSigmoidLoss, LogSigmoid,
+                                 MaxPool3D, PairwiseDistance, Pool2D,
+                                 RowConv, Softsign)
+from ..fluid.clip import (ClipGradByGlobalNorm, ClipGradByNorm,
+                          ClipGradByValue)
+from ..fluid.layers import clip, clip_by_norm  # noqa: F401
+from .decode import BeamSearchDecoder, Decoder, dynamic_decode

@@ -1,0 +1,212 @@
+"""Seq2seq decoding API (reference python/paddle/fluid/layers/rnn.py
+BeamSearchDecoder:1015 + dynamic_decode:1569, re-exported by
+python/paddle/nn/__init__.py).
+
+TPU-native re-design: beams live DENSELY as a flattened (batch*beam)
+leading dim — no LoD, no SelectedRows; parent hand-off is a gather
+over that dim, exactly the transformer beam decode's bookkeeping
+(paddle_tpu/models/transformer_wmt.py beam_decode).  dynamic_decode
+drives the decoder step-by-step eagerly (dygraph mode — the
+reference's dygraph path is the same python loop); for a fully
+compiled decode use the models' lax.while_loop implementations.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..fluid.dygraph.tracer import trace_fn
+from ..fluid.dygraph.varbase import Tensor
+
+
+def _tree_map(f, t):
+    if isinstance(t, (list, tuple)):
+        return type(t)(_tree_map(f, x) for x in t)
+    return f(t)
+
+
+def _tree_leaves(t):
+    if isinstance(t, (list, tuple)):
+        out = []
+        for x in t:
+            out.extend(_tree_leaves(x))
+        return out
+    return [t]
+
+
+class Decoder:
+    """Abstract decode contract (reference rnn.py Decoder:964):
+    initialize() -> (initial_inputs, initial_states, initial_finished);
+    step() -> (outputs, next_states, next_inputs, finished)."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        return outputs, final_states
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam search over an RNN cell (reference rnn.py
+    BeamSearchDecoder:1015).  States and inputs carry a flattened
+    (batch*beam_size) leading dim."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- helpers (the reference exposes these as static methods) ----------
+
+    def tile_beam_merge_with_batch(self, x):
+        """(B, ...) -> (B*K, ...) by repeating each row K times."""
+        import jax.numpy as jnp
+
+        k = self.beam_size
+        return trace_fn(
+            lambda x: jnp.repeat(x, k, axis=0), {"x": x})
+
+    def _split(self, x):
+        import jax.numpy as jnp
+
+        k = self.beam_size
+        return trace_fn(
+            lambda x: x.reshape((-1, k) + x.shape[1:]), {"x": x})
+
+    def _merge(self, x):
+        import jax.numpy as jnp
+
+        return trace_fn(
+            lambda x: x.reshape((-1,) + x.shape[2:]), {"x": x})
+
+    # -- contract ---------------------------------------------------------
+
+    def initialize(self, initial_cell_states):
+        import jax.numpy as jnp
+
+        states = _tree_map(self.tile_beam_merge_with_batch,
+                           initial_cell_states)
+        bk = int(_tree_leaves(states)[0].shape[0])
+        b, k = bk // self.beam_size, self.beam_size
+        tokens = Tensor(np.full((bk,), self.start_token, "int64"),
+                        stop_gradient=True)
+        inputs = (self.embedding_fn(tokens) if self.embedding_fn
+                  else tokens)
+        # beam 0 starts live, the rest at -inf so step 1 fans out from
+        # one beam per batch element (the reference's kInitLogProb)
+        lp = np.full((b, k), -1e9, "float32")
+        lp[:, 0] = 0.0
+        self._log_probs = Tensor(lp, stop_gradient=True)
+        finished = Tensor(np.zeros((b, k), bool), stop_gradient=True)
+        return inputs, states, finished
+
+    def step(self, time, inputs, states, **kwargs):
+        import jax.numpy as jnp
+
+        cell_out, next_states = self.cell(inputs, states, **kwargs)
+        if self.output_fn is not None:
+            cell_out = self.output_fn(cell_out)
+        k = self.beam_size
+
+        def beam_step(logits, lp, fin):
+            bk, v = logits.shape
+            b = bk // k
+            logp = jnp.log(jnp.maximum(
+                jnp.exp(logits - jnp.max(logits, -1, keepdims=True))
+                / jnp.sum(jnp.exp(
+                    logits - jnp.max(logits, -1, keepdims=True)),
+                    -1, keepdims=True), 1e-20)).reshape(b, k, v)
+            # finished beams only extend with end_token at no cost
+            mask = jnp.full((v,), -1e9).at[self.end_token].set(0.0)
+            logp = jnp.where(fin[:, :, None], mask[None, None, :], logp)
+            total = lp[:, :, None] + logp           # (b, k, v)
+            flat = total.reshape(b, k * v)
+            top, idx = jax.lax.top_k(flat, k)
+            parent = idx // v                        # (b, k) in [0, k)
+            token = (idx % v).astype(jnp.int64)
+            fin2 = jnp.take_along_axis(fin, parent, axis=1) \
+                | (token == self.end_token)
+            gather = (jnp.arange(b)[:, None] * k + parent).reshape(-1)
+            return top, parent, token, fin2, gather
+
+        import jax
+
+        outs = trace_fn(
+            lambda logits, lp, fin: beam_step(logits, lp, fin),
+            {"logits": cell_out, "lp": self._log_probs,
+             "fin": self._finished_in}, multi_out=True)
+        top, parent, token, fin2, gather = outs
+        self._log_probs = top.detach()
+        # reorder every cell state by the parent pointers
+        next_states = _tree_map(
+            lambda s: trace_fn(
+                lambda s, g: jnp.take(s, g.astype(jnp.int32), axis=0),
+                {"s": s, "g": gather}), next_states)
+        flat_tok = trace_fn(lambda t: t.reshape(-1), {"t": token})
+        inputs = (self.embedding_fn(flat_tok) if self.embedding_fn
+                  else flat_tok)
+        outputs = {"predicted_ids": token, "parent_ids": parent,
+                   "scores": top}
+        return outputs, next_states, inputs, fin2
+
+    @property
+    def tracks_own_finished(self):
+        return True
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Drive a Decoder until every sequence finishes or max_step_num
+    (reference rnn.py dynamic_decode:1569).  Returns (outputs,
+    final_states) — outputs stacked along time axis 1 (or 0 when
+    output_time_major)."""
+    import jax.numpy as jnp
+
+    max_step_num = max_step_num or 64
+    inputs, states, finished = decoder.initialize(inits)
+    collected = []
+    seq_len = None
+    for t in range(int(max_step_num)):
+        decoder._finished_in = finished
+        outputs, states, inputs, finished = decoder.step(
+            t, inputs, states, **kwargs)
+        collected.append(outputs)
+        fin_np = np.asarray(finished.numpy(), bool)
+        if seq_len is None:
+            seq_len = np.full(fin_np.shape, 0, "int64")
+        seq_len = np.where((seq_len == 0) & fin_np, t + 1, seq_len)
+        if fin_np.all():
+            break
+    seq_len = np.where(seq_len == 0, len(collected), seq_len)
+    axis = 0 if output_time_major else 1
+
+    def stack_key(key):
+        vals = [c[key] for c in collected]
+        n = len(vals)
+
+        def f(**kw):
+            return jnp.stack([kw[f"x{i}"] for i in range(n)],
+                             axis=axis)
+
+        return trace_fn(f, {f"x{i}": v for i, v in enumerate(vals)})
+
+    if isinstance(collected[0], dict):
+        stacked = {k: stack_key(k) for k in collected[0]}
+    else:
+        stacked = stack_key(0)
+    outputs, states = decoder.finalize(stacked, states, seq_len)
+    if return_length:
+        return outputs, states, Tensor(seq_len, stop_gradient=True)
+    return outputs, states

@@ -1,0 +1,5 @@
+"""`paddle.nn.layer.vision` (reference nn/layer/vision.py): the vision
+layer namespace — PixelShuffle lives in common.py here; this module
+mirrors the reference's submodule so `paddle.nn.vision` resolves."""
+
+from .common import PixelShuffle  # noqa: F401

@@ -34,9 +34,12 @@ _DEFAULT_DTYPE = ["float32"]
 # -- creation -----------------------------------------------------------------
 
 def to_tensor(data, dtype=None, place=None, stop_gradient=True):
-    if dtype is None and _DEFAULT_DTYPE[0] != "float32":
-        # reference semantics: float data without an explicit dtype
-        # lands in the configured default float type
+    if (dtype is None and _DEFAULT_DTYPE[0] != "float32"
+            and not hasattr(data, "dtype")):
+        # reference semantics: PYTHON float data (scalars/lists) without
+        # an explicit dtype lands in the configured default float type;
+        # explicitly-typed arrays/Tensors keep their own dtype (and are
+        # never materialized just to probe it)
         probe = np.asarray(data)
         if probe.dtype.kind == "f":
             dtype = _DEFAULT_DTYPE[0]

@@ -178,25 +178,40 @@ def main():
     run = build(B, S, H, D, 512, interpret=False)
     fold = build_fold3d(B, S, H, D, 512, interpret=False)
     to3 = lambda x: x.reshape(B, S, H * D)
-    ref = reference(q4, k4, v4).astype(jnp.float32)
+    try:
+        ref = reference(q4, k4, v4).astype(jnp.float32)
+        ref.block_until_ready()
+    except Exception as e:  # noqa: BLE001 - keep the JSON contract
+        print(json.dumps({"mode": "tpu", "reference_failed":
+                          f"{type(e).__name__}: {str(e)[:300]}"}))
+        return 1
     compiles, errs = {}, {}
 
     def attempt(key, f, reshape=None):
+        # compile/run status FIRST, numeric check in its own try: a
+        # post-run comparison failure must not masquerade as Mosaic
+        # rejecting the kernel
         try:
             o = f()
             o.block_until_ready()
-            compiles[key] = True
+        except Exception as e:  # noqa: BLE001
+            compiles[key] = f"{type(e).__name__}: {str(e)[:200]}"
+            return
+        compiles[key] = True
+        try:
             o = o.reshape(B, S, H, D) if reshape else o
             errs[key] = float(jnp.max(jnp.abs(
                 o.astype(jnp.float32) - ref)))
         except Exception as e:  # noqa: BLE001
-            compiles[key] = f"{type(e).__name__}: {str(e)[:200]}"
+            errs[key] = f"check failed: {type(e).__name__}: " \
+                f"{str(e)[:160]}"
 
     attempt("4d", lambda: run(q4, k4, v4))
     attempt("fold3d", lambda: fold(to3(q4), to3(k4), to3(v4)),
             reshape=True)
     usable = {k for k, v in compiles.items()
-              if v is True and errs.get(k, 1.0) < 0.05}
+              if v is True and isinstance(errs.get(k), float)
+              and errs[k] < 0.05}
     if not usable:
         print(json.dumps({"mode": "tpu", "compiles": compiles,
                           "max_err": errs}))
