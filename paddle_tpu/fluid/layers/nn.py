@@ -10,6 +10,7 @@ from ..initializer import ConstantInitializer, XavierInitializer
 from ..layer_helper import LayerHelper
 
 __all__ = [
+    "Print",
     "fc", "embedding", "conv2d", "conv2d_transpose", "conv3d", "pool2d",
     "adaptive_pool2d", "batch_norm", "layer_norm", "instance_norm",
     "group_norm", "dropout", "softmax", "log_softmax", "relu", "relu6",
@@ -989,4 +990,22 @@ def crf_decoding(input, param_attr, label=None, length=None):
         ins["Length"] = [length]
     helper.append_op("crf_decoding", inputs=ins,
                      outputs={"ViterbiPath": [out]}, infer_shape=False)
+    return out
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=False,
+          print_phase="both"):
+    """Debug print op (reference layers/control_flow.py Print:284):
+    passes `input` through while printing it at run time — lowered to
+    jax.debug.print inside the compiled block
+    (ops/control_flow_ops.py `print`)."""
+    helper = LayerHelper("print")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("print", inputs={"In": input},
+                     outputs={"Out": out},
+                     attrs={"message": message or "",
+                            "first_n": first_n,
+                            "summarize": summarize})
     return out

@@ -1,0 +1,33 @@
+"""`paddle.framework` (reference python/paddle/framework/__init__.py):
+the 2.0 framework-utilities namespace — places, ParamAttr, default
+dtype, RNG seeding, save/load, DataParallel.  Everything here is a
+re-export of the same objects the other namespaces expose; the module
+exists so reference imports like `paddle.framework.seed` resolve."""
+
+from ..fluid import CPUPlace, CUDAPinnedPlace, CUDAPlace, TPUPlace
+from ..fluid import core  # noqa: F401
+from ..fluid.dygraph import grad, no_grad  # noqa: F401
+from ..fluid.dygraph.parallel import DataParallel  # noqa: F401
+from ..fluid.dygraph.varbase import Tensor as VarBase  # noqa: F401
+from ..fluid.layers import create_parameter  # noqa: F401
+from ..fluid.param_attr import ParamAttr  # noqa: F401
+from ..tensor import get_default_dtype, set_default_dtype  # noqa: F401
+
+# the reference's ComplexVariable predates native complex dtypes; jax
+# carries complex64/128 natively, so the eager Tensor IS the complex
+# variable — alias for import compatibility
+ComplexVariable = VarBase
+
+
+def seed(value):
+    """reference framework/random.py seed: seed the global generator.
+    TPU-native: jax PRNG keys are explicit, so this restarts the
+    dygraph tracer's thread-local key stream (manual_seed) and returns
+    the seed for chaining."""
+    from ..fluid.dygraph.tracer import manual_seed
+
+    manual_seed(int(value))
+    return int(value)
+
+
+from ..framework_io import load, save  # noqa: F401,E402

@@ -183,6 +183,11 @@ def to_static(layer_or_fn=None, input_spec=None, **kwargs):
     from ..nn.layer.layers import Layer
 
     def wrap(target):
+        # ProgramTranslator().enable(False) turns conversion off: the
+        # target runs eagerly, unchanged (the reference's debugging
+        # escape hatch, program_translator.py ProgramTranslator.enable)
+        if not ProgramTranslator.enabled:
+            return target
         if isinstance(target, Layer):
             return TracedLayer(target, training=target.training)
 
@@ -282,3 +287,44 @@ def load(path, **configs):
             return self
 
     return _Loaded(pred)
+
+
+# -- dy2static compat surface (reference jit/__init__.py aliases) -------------
+
+class ProgramTranslator:
+    """reference dygraph_to_static/program_translator.py
+    ProgramTranslator: the dygraph->static conversion switchboard.
+    Conversion here is jit.to_static's trace+AST bridge; this singleton
+    keeps `ProgramTranslator().enable(False)` scripts working by gating
+    to_static into an identity."""
+
+    _instance = None
+    enabled = True
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    @classmethod
+    def get_instance(cls):
+        return cls()
+
+    def enable(self, enable_to_static=True):
+        ProgramTranslator.enabled = bool(enable_to_static)
+
+
+TranslatedLayer = TracedLayer
+"""Alias: the reference's TranslatedLayer is the layer-like object
+jit.load returns; here TracedLayer plays that role for traced saves."""
+
+_VERBOSITY = [0]
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """reference dygraph_to_static logging verbosity (stored; the
+    trace-based converter has no transformation log to print)."""
+    _VERBOSITY[0] = int(level)
+
+
+set_code_level = set_verbosity

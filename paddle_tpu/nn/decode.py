@@ -77,19 +77,6 @@ class BeamSearchDecoder(Decoder):
         return trace_fn(
             lambda x: jnp.repeat(x, k, axis=0), {"x": x})
 
-    def _split(self, x):
-        import jax.numpy as jnp
-
-        k = self.beam_size
-        return trace_fn(
-            lambda x: x.reshape((-1, k) + x.shape[1:]), {"x": x})
-
-    def _merge(self, x):
-        import jax.numpy as jnp
-
-        return trace_fn(
-            lambda x: x.reshape((-1,) + x.shape[2:]), {"x": x})
-
     # -- contract ---------------------------------------------------------
 
     def initialize(self, initial_cell_states):
@@ -109,6 +96,10 @@ class BeamSearchDecoder(Decoder):
         lp[:, 0] = 0.0
         self._log_probs = Tensor(lp, stop_gradient=True)
         finished = Tensor(np.zeros((b, k), bool), stop_gradient=True)
+        # the finished mask also lives on the decoder so step() works
+        # standalone per the Decoder contract (not only under
+        # dynamic_decode)
+        self._finished_in = finished
         return inputs, states, finished
 
     def step(self, time, inputs, states, **kwargs):
@@ -156,6 +147,7 @@ class BeamSearchDecoder(Decoder):
         flat_tok = trace_fn(lambda t: t.reshape(-1), {"t": token})
         inputs = (self.embedding_fn(flat_tok) if self.embedding_fn
                   else flat_tok)
+        self._finished_in = fin2
         outputs = {"predicted_ids": token, "parent_ids": parent,
                    "scores": top}
         return outputs, next_states, inputs, fin2
@@ -179,7 +171,6 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
     collected = []
     seq_len = None
     for t in range(int(max_step_num)):
-        decoder._finished_in = finished
         outputs, states, inputs, finished = decoder.step(
             t, inputs, states, **kwargs)
         collected.append(outputs)
@@ -192,8 +183,7 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
     seq_len = np.where(seq_len == 0, len(collected), seq_len)
     axis = 0 if output_time_major else 1
 
-    def stack_key(key):
-        vals = [c[key] for c in collected]
+    def stack_vals(vals):
         n = len(vals)
 
         def f(**kw):
@@ -203,9 +193,14 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
         return trace_fn(f, {f"x{i}": v for i, v in enumerate(vals)})
 
     if isinstance(collected[0], dict):
-        stacked = {k: stack_key(k) for k in collected[0]}
+        stacked = {k: stack_vals([c[k] for c in collected])
+                   for k in collected[0]}
+    elif isinstance(collected[0], (list, tuple)):
+        stacked = type(collected[0])(
+            stack_vals([c[i] for c in collected])
+            for i in range(len(collected[0])))
     else:
-        stacked = stack_key(0)
+        stacked = stack_vals(collected)
     outputs, states = decoder.finalize(stacked, states, seq_len)
     if return_length:
         return outputs, states, Tensor(seq_len, stop_gradient=True)

@@ -435,8 +435,20 @@ def affine_channel(x, scale=None, bias=None, data_layout="NCHW",
 
 @_export
 def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
-    return trace_op("space_to_depth", {"X": x},
-                    {"blocksize": downscale_factor})
+    """Inverse of pixel_shuffle: (B, C, H, W) -> (B, C*r^2, H/r, W/r)
+    with pixel_unshuffle(pixel_shuffle(y, r), r) == y.  NOT the
+    space_to_depth op — that one reproduces the reference kernel's
+    quirky buffer reinterpretation, a different permutation."""
+    jnp = _jnp()
+    r = int(downscale_factor)
+
+    def f(x):
+        b, c, h, w = x.shape
+        y = x.reshape(b, c, h // r, r, w // r, r)
+        y = jnp.transpose(y, (0, 1, 3, 5, 2, 4))
+        return y.reshape(b, c * r * r, h // r, w // r)
+
+    return trace_fn(f, {"x": x})
 
 
 @_export
@@ -510,7 +522,13 @@ def polygon_box_transform(input, name=None):
 def resize_trilinear(input, out_shape=None, scale=None, name=None,
                      actual_shape=None, align_corners=True,
                      align_mode=1, data_format="NCDHW"):
-    d, h, w = out_shape
+    if out_shape is not None:
+        d, h, w = [int(v) for v in out_shape]
+    elif scale is not None:
+        d, h, w = [int(s * scale) for s in input.shape[2:5]]
+    else:
+        raise ValueError(
+            "resize_trilinear needs out_shape or scale")
     return trace_op("trilinear_interp", {"X": input},
                     {"out_d": d, "out_h": h, "out_w": w,
                      "align_corners": align_corners,
@@ -681,33 +699,42 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
         return x
     import jax
 
-    from ...fluid.dygraph.tracer import _next_func_key, _tracer
+    from . import _traced_random
 
-    key = _next_func_key()
-    if key is None:
-        t = _tracer()
-        key = t.next_rng_key() if t is not None else jax.random.PRNGKey(0)
     jnp = _jnp()
     alpha = 1.6732632423543772
     scale = 1.0507009873554805
     alpha_p = -alpha * scale
 
-    def f(x):
+    def f(x, key):
         keep = jax.random.bernoulli(key, 1 - p, x.shape)
         a = (1 / ((1 - p) * (1 + p * alpha_p ** 2)) ** 0.5)
         b = -a * alpha_p * p
         return (a * jnp.where(keep, x, alpha_p) + b).astype(x.dtype)
 
-    return trace_fn(f, {"x": x})
+    return _traced_random(f, x)
 
 
 @_export
 def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
-    """Channel-wise dropout on 5D input (reference common.py)."""
-    from . import dropout
+    """Channel-wise dropout on 5D input (reference common.py): whole
+    (N, C) channels are zeroed together, mask shape (N, C, 1, 1, 1)."""
+    if not training or p == 0.0:
+        return x
+    import jax
 
-    return dropout(x, p=p, axis=[0, 1] if data_format == "NCDHW"
-                   else [0, 4], training=training)
+    from . import _traced_random
+
+    jnp = _jnp()
+    caxis = 1 if data_format == "NCDHW" else 4
+
+    def f(x, key):
+        mshape = [x.shape[0]] + [1] * 4
+        mshape[caxis] = x.shape[caxis]
+        keep = jax.random.bernoulli(key, 1 - p, tuple(mshape))
+        return jnp.where(keep, x / (1 - p), 0.0).astype(x.dtype)
+
+    return _traced_random(f, x)
 
 
 # -- LoD / SelectedRows / PS-era names: documented descopes -------------------

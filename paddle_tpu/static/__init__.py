@@ -78,3 +78,39 @@ __all__ = [
     "load_program_state", "set_program_state", "cpu_places", "Variable",
     "Scope", "nn",
 ]
+
+
+# 2.0 static tail (reference static/__init__.py uncommented aliases)
+from ..fluid import cuda_places  # noqa: F401,E402
+from ..fluid.layers import (Print, create_global_var,  # noqa: F401,E402
+                            create_parameter, py_func)
+
+
+class ParallelExecutor:
+    """Compat shim for the reference's ParallelExecutor
+    (parallel_executor.cc): its per-device program cloning + AllReduce
+    insertion is the CompiledProgram/with_data_parallel path here
+    (parallel/compiler.py — SPMD over a jax Mesh).  This class keeps
+    `ParallelExecutor(use_cuda, loss_name=...)` scripts running by
+    delegating to exactly that."""
+
+    def __init__(self, use_cuda=True, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None,
+                 build_strategy=None, num_trainers=1, trainer_id=0,
+                 scope=None):
+        from ..fluid import (CompiledProgram, Executor,
+                             default_main_program)
+
+        self._program = main_program or default_main_program()
+        self._compiled = CompiledProgram(self._program) \
+            .with_data_parallel(loss_name=loss_name,
+                                exec_strategy=exec_strategy,
+                                build_strategy=build_strategy)
+        self._exe = Executor()
+
+    def run(self, fetch_list=None, feed=None, feed_dict=None,
+            return_numpy=True):
+        feed = feed if feed is not None else feed_dict
+        return self._exe.run(self._compiled, feed=feed,
+                             fetch_list=fetch_list,
+                             return_numpy=return_numpy)

@@ -841,3 +841,9 @@ def get_tensor_from_selected_rows(x, name=None):
         "get_tensor_from_selected_rows: SelectedRows does not exist on "
         "this build (gradients are dense); got "
         f"{type(x).__name__}")
+
+
+def shape(input):
+    """reference tensor/attribute.py shape: the SHAPE AS A TENSOR (the
+    `shape` op) — static shapes are always concrete here."""
+    return to_tensor(np.asarray(list(input.shape), "int32"))

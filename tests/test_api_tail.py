@@ -120,3 +120,71 @@ def test_cuda_compat_stubs():
     assert paddle.is_tensor(t)
     with pytest.raises(TypeError):
         paddle.get_tensor_from_selected_rows(np.ones(3))
+
+
+def test_submodule_surfaces_complete():
+    """Every uncommented DEFINE_ALIAS name in each reference submodule
+    resolves on ours (the paddle.nn/nn.functional variants have their
+    own dedicated tests)."""
+    import importlib
+    import os
+
+    R = "/root/reference/python/paddle"
+
+    def ref_names(path):
+        names = set()
+        for line in open(path):
+            s = line.strip()
+            if s.startswith("#"):
+                continue
+            m = re.match(r"from [\w.]+ import (\w+)\s+#DEFINE_ALIAS", s)
+            if m:
+                names.add(m.group(1))
+        return names
+
+    gaps = {}
+    for sub in ["tensor", "optimizer", "static", "io", "metric",
+                "distribution", "amp", "vision", "text", "jit",
+                "distributed", "framework"]:
+        path = f"{R}/{sub}/__init__.py"
+        if not os.path.exists(path):
+            path = f"{R}/{sub}.py"
+        if not os.path.exists(path):
+            continue
+        names = ref_names(path)
+        mod = importlib.import_module(f"paddle_tpu.{sub}")
+        missing = sorted(n for n in names if not hasattr(mod, n))
+        if missing:
+            gaps[sub] = missing
+    assert gaps == {}, f"submodule surface gaps: {gaps}"
+
+
+def test_device_and_framework_modules():
+    import paddle_tpu.device as device
+    import paddle_tpu.framework as framework
+
+    assert device.get_cudnn_version() is None
+    assert not device.is_compiled_with_xpu()
+    d = device.set_device("cpu")
+    assert device.get_device() == "cpu"
+    assert d is not None
+    assert framework.seed(7) == 7
+    assert framework.ComplexVariable is framework.VarBase
+
+
+def test_static_print_and_parallel_executor():
+    import paddle_tpu.fluid as fluid
+    import paddle_tpu.static as static
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [-1, 4], "float32")
+        y = static.Print(x, message="dbg")
+        loss = fluid.layers.reduce_mean(y)
+    exe = fluid.Executor()
+    exe.run(startup)
+    out = exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+                  fetch_list=[loss])
+    np.testing.assert_allclose(out[0], 1.0, rtol=1e-6)
+    assert hasattr(static, "ParallelExecutor")
+    assert hasattr(static, "py_func")
