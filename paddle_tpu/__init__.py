@@ -31,7 +31,7 @@ from . import amp
 from . import io
 from . import metric
 from . import hapi
-from .hapi import Model
+from .hapi import Model, summary
 from .framework_io import load, save
 from . import distribution
 from . import vision

@@ -1,4 +1,4 @@
 """hapi — high-level Model API (reference: python/paddle/hapi)."""
 
 from . import callbacks  # noqa: F401
-from .model import Model  # noqa: F401
+from .model import Model, summary  # noqa: F401

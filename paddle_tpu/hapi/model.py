@@ -451,6 +451,7 @@ class Model:
     def parameters(self, *args, **kwargs):
         return self.network.parameters()
 
+
     def summary(self, input_size=None, dtype=None):
         lines = [repr(self.network)]
         n_params = sum(p.size for p in self.network.parameters())
@@ -458,3 +459,30 @@ class Model:
         s = "\n".join(lines)
         print(s)
         return {"total_params": n_params}
+
+
+def summary(net, input_size=None, dtypes=None):
+    """reference hapi/model_summary.py summary(net, input_size): layer
+    table + parameter counts for a bare nn.Layer (paddle.summary)."""
+    rows = []
+    total = 0
+    trainable = 0
+    for name, sub in [("", net)] + list(net.named_sublayers()):
+        ps = list(sub.parameters(include_sublayers=False)) \
+            if hasattr(sub, "parameters") else []
+        n = sum(p.size for p in ps)
+        if name:
+            rows.append((name, type(sub).__name__, n))
+        for p in ps:
+            total += p.size
+            if not getattr(p, "stop_gradient", False):
+                trainable += p.size
+    width = max([len(r[0]) for r in rows], default=10) + 2
+    print(f"{'Layer':<{width}}{'Type':<24}{'Params':>12}")
+    for name, t, n in rows:
+        print(f"{name:<{width}}{t:<24}{n:>12}")
+    print(f"Total params: {total}")
+    print(f"Trainable params: {trainable}")
+    print(f"Non-trainable params: {total - trainable}")
+    return {"total_params": int(total),
+            "trainable_params": int(trainable)}

@@ -16,7 +16,15 @@ import numpy as np
 
 __all__ = ["Compose", "ToTensor", "Normalize", "Resize", "CenterCrop",
            "RandomCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
-           "Transpose", "Pad"]
+           "Transpose", "Pad",
+           # round-5 tail (classes + functional re-exports below)
+           "BaseTransform", "RandomResizedCrop", "BrightnessTransform",
+           "ContrastTransform", "SaturationTransform", "HueTransform",
+           "ColorJitter", "RandomRotation", "Grayscale",
+           "to_tensor", "resize", "pad", "crop", "center_crop",
+           "hflip", "vflip", "adjust_brightness", "adjust_contrast",
+           "adjust_saturation", "adjust_hue", "rotate", "to_grayscale",
+           "normalize", "functional"]
 
 
 class Compose:
@@ -65,33 +73,24 @@ def _hwc(a):
 
 
 class Resize:
-    """Nearest-neighbor resize (no PIL dependency on the image).
+    """Resize (no PIL dependency — numpy sampling in
+    transforms_functional.resize, the single implementation).
 
     An int size resizes the SHORTER edge to that length preserving
     aspect ratio (reference paddle.vision.transforms.Resize); a
-    (h, w) pair resizes to exactly that shape.
+    (h, w) pair resizes to exactly that shape.  Default interpolation
+    is bilinear like the reference class.
     """
 
-    def __init__(self, size):
+    def __init__(self, size, interpolation="bilinear"):
         self.size = int(size) if isinstance(size, numbers.Number) \
             else tuple(size)
+        self.interpolation = interpolation
 
     def __call__(self, img):
-        a = np.asarray(img)
-        a, squeeze = _hwc(a)
-        if isinstance(self.size, int):
-            # int() truncation, matching reference functional_cv2.resize
-            ih, iw = a.shape[:2]
-            if ih <= iw:
-                h, w = self.size, max(1, int(iw * self.size / ih))
-            else:
-                h, w = max(1, int(ih * self.size / iw)), self.size
-        else:
-            h, w = self.size
-        ys = (np.arange(h) * a.shape[0] / h).astype(int)
-        xs = (np.arange(w) * a.shape[1] / w).astype(int)
-        out = a[ys][:, xs]
-        return out[:, :, 0] if squeeze else out
+        from . import transforms_functional as F_
+
+        return F_.resize(img, self.size, self.interpolation)
 
 
 def _pad_to(a, h, w):
@@ -199,3 +198,179 @@ class Pad:
         l, t, r, b = self.padding
         out = np.pad(a, ((t, b), (l, r), (0, 0)), constant_values=self.fill)
         return out[:, :, 0] if squeeze else out
+
+
+# -- round-5 tail: BaseTransform + color/geometry classes over the
+# functional module (reference transforms/transforms.py) ----------------------
+
+from . import transforms_functional as _F  # noqa: E402
+
+
+class BaseTransform:
+    """reference transforms.py BaseTransform: keys-aware callable base;
+    subclasses implement _apply_image (and optionally _apply_* for
+    other keys)."""
+
+    def __init__(self, keys=None):
+        self.keys = keys or ("image",)
+
+    def __call__(self, inputs):
+        if isinstance(inputs, tuple):
+            out = []
+            for key, data in zip(self.keys, inputs):
+                fn = getattr(self, f"_apply_{key}", None)
+                out.append(fn(data) if fn else data)
+            # elements beyond len(keys) pass through untouched (the
+            # reference extends outputs with inputs[len(keys):]) — a
+            # (img, label) pipeline must never lose its labels
+            out.extend(inputs[len(self.keys):])
+            return tuple(out)
+        return self._apply_image(inputs)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale, self.ratio = scale, ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        import numpy as _np
+
+        a = _F._hwc(img)
+        h, w = a.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * _np.random.uniform(*self.scale)
+            ar = _np.exp(_np.random.uniform(_np.log(self.ratio[0]),
+                                            _np.log(self.ratio[1])))
+            cw = int(round((target * ar) ** 0.5))
+            ch = int(round((target / ar) ** 0.5))
+            if 0 < cw <= w and 0 < ch <= h:
+                top = _np.random.randint(0, h - ch + 1)
+                left = _np.random.randint(0, w - cw + 1)
+                patch = _F.crop(img, top, left, ch, cw)
+                return _F.resize(patch, self.size, self.interpolation)
+        return _F.resize(_F.center_crop(img, min(h, w)), self.size,
+                         self.interpolation)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _factor(self):
+        import numpy as _np
+
+        return _np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+
+    def _apply_image(self, img):
+        return _F.adjust_brightness(img, self._factor()) \
+            if self.value > 0 else img
+
+
+class ContrastTransform(BrightnessTransform):
+    def _apply_image(self, img):
+        return _F.adjust_contrast(img, self._factor()) \
+            if self.value > 0 else img
+
+
+class SaturationTransform(BrightnessTransform):
+    def _apply_image(self, img):
+        return _F.adjust_saturation(img, self._factor()) \
+            if self.value > 0 else img
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        assert 0 <= value <= 0.5
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        import numpy as _np
+
+        if self.value == 0:
+            return img
+        return _F.adjust_hue(
+            img, _np.random.uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
+        self.ts = [BrightnessTransform(brightness),
+                   ContrastTransform(contrast),
+                   SaturationTransform(saturation), HueTransform(hue)]
+
+    def _apply_image(self, img):
+        import numpy as _np
+
+        order = _np.random.permutation(len(self.ts))
+        for i in order:
+            img = self.ts[i]._apply_image(img)
+        return img
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        self.degrees = ((-degrees, degrees)
+                        if isinstance(degrees, (int, float))
+                        else tuple(degrees))
+        self.interpolation = interpolation
+        self.expand = expand
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        import numpy as _np
+
+        angle = _np.random.uniform(*self.degrees)
+        return _F.rotate(img, angle, self.interpolation, self.expand,
+                         self.center, self.fill)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return _F.to_grayscale(img, self.num_output_channels)
+
+
+functional = _F
+"""`paddle.vision.transforms.functional` — the stateless numpy image
+ops (reference transforms/functional.py)."""
+
+# make `import paddle_tpu.vision.transforms.functional` work even
+# though transforms is a module, not a package (same pattern as
+# nn/functional's submodule registration)
+import sys as _sys  # noqa: E402
+
+_sys.modules[__name__ + ".functional"] = _F
+
+# reference transforms module also re-exports the functional names
+to_tensor = _F.to_tensor
+resize = _F.resize
+pad = _F.pad
+crop = _F.crop
+center_crop = _F.center_crop
+hflip = _F.hflip
+vflip = _F.vflip
+adjust_brightness = _F.adjust_brightness
+adjust_contrast = _F.adjust_contrast
+adjust_saturation = _F.adjust_saturation
+adjust_hue = _F.adjust_hue
+rotate = _F.rotate
+to_grayscale = _F.to_grayscale
+normalize = _F.normalize

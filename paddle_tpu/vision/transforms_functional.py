@@ -1,0 +1,257 @@
+"""`paddle.vision.transforms.functional` (reference
+python/paddle/vision/transforms/functional.py): the stateless image
+ops behind the transform classes.
+
+Images are numpy arrays, HWC (or HW for grayscale), uint8 or float —
+the zero-egress analogue of the reference's cv2/PIL backends; every op
+is pure numpy so data pipelines stay host-side (the device never sees
+un-batched images)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _hwc(img):
+    img = np.asarray(img)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return img
+
+
+def to_tensor(pic, data_format="CHW"):
+    """HWC uint8/float -> float32 in [0,1], CHW by default (reference
+    functional.py to_tensor)."""
+    a = _hwc(pic).astype("float32")
+    if np.asarray(pic).dtype == np.uint8:
+        a = a / 255.0
+    if data_format.upper() == "CHW":
+        a = a.transpose(2, 0, 1)
+    return a
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    a = np.asarray(img, "float32")
+    mean = np.asarray(mean, "float32")
+    std = np.asarray(std, "float32")
+    if data_format.upper() == "CHW":
+        shape = (-1, 1, 1)
+    else:
+        shape = (1, 1, -1)
+    return (a - mean.reshape(shape)) / std.reshape(shape)
+
+
+def resize(img, size, interpolation="bilinear"):
+    """Resize HWC to `size` (int: short side; (h, w): exact) with
+    numpy bilinear/nearest sampling."""
+    a = _hwc(img)
+    h, w = a.shape[:2]
+    if isinstance(size, int):
+        if h <= w:
+            oh, ow = size, max(1, int(round(w * size / h)))
+        else:
+            oh, ow = max(1, int(round(h * size / w))), size
+    else:
+        oh, ow = size
+    if (oh, ow) == (h, w):
+        return a if np.asarray(img).ndim == 3 else a[:, :, 0]
+    if interpolation == "nearest":
+        ri = np.clip(np.round(np.linspace(0, h - 1, oh)), 0,
+                     h - 1).astype(int)
+        ci = np.clip(np.round(np.linspace(0, w - 1, ow)), 0,
+                     w - 1).astype(int)
+        out = a[ri][:, ci]
+    else:  # bilinear, align_corners=False convention
+        ys = (np.arange(oh) + 0.5) * h / oh - 0.5
+        xs = (np.arange(ow) + 0.5) * w / ow - 0.5
+        y0 = np.clip(np.floor(ys).astype(int), 0, h - 1)
+        x0 = np.clip(np.floor(xs).astype(int), 0, w - 1)
+        y1 = np.clip(y0 + 1, 0, h - 1)
+        x1 = np.clip(x0 + 1, 0, w - 1)
+        wy = np.clip(ys - y0, 0, 1)[:, None, None]
+        wx = np.clip(xs - x0, 0, 1)[None, :, None]
+        af = a.astype("float32")
+        top = af[y0][:, x0] * (1 - wx) + af[y0][:, x1] * wx
+        bot = af[y1][:, x0] * (1 - wx) + af[y1][:, x1] * wx
+        out = top * (1 - wy) + bot * wy
+        if a.dtype == np.uint8:
+            out = np.clip(np.round(out), 0, 255).astype(np.uint8)
+        else:
+            out = out.astype(a.dtype)
+    return out if np.asarray(img).ndim == 3 else out[:, :, 0]
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    a = _hwc(img)
+    if isinstance(padding, int):
+        pl = pr = pt = pb = padding
+    elif len(padding) == 2:
+        pl, pt = padding
+        pr, pb = padding
+    else:
+        pl, pt, pr, pb = padding
+    mode = {"constant": "constant", "edge": "edge",
+            "reflect": "reflect", "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if mode == "constant" else {}
+    out = np.pad(a, ((pt, pb), (pl, pr), (0, 0)), mode=mode, **kw)
+    return out if np.asarray(img).ndim == 3 else out[:, :, 0]
+
+
+def crop(img, top, left, height, width):
+    a = _hwc(img)
+    out = a[top:top + height, left:left + width]
+    return out if np.asarray(img).ndim == 3 else out[:, :, 0]
+
+
+def center_crop(img, output_size):
+    a = _hwc(img)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    th, tw = output_size
+    h, w = a.shape[:2]
+    return crop(img, max(0, (h - th) // 2), max(0, (w - tw) // 2),
+                th, tw)
+
+
+def hflip(img):
+    a = _hwc(img)
+    out = a[:, ::-1]
+    return out if np.asarray(img).ndim == 3 else out[:, :, 0]
+
+
+def vflip(img):
+    a = _hwc(img)
+    out = a[::-1]
+    return out if np.asarray(img).ndim == 3 else out[:, :, 0]
+
+
+def _blend(a, b, ratio):
+    out = a.astype("float32") * ratio + b.astype("float32") * (1 - ratio)
+    if np.asarray(a).dtype == np.uint8:
+        return np.clip(out, 0, 255).astype(np.uint8)
+    return out.astype(np.asarray(a).dtype)
+
+
+def adjust_brightness(img, brightness_factor):
+    a = _hwc(img)
+    return _blend(a, np.zeros_like(a), brightness_factor)
+
+
+def adjust_contrast(img, contrast_factor):
+    a = _hwc(img)
+    mean = to_grayscale(a).astype("float32").mean()
+    return _blend(a, np.full_like(a, mean, dtype=a.dtype
+                                  if a.dtype != np.uint8 else np.uint8),
+                  contrast_factor)
+
+
+def adjust_saturation(img, saturation_factor):
+    a = _hwc(img)
+    gray = to_grayscale(a, num_output_channels=a.shape[2])
+    return _blend(a, gray, saturation_factor)
+
+
+def adjust_hue(img, hue_factor):
+    """Shift hue by hue_factor (in [-0.5, 0.5]) through HSV space.
+    Grayscale images (fewer than 3 channels) have no hue — returned
+    unchanged, matching the reference's PIL 'L'-mode behavior."""
+    assert -0.5 <= hue_factor <= 0.5, hue_factor
+    a = _hwc(img)
+    if a.shape[2] < 3:
+        return np.asarray(img)
+    dtype = a.dtype
+    f = a.astype("float32") / (255.0 if dtype == np.uint8 else 1.0)
+    r, g, b = f[..., 0], f[..., 1], f[..., 2]
+    mx, mn = f.max(-1), f.min(-1)
+    d = mx - mn + 1e-12
+    h = np.zeros_like(mx)
+    h = np.where(mx == r, ((g - b) / d) % 6, h)
+    h = np.where(mx == g, (b - r) / d + 2, h)
+    h = np.where(mx == b, (r - g) / d + 4, h)
+    h = (h / 6.0 + hue_factor) % 1.0
+    s = np.where(mx > 0, d / (mx + 1e-12), 0)
+    v = mx
+    i = np.floor(h * 6.0)
+    fr = h * 6.0 - i
+    p = v * (1 - s)
+    q = v * (1 - fr * s)
+    t = v * (1 - (1 - fr) * s)
+    i = i.astype(int) % 6
+    r2 = np.choose(i, [v, q, p, p, t, v])
+    g2 = np.choose(i, [t, v, v, q, p, p])
+    b2 = np.choose(i, [p, p, t, v, v, q])
+    out = np.stack([r2, g2, b2], -1)
+    if dtype == np.uint8:
+        return np.clip(out * 255.0 + 0.5, 0, 255).astype(np.uint8)
+    return out.astype(dtype)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False,
+           center=None, fill=0):
+    """Rotate counter-clockwise by `angle` degrees about `center`
+    (default: image center), nearest or bilinear sampling."""
+    a = _hwc(img).astype("float32")
+    h, w = a.shape[:2]
+    cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None \
+        else (center[1], center[0])
+    rad = np.deg2rad(angle)
+    cos, sin = np.cos(rad), np.sin(rad)
+    if expand:
+        corners = np.array([[-cx, -cy], [w - 1 - cx, -cy],
+                            [-cx, h - 1 - cy], [w - 1 - cx, h - 1 - cy]])
+        rot = corners @ np.array([[cos, sin], [-sin, cos]])
+        # round away float epsilon before ceil: cos(90deg) ~ 6e-17
+        # would otherwise add a spurious fill row/column
+        ow = int(np.ceil(round(rot[:, 0].max() - rot[:, 0].min(),
+                               6))) + 1
+        oh = int(np.ceil(round(rot[:, 1].max() - rot[:, 1].min(),
+                               6))) + 1
+        ocx, ocy = (ow - 1) / 2.0, (oh - 1) / 2.0
+    else:
+        oh, ow, ocx, ocy = h, w, cx, cy
+    yy, xx = np.meshgrid(np.arange(oh), np.arange(ow), indexing="ij")
+    # inverse map: output coords -> input coords
+    xs = (xx - ocx) * cos - (yy - ocy) * sin + cx
+    ys = (xx - ocx) * sin + (yy - ocy) * cos + cy
+    if interpolation == "bilinear":
+        x0 = np.floor(xs).astype(int)
+        y0 = np.floor(ys).astype(int)
+        wx = (xs - x0)[..., None]
+        wy = (ys - y0)[..., None]
+        val = 0.0
+        for (yi, xi, wgt) in [(y0, x0, (1 - wy) * (1 - wx)),
+                              (y0, x0 + 1, (1 - wy) * wx),
+                              (y0 + 1, x0, wy * (1 - wx)),
+                              (y0 + 1, x0 + 1, wy * wx)]:
+            inside = ((yi >= 0) & (yi < h) & (xi >= 0) & (xi < w))
+            samp = np.where(
+                inside[..., None],
+                a[np.clip(yi, 0, h - 1), np.clip(xi, 0, w - 1)], fill)
+            val = val + samp * wgt
+        out = val
+    else:
+        xi = np.round(xs).astype(int)
+        yi = np.round(ys).astype(int)
+        inside = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        out = np.where(
+            inside[..., None],
+            a[np.clip(yi, 0, h - 1), np.clip(xi, 0, w - 1)], fill)
+    if np.asarray(img).dtype == np.uint8:
+        out = np.clip(np.round(out), 0, 255).astype(np.uint8)
+    else:
+        out = out.astype(np.asarray(img).dtype)
+    return out if np.asarray(img).ndim == 3 else out[:, :, 0]
+
+
+def to_grayscale(img, num_output_channels=1):
+    a = _hwc(img)
+    if a.shape[2] == 1:
+        gray = a[..., 0].astype("float32")
+    else:
+        gray = (0.299 * a[..., 0].astype("float32")
+                + 0.587 * a[..., 1] + 0.114 * a[..., 2])
+    if np.asarray(img).dtype == np.uint8:
+        gray = np.clip(np.round(gray), 0, 255).astype(np.uint8)
+    else:
+        gray = gray.astype(np.asarray(img).dtype)
+    return np.repeat(gray[:, :, None], num_output_channels, axis=2)

@@ -596,3 +596,124 @@ class TestClassicDatasetReaders:
         assert t.shape == (3, 6, 6) and t.dtype == np.float32
         f = dimg.left_right_flip(im)
         np.testing.assert_array_equal(f, im[:, ::-1, :])
+
+
+class TestTransformsTail:
+    """Round-5 transforms tail (reference transforms/transforms.py +
+    functional.py): color/geometry classes and the functional module."""
+
+    def test_functional_oracles(self):
+        import paddle_tpu.vision.transforms as T
+
+        r = np.random.RandomState(0)
+        img = (r.rand(8, 6, 3) * 255).astype("uint8")
+        t = T.to_tensor(img)
+        assert t.shape == (3, 8, 6) and t.dtype == np.float32
+        assert t.max() <= 1.0
+        np.testing.assert_array_equal(T.hflip(img), img[:, ::-1])
+        np.testing.assert_array_equal(T.vflip(img), img[::-1])
+        c = T.crop(img, 2, 1, 4, 3)
+        np.testing.assert_array_equal(c, img[2:6, 1:4])
+        cc = T.center_crop(img, 4)
+        assert cc.shape == (4, 4, 3)
+        rs = T.resize(img, (16, 12))
+        assert rs.shape == (16, 12, 3)
+        # nearest resize by integer factor replicates pixels
+        nn_ = T.resize(img, (16, 12), interpolation="nearest")
+        np.testing.assert_array_equal(nn_[::2, ::2], img)
+        g = T.to_grayscale(img)
+        assert g.shape == (8, 6, 1)
+        norm = T.normalize(T.to_tensor(img), [0.5] * 3, [0.5] * 3)
+        assert norm.min() >= -1.0 - 1e-6 and norm.max() <= 1.0 + 1e-6
+
+    def test_adjust_and_rotate(self):
+        import paddle_tpu.vision.transforms as T
+
+        r = np.random.RandomState(1)
+        img = (r.rand(6, 6, 3) * 255).astype("uint8")
+        np.testing.assert_array_equal(
+            T.adjust_brightness(img, 1.0), img)
+        dark = T.adjust_brightness(img, 0.5)
+        assert dark.astype(int).sum() < img.astype(int).sum()
+        np.testing.assert_array_equal(T.adjust_hue(img, 0.0), img)
+        # rotate by 90 CCW == transpose+flip for square images
+        r90 = T.rotate(img, 90.0, interpolation="nearest")
+        np.testing.assert_array_equal(r90, np.rot90(img, 1))
+
+    def test_transform_classes(self):
+        import paddle_tpu.vision.transforms as T
+
+        np.random.seed(0)
+        img = (np.random.rand(32, 32, 3) * 255).astype("uint8")
+        out = T.RandomResizedCrop(16)(img)
+        assert out.shape == (16, 16, 3)
+        jit = T.ColorJitter(0.4, 0.4, 0.4, 0.2)(img)
+        assert jit.shape == img.shape
+        rot = T.RandomRotation(30)(img)
+        assert rot.shape == img.shape
+        gray = T.Grayscale(3)(img)
+        assert gray.shape == img.shape
+        assert np.allclose(gray[..., 0], gray[..., 1])
+        # BaseTransform keys routing
+        class Neg(T.BaseTransform):
+            def _apply_image(self, im):
+                return 255 - im
+
+        a, b = Neg(keys=("image", "label"))((img, 7))
+        np.testing.assert_array_equal(a, 255 - img)
+        assert b == 7
+
+
+class TestSummaryAndTestBatch:
+    def test_paddle_summary(self):
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu.fluid import dygraph
+
+        with dygraph.guard():
+            net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(),
+                                nn.Linear(8, 2))
+            info = paddle.summary(net, (1, 4))
+            assert info["total_params"] == 4 * 8 + 8 + 8 * 2 + 2
+            assert info["trainable_params"] == info["total_params"]
+
+
+
+class TestTransformsReviewFixes:
+    def test_base_transform_passes_extras_through(self):
+        import paddle_tpu.vision.transforms as T
+
+        img = (np.random.rand(4, 4, 3) * 255).astype("uint8")
+        out = T.Grayscale(3)((img, 7, "meta"))
+        assert len(out) == 3 and out[1] == 7 and out[2] == "meta"
+
+    def test_adjust_hue_grayscale_passthrough(self):
+        import paddle_tpu.vision.transforms as T
+
+        g = (np.random.rand(4, 4) * 255).astype("uint8")
+        np.testing.assert_array_equal(T.adjust_hue(g, 0.3), g)
+        out = T.ColorJitter(hue=0.2)(g[:, :, None])
+        assert out.shape == (4, 4, 1)
+
+    def test_rotate_expand_90_exact_shape(self):
+        import paddle_tpu.vision.transforms as T
+
+        img = (np.random.rand(6, 10, 3) * 255).astype("uint8")
+        out = T.rotate(img, 90, expand=True)
+        assert out.shape == (10, 6, 3)
+
+    def test_functional_submodule_importable(self):
+        import importlib
+
+        m = importlib.import_module(
+            "paddle_tpu.vision.transforms.functional")
+        import paddle_tpu.vision.transforms as T
+
+        assert m is T.functional
+
+    def test_resize_class_delegates_to_functional(self):
+        import paddle_tpu.vision.transforms as T
+
+        img = (np.random.rand(8, 6, 3) * 255).astype("uint8")
+        np.testing.assert_array_equal(T.Resize((4, 4))(img),
+                                      T.resize(img, (4, 4)))
