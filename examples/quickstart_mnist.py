@@ -8,9 +8,10 @@ reference (Fluid-era PaddlePaddle) user would reach for, unchanged:
      XLA computation on TPU)
 
 Runs on whatever jax backend is attached (TPU if available, CPU
-otherwise).  MNIST loads from the standard IDX files if present under
-~/.cache/paddle/dataset/mnist; otherwise swap in the synthetic batch
-below (zero-egress environments).
+otherwise).  Data is SYNTHETIC (random images/labels — this image has
+no dataset downloads); to train on real MNIST, replace
+synthetic_batches with paddle.vision.datasets.MNIST pointed at local
+IDX files.
 
 Usage: python examples/quickstart_mnist.py [hapi|dygraph|static]
 """

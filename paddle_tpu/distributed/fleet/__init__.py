@@ -35,3 +35,13 @@ distributed_model = fleet.distributed_model
 save_inference_model = fleet.save_inference_model
 save_persistables = fleet.save_persistables
 minimize = fleet.minimize
+from .base import UtilBase  # noqa: F401
+from ...fluid.incubate.data_generator import (  # noqa: F401
+    MultiSlotDataGenerator, MultiSlotStringDataGenerator)
+
+# fleet.util: the singleton UtilBase the reference hangs off the fleet
+# facade (util_factory._create_util)
+util = UtilBase()
+# lazy: resolve the role maker at CALL time so a later fleet.init()
+# is honored (review finding: an import-time snapshot is always None)
+util._set_role_maker(lambda: getattr(fleet, "_role_maker", None))

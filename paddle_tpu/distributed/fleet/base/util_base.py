@@ -1,0 +1,99 @@
+"""fleet.UtilBase (reference distributed/fleet/base/util_factory.py:43):
+host-side cross-worker utilities.  The reference runs these over Gloo
+rings; TPU-natively the host collective is jax's multi-process global
+arrays when launched with N processes, and identity on a single
+process (the common case here: one process drives all chips, so
+"worker"-world collectives have exactly one participant)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class UtilBase:
+    def __init__(self):
+        self.role_maker = None
+        self.dist_strategy = None
+
+    def _set_strategy(self, dist_strategy):
+        self.dist_strategy = dist_strategy
+
+    def _set_role_maker(self, role_maker):
+        """Accepts the role maker itself OR a zero-arg callable
+        resolving to it — the fleet facade passes a callable so the
+        util singleton always sees the role maker installed by a LATER
+        fleet.init() (the reference builds util inside init; a static
+        snapshot at import time would permanently see None)."""
+        self.role_maker = role_maker
+
+    def _role(self):
+        rm = self.role_maker
+        return rm() if callable(rm) else rm
+
+    # -- host collectives -------------------------------------------------
+
+    def _world(self):
+        import jax
+
+        return jax.process_count(), jax.process_index()
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        if mode not in ("sum", "min", "max"):
+            # validate BEFORE the single-process fast path: a bad mode
+            # must fail on the dev box, not only on the cluster
+            raise ValueError(f"all_reduce mode must be sum/min/max, "
+                             f"got {mode!r}")
+        n, _ = self._world()
+        a = np.asarray(input)
+        if n == 1:
+            return a.copy()
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import multihost_utils
+
+        g = multihost_utils.process_allgather(jnp.asarray(a))
+        red = {"sum": jnp.sum, "min": jnp.min, "max": jnp.max}[mode]
+        return np.asarray(red(g, axis=0))
+
+    def all_gather(self, input, comm_world="worker"):
+        n, _ = self._world()
+        if n == 1:
+            return [input]
+        import jax.numpy as jnp
+        from jax.experimental import multihost_utils
+
+        g = multihost_utils.process_allgather(
+            jnp.asarray(np.asarray(input)))
+        return [np.asarray(x) for x in g]
+
+    def barrier(self, comm_world="worker"):
+        n, _ = self._world()
+        if n > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("fleet_util_barrier")
+
+    # -- file sharding / logging ------------------------------------------
+
+    def get_file_shard(self, files):
+        """Split `files` contiguously across workers (reference
+        util_factory.py:205 — trainer i gets blocks[i])."""
+        if not isinstance(files, list):
+            raise TypeError("files should be a list of file paths")
+        rm = self._role()
+        if rm is not None:
+            idx = rm.worker_index()
+            num = rm.worker_num()
+        else:
+            num, idx = self._world()
+        base, remain = divmod(len(files), num)
+        begin = idx * base + min(idx, remain)
+        count = base + (1 if idx < remain else 0)
+        return files[begin:begin + count]
+
+    def print_on_rank(self, message, rank_id):
+        rm = self._role()
+        idx = (rm.worker_index() if rm is not None
+               else self._world()[1])
+        if idx == rank_id:
+            print(message)

@@ -289,3 +289,69 @@ class TestReviewRegressions:
 
         a, b = load(), load()
         np.testing.assert_array_equal(a, b)
+
+
+class TestFleetUtil:
+    """fleet.util (reference util_factory.py UtilBase): host-side
+    cross-worker utilities; single-process semantics here, shard math
+    identical to the reference's contiguous-block split."""
+
+    def test_surface_and_single_process_semantics(self):
+        import paddle_tpu.distributed.fleet as fleet
+
+        assert hasattr(fleet, "UtilBase")
+        assert hasattr(fleet, "MultiSlotDataGenerator")
+        u = fleet.util
+        np.testing.assert_allclose(
+            u.all_reduce(np.array([1.0, 2.0]), "sum"), [1.0, 2.0])
+        assert u.all_gather(7) == [7]
+        u.barrier()
+
+    def test_get_file_shard_matches_reference_split(self):
+        from paddle_tpu.distributed.fleet.base.util_base import UtilBase
+
+        class FakeRole:
+            def __init__(self, idx, num):
+                self._i, self._n = idx, num
+
+            def worker_index(self):
+                return self._i
+
+            def worker_num(self):
+                return self._n
+
+        files = [f"f{i}" for i in range(7)]
+        # reference: 7 files over 3 workers -> 3/2/2 contiguous blocks
+        got = []
+        for i in range(3):
+            u = UtilBase()
+            u._set_role_maker(FakeRole(i, 3))
+            got.append(u.get_file_shard(files))
+        assert got == [["f0", "f1", "f2"], ["f3", "f4"], ["f5", "f6"]]
+        with pytest.raises(TypeError):
+            u.get_file_shard("not-a-list")
+
+    def test_util_sees_late_role_maker(self):
+        """fleet.util must honor a role maker installed AFTER import
+        (review finding: an import-time snapshot is always None)."""
+        import paddle_tpu.distributed.fleet as fleet
+
+        class FakeRole:
+            def worker_index(self):
+                return 1
+
+            def worker_num(self):
+                return 2
+
+        old = getattr(fleet.fleet, "_role_maker", None)
+        fleet.fleet._role_maker = FakeRole()
+        try:
+            assert fleet.util.get_file_shard(["a", "b", "c"]) == ["c"]
+        finally:
+            fleet.fleet._role_maker = old
+
+    def test_all_reduce_bad_mode_fails_single_process(self):
+        import paddle_tpu.distributed.fleet as fleet
+
+        with pytest.raises(ValueError, match="sum/min/max"):
+            fleet.util.all_reduce(np.ones(2), mode="avg")
