@@ -11,8 +11,9 @@ from __future__ import annotations
 from ..framework import default_main_program
 from ..layer_helper import LayerHelper
 
-__all__ = ["While", "while_loop", "cond", "increment_", "array_write",
-           "array_read", "array_length", "create_array"]
+__all__ = ["While", "while_loop", "cond", "case", "switch_case",
+           "increment_", "array_write", "array_read", "array_length",
+           "create_array"]
 
 
 class While:
@@ -166,4 +167,52 @@ def array_length(array):
     out = helper.create_variable_for_type_inference(dtype="int64")
     helper.append_op("lod_array_length", inputs={"X": [array]},
                      outputs={"Out": [out]})
+    return out
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """Run the fn of the FIRST true pred (reference
+    layers/control_flow.py case:3036) — lowered as a right-fold of
+    cond selects, so 'first true wins' exactly like the reference."""
+    if not pred_fn_pairs:
+        raise TypeError("pred_fn_pairs must be a non-empty list/tuple")
+    for p in pred_fn_pairs:
+        if not (isinstance(p, (list, tuple)) and len(p) == 2
+                and callable(p[1])):
+            raise TypeError(
+                "each pred_fn_pairs element must be a (pred, callable) "
+                f"pair, got {p!r}")
+    if default is None:
+        # reference semantics: last fn doubles as the default
+        pred_fn_pairs, default = (pred_fn_pairs[:-1],
+                                  pred_fn_pairs[-1][1])
+    out = default()
+    for pred, fn in reversed(list(pred_fn_pairs)):
+        out = cond(pred, fn, (lambda o=out: o))
+    return out
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Select a branch by integer index (reference
+    layers/control_flow.py switch_case:3129).  branch_fns: dict
+    {index: fn} or list of (index, fn) / fns."""
+    from .tensor import fill_constant
+
+    if isinstance(branch_fns, (list, tuple)):
+        pairs = [(i, fn) if callable(fn) else tuple(fn)
+                 for i, fn in enumerate(branch_fns)]
+    elif isinstance(branch_fns, dict):
+        pairs = sorted(branch_fns.items())
+    else:
+        raise TypeError("branch_fns must be list/tuple/dict")
+    keys = [k for k, _ in pairs]
+    if len(set(keys)) != len(keys):
+        raise ValueError(f"duplicate branch indices: {keys}")
+    if default is None:
+        default = pairs[-1][1]  # reference: max-index fn is default
+        pairs = pairs[:-1]
+    out = default()
+    for idx, fn in reversed(pairs):
+        eq = branch_index == fill_constant([1], branch_index.dtype, idx)
+        out = cond(eq, fn, (lambda o=out: o))
     return out

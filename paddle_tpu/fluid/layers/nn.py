@@ -33,6 +33,9 @@ __all__ = [
     "interpolate", "py_func", "auc", "warpctc",
     "ctc_greedy_decoder", "edit_distance",
     "linear_chain_crf", "crf_decoding",
+    "bilinear_tensor_product", "row_conv", "spectral_norm",
+    "data_norm", "nce", "deform_conv2d", "conv3d_transpose",
+    "multi_box_head",
 ]
 
 
@@ -1009,3 +1012,299 @@ def Print(input, first_n=-1, message=None, summarize=20,
                             "first_n": first_n,
                             "summarize": summarize})
     return out
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """reference layers/nn.py bilinear_tensor_product: out_k = x W_k y^T
+    (+ bias, + act), weight (size, x_dim, y_dim)."""
+    helper = LayerHelper("bilinear_tensor_product", name=name)
+    w = helper.create_parameter(
+        param_attr, shape=[size, int(x.shape[1]), int(y.shape[1])],
+        dtype=x.dtype)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("bilinear_tensor_product",
+                     inputs={"X": [x], "Y": [y], "Weight": [w]},
+                     outputs={"Out": [out]})
+    out = helper.append_bias_op(out, bias_attr)
+    return helper.append_activation(out, act)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """reference layers/nn.py row_conv (lookahead convolution)."""
+    helper = LayerHelper("row_conv")
+    w = helper.create_parameter(
+        param_attr,
+        shape=[future_context_size + 1, int(input.shape[-1])],
+        dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op("row_conv", inputs={"X": [input], "Filter": [w]},
+                     outputs={"Out": [out]})
+    return helper.append_activation(out, act)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """reference layers/nn.py spectral_norm: weight normalized by its
+    largest singular value via power iteration; u/v are persistable
+    power-iteration state."""
+    import numpy as _np
+
+    helper = LayerHelper("spectral_norm", name=name)
+    shape = [int(s) for s in weight.shape]
+    h = shape[dim]
+    w = 1
+    for i, s in enumerate(shape):
+        if i != dim:
+            w *= s
+    from ..initializer import NormalInitializer
+
+    u = helper.create_parameter(
+        None, shape=[h], dtype=weight.dtype,
+        default_initializer=NormalInitializer(0.0, 1.0))
+    v = helper.create_parameter(
+        None, shape=[w], dtype=weight.dtype,
+        default_initializer=NormalInitializer(0.0, 1.0))
+    u.stop_gradient = True
+    v.stop_gradient = True
+    out = helper.create_variable_for_type_inference(dtype=weight.dtype)
+    # U/V outputs alias the persistable vectors so the power iteration
+    # REFINES across steps (the kernel persists them only when these
+    # slots are declared — same pattern as batch_norm's MeanOut)
+    helper.append_op("spectral_norm",
+                     inputs={"Weight": [weight], "U": [u], "V": [v]},
+                     outputs={"Out": [out], "U": [u], "V": [v]},
+                     attrs={"dim": dim, "power_iters": power_iters,
+                            "eps": eps})
+    return out
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              enable_scale_and_shift=False, name=None, moving_mean_name=None,
+              moving_variance_name=None, do_model_average_for_mean_and_var=True,
+              slot_dim=-1, summary_decay_rate=0.9999999):
+    """reference layers/nn.py data_norm: normalization by accumulated
+    batch statistics (CTR models); the three stat tensors are
+    persistable state initialized like the reference (size ~0, sum 0,
+    square-sum ~0 -> initial mean 0 / scale 1)."""
+    from ..initializer import ConstantInitializer
+
+    if enable_scale_and_shift:
+        raise NotImplementedError(
+            "data_norm(enable_scale_and_shift=True) is not supported "
+            "on this build; apply an explicit fc/elementwise affine "
+            "after data_norm instead (silently dropping the learnable "
+            "affine would change model capacity)")
+    helper = LayerHelper("data_norm", name=name)
+    c = int(input.shape[-1])
+    batch_size = helper.create_parameter(
+        None, shape=[c], dtype=input.dtype,
+        default_initializer=ConstantInitializer(1e4))
+    batch_sum = helper.create_parameter(
+        None, shape=[c], dtype=input.dtype,
+        default_initializer=ConstantInitializer(0.0))
+    batch_square_sum = helper.create_parameter(
+        None, shape=[c], dtype=input.dtype,
+        default_initializer=ConstantInitializer(1e4))
+    for t in (batch_size, batch_sum, batch_square_sum):
+        t.stop_gradient = True
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    # the *Out slots alias the persistable stats so they ACCUMULATE
+    # across steps (the kernel only writes them when declared)
+    helper.append_op("data_norm",
+                     inputs={"X": [input], "BatchSize": [batch_size],
+                             "BatchSum": [batch_sum],
+                             "BatchSquareSum": [batch_square_sum]},
+                     outputs={"Y": [out],
+                              "BatchSizeOut": [batch_size],
+                              "BatchSumOut": [batch_sum],
+                              "BatchSquareSumOut": [batch_square_sum]},
+                     attrs={"epsilon": epsilon})
+    return helper.append_activation(out, act)
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=None, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """reference layers/nn.py nce (noise-contrastive estimation loss)."""
+    if sampler != "uniform" or custom_dist is not None:
+        raise NotImplementedError(
+            f"nce sampler={sampler!r}/custom_dist is not supported on "
+            "this build (the lowering draws uniform noise); running a "
+            "different distribution silently would change the loss")
+    helper = LayerHelper("nce", name=name)
+    dim = int(input.shape[-1])
+    w = helper.create_parameter(param_attr,
+                                shape=[num_total_classes, dim],
+                                dtype=input.dtype)
+    b = helper.create_parameter(bias_attr, shape=[num_total_classes, 1],
+                                dtype=input.dtype, is_bias=True)
+    cost = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op("nce",
+                     inputs={"Input": [input], "Label": [label],
+                             "Weight": [w], "Bias": [b]},
+                     outputs={"Cost": [cost]},
+                     attrs={"num_total_classes": num_total_classes,
+                            "num_neg_samples": num_neg_samples or 10,
+                            "seed": seed, "sampler": 0},
+                     infer_shape=False)
+    return cost
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, weight_attr=None, bias_attr=None,
+                  name=None):
+    """reference static/nn/common.py deform_conv2d over the
+    deformable_conv lowering."""
+    helper = LayerHelper("deformable_conv", name=name)
+    c_in = int(x.shape[1])
+    k = [filter_size, filter_size] if isinstance(filter_size, int) \
+        else list(filter_size)
+    w = helper.create_parameter(
+        weight_attr, shape=[num_filters, c_in // groups] + k,
+        dtype=x.dtype)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    pair = lambda v: [v, v] if isinstance(v, int) else list(v)
+    ins = {"Input": [x], "Offset": [offset], "Filter": [w]}
+    if mask is not None:
+        ins["Mask"] = [mask]
+    helper.append_op("deformable_conv", inputs=ins,
+                     outputs={"Output": [out]},
+                     attrs={"strides": pair(stride),
+                            "paddings": pair(padding),
+                            "dilations": pair(dilation),
+                            "groups": groups,
+                            "deformable_groups": deformable_groups,
+                            "im2col_step": im2col_step})
+    # per-FILTER bias on the channel axis (append_bias_op would size
+    # it by the trailing spatial dim and broadcast per column)
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, shape=[num_filters],
+                                    dtype=x.dtype, is_bias=True)
+        if b is not None:
+            pre = helper.create_variable_for_type_inference(x.dtype)
+            helper.append_op("elementwise_add",
+                             inputs={"X": [out], "Y": [b]},
+                             outputs={"Out": [pre]}, attrs={"axis": 1})
+            out = pre
+    return out
+
+
+def conv3d_transpose(input, num_filters, output_size=None,
+                     filter_size=None, padding=0, stride=1, dilation=1,
+                     groups=1, param_attr=None, bias_attr=None,
+                     use_cudnn=True, act=None, name=None,
+                     data_format="NCDHW"):
+    """reference layers/nn.py conv3d_transpose over the
+    conv3d_transpose lowering."""
+    helper = LayerHelper("conv3d_transpose", name=name, act=act)
+    trip = lambda v: [v] * 3 if isinstance(v, int) else list(v)
+    stride, dilation, padding = trip(stride), trip(dilation), trip(padding)
+    assert filter_size is not None, \
+        "conv3d_transpose requires filter_size on this build"
+    if output_size is not None:
+        raise NotImplementedError(
+            "conv3d_transpose(output_size=...) is not supported here "
+            "(the reference uses it to disambiguate stride>1 output "
+            "shapes); size the output via filter_size/stride/padding")
+    filter_size = trip(filter_size)
+    channels = int(input.shape[1])
+    w = helper.create_parameter(
+        param_attr, shape=[channels, num_filters // groups] + filter_size,
+        dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op("conv3d_transpose",
+                     inputs={"Input": [input], "Filter": [w]},
+                     outputs={"Output": [out]},
+                     attrs={"strides": stride, "paddings": padding,
+                            "dilations": dilation, "groups": groups,
+                            "padding_algorithm": "EXPLICIT",
+                            "data_format": data_format})
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, shape=[num_filters],
+                                    dtype=input.dtype, is_bias=True)
+        if b is not None:
+            pre = helper.create_variable_for_type_inference(input.dtype)
+            helper.append_op("elementwise_add",
+                             inputs={"X": [out], "Y": [b]},
+                             outputs={"Out": [pre]}, attrs={"axis": 1})
+            out = pre
+    return helper.append_activation(out, act)
+
+
+def multi_box_head(inputs, image, base_size, num_classes,
+                   aspect_ratios, min_ratio=None, max_ratio=None,
+                   min_sizes=None, max_sizes=None, steps=None,
+                   step_w=None, step_h=None, offset=0.5, variance=None,
+                   flip=True, clip=False, kernel_size=1, pad=0,
+                   stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD detection head (reference layers/detection.py
+    multi_box_head:1924): per feature map, a conv head for box
+    locations and one for class confidences plus a prior_box grid;
+    everything concatenated across maps.  Returns
+    (mbox_locs, mbox_confs, prior_boxes, variances)."""
+    from .detection import prior_box as _prior_box
+    from .tensor import concat
+
+    n_maps = len(inputs)
+    if min_sizes is None:
+        # reference ratio schedule: evenly spaced in [min_ratio,
+        # max_ratio] percent of base_size, first map at half min
+        assert min_ratio is not None and max_ratio is not None
+        min_sizes, max_sizes = [], []
+        step = int((max_ratio - min_ratio) / max(1, n_maps - 2))
+        for r in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * r / 100.0)
+            max_sizes.append(base_size * (r + step) / 100.0)
+        min_sizes = [base_size * 0.1] + min_sizes
+        max_sizes = [base_size * 0.2] + max_sizes
+    variance = list(variance or (0.1, 0.1, 0.2, 0.2))
+    locs, confs, boxes_all, vars_all = [], [], [], []
+    for i, x in enumerate(inputs):
+        mins = min_sizes[i]
+        maxs = max_sizes[i] if max_sizes else None
+        ar = aspect_ratios[i]
+        mins = [mins] if not isinstance(mins, (list, tuple)) else mins
+        maxs = ([maxs] if maxs is not None
+                and not isinstance(maxs, (list, tuple)) else maxs)
+        ar = [ar] if not isinstance(ar, (list, tuple)) else list(ar)
+        box, var = _prior_box(
+            x, image, mins, maxs, ar, variance, flip, clip,
+            steps=((lambda sv: [sv, sv] if not isinstance(
+                sv, (list, tuple)) else list(sv))(steps[i])
+                if steps else
+                [step_w[i] if step_w else 0.0,
+                 step_h[i] if step_h else 0.0]),
+            offset=offset,
+            min_max_aspect_ratios_order=min_max_aspect_ratios_order)
+        # priors per spatial cell, computed like the reference op's
+        # ExpandAspectRatios (prior_box_op.h): [1.0] + each new ar
+        # (+ its flip), times min sizes, plus one per max size
+        import math as _math
+
+        # NB math.fabs, not abs: this module defines a layer named
+        # `abs` that shadows the builtin
+        expanded = [1.0]
+        for a in ar:
+            if not any(_math.fabs(a - e) < 1e-6 for e in expanded):
+                expanded.append(a)
+                if flip and _math.fabs(a - 1.0) > 1e-6:
+                    expanded.append(1.0 / a)
+        num_priors = len(expanded) * len(mins) + len(maxs or [])
+        loc = conv2d(x, num_priors * 4, kernel_size, stride=stride,
+                     padding=pad)
+        conf = conv2d(x, num_priors * num_classes, kernel_size,
+                      stride=stride, padding=pad)
+        # NCHW -> (N, priors, 4 / classes)
+        loc = transpose(loc, [0, 2, 3, 1])
+        conf = transpose(conf, [0, 2, 3, 1])
+        locs.append(reshape(loc, [0, -1, 4]))
+        confs.append(reshape(conf, [0, -1, num_classes]))
+        boxes_all.append(reshape(box, [-1, 4]))
+        vars_all.append(reshape(var, [-1, 4]))
+    mbox_locs = concat(locs, axis=1)
+    mbox_confs = concat(confs, axis=1)
+    prior_boxes = concat(boxes_all, axis=0)
+    box_vars = concat(vars_all, axis=0)
+    return mbox_locs, mbox_confs, prior_boxes, box_vars

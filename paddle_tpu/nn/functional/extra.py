@@ -771,10 +771,10 @@ deformable_roi_pooling = _na(
     "roi_align in the supported detection path",
     "paddle.nn.functional.deformable_conv / roi_align")
 multi_box_head = _na(
-    "multi_box_head", "the SSD head builder composes conv2d + "
-    "prior_box + reshape, all available individually",
-    "prior_box + conv2d + detection_output composition "
-    "(see examples in the reference's SSD model)")
+    "multi_box_head", "the SSD head builder creates parameters, which "
+    "is a static-graph (LayerHelper) affair",
+    "paddle.static.nn.multi_box_head (implemented) inside a static "
+    "program, or prior_box + nn.Conv2D composition in dygraph")
 merge_selected_rows = _na(
     "merge_selected_rows", "SelectedRows never materializes here "
     "(gradients are dense on TPU)", "dense tensors directly")

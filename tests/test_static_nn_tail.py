@@ -1,0 +1,216 @@
+"""paddle.static.nn tail (reference static/nn/__init__.py __all__):
+surface completeness + executor-backed smoke/oracle tests for the
+param-creating static layers and case/switch_case control flow."""
+
+import ast
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.static as static
+
+
+@pytest.fixture
+def prog():
+    from paddle_tpu.fluid import framework, unique_name
+    from paddle_tpu.fluid.executor import Scope, scope_guard
+
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        with unique_name.guard():
+            with scope_guard(Scope()):
+                yield main, startup
+
+
+def _run(main, startup, feed, fetch):
+    exe = fluid.Executor()
+    exe.run(startup)
+    return exe.run(main, feed=feed, fetch_list=fetch)
+
+
+def test_static_nn_surface_complete():
+    names = None
+    for node in ast.walk(ast.parse(open(
+            "/root/reference/python/paddle/static/nn/__init__.py"
+    ).read())):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if getattr(t, "id", "") == "__all__":
+                    names = set(ast.literal_eval(node.value))
+    missing = sorted(n for n in names if not hasattr(static.nn, n))
+    assert missing == [], f"static.nn gaps: {missing}"
+
+
+def test_bilinear_tensor_product(prog):
+    main, startup = prog
+    x = fluid.data("x", [-1, 4], "float32")
+    y = fluid.data("y", [-1, 5], "float32")
+    out = static.nn.bilinear_tensor_product(x, y, size=6)
+    xv = np.random.RandomState(0).rand(3, 4).astype("float32")
+    yv = np.random.RandomState(1).rand(3, 5).astype("float32")
+    (o,) = _run(main, startup, {"x": xv, "y": yv}, [out])
+    assert o.shape == (3, 6) and np.isfinite(o).all()
+
+
+def test_row_conv_and_spectral_norm(prog):
+    main, startup = prog
+    x = fluid.data("x", [-1, 5, 8], "float32")
+    out = static.nn.row_conv(x, future_context_size=2)
+    w = static.nn.create_parameter([4, 6], "float32")
+    wn = static.nn.spectral_norm(w, power_iters=2)
+    xv = np.random.RandomState(2).rand(2, 5, 8).astype("float32")
+    o, wv = _run(main, startup, {"x": xv}, [out, wn])
+    assert o.shape == xv.shape
+    # spectral norm bounds the top singular value near 1
+    assert np.linalg.svd(wv, compute_uv=False)[0] < 2.0
+
+
+def test_data_norm_and_nce(prog):
+    main, startup = prog
+    x = fluid.data("x", [-1, 6], "float32")
+    out = static.nn.data_norm(x)
+    emb = fluid.data("e", [-1, 8], "float32")
+    lbl = fluid.data("l", [-1, 1], "int64")
+    cost = static.nn.nce(emb, lbl, num_total_classes=12,
+                         num_neg_samples=3)
+    xv = np.random.RandomState(3).rand(4, 6).astype("float32")
+    ev = np.random.RandomState(4).rand(4, 8).astype("float32")
+    lv = np.array([[1], [2], [3], [0]], "int64")
+    o, c = _run(main, startup, {"x": xv, "e": ev, "l": lv},
+                [out, cost])
+    assert o.shape == xv.shape and np.isfinite(c).all()
+
+
+def test_deform_conv2d_and_conv3d_transpose(prog):
+    main, startup = prog
+    x = fluid.data("x", [-1, 3, 8, 8], "float32")
+    # 3x3 kernel -> offset 2*3*3 channels, mask 3*3
+    off = fluid.data("off", [-1, 18, 8, 8], "float32")
+    mask = fluid.data("m", [-1, 9, 8, 8], "float32")
+    out = static.nn.deform_conv2d(x, off, mask, num_filters=4,
+                                  filter_size=3, padding=1)
+    x3 = fluid.data("x3", [-1, 2, 4, 4, 4], "float32")
+    out3 = static.nn.conv3d_transpose(x3, 5, filter_size=3)
+    r = np.random.RandomState(5)
+    o, o3 = _run(main, startup,
+                 {"x": r.rand(2, 3, 8, 8).astype("float32"),
+                  "off": np.zeros((2, 18, 8, 8), "float32"),
+                  "m": np.ones((2, 9, 8, 8), "float32"),
+                  "x3": r.rand(2, 2, 4, 4, 4).astype("float32")},
+                 [out, out3])
+    assert o.shape == (2, 4, 8, 8)
+    assert o3.shape[:2] == (2, 5)
+
+
+def test_case_and_switch_case(prog):
+    main, startup = prog
+    x = fluid.data("x", [1], "float32")
+    one = lambda: fluid.layers.fill_constant([1], "float32", 1.0)
+    two = lambda: fluid.layers.fill_constant([1], "float32", 2.0)
+    three = lambda: fluid.layers.fill_constant([1], "float32", 3.0)
+    pred_hi = x > fluid.layers.fill_constant([1], "float32", 10.0)
+    pred_lo = x > fluid.layers.fill_constant([1], "float32", 0.0)
+    out = static.nn.case([(pred_hi, one), (pred_lo, two)],
+                         default=three)
+    idx = fluid.data("i", [1], "int64")
+    sw = static.nn.switch_case(idx, {0: one, 1: two, 3: three})
+    (a, s0) = _run(main, startup,
+                   {"x": np.array([5.0], "float32"),
+                    "i": np.array([1], "int64")}, [out, sw])
+    assert float(a) == 2.0   # first true pred wins (pred_lo)
+    assert float(s0) == 2.0  # index 1 -> two
+    exe = fluid.Executor()
+    (b, s1) = exe.run(main, feed={"x": np.array([50.0], "float32"),
+                                  "i": np.array([7], "int64")},
+                      fetch_list=[out, sw])
+    assert float(b) == 1.0   # pred_hi wins
+    assert float(s1) == 3.0  # unknown index -> default (max-index fn)
+
+
+def test_multi_box_head(prog):
+    main, startup = prog
+    img = fluid.data("img", [-1, 3, 32, 32], "float32")
+    f1 = fluid.data("f1", [-1, 8, 8, 8], "float32")
+    f2 = fluid.data("f2", [-1, 8, 4, 4], "float32")
+    locs, confs, boxes, vars_ = static.nn.multi_box_head(
+        [f1, f2], img, base_size=32, num_classes=5,
+        aspect_ratios=[[2.0], [2.0]], min_ratio=20, max_ratio=90,
+        flip=True)
+    r = np.random.RandomState(6)
+    lv, cv, bv, vv = _run(
+        main, startup,
+        {"img": r.rand(2, 3, 32, 32).astype("float32"),
+         "f1": r.rand(2, 8, 8, 8).astype("float32"),
+         "f2": r.rand(2, 8, 4, 4).astype("float32")},
+        [locs, confs, boxes, vars_])
+    n_priors = bv.shape[0]
+    assert lv.shape == (2, n_priors, 4)
+    assert cv.shape == (2, n_priors, 5)
+    assert vv.shape == (n_priors, 4)
+
+
+def test_data_norm_accumulates_stats(prog):
+    """The *Out slots alias the persistable stats — they must CHANGE
+    after a run (review finding: without the slots the layer is a
+    permanent identity)."""
+    main, startup = prog
+    x = fluid.data("x", [-1, 3], "float32")
+    out = static.nn.data_norm(x)
+    exe = fluid.Executor()
+    exe.run(startup)
+    from paddle_tpu.fluid.executor import global_scope
+
+    xv = (np.random.RandomState(7).rand(8, 3) + 5).astype("float32")
+    # params in creation order: w_0=batch_size, w_1=batch_sum, w_2=sq
+    name = "data_norm_0.w_1"
+    before = np.asarray(global_scope().find_var(name).get_tensor())
+    exe.run(main, feed={"x": xv}, fetch_list=[out])
+    after = np.asarray(global_scope().find_var(name).get_tensor())
+    assert not np.allclose(before, after), "stats did not accumulate"
+
+
+def test_spectral_norm_refines_u(prog):
+    main, startup = prog
+    w = static.nn.create_parameter([4, 6], "float32")
+    wn = static.nn.spectral_norm(w, power_iters=1)
+    exe = fluid.Executor()
+    exe.run(startup)
+    from paddle_tpu.fluid.executor import global_scope
+
+    # u/v are the spectral_norm helper's params (creation order)
+    uname = "spectral_norm_0.w_0"
+    exe.run(main, fetch_list=[wn])
+    u1 = np.asarray(global_scope().find_var(uname).get_tensor()).copy()
+    exe.run(main, fetch_list=[wn])
+    u2 = np.asarray(global_scope().find_var(uname).get_tensor())
+    assert not np.allclose(u1, u2), "power-iteration u never refined"
+
+
+def test_multi_box_head_scalar_steps(prog):
+    main, startup = prog
+    img = fluid.data("img", [-1, 3, 16, 16], "float32")
+    f1 = fluid.data("f1", [-1, 4, 4, 4], "float32")
+    locs, confs, boxes, _ = static.nn.multi_box_head(
+        [f1], img, base_size=16, num_classes=3,
+        aspect_ratios=[[2.0]], min_sizes=[[4.0]], max_sizes=[[8.0]],
+        steps=[4.0])  # scalar per map, like the reference API
+    r = np.random.RandomState(8)
+    lv, = _run(main, startup,
+               {"img": r.rand(1, 3, 16, 16).astype("float32"),
+                "f1": r.rand(1, 4, 4, 4).astype("float32")}, [locs])
+    assert lv.shape[0] == 1 and lv.shape[2] == 4
+
+
+def test_loud_unsupported_knobs(prog):
+    main, startup = prog
+    x = fluid.data("x", [-1, 6], "float32")
+    with pytest.raises(NotImplementedError, match="scale_and_shift"):
+        static.nn.data_norm(x, enable_scale_and_shift=True)
+    lbl = fluid.data("l", [-1, 1], "int64")
+    with pytest.raises(NotImplementedError, match="sampler"):
+        static.nn.nce(x, lbl, 10, sampler="log_uniform")
+    x3 = fluid.data("x3", [-1, 2, 4, 4, 4], "float32")
+    with pytest.raises(NotImplementedError, match="output_size"):
+        static.nn.conv3d_transpose(x3, 5, output_size=[8, 8, 8],
+                                   filter_size=3)
