@@ -15,3 +15,4 @@ from .sequence_lod import *  # noqa: F401,F403
 from .detection import *  # noqa: F401,F403
 from . import detection  # noqa: F401
 from . import tensor, nn, loss, control_flow, rnn, learning_rate_scheduler, sequence_lod  # noqa: F401
+from .compat import *  # noqa: F401,F403 - legacy-name tail

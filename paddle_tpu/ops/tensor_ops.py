@@ -564,9 +564,24 @@ def _meshgrid(ctx, op, ins):
 def _unique(ctx, op, ins):
     # Static-shape variant: returns sorted unique values padded with the
     # max value (XLA cannot produce dynamic shapes; see module docstring).
+    # Index (the inverse map: x[i] == out[index[i]]) and Counts are
+    # computed only when the program declares those slots (the
+    # unique_with_counts legacy layer does; reference unique_op.cc).
     x = first(ins, "X")
-    vals = jnp.unique(x, size=x.size, fill_value=None)
-    return {"Out": [vals]}
+    want_index = "Index" in op.outputs
+    want_counts = "Counts" in op.outputs
+    idx_dtype = op.attr("dtype", "int32")
+    if not (want_index or want_counts):
+        return {"Out": [jnp.unique(x, size=x.size, fill_value=None)]}
+    vals, inv, counts = jnp.unique(
+        x.reshape(-1), size=x.size, fill_value=None,
+        return_inverse=True, return_counts=True)
+    outs = {"Out": [vals]}
+    if want_index:
+        outs["Index"] = [inv.reshape(-1).astype(idx_dtype)]
+    if want_counts:
+        outs["Counts"] = [counts.astype(idx_dtype)]
+    return outs
 
 
 @register_op("masked_fill")
