@@ -124,3 +124,4 @@ def device_count():
 from ..parallel.compiler import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa: E402
 from . import compiler  # noqa: E402
 from . import contrib  # noqa: E402
+from . import metrics  # noqa: E402,F401 - legacy host-side metric classes

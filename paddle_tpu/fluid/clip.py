@@ -81,3 +81,48 @@ class ClipGradByGlobalNorm(ClipGradBase):
 GradientClipByValue = ClipGradByValue
 GradientClipByNorm = ClipGradByNorm
 GradientClipByGlobalNorm = ClipGradByGlobalNorm
+
+
+class ErrorClipByValue:
+    """reference clip.py ErrorClipByValue:32 — clip the ERROR (the
+    gradient flowing into an intermediate var).  NOT APPLIED on this
+    build: backward is one fused jax.vjp over the whole block, so
+    there is no per-var gradient edge to hook — constructing one warns
+    loudly (silent no-op would change training), and the working
+    alternative is a ClipGradBy* on the optimizer."""
+
+    def __init__(self, max, min=None):
+        import warnings
+
+        warnings.warn(
+            "ErrorClipByValue is not applied on this TPU build "
+            "(whole-block vjp has no per-var gradient hook); use "
+            "ClipGradByValue/ClipGradByNorm on the optimizer instead.",
+            RuntimeWarning, stacklevel=2)
+        max = float(max)
+        self.max = max
+        self.min = float(min) if min is not None else -max
+
+    def _clip(self, grad_np):
+        import numpy as np
+
+        return np.clip(grad_np, self.min, self.max)
+
+
+_GLOBAL_GRAD_CLIP = [None]
+
+
+def set_gradient_clip(clip, param_list=None, program=None):
+    """reference clip.py set_gradient_clip:676 — a program-level
+    default gradient clip applied at minimize() when the optimizer was
+    not given its own grad_clip.  (The reference's per-param attr
+    plumbing collapses to this single default + the optimizer's
+    grad_clip argument, which takes precedence like 2.0 recommends.)"""
+    if clip is not None and not isinstance(clip, ClipGradBase):
+        raise TypeError(
+            "set_gradient_clip expects a ClipGradBy* instance or None")
+    _GLOBAL_GRAD_CLIP[0] = clip
+
+
+def _global_gradient_clip():
+    return _GLOBAL_GRAD_CLIP[0]

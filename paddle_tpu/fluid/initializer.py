@@ -236,3 +236,19 @@ Xavier = XavierInitializer
 MSRA = MSRAInitializer
 Bilinear = BilinearInitializer
 NumpyArray = NumpyArrayInitializer
+
+
+_GLOBAL_WEIGHT_INIT = [None]
+_GLOBAL_BIAS_INIT = [None]
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """reference initializer.py set_global_initializer: the default
+    initializer create_parameter uses when neither the ParamAttr nor
+    the layer supplies one.  Pass None to clear."""
+    _GLOBAL_WEIGHT_INIT[0] = weight_init
+    _GLOBAL_BIAS_INIT[0] = bias_init
+
+
+def _global_initializer(is_bias):
+    return _GLOBAL_BIAS_INIT[0] if is_bias else _GLOBAL_WEIGHT_INIT[0]

@@ -41,6 +41,10 @@ class LayerHelper:
                                              else f"{self.name}.b")
         init = attr.initializer or default_initializer
         if init is None:
+            from .initializer import _global_initializer
+
+            init = _global_initializer(is_bias)
+        if init is None:
             init = ConstantInitializer(0.0) if is_bias else XavierInitializer()
         shape = [int(s) for s in shape]
         # main program: the Parameter node

@@ -16,3 +16,10 @@ from .detection import *  # noqa: F401,F403
 from . import detection  # noqa: F401
 from . import tensor, nn, loss, control_flow, rnn, learning_rate_scheduler, sequence_lod  # noqa: F401
 from .compat import *  # noqa: F401,F403 - legacy-name tail
+from . import compat as _compat  # noqa: E402
+
+
+def __getattr__(name):
+    """Lazy legacy-class aliases (GRUCell, BeamSearchDecoder, Normal,
+    ...) resolve through compat's module __getattr__ on first use."""
+    return getattr(_compat, name)

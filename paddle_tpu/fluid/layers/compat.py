@@ -23,7 +23,7 @@ __all__ = []  # populated below
 
 def _static_op(name, slots, out_slot="Out", dtype_from=0,
                out_dtype=None, n_outs=1, extra_out_slots=(),
-               attr_names=()):
+               attr_names=(), extra_out_dtypes=()):
     """One-op static wrapper: positional tensor args -> slots, then
     positional ATTR args -> attr_names in order (the reference's
     positional signatures), keyword args -> attrs.  Excess positionals
@@ -45,14 +45,16 @@ def _static_op(name, slots, out_slot="Out", dtype_from=0,
             if a is None:
                 continue
             ins[slot] = list(a) if isinstance(a, (list, tuple)) else [a]
-        dt = out_dtype
+        dt = out_dtype(kwargs) if callable(out_dtype) else out_dtype
         if dt is None:
             ref = args[dtype_from]
             ref = ref[0] if isinstance(ref, (list, tuple)) else ref
             dt = getattr(ref, "dtype", "float32")
         outs = {out_slot: [helper.create_variable_for_type_inference(dt)]}
-        for s in extra_out_slots:
-            outs[s] = [helper.create_variable_for_type_inference(dt)]
+        for i, s in enumerate(extra_out_slots):
+            ed = (extra_out_dtypes[i] if i < len(extra_out_dtypes)
+                  and extra_out_dtypes[i] else dt)
+            outs[s] = [helper.create_variable_for_type_inference(ed)]
         helper.append_op(name, inputs=ins, outputs=outs, attrs=kwargs,
                          infer_shape=False)
         ordered = [outs[out_slot][0]] + [outs[s][0]
@@ -148,17 +150,20 @@ retinanet_detection_output = _static_op(
 resize_trilinear = _static_op("trilinear_interp", ["X"])
 resize_linear = _static_op("linear_interp", ["X"])
 gaussian_random = _static_op(
-    "gaussian_random", [], out_dtype="float32",
+    "gaussian_random", [],
+    out_dtype=lambda kw: kw.get("dtype", "float32"),
     attr_names=("shape", "mean", "std", "seed", "dtype"))
 uniform_random = _static_op(
-    "uniform_random", [], out_dtype="float32",
+    "uniform_random", [],
+    out_dtype=lambda kw: kw.get("dtype", "float32"),
     attr_names=("shape", "dtype", "min", "max", "seed"))
 gaussian_random_batch_size_like = _static_op(
     "gaussian_random_batch_size_like", ["Input"])
 uniform_random_batch_size_like = _static_op(
     "uniform_random_batch_size_like", ["Input"])
 
-unique = _static_op("unique", ["X"], extra_out_slots=("Index",))
+unique = _static_op("unique", ["X"], extra_out_slots=("Index",),
+                    extra_out_dtypes=("int32",))
 
 
 def unique_with_counts(x, dtype="int32", name=None):
@@ -290,34 +295,35 @@ def _lazy_alias(name, import_path, attr):
     __all__.append(name)
 
 
-class _LazyClass:
-    def __init__(self, import_path, attr):
-        self._p, self._a = import_path, attr
+_LAZY_CLASSES = {
+    "BeamSearchDecoder": ("paddle_tpu.nn.decode", "BeamSearchDecoder"),
+    "Decoder": ("paddle_tpu.nn.decode", "Decoder"),
+    "GRUCell": ("paddle_tpu.nn.layer.rnn", "GRUCell"),
+    "LSTMCell": ("paddle_tpu.nn.layer.rnn", "LSTMCell"),
+    "RNNCell": ("paddle_tpu.nn.layer.rnn", "RNNCellBase"),
+    "Normal": ("paddle_tpu.distribution", "Normal"),
+    "Uniform": ("paddle_tpu.distribution", "Uniform"),
+    "Categorical": ("paddle_tpu.distribution", "Categorical"),
+}
+# NOT in __all__: a star-import would resolve these eagerly at
+# fluid.layers import time and recreate the import cycle __getattr__
+# exists to break; fluid.layers/__init__ delegates attribute misses
+# here instead.
 
-    def _cls(self):
+
+def __getattr__(name):
+    """PEP-562 lazy class aliases: resolve on first access (an eager
+    import would cycle — distribution/nn.decode import fluid.layers)
+    and cache the REAL class so isinstance/subclassing work."""
+    if name in _LAZY_CLASSES:
         import importlib
 
-        return getattr(importlib.import_module(self._p), self._a)
+        path, attr = _LAZY_CLASSES[name]
+        cls = getattr(importlib.import_module(path), attr)
+        globals()[name] = cls
+        return cls
+    raise AttributeError(name)
 
-    def __call__(self, *a, **k):
-        return self._cls()(*a, **k)
-
-    def __instancecheck__(self, inst):
-        return isinstance(inst, self._cls())
-
-
-for _n, _p, _a in [
-    ("BeamSearchDecoder", "paddle_tpu.nn.decode", "BeamSearchDecoder"),
-    ("Decoder", "paddle_tpu.nn.decode", "Decoder"),
-    ("GRUCell", "paddle_tpu.nn.layer.rnn", "GRUCell"),
-    ("LSTMCell", "paddle_tpu.nn.layer.rnn", "LSTMCell"),
-    ("RNNCell", "paddle_tpu.nn.layer.rnn", "RNNCellBase"),
-    ("Normal", "paddle_tpu.distribution", "Normal"),
-    ("Uniform", "paddle_tpu.distribution", "Uniform"),
-    ("Categorical", "paddle_tpu.distribution", "Categorical"),
-]:
-    globals()[_n] = _LazyClass(_p, _a)
-    __all__.append(_n)
 
 _lazy_alias("dynamic_decode", "paddle_tpu.nn.decode", "dynamic_decode")
 _lazy_alias("birnn", "paddle_tpu.nn.functional", "birnn")

@@ -199,8 +199,9 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
     from .tensor import fill_constant
 
     if isinstance(branch_fns, (list, tuple)):
-        pairs = sorted((i, fn) if callable(fn) else tuple(fn)
-                       for i, fn in enumerate(branch_fns))
+        pairs = sorted(((i, fn) if callable(fn) else tuple(fn)
+                        for i, fn in enumerate(branch_fns)),
+                       key=lambda p: p[0])
     elif isinstance(branch_fns, dict):
         pairs = sorted(branch_fns.items())
     else:

@@ -106,8 +106,13 @@ class Optimizer:
 
     def apply_gradients(self, params_grads):
         params_grads = sorted(params_grads, key=lambda pg: pg[0].name)
-        if self._grad_clip is not None:
-            params_grads = self._grad_clip(params_grads)
+        clip = self._grad_clip
+        if clip is None:
+            from .clip import _global_gradient_clip
+
+            clip = _global_gradient_clip()
+        if clip is not None:
+            params_grads = clip(params_grads)
         params_grads = self._apply_regularization(params_grads)
         self._create_global_learning_rate()
         ops = []
@@ -590,3 +595,271 @@ DecayedAdagrad = DecayedAdagradOptimizer
 ProximalGD = ProximalGDOptimizer
 ProximalAdagrad = ProximalAdagradOptimizer
 Ftrl = FtrlOptimizer
+
+
+Dpsgd = None  # defined below; forward name for __all__ scans
+
+
+class DpsgdOptimizer(Optimizer):
+    """Differentially-private SGD (reference optimizer.py Dpsgd over
+    dpsgd_op.cc: per-batch gradient L2 clip + Gaussian noise)."""
+
+    type = "dpsgd"
+
+    def __init__(self, learning_rate=0.001, clip=0.9, batch_size=0.999,
+                 sigma=1e-8, parameter_list=None):
+        super().__init__(learning_rate, parameter_list=parameter_list)
+        self._clip = clip
+        self._batch_size = batch_size
+        self._sigma = sigma
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            "dpsgd",
+            inputs={"Param": [p], "Grad": [g],
+                    "LearningRate": [self._global_learning_rate()]},
+            outputs={"ParamOut": [p]},
+            attrs=self._opt_attrs({"clip": self._clip,
+                                   "batch_size": self._batch_size,
+                                   "sigma": self._sigma}),
+            infer_shape=False)
+
+
+Dpsgd = DpsgdOptimizer
+LarsMomentum = LarsMomentumOptimizer
+
+
+class ExponentialMovingAverage:
+    """EMA of every trainable parameter (reference optimizer.py
+    ExponentialMovingAverage:2973).  TPU-native: the shadow state lives
+    HOST-side over scope values — update() after each optimizer step,
+    `with ema.apply(exe):` swaps the averages in for eval/serving and
+    restores after (the reference builds the same state as in-graph
+    persistables; host-side keeps the fused train step untouched)."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        # reference semantics (optimizer.py:3604): decay ramps
+        # (1+t)/(10+t) ONLY when thres_steps is given; constant
+        # otherwise.  Bias correction divides by (1 - prod(decay_t)).
+        self._thres_steps = thres_steps
+        self._decay_prod = 1.0
+        self._shadow = {}
+        self._backup = {}
+        self._step = 0
+        self._program = None
+
+    def _params(self, program):
+        from .framework import default_main_program
+
+        program = program or self._program or default_main_program()
+        self._program = program
+        return [v for v in program.global_block().vars.values()
+                if getattr(v, "persistable", False)
+                and getattr(v, "trainable", True)
+                and getattr(v, "is_parameter", False)]
+
+    def update(self, scope=None, program=None):
+        from .executor import global_scope
+
+        scope = scope or global_scope()
+        self._step += 1
+        decay = self._decay
+        if self._thres_steps is not None:
+            decay = min(decay, (1 + self._step) / (10 + self._step))
+        self._decay_prod *= decay
+        for p in self._params(program):
+            holder = scope.find_var(p.name)
+            if holder is None:
+                continue
+            val = np.asarray(holder.get_tensor())
+            prev = self._shadow.get(p.name, np.zeros_like(val))
+            self._shadow[p.name] = decay * prev + (1 - decay) * val
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        from .executor import global_scope
+
+        @contextlib.contextmanager
+        def ctx():
+            scope = global_scope()
+            self._backup = {}
+            corr = 1.0 - self._decay_prod
+            for name, avg in self._shadow.items():
+                holder = scope.find_var(name)
+                if holder is None:
+                    continue
+                self._backup[name] = np.asarray(
+                    holder.get_tensor()).copy()
+                ema = avg / corr if corr > 0 else avg
+                scope.set(name, ema.astype(self._backup[name].dtype))
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+
+        return ctx()
+
+    def restore(self, executor=None):
+        from .executor import global_scope
+
+        scope = global_scope()
+        for name, val in self._backup.items():
+            scope.set(name, val)
+        self._backup = {}
+
+
+class ModelAverage(ExponentialMovingAverage):
+    """Sliding average of parameters (reference optimizer.py
+    ModelAverage:2790) — same host-side shadow machinery with a
+    cumulative mean instead of exponential decay."""
+
+    def __init__(self, average_window_rate=0.15, min_average_window=
+                 10000, max_average_window=10000, name=None):
+        super().__init__(decay=0.0, name=name)
+        self._n = {}
+
+    def update(self, scope=None, program=None):
+        from .executor import global_scope
+
+        scope = scope or global_scope()
+        self._step += 1
+        for p in self._params(program):
+            holder = scope.find_var(p.name)
+            if holder is None:
+                continue
+            val = np.asarray(holder.get_tensor())
+            n = self._n.get(p.name, 0)
+            prev = self._shadow.get(p.name)
+            self._shadow[p.name] = (val.copy() if prev is None
+                                    else (prev * n + val) / (n + 1))
+            self._n[p.name] = n + 1
+
+
+class LookaheadOptimizer:
+    """Lookahead (reference optimizer.py LookaheadOptimizer:3127):
+    fast weights step with the inner optimizer every step; every k
+    steps the slow weights interpolate toward the fast ones and the
+    fast weights reset to the slow.  In-graph: slow copies live as
+    persistables, the k-step gate is a where() select on step % k."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        assert inner_optimizer is not None
+        assert 0.0 <= alpha <= 1.0
+        assert isinstance(k, int) and k > 0
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+
+    def minimize(self, loss, startup_program=None):
+        from .framework import default_startup_program, program_guard
+        from .layers import tensor as T
+        from .layers import nn as L
+
+        mini_out = self.inner_optimizer.minimize(
+            loss, startup_program=startup_program)
+        main = loss.block.program
+        with program_guard(main, startup_program
+                           or default_startup_program()):
+            step = T.create_global_var(
+                name=unique_name.generate("lookahead_step"), shape=[1],
+                value=0.0, dtype="float32", persistable=True)
+            one = T.fill_constant([1], "float32", 1.0)
+            kf = T.fill_constant([1], "float32", float(self.k))
+            new_step = L.elementwise_add(step, one)
+            T.assign(new_step, step)
+            mod = L.elementwise_mod(new_step, kf)
+            sync = L.equal(mod, T.fill_constant([1], "float32", 0.0))
+            syncf = T.cast(sync, "float32")
+            params = [v for v in main.global_block().vars.values()
+                      if getattr(v, "is_parameter", False)
+                      and getattr(v, "trainable", True)]
+            for p in params:
+                slow = T.create_global_var(
+                    name=unique_name.generate(p.name + "_slow"),
+                    shape=list(p.shape), value=0.0, dtype=p.dtype,
+                    persistable=True)
+                # first sync initializes slow = fast (step 0 weights
+                # are unknown at build time; k-step 1 copies them)
+                new_slow = L.elementwise_add(
+                    L.elementwise_mul(
+                        L.elementwise_add(
+                            L.elementwise_mul(p, T.fill_constant(
+                                [1], "float32", self.alpha)),
+                            L.elementwise_mul(slow, T.fill_constant(
+                                [1], "float32", 1 - self.alpha))),
+                        syncf),
+                    L.elementwise_mul(slow, L.elementwise_sub(
+                        one, syncf)))
+                is_first = L.equal(new_step, kf)
+                firstf = T.cast(is_first, "float32")
+                new_slow = L.elementwise_add(
+                    L.elementwise_mul(p, firstf),
+                    L.elementwise_mul(new_slow,
+                                      L.elementwise_sub(one, firstf)))
+                new_fast = L.elementwise_add(
+                    L.elementwise_mul(new_slow, syncf),
+                    L.elementwise_mul(p, L.elementwise_sub(one, syncf)))
+                T.assign(new_slow, slow)
+                T.assign(new_fast, p)
+        return mini_out
+
+
+class RecomputeOptimizer:
+    """Recompute/checkpointing wrapper (reference optimizer.py
+    RecomputeOptimizer:3260): backward re-runs the forward segments
+    between user-chosen checkpoints instead of storing activations —
+    here via append_backward_with_checkpoints (jax.checkpoint under
+    the hood)."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+        self._checkpoints = None
+
+    def _set_checkpoints(self, checkpoints):
+        self._checkpoints = list(checkpoints)
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        from .backward import append_backward_with_checkpoints
+
+        assert self._checkpoints, \
+            "call _set_checkpoints before minimize"
+        return append_backward_with_checkpoints(
+            loss, self._checkpoints, parameter_list)
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from .framework import default_startup_program, program_guard
+
+        main = loss.block.program
+        self._optimizer._startup_program = startup_program
+        with program_guard(main, startup_program
+                           or default_startup_program()):
+            params_grads = self.backward(loss, startup_program,
+                                         parameter_list, no_grad_set)
+            opt_ops = self._optimizer.apply_gradients(params_grads)
+        return opt_ops, params_grads
+
+
+class PipelineOptimizer:
+    """The reference's SectionWorker pipeline rewrites a static program
+    into per-device section programs (pipeline_trainer.cc).  The TPU
+    build runs pipeline parallelism as shard_map+ppermute GPipe over
+    model steps (paddle_tpu/parallel/pipeline.py, fleet strategy
+    `pipeline=True`); the static-program section rewrite is not
+    carried."""
+
+    def __init__(self, optimizer, num_microbatches=1, **kwargs):
+        raise NotImplementedError(
+            "PipelineOptimizer's section-program rewrite is replaced "
+            "by the TPU-native GPipe path: use fleet.distributed_"
+            "optimizer with DistributedStrategy().pipeline = True, or "
+            "paddle_tpu.parallel.pipeline / models.bert."
+            "build_pipeline_pretrain_step directly.")

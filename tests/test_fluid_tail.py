@@ -1,0 +1,322 @@
+"""fluid.metrics + the optimizer tail (EMA, ModelAverage, Lookahead,
+Dpsgd, Recompute wrapper) + set_global_initializer/set_gradient_clip."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+
+@pytest.fixture
+def prog():
+    from paddle_tpu.fluid import framework, unique_name
+    from paddle_tpu.fluid.executor import Scope, scope_guard
+
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        with unique_name.guard():
+            with scope_guard(Scope()):
+                yield main, startup
+
+
+class TestFluidMetrics:
+    def test_precision_recall_accuracy(self):
+        from paddle_tpu.fluid import metrics
+
+        p = metrics.Precision()
+        r = metrics.Recall()
+        preds = np.array([1, 1, 0, 1])
+        labels = np.array([1, 0, 0, 1])
+        p.update(preds, labels)
+        r.update(preds, labels)
+        assert p.eval() == pytest.approx(2 / 3)
+        assert r.eval() == pytest.approx(1.0)
+        a = metrics.Accuracy()
+        a.update(0.5, 10)
+        a.update(1.0, 10)
+        assert a.eval() == pytest.approx(0.75)
+        comp = metrics.CompositeMetric()
+        comp.add_metric(metrics.Precision())
+        comp.add_metric(metrics.Recall())
+        comp.update(preds, labels)
+        assert comp.eval() == [pytest.approx(2 / 3), pytest.approx(1.0)]
+
+    def test_chunk_edit_auc(self):
+        from paddle_tpu.fluid import metrics
+
+        c = metrics.ChunkEvaluator()
+        c.update(10, 8, 6)
+        pr, rc, f1 = c.eval()
+        assert pr == 0.6 and rc == 0.75
+        assert f1 == pytest.approx(2 * 0.6 * 0.75 / 1.35)
+
+        e = metrics.EditDistance()
+        e.update(np.array([0.0, 2.0, 1.0]), 3)
+        avg, err = e.eval()
+        assert avg == pytest.approx(1.0) and err == pytest.approx(2 / 3)
+
+        auc = metrics.Auc(num_thresholds=1000)
+        r = np.random.RandomState(0)
+        scores = np.concatenate([r.rand(500) * 0.5 + 0.5,
+                                 r.rand(500) * 0.5])
+        labels = np.concatenate([np.ones(500), np.zeros(500)])
+        auc.update(scores, labels)
+        assert auc.eval() > 0.95
+
+    def test_detection_map(self):
+        from paddle_tpu.fluid import metrics
+
+        m = metrics.DetectionMAP()
+        # one image: a perfect detection and a miss
+        dets = np.array([[0, 0.9, 0, 0, 10, 10],
+                         [0, 0.8, 50, 50, 60, 60]], "float32")
+        gts = np.array([[0, 0, 10, 10], [20, 20, 30, 30]], "float32")
+        m.update(dets, gts, np.array([0, 0]))
+        ap = m.eval()
+        assert 0.0 < ap <= 1.0
+        m.reset()
+        assert m.eval() == 0.0
+
+
+class TestOptimizerTail:
+    def _lr_prog(self):
+        x = fluid.data("x", [-1, 4], "float32")
+        y = fluid.data("y", [-1, 1], "float32")
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.loss.square_error_cost(pred, y))
+        return x, y, loss
+
+    def test_dpsgd_trains(self, prog):
+        main, startup = prog
+        _, _, loss = self._lr_prog()
+        fluid.optimizer.DpsgdOptimizer(
+            learning_rate=0.1, clip=5.0, batch_size=8.0,
+            sigma=0.01).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        r = np.random.RandomState(0)
+        xv = r.rand(8, 4).astype("float32")
+        yv = (xv @ np.ones((4, 1))).astype("float32")
+        losses = [float(exe.run(main, feed={"x": xv, "y": yv},
+                                fetch_list=[loss])[0])
+                  for _ in range(30)]
+        assert losses[-1] < losses[0]
+
+    def test_lookahead_sync_math(self, prog):
+        main, startup = prog
+        _, _, loss = self._lr_prog()
+        inner = fluid.optimizer.SGDOptimizer(learning_rate=0.05)
+        la = fluid.optimizer.LookaheadOptimizer(inner, alpha=0.5, k=2)
+        la.minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        r = np.random.RandomState(1)
+        xv = r.rand(8, 4).astype("float32")
+        yv = (xv @ np.ones((4, 1))).astype("float32")
+        losses = [float(exe.run(main, feed={"x": xv, "y": yv},
+                                fetch_list=[loss])[0])
+                  for _ in range(40)]
+        assert losses[-1] < losses[0]  # converges with sync steps
+
+    def test_ema_and_model_average_swap(self, prog):
+        main, startup = prog
+        _, _, loss = self._lr_prog()
+        opt = fluid.optimizer.SGDOptimizer(learning_rate=0.1)
+        opt.minimize(loss)
+        ema = fluid.optimizer.ExponentialMovingAverage(0.5)
+        exe = fluid.Executor()
+        exe.run(startup)
+        from paddle_tpu.fluid.executor import global_scope
+
+        r = np.random.RandomState(2)
+        xv = r.rand(8, 4).astype("float32")
+        yv = (xv @ np.ones((4, 1))).astype("float32")
+        pname = [v for v in main.global_block().vars
+                 if v.endswith(".w_0")][0]
+        for _ in range(5):
+            exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+            ema.update(program=main)
+        live = np.asarray(
+            global_scope().find_var(pname).get_tensor()).copy()
+        with ema.apply():
+            inside = np.asarray(
+                global_scope().find_var(pname).get_tensor()).copy()
+            assert not np.allclose(inside, live)
+        restored = np.asarray(
+            global_scope().find_var(pname).get_tensor())
+        np.testing.assert_allclose(restored, live)
+
+        ma = fluid.optimizer.ModelAverage()
+        ma.update(program=main)
+        ma.update(program=main)
+        with ma.apply():
+            pass  # swap/restore path works
+
+    def test_recompute_optimizer(self, prog):
+        main, startup = prog
+        x = fluid.data("x", [-1, 4], "float32")
+        y = fluid.data("y", [-1, 1], "float32")
+        h = fluid.layers.fc(x, 16, act="relu")
+        pred = fluid.layers.fc(h, 1)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.loss.square_error_cost(pred, y))
+        opt = fluid.optimizer.RecomputeOptimizer(
+            fluid.optimizer.SGDOptimizer(learning_rate=0.1))
+        opt._set_checkpoints([h])
+        opt.minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        r = np.random.RandomState(3)
+        xv = r.rand(8, 4).astype("float32")
+        yv = (xv @ np.ones((4, 1))).astype("float32")
+        losses = [float(exe.run(main, feed={"x": xv, "y": yv},
+                                fetch_list=[loss])[0])
+                  for _ in range(25)]
+        assert losses[-1] < losses[0]
+
+    def test_pipeline_optimizer_is_loud(self):
+        with pytest.raises(NotImplementedError, match="GPipe"):
+            fluid.optimizer.PipelineOptimizer(None)
+
+
+class TestGlobalDefaults:
+    def test_set_global_initializer(self, prog):
+        main, startup = prog
+        from paddle_tpu.fluid.initializer import (ConstantInitializer,
+                                                  set_global_initializer)
+
+        set_global_initializer(ConstantInitializer(3.0),
+                               ConstantInitializer(1.0))
+        try:
+            x = fluid.data("x", [-1, 2], "float32")
+            fluid.layers.fc(x, 3)
+            exe = fluid.Executor()
+            exe.run(startup)
+            from paddle_tpu.fluid.executor import global_scope
+
+            wname = [v for v in main.global_block().vars
+                     if v.endswith(".w_0")][0]
+            w = np.asarray(global_scope().find_var(wname).get_tensor())
+            np.testing.assert_allclose(w, 3.0)
+        finally:
+            set_global_initializer(None)
+
+    def test_set_gradient_clip_default(self, prog):
+        main, startup = prog
+        from paddle_tpu.fluid.clip import (ClipGradByValue,
+                                           set_gradient_clip)
+
+        set_gradient_clip(ClipGradByValue(1e-6))
+        try:
+            x = fluid.data("x", [-1, 4], "float32")
+            y = fluid.data("y", [-1, 1], "float32")
+            pred = fluid.layers.fc(x, 1)
+            loss = fluid.layers.reduce_mean(
+                fluid.layers.loss.square_error_cost(pred, y))
+            fluid.optimizer.SGDOptimizer(learning_rate=1.0) \
+                .minimize(loss)
+            exe = fluid.Executor()
+            exe.run(startup)
+            from paddle_tpu.fluid.executor import global_scope
+
+            wname = [v for v in main.global_block().vars
+                     if v.endswith(".w_0")][0]
+            before = np.asarray(
+                global_scope().find_var(wname).get_tensor()).copy()
+            r = np.random.RandomState(4)
+            xv = r.rand(8, 4).astype("float32") + 1
+            yv = np.full((8, 1), 100.0, "float32")
+            exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+            after = np.asarray(
+                global_scope().find_var(wname).get_tensor())
+            # clipped to 1e-6 * lr 1.0: the update is tiny despite the
+            # huge loss — the global default clip was applied
+            assert np.abs(after - before).max() < 1e-4
+        finally:
+            set_gradient_clip(None)
+
+    def test_error_clip_by_value_type(self):
+        from paddle_tpu.fluid.clip import ErrorClipByValue
+
+        c = ErrorClipByValue(max=2.0)
+        np.testing.assert_allclose(
+            c._clip(np.array([-5.0, 0.5, 5.0])), [-2.0, 0.5, 2.0])
+        with pytest.raises(TypeError):
+            fluid.clip.set_gradient_clip("not-a-clip")
+
+
+class TestReviewFixes:
+    def test_legacy_cells_are_subclassable(self):
+        import paddle_tpu.fluid.layers as L
+
+        class MyCell(L.RNNCell):
+            pass
+
+        from paddle_tpu.nn.layer.rnn import RNNCellBase
+
+        assert issubclass(MyCell, RNNCellBase)
+        assert isinstance(L.GRUCell(4, 6), L.GRUCell)
+
+    def test_switch_case_duplicate_index_raises(self, prog):
+        import paddle_tpu.fluid.layers as L
+
+        main, startup = prog
+        idx = fluid.data("i", [1], "int64")
+        f = lambda: L.fill_constant([1], "float32", 1.0)
+        with pytest.raises(ValueError, match="duplicate"):
+            L.switch_case(idx, [(1, f), f])
+
+    def test_unique_index_dtype_and_random_dtype(self, prog):
+        main, startup = prog
+        L = fluid.layers
+        x = fluid.data("x", [-1], "float32")
+        out, idx = L.unique(x)
+        assert "int" in str(idx.dtype)
+        g = L.gaussian_random(shape=[2, 3], dtype="float32")
+        assert str(g.dtype).endswith("float32")
+
+    def test_error_clip_warns(self):
+        import warnings
+
+        from paddle_tpu.fluid.clip import ErrorClipByValue
+
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            ErrorClipByValue(max=1.0)
+        assert any("not applied" in str(x.message) for x in w)
+
+    def test_ema_constant_decay_and_bias_correction(self, prog):
+        main, startup = prog
+        from paddle_tpu.fluid.optimizer import ExponentialMovingAverage
+
+        # no thres_steps: constant decay, bias-corrected -> after ONE
+        # update the EMA equals the raw value exactly
+        ema = ExponentialMovingAverage(0.9)
+        x = fluid.data("x", [-1, 2], "float32")
+        fluid.layers.fc(x, 2)
+        exe = fluid.Executor()
+        exe.run(startup)
+        from paddle_tpu.fluid.executor import global_scope
+
+        ema.update(program=main)
+        pname = [v for v in main.global_block().vars
+                 if v.endswith(".w_0")][0]
+        live = np.asarray(global_scope().find_var(pname).get_tensor())
+        with ema.apply():
+            inside = np.asarray(
+                global_scope().find_var(pname).get_tensor())
+            np.testing.assert_allclose(inside, live, rtol=1e-6)
+
+    def test_detection_map_ignores_matched_difficult(self):
+        from paddle_tpu.fluid import metrics
+
+        m = metrics.DetectionMAP()
+        # one non-difficult GT detected perfectly + one detection that
+        # matches a DIFFICULT GT: the latter must be ignored, not FP
+        dets = np.array([[0, 0.9, 0, 0, 10, 10],
+                         [0, 0.8, 20, 20, 30, 30]], "float32")
+        gts = np.array([[0, 0, 10, 10], [20, 20, 30, 30]], "float32")
+        m.update(dets, gts, np.array([0, 0]),
+                 difficult=np.array([0, 1]))
+        assert m.eval() == pytest.approx(1.0)
