@@ -19,3 +19,116 @@ from .varbase import Tensor, VarBase
 from .parallel import DataParallel, ParallelEnv
 
 from . import math_op_patch  # installs Tensor operator overloads
+
+# 1.x dygraph surface tail (reference fluid/dygraph/__init__ star set):
+# layer classes with 1.x signatures, LR decay classes, jit/io aliases
+from . import nn as dygraph_nn  # noqa: E402
+from .nn import (BatchNorm, BilinearTensorProduct, Conv2D,  # noqa: E402,F401
+                 Conv2DTranspose, Conv3D, Conv3DTranspose, Dropout,
+                 Embedding, Flatten, GRUUnit, Linear, NCE, Pool2D,
+                 PRelu, TreeConv)
+from .tracer import no_grad as no_grad_  # noqa: E402,F401
+
+# nn/optimizer-backed names resolve lazily via __getattr__ below — an
+# eager import here would cycle (nn.functional imports this package)
+_NN_ALIASES = {
+    "GroupNorm": ("paddle_tpu.nn", "GroupNorm"),
+    "LayerNorm": ("paddle_tpu.nn", "LayerNorm"),
+    "LayerList": ("paddle_tpu.nn", "LayerList"),
+    "ParameterList": ("paddle_tpu.nn", "ParameterList"),
+    "Sequential": ("paddle_tpu.nn", "Sequential"),
+    "SpectralNorm": ("paddle_tpu.nn", "SpectralNorm"),
+    "InstanceNorm": ("paddle_tpu.nn", "InstanceNorm2D"),
+    "Layer": ("paddle_tpu.nn.layer.layers", "Layer"),
+    "GRUCell": ("paddle_tpu.nn.layer.rnn", "GRUCell"),
+    "LSTMCell": ("paddle_tpu.nn.layer.rnn", "LSTMCell"),
+    "CosineDecay": ("paddle_tpu.optimizer.lr", "CosineAnnealingDecay"),
+    "ExponentialDecay": ("paddle_tpu.optimizer.lr", "ExponentialDecay"),
+    "InverseTimeDecay": ("paddle_tpu.optimizer.lr", "InverseTimeDecay"),
+    "LambdaDecay": ("paddle_tpu.optimizer.lr", "LambdaDecay"),
+    "LinearLrWarmup": ("paddle_tpu.optimizer.lr", "LinearWarmup"),
+    "MultiStepDecay": ("paddle_tpu.optimizer.lr", "MultiStepDecay"),
+    "NaturalExpDecay": ("paddle_tpu.optimizer.lr", "NaturalExpDecay"),
+    "NoamDecay": ("paddle_tpu.optimizer.lr", "NoamDecay"),
+    "PiecewiseDecay": ("paddle_tpu.optimizer.lr", "PiecewiseDecay"),
+    "PolynomialDecay": ("paddle_tpu.optimizer.lr", "PolynomialDecay"),
+    "ReduceLROnPlateau": ("paddle_tpu.optimizer.lr", "ReduceOnPlateau"),
+    "StepDecay": ("paddle_tpu.optimizer.lr", "StepDecay"),
+}
+from ...framework_io import load, save  # noqa: E402,F401
+
+
+def save_dygraph(state_dict, model_path):
+    """reference dygraph/checkpoint.py save_dygraph: state dict ->
+    <path>.pdparams for layer params, <path>.pdopt for optimizer
+    state.  Optimizer dicts are identified structurally: this build's
+    Optimizer.state_dict always carries the "global_step" scalar (and
+    optionally "LR_Scheduler"), which no layer state_dict can contain
+    (layer keys are parameter names)."""
+    is_opt = ("global_step" in state_dict
+              or "LR_Scheduler" in state_dict)
+    save(state_dict, model_path + (".pdopt" if is_opt
+                                   else ".pdparams"))
+
+
+def load_dygraph(model_path):
+    """reference checkpoint.py load_dygraph -> (param_dict, opt_dict),
+    either possibly None."""
+    import os
+
+    params = opt = None
+    if os.path.exists(model_path + ".pdparams"):
+        params = load(model_path + ".pdparams")
+    if os.path.exists(model_path + ".pdopt"):
+        opt = load(model_path + ".pdopt")
+    if params is None and opt is None and os.path.exists(model_path):
+        params = load(model_path)
+    return params, opt
+
+
+def _jit_alias(name):
+    def fn(*args, **kwargs):
+        import importlib
+
+        jit = importlib.import_module("paddle_tpu.jit")
+        return getattr(jit, name)(*args, **kwargs)
+
+    fn.__name__ = name
+    return fn
+
+
+declarative = _jit_alias("to_static")
+dygraph_to_static_func = _jit_alias("to_static")
+set_code_level = _jit_alias("set_code_level")
+set_verbosity = _jit_alias("set_verbosity")
+
+
+def __getattr__(name):
+    if name in _NN_ALIASES:
+        import importlib
+
+        path, attr = _NN_ALIASES[name]
+        obj = getattr(importlib.import_module(path), attr)
+        globals()[name] = obj
+        return obj
+    # lazy: jit imports fluid.dygraph (cycle), distributed too
+    if name in ("TracedLayer", "TranslatedLayer", "ProgramTranslator"):
+        import importlib
+
+        return getattr(importlib.import_module("paddle_tpu.jit"), name)
+    if name == "prepare_context":
+        import importlib
+
+        return getattr(importlib.import_module(
+            "paddle_tpu.distributed.parallel"), "prepare_context")
+    if name == "amp_guard":
+        import importlib
+
+        return getattr(importlib.import_module("paddle_tpu.amp"),
+                       "auto_cast")
+    if name == "AmpScaler":
+        import importlib
+
+        return getattr(importlib.import_module("paddle_tpu.amp"),
+                       "GradScaler")
+    raise AttributeError(name)

@@ -1,0 +1,287 @@
+"""fluid.dygraph 1.x layer classes (reference fluid/dygraph/nn.py).
+
+The 2.0 paddle.nn classes carry the implementations; these wrappers
+keep the 1.x constructor signatures (channel-first arg names, `act=`
+epilogues) so reference dygraph scripts run unchanged."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _act(out, act):
+    if not act:
+        return out
+    from ...nn import functional as F
+
+    return getattr(F, act)(out)
+
+
+def _nn():
+    from ... import nn
+
+    return nn
+
+
+class Linear:
+    """1.x Linear(input_dim, output_dim, act=None) over nn.Linear."""
+
+    def __new__(cls, input_dim, output_dim, param_attr=None,
+                bias_attr=None, act=None, dtype="float32"):
+        nn = _nn()
+
+        class _Linear(nn.Linear):
+            def __init__(self):
+                super().__init__(input_dim, output_dim,
+                                 weight_attr=param_attr,
+                                 bias_attr=bias_attr)
+                self._act = act
+
+            def forward(self, x):
+                return _act(super().forward(x), self._act)
+
+        return _Linear()
+
+
+class Conv2D:
+    """1.x Conv2D(num_channels, num_filters, filter_size, ...)."""
+
+    def __new__(cls, num_channels, num_filters, filter_size, stride=1,
+                padding=0, dilation=1, groups=1, param_attr=None,
+                bias_attr=None, use_cudnn=True, act=None,
+                dtype="float32"):
+        nn = _nn()
+
+        class _Conv(nn.Conv2D):
+            def __init__(self):
+                super().__init__(num_channels, num_filters, filter_size,
+                                 stride=stride, padding=padding,
+                                 dilation=dilation, groups=groups,
+                                 weight_attr=param_attr,
+                                 bias_attr=bias_attr)
+                self._act = act
+
+            def forward(self, x):
+                return _act(super().forward(x), self._act)
+
+        return _Conv()
+
+
+class Conv2DTranspose:
+    def __new__(cls, num_channels, num_filters, filter_size,
+                output_size=None, padding=0, stride=1, dilation=1,
+                groups=1, param_attr=None, bias_attr=None,
+                use_cudnn=True, act=None, dtype="float32"):
+        nn = _nn()
+
+        class _ConvT(nn.Conv2DTranspose):
+            def __init__(self):
+                super().__init__(num_channels, num_filters, filter_size,
+                                 stride=stride, padding=padding,
+                                 dilation=dilation, groups=groups,
+                                 weight_attr=param_attr,
+                                 bias_attr=bias_attr)
+                self._act = act
+
+            def forward(self, x):
+                return _act(super().forward(x), self._act)
+
+        return _ConvT()
+
+
+class Conv3D:
+    def __new__(cls, num_channels, num_filters, filter_size, stride=1,
+                padding=0, dilation=1, groups=1, param_attr=None,
+                bias_attr=None, use_cudnn=True, act=None,
+                dtype="float32"):
+        nn = _nn()
+
+        class _Conv(nn.Conv3D):
+            def __init__(self):
+                super().__init__(num_channels, num_filters, filter_size,
+                                 stride=stride, padding=padding,
+                                 dilation=dilation, groups=groups,
+                                 weight_attr=param_attr,
+                                 bias_attr=bias_attr)
+                self._act = act
+
+            def forward(self, x):
+                return _act(super().forward(x), self._act)
+
+        return _Conv()
+
+
+class Conv3DTranspose:
+    def __new__(cls, num_channels, num_filters, filter_size,
+                padding=0, stride=1, dilation=1, groups=1,
+                param_attr=None, bias_attr=None, use_cudnn=True,
+                act=None, dtype="float32"):
+        nn = _nn()
+
+        class _ConvT(nn.Conv3DTranspose):
+            def __init__(self):
+                super().__init__(num_channels, num_filters, filter_size,
+                                 stride=stride, padding=padding,
+                                 dilation=dilation, groups=groups,
+                                 weight_attr=param_attr,
+                                 bias_attr=bias_attr)
+                self._act = act
+
+            def forward(self, x):
+                return _act(super().forward(x), self._act)
+
+        return _ConvT()
+
+
+def BatchNorm(num_channels, act=None, is_test=False, momentum=0.9,
+              epsilon=1e-5, param_attr=None, bias_attr=None,
+              dtype="float32", data_layout="NCHW", in_place=False,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True,
+              use_global_stats=False, trainable_statistics=False):
+    """1.x BatchNorm(num_channels, act=...) over nn.BatchNorm."""
+    nn = _nn()
+
+    class _BN(nn.BatchNorm):
+        def __init__(self):
+            super().__init__(num_channels, momentum=momentum,
+                             epsilon=epsilon)
+            self._act1x = act
+            if is_test:
+                self.eval()
+
+        def forward(self, x):
+            return _act(super().forward(x), self._act1x)
+
+    return _BN()
+
+
+def Embedding(size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    nn = _nn()
+    return nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                        sparse=is_sparse, weight_attr=param_attr)
+
+
+def Dropout(p=0.5, seed=None, dropout_implementation="downgrade_in_infer",
+            is_test=False):
+    nn = _nn()
+    layer = nn.Dropout(p, mode=dropout_implementation)
+    if is_test:
+        layer.eval()
+    return layer
+
+
+def Flatten(axis=1):
+    nn = _nn()
+    return nn.Flatten(start_axis=axis)
+
+
+class GRUUnit:
+    """1.x GRUUnit eager layer over the gru_unit lowering (reference
+    dygraph/nn.py GRUUnit:3060)."""
+
+    def __new__(cls, size, param_attr=None, bias_attr=None,
+                activation="tanh", gate_activation="sigmoid",
+                origin_mode=False, dtype="float32"):
+        nn = _nn()
+
+        class _GRUUnit(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                d = size // 3
+                self.weight = self.create_parameter([d, d * 3],
+                                                    attr=param_attr)
+                self.bias = self.create_parameter([1, d * 3],
+                                                  attr=bias_attr,
+                                                  is_bias=True)
+                self._cfg = (activation, gate_activation, origin_mode)
+
+            def forward(self, input, hidden):
+                from ...nn import functional as F
+
+                a, ga, om = self._cfg
+                return F.gru_unit(input, hidden, self.weight,
+                                  bias=self.bias, activation=a,
+                                  gate_activation=ga, origin_mode=om)
+
+        return _GRUUnit()
+
+
+class NCE:
+    """1.x NCE eager layer over the nce lowering."""
+
+    def __new__(cls, num_total_classes, dim, sample_weight=None,
+                param_attr=None, bias_attr=None, num_neg_samples=None,
+                sampler="uniform", custom_dist=None, seed=0,
+                is_sparse=False, dtype="float32"):
+        nn = _nn()
+
+        class _NCE(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.weight = self.create_parameter(
+                    [num_total_classes, dim], attr=param_attr)
+                self.bias = self.create_parameter(
+                    [num_total_classes, 1], attr=bias_attr,
+                    is_bias=True)
+
+            def forward(self, input, label, sample_weights=None):
+                from ...nn import functional as F
+
+                return F.nce(input, label, num_total_classes,
+                             num_neg_samples=num_neg_samples,
+                             seed=seed, weight=self.weight,
+                             bias=self.bias)
+
+        return _NCE()
+
+
+class PRelu:
+    def __new__(cls, mode="all", channel=None, input_shape=None,
+                param_attr=None, dtype="float32"):
+        nn = _nn()
+        if mode == "all":
+            num = 1
+        elif mode == "channel":
+            num = channel
+        else:
+            num = int(np.prod(input_shape[1:]))
+        return nn.PReLU(num_parameters=num, weight_attr=param_attr)
+
+
+def Pool2D(pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, exclusive=True, data_format="NCHW"):
+    from ...nn.layer.extra_layers import Pool2D as _P
+
+    return _P(pool_size, pool_type, pool_stride, pool_padding,
+              global_pooling, use_cudnn, ceil_mode, exclusive,
+              data_format)
+
+
+class BilinearTensorProduct:
+    def __new__(cls, input1_dim, input2_dim, output_dim, name=None,
+                act=None, param_attr=None, bias_attr=None,
+                dtype="float32"):
+        nn = _nn()
+
+        class _BTP(nn.BilinearTensorProduct):
+            def __init__(self):
+                super().__init__(input1_dim, input2_dim, output_dim,
+                                 weight_attr=param_attr,
+                                 bias_attr=bias_attr)
+                self._act = act
+
+            def forward(self, x, y):
+                return _act(super().forward(x, y), self._act)
+
+        return _BTP()
+
+
+def TreeConv(*args, **kwargs):
+    raise NotImplementedError(
+        "fluid.dygraph.TreeConv (tree-based convolution over AST "
+        "structures, tree_conv_op.cc) is not carried by this build — "
+        "its gather patterns are expressible with paddle.gather + "
+        "nn.Conv1D over flattened node sequences.")

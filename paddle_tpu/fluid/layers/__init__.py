@@ -23,3 +23,11 @@ def __getattr__(name):
     """Lazy legacy-class aliases (GRUCell, BeamSearchDecoder, Normal,
     ...) resolve through compat's module __getattr__ on first use."""
     return getattr(_compat, name)
+
+
+# star-import support for the lazy aliases: `from fluid.layers import
+# *` consults __all__ and getattr()s each name, which routes through
+# __getattr__ above — and user star-imports happen after this package
+# is fully imported, so the lazy resolution cannot cycle
+__all__ = [n for n in globals() if not n.startswith("_")] \
+    + list(_compat._LAZY_CLASSES)

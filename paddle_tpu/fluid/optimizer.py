@@ -714,29 +714,49 @@ class ExponentialMovingAverage:
 
 class ModelAverage(ExponentialMovingAverage):
     """Sliding average of parameters (reference optimizer.py
-    ModelAverage:2790) — same host-side shadow machinery with a
-    cumulative mean instead of exponential decay."""
+    ModelAverage:2790).  The reference bounds staleness with chunked
+    sums (sum_1/sum_2/sum_3 + restore points); same scheme here: a
+    current chunk accumulates until max_average_window updates, then
+    rolls into the previous-chunk slot — the average always covers at
+    most the last TWO windows, never the whole run."""
 
-    def __init__(self, average_window_rate=0.15, min_average_window=
-                 10000, max_average_window=10000, name=None):
+    def __init__(self, average_window_rate=0.15,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
         super().__init__(decay=0.0, name=name)
-        self._n = {}
+        self._rate = average_window_rate
+        self._min_window = min_average_window
+        self._max_window = max_average_window
+        self._cur = {}
+        self._cur_n = 0
+        self._old = {}
+        self._old_n = 0
 
     def update(self, scope=None, program=None):
         from .executor import global_scope
 
         scope = scope or global_scope()
         self._step += 1
+        window = max(self._min_window,
+                     min(self._max_window,
+                         int(self._step * self._rate) or 1))
+        if self._cur_n >= window:
+            self._old, self._old_n = self._cur, self._cur_n
+            self._cur, self._cur_n = {}, 0
+        self._cur_n += 1
         for p in self._params(program):
             holder = scope.find_var(p.name)
             if holder is None:
                 continue
             val = np.asarray(holder.get_tensor())
-            n = self._n.get(p.name, 0)
-            prev = self._shadow.get(p.name)
-            self._shadow[p.name] = (val.copy() if prev is None
-                                    else (prev * n + val) / (n + 1))
-            self._n[p.name] = n + 1
+            self._cur[p.name] = self._cur.get(p.name, 0.0) + val
+        # the shadow the apply() machinery swaps in
+        self._decay_prod = 0.0  # bias correction is a no-op here
+        n = self._cur_n + self._old_n
+        self._shadow = {
+            name: (self._cur.get(name, 0.0)
+                   + self._old.get(name, 0.0)) / n
+            for name in self._cur}
 
 
 class LookaheadOptimizer:
