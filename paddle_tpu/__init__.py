@@ -126,3 +126,4 @@ def disable_static(place=None):
     from .fluid.dygraph import enable_dygraph
 
     enable_dygraph(place)
+from . import incubate  # noqa: E402,F401

@@ -3,3 +3,4 @@ mixed_precision (AMP) and slim (quantization-aware training)."""
 
 from . import mixed_precision  # noqa: F401
 from . import slim  # noqa: F401
+from . import reader  # noqa: F401

@@ -22,11 +22,9 @@ from . import math_op_patch  # installs Tensor operator overloads
 
 # 1.x dygraph surface tail (reference fluid/dygraph/__init__ star set):
 # layer classes with 1.x signatures, LR decay classes, jit/io aliases
-from . import nn as dygraph_nn  # noqa: E402
-from .nn import (BatchNorm, BilinearTensorProduct, Conv2D,  # noqa: E402,F401
-                 Conv2DTranspose, Conv3D, Conv3DTranspose, Dropout,
-                 Embedding, Flatten, GRUUnit, Linear, NCE, Pool2D,
-                 PRelu, TreeConv)
+# the 1.x layer/decay classes live in .nn, which imports paddle_tpu.nn
+# at ITS import time — deferred to first attribute access (below), so
+# no cycle with nn.functional importing this package
 from .tracer import no_grad as no_grad_  # noqa: E402,F401
 
 # nn/optimizer-backed names resolve lazily via __getattr__ below — an
@@ -42,18 +40,44 @@ _NN_ALIASES = {
     "Layer": ("paddle_tpu.nn.layer.layers", "Layer"),
     "GRUCell": ("paddle_tpu.nn.layer.rnn", "GRUCell"),
     "LSTMCell": ("paddle_tpu.nn.layer.rnn", "LSTMCell"),
-    "CosineDecay": ("paddle_tpu.optimizer.lr", "CosineAnnealingDecay"),
-    "ExponentialDecay": ("paddle_tpu.optimizer.lr", "ExponentialDecay"),
-    "InverseTimeDecay": ("paddle_tpu.optimizer.lr", "InverseTimeDecay"),
+    # 1.x-SIGNATURE decays live in .nn (the 2.0 classes take
+    # different constructor args — aliasing them silently produced
+    # wrong schedules); same-signature ones alias the 2.0 classes
+    "CosineDecay": ("paddle_tpu.fluid.dygraph.nn", "CosineDecay"),
+    "ExponentialDecay": ("paddle_tpu.fluid.dygraph.nn",
+                         "ExponentialDecay"),
+    "InverseTimeDecay": ("paddle_tpu.fluid.dygraph.nn",
+                         "InverseTimeDecay"),
+    "NaturalExpDecay": ("paddle_tpu.fluid.dygraph.nn",
+                        "NaturalExpDecay"),
+    "PiecewiseDecay": ("paddle_tpu.fluid.dygraph.nn",
+                       "PiecewiseDecay"),
     "LambdaDecay": ("paddle_tpu.optimizer.lr", "LambdaDecay"),
     "LinearLrWarmup": ("paddle_tpu.optimizer.lr", "LinearWarmup"),
     "MultiStepDecay": ("paddle_tpu.optimizer.lr", "MultiStepDecay"),
-    "NaturalExpDecay": ("paddle_tpu.optimizer.lr", "NaturalExpDecay"),
     "NoamDecay": ("paddle_tpu.optimizer.lr", "NoamDecay"),
-    "PiecewiseDecay": ("paddle_tpu.optimizer.lr", "PiecewiseDecay"),
     "PolynomialDecay": ("paddle_tpu.optimizer.lr", "PolynomialDecay"),
     "ReduceLROnPlateau": ("paddle_tpu.optimizer.lr", "ReduceOnPlateau"),
     "StepDecay": ("paddle_tpu.optimizer.lr", "StepDecay"),
+    # 1.x layer classes (real module-level subclasses in .nn)
+    "BatchNorm": ("paddle_tpu.fluid.dygraph.nn", "BatchNorm"),
+    "BilinearTensorProduct": ("paddle_tpu.fluid.dygraph.nn",
+                              "BilinearTensorProduct"),
+    "Conv2D": ("paddle_tpu.fluid.dygraph.nn", "Conv2D"),
+    "Conv2DTranspose": ("paddle_tpu.fluid.dygraph.nn",
+                        "Conv2DTranspose"),
+    "Conv3D": ("paddle_tpu.fluid.dygraph.nn", "Conv3D"),
+    "Conv3DTranspose": ("paddle_tpu.fluid.dygraph.nn",
+                        "Conv3DTranspose"),
+    "Dropout": ("paddle_tpu.fluid.dygraph.nn", "Dropout"),
+    "Embedding": ("paddle_tpu.fluid.dygraph.nn", "Embedding"),
+    "Flatten": ("paddle_tpu.fluid.dygraph.nn", "Flatten"),
+    "GRUUnit": ("paddle_tpu.fluid.dygraph.nn", "GRUUnit"),
+    "Linear": ("paddle_tpu.fluid.dygraph.nn", "Linear"),
+    "NCE": ("paddle_tpu.fluid.dygraph.nn", "NCE"),
+    "Pool2D": ("paddle_tpu.fluid.dygraph.nn", "Pool2D"),
+    "PRelu": ("paddle_tpu.fluid.dygraph.nn", "PRelu"),
+    "TreeConv": ("paddle_tpu.fluid.dygraph.nn", "TreeConv"),
 }
 from ...framework_io import load, save  # noqa: E402,F401
 

@@ -1,0 +1,6 @@
+"""paddle.incubate (reference python/paddle/incubate/__init__.py):
+experimental namespaces — at this reference version, the complex-
+tensor API and the distributed reader re-export."""
+
+from . import complex  # noqa: F401
+from ..fluid.contrib import reader  # noqa: F401

@@ -1,0 +1,13 @@
+"""paddle.incubate.complex (reference incubate/complex): complex-valued
+tensor math.
+
+TPU-native re-design: the reference predates native complex dtypes and
+ships ComplexVariable (a real/imag pair) plus paired kernels; jax
+carries complex64/128 natively, so these functions are the SAME names
+over ordinary complex-dtype eager Tensors — no paired plumbing, and
+the math runs on the same XLA ops as real dtypes."""
+
+from . import tensor  # noqa: F401
+from .tensor import *  # noqa: F401,F403
+
+__all__ = list(tensor.__all__)

@@ -1,0 +1,88 @@
+"""Complex tensor ops (reference incubate/complex/tensor/{math,
+linalg,manipulation}.py) over native jax complex dtypes."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...fluid.dygraph.tracer import trace_fn
+from ...fluid.dygraph.varbase import Tensor
+
+__all__ = ["elementwise_add", "elementwise_sub", "elementwise_mul",
+           "elementwise_div", "kron", "matmul", "reshape", "sum",
+           "trace", "transpose"]
+
+
+def _as_complex(x):
+    if isinstance(x, Tensor):
+        return x
+    a = np.asarray(x)
+    if a.dtype.kind != "c":
+        a = a.astype("complex64")
+    return Tensor(a)
+
+
+def _binop(fn, name):
+    def f(x, y, axis=-1, name=None):
+        import jax.numpy as jnp
+
+        return trace_fn(lambda x, y: fn(jnp, x, y),
+                        {"x": _as_complex(x), "y": _as_complex(y)})
+
+    f.__name__ = name
+    return f
+
+
+elementwise_add = _binop(lambda jnp, x, y: x + y, "elementwise_add")
+elementwise_sub = _binop(lambda jnp, x, y: x - y, "elementwise_sub")
+elementwise_mul = _binop(lambda jnp, x, y: x * y, "elementwise_mul")
+elementwise_div = _binop(lambda jnp, x, y: x / y, "elementwise_div")
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0,
+           name=None):
+    import jax.numpy as jnp
+
+    def f(x, y):
+        a = jnp.swapaxes(x, -1, -2) if transpose_x else x
+        b = jnp.swapaxes(y, -1, -2) if transpose_y else y
+        return alpha * (a @ b)
+
+    return trace_fn(f, {"x": _as_complex(x), "y": _as_complex(y)})
+
+
+def kron(x, y, name=None):
+    import jax.numpy as jnp
+
+    return trace_fn(lambda x, y: jnp.kron(x, y),
+                    {"x": _as_complex(x), "y": _as_complex(y)})
+
+
+def reshape(x, shape, inplace=False, name=None):
+    import jax.numpy as jnp
+
+    return trace_fn(lambda x: jnp.reshape(x, tuple(shape)),
+                    {"x": _as_complex(x)})
+
+
+def transpose(x, perm, name=None):
+    import jax.numpy as jnp
+
+    return trace_fn(lambda x: jnp.transpose(x, tuple(perm)),
+                    {"x": _as_complex(x)})
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    import jax.numpy as jnp
+
+    return trace_fn(
+        lambda x: jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2),
+        {"x": _as_complex(x)})
+
+
+def sum(x, dim=None, keep_dim=False, name=None):  # noqa: A001
+    import jax.numpy as jnp
+
+    ax = tuple(dim) if isinstance(dim, (list, tuple)) else dim
+    return trace_fn(lambda x: jnp.sum(x, axis=ax, keepdims=keep_dim),
+                    {"x": _as_complex(x)})

@@ -188,3 +188,42 @@ def test_static_print_and_parallel_executor():
     np.testing.assert_allclose(out[0], 1.0, rtol=1e-6)
     assert hasattr(static, "ParallelExecutor")
     assert hasattr(static, "py_func")
+
+
+def test_incubate_complex_and_reader():
+    """paddle.incubate (reference incubate/__init__.py): the complex
+    tensor API over NATIVE jax complex dtypes (the reference's
+    ComplexVariable pair plumbing predates them) + the distributed
+    reader shard."""
+    import os
+
+    import paddle_tpu as paddle
+
+    C = paddle.incubate.complex
+    a = np.array([[1 + 2j, 3 + 4j], [5 + 6j, 7 + 8j]], "complex64")
+    b = np.array([[1 - 1j, 0], [0, 1 + 1j]], "complex64")
+    np.testing.assert_allclose(C.matmul(a, b).numpy(), a @ b,
+                               rtol=1e-6)
+    np.testing.assert_allclose(C.elementwise_div(a, b + 1).numpy(),
+                               a / (b + 1), rtol=1e-6)
+    np.testing.assert_allclose(C.kron(a, b).numpy(), np.kron(a, b))
+    np.testing.assert_allclose(
+        C.transpose(a, [1, 0]).numpy(), a.T)
+    np.testing.assert_allclose(C.sum(a).numpy(), a.sum())
+
+    old = {k: os.environ.get(k)
+           for k in ("PADDLE_TRAINER_ID", "PADDLE_TRAINERS_NUM")}
+    os.environ["PADDLE_TRAINER_ID"] = "1"
+    os.environ["PADDLE_TRAINERS_NUM"] = "2"
+    try:
+        from paddle_tpu.fluid.contrib.reader import (
+            distributed_batch_reader)
+
+        r = distributed_batch_reader(lambda: iter(range(6)))
+        assert list(r()) == [1, 3, 5]
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v

@@ -451,3 +451,64 @@ class TestSecondReviewFixes:
             avg = np.asarray(
                 global_scope().find_var(pname).get_tensor())
         assert avg.min() > 0.45, avg  # stale zeros aged out
+
+
+class TestThirdReviewFixes:
+    def test_1x_decay_signatures(self):
+        import paddle_tpu.fluid.dygraph as D
+
+        ne = D.NaturalExpDecay(0.1, decay_steps=100, decay_rate=0.5)
+        ne.step(100)
+        assert ne() == pytest.approx(0.1 * np.exp(-0.5), rel=1e-5)
+        ex = D.ExponentialDecay(0.1, 100, 0.5, staircase=True)
+        ex.step(150)
+        assert ex() == pytest.approx(0.1 * 0.5)  # floor(1.5) = 1
+        it = D.InverseTimeDecay(0.1, 100, 1.0)
+        it.step(100)
+        assert it() == pytest.approx(0.05)
+        cd = D.CosineDecay(0.1, step_each_epoch=10, epochs=4)
+        cd.step(20)  # epoch 2 of 4 -> cos(pi/2) = 0
+        assert cd() == pytest.approx(0.05, abs=1e-6)
+        pw = D.PiecewiseDecay([3, 6], [0.1, 0.01, 0.001], begin=0)
+        pw.step(4)
+        assert pw() == pytest.approx(0.01)
+
+    def test_1x_layers_are_real_classes(self):
+        import copy
+        import pickle
+
+        import paddle_tpu.fluid.dygraph as D
+        from paddle_tpu.fluid import dygraph
+
+        with dygraph.guard():
+            lin = D.Linear(4, 3, act="relu")
+            assert isinstance(lin, D.Linear)
+            lin2 = copy.deepcopy(lin)
+            out = lin2(dygraph.to_variable(
+                np.ones((2, 4), "float32")))
+            assert list(out.shape) == [2, 3]
+            assert pickle.dumps(lin)  # module-level class: picklable
+
+    def test_conv2d_transpose_output_size_honored(self):
+        import paddle_tpu.fluid.dygraph as D
+        from paddle_tpu.fluid import dygraph
+
+        with dygraph.guard():
+            ct = D.Conv2DTranspose(4, 8, 3, output_size=[9, 9],
+                                   stride=2)
+            y = ct(dygraph.to_variable(
+                np.ones((1, 4, 4, 4), "float32")))
+            assert list(y.shape)[2:] == [9, 9]
+
+    def test_flatten_stop_axis_and_nce_loud(self):
+        import paddle_tpu.fluid.dygraph as D
+        from paddle_tpu.fluid import dygraph
+
+        with dygraph.guard():
+            f = D.Flatten(start_axis=1, stop_axis=2)
+            y = f(dygraph.to_variable(
+                np.ones((2, 3, 4, 5), "float32")))
+            assert list(y.shape) == [2, 12, 5]
+            with pytest.raises(NotImplementedError, match="uniform"):
+                D.NCE(10, 4, sampler="custom_dist",
+                      custom_dist=[0.1] * 10)
