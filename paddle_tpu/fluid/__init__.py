@@ -11,7 +11,8 @@ from .dataset import DatasetFactory, InMemoryDataset, QueueDataset
 from .framework import (Program, Variable, Parameter, OpRole,
                         default_main_program, default_startup_program,
                         program_guard, in_dygraph_mode)
-from .executor import Executor, Scope, global_scope, scope_guard
+from .executor import (Executor, LazyFetch, Scope, global_scope,
+                       scope_guard)
 from .backward import append_backward, gradients
 from . import initializer, regularizer, clip, io
 from .param_attr import ParamAttr, WeightNormParamAttr

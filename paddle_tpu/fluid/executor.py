@@ -14,12 +14,26 @@ write Param in place).  Here persistable vars that a program writes are
 returned as fresh outputs and committed back to the Scope, with the old
 buffers donated to XLA (`donate_argnums`), which gives true in-place updates
 in HBM without copies.
+
+Async dispatch-ahead hot path (docs/async_hot_path.md): `run` never blocks
+on the device.  Feeds are staged with async `jax.device_put` (content-hashed
+constants hit a device cache), const state is device-cached per compiled
+entry, step state stays device-resident in the Scope between steps, and
+fetches come back as `LazyFetch` handles that only materialize at sanctioned
+sync points.  `FLAGS_check_nan_inf` compiles a device-side finite scan into
+the step and drains it on a background thread, so the host can run
+`prefetch_depth` steps ahead of the device — the TensorFlow-style async
+dataflow the paper's design calls for.
 """
 
 from __future__ import annotations
 
 import collections
 import contextlib
+import hashlib
+import os
+import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 import jax
@@ -29,6 +43,90 @@ import numpy as np
 from . import core
 from .framework import (EMPTY_VAR_NAME, Program, Variable,
                         default_main_program)
+
+# Host steps dispatched ahead of the device in the dataset loops; also the
+# feed-prefetcher queue depth (double buffering at the default of 2).
+DEFAULT_PREFETCH_DEPTH = int(os.environ.get("PADDLE_PREFETCH_DEPTH", "2"))
+
+
+def _is_device_array(v) -> bool:
+    return isinstance(v, jax.Array)
+
+
+class LazyFetch:
+    """Future-like fetch handle (`run(..., return_numpy=False)`).
+
+    Wraps the device array of one fetch target without transferring it.
+    `.numpy()` / `np.asarray(h)` / `float(h)` are the sanctioned sync
+    points — each counts on `executor_sync_count` and `sync_ms` so the
+    zero-transfer contract of the async loop stays testable.  `.jax()`
+    hands back the raw device array with no transfer; shape/dtype are
+    metadata reads and never sync.
+    """
+
+    __slots__ = ("_val", "_np", "name")
+
+    def __init__(self, val, name: str = None):
+        self._val = val
+        self._np = None
+        self.name = name
+
+    # -- metadata (never syncs) -------------------------------------------
+    @property
+    def shape(self):
+        return tuple(np.shape(self._val))
+
+    @property
+    def dtype(self):
+        if self._np is not None:
+            return self._np.dtype
+        d = getattr(self._val, "dtype", None)
+        return np.dtype(d) if d is not None else self.numpy().dtype
+
+    def jax(self):
+        """The underlying device array; no transfer."""
+        return self._val
+
+    def is_ready(self) -> bool:
+        try:
+            return bool(self._val.is_ready())
+        except AttributeError:
+            return True
+
+    def block_until_ready(self):
+        """Wait for the producing computation; device barrier, NOT a
+        device->host transfer."""
+        jax.block_until_ready(self._val)
+        return self
+
+    # -- materialization (sanctioned sync points) -------------------------
+    def numpy(self):
+        if self._np is None:
+            from ..profiler import count_sync, timed
+
+            with timed("sync_ms"):
+                count_sync()
+                self._np = np.asarray(self._val)  # sync-ok: materialization
+        return self._np
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __float__(self):
+        return float(self.numpy())
+
+    def __int__(self):
+        return int(self.numpy())
+
+    def __len__(self):
+        return self.shape[0]
+
+    def __repr__(self):
+        state = "ready" if self._np is not None or self.is_ready() \
+            else "pending"
+        return (f"LazyFetch(name={self.name!r}, shape={self.shape}, "
+                f"{state})")
 
 
 class _VarHolder:
@@ -43,10 +141,20 @@ class _VarHolder:
         return self
 
     def set(self, value, place=None):
-        self._scope.set(self._name, np.asarray(value))
+        # device-array fast path: committing a jax array (or ndarray)
+        # must not bounce through host np.asarray — step state stays
+        # device-resident between steps
+        if not _is_device_array(value) and not isinstance(value, np.ndarray):
+            value = np.asarray(value)  # sync-ok: host python value
+        self._scope.set(self._name, value)
 
     def numpy(self):
-        return np.asarray(self._scope.get(self._name))
+        from ..profiler import stat_add
+
+        val = self._scope.get(self._name)
+        if _is_device_array(val):
+            stat_add("scope_host_reads")
+        return np.asarray(val)  # sync-ok: explicit scope read
 
     def __array__(self, dtype=None):
         a = self.numpy()
@@ -59,7 +167,9 @@ class _VarHolder:
 class Scope:
     """Name -> array store for persistable state (parameters, optimizer
     moments, running stats).  Hierarchical like the reference's Scope
-    (scope.h:52); child scopes see parent vars."""
+    (scope.h:52); child scopes see parent vars.  Values are stored
+    verbatim — jax device arrays committed by the Executor stay
+    device-resident, numpy only enters via host-side writers."""
 
     def __init__(self, parent: Optional["Scope"] = None):
         self._vars: Dict[str, Any] = {}
@@ -132,7 +242,71 @@ class _CompiledEntry:
     # can never collide with a recycled address.
     __slots__ = ("fn", "state_in_names", "mutable_in_names", "const_in_names",
                  "mutable_out_names", "feed_names", "fetch_names", "program",
-                 "scope")
+                 "scope", "check_nan", "check_names", "const_src",
+                 "const_dev", "feed_shardings", "const_shardings",
+                 "dispatched")
+
+
+class _NanMonitor:
+    """Async FLAGS_check_nan_inf drain (replaces the old post-run host
+    scan, which forced a device->host transfer EVERY step).  The compiled
+    step emits one device-side bool per checked array; this thread
+    materializes those flag vectors off the hot path and parks any hit
+    until the next poll() — the executor polls at each run() entry and at
+    sync()/drain boundaries, so a NaN still raises, just asynchronously
+    (within `prefetch_depth` steps of where it occurred)."""
+
+    def __init__(self):
+        self._q = None
+        self._thread = None
+        self._errs: List[str] = []
+        self._lock = threading.Lock()
+
+    def _ensure(self):
+        if self._thread is None or not self._thread.is_alive():
+            import queue as _queue
+
+            self._q = _queue.Queue()
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+    def _loop(self):
+        while True:
+            flags, names = self._q.get()
+            try:
+                try:
+                    bad = np.asarray(flags)  # background thread: off the
+                    # hot path by construction
+                    hits = [names[i] for i in np.nonzero(bad)[0]]
+                except Exception as e:  # noqa: BLE001 - deleted buffer etc.
+                    hits = [f"<flag materialization failed: {e}>"]
+                if hits:
+                    with self._lock:
+                        self._errs.append(
+                            f"NaN/Inf detected in variable {hits[0]!r} "
+                            f"after Executor.run (FLAGS_check_nan_inf is "
+                            f"set; async scan, all hits: {hits})")
+            finally:
+                self._q.task_done()
+
+    def submit(self, flags, names):
+        self._ensure()
+        self._q.put((flags, names))
+
+    def poll(self):
+        """Raise the first parked NaN/Inf report, if any."""
+        with self._lock:
+            if self._errs:
+                msg = self._errs[0]
+                del self._errs[:]
+                raise RuntimeError(msg)
+
+    def drain(self):
+        """Block until every submitted flag has been inspected, then
+        surface any hit.  A sanctioned sync boundary."""
+        if self._q is not None:
+            self._q.join()
+        self.poll()
 
 
 class FetchHandler:
@@ -159,7 +333,6 @@ class FetchHandlerMonitor:
     scope vars every period and hands them to handler()."""
 
     def __init__(self, scope, handler):
-        import threading
         self._scope = scope
         self._handler = handler
         self._stop = threading.Event()
@@ -182,6 +355,66 @@ class FetchHandlerMonitor:
     def stop(self):
         self._stop.set()
         self._thread.join(timeout=5)
+
+
+class _FeedPrefetcher:
+    """Overlapped feed stage for the dataset loops: a background thread
+    normalizes + `jax.device_put`s batch N+k while batch N computes (the
+    reference's BufferedReader double-buffer, buffered_reader.cc, lifted
+    to the whole feed dict).  Queue depth = prefetch_depth; upstream
+    exceptions re-raise in the consumer."""
+
+    _END = object()
+
+    def __init__(self, executor, program, batch_iter, depth):
+        import queue as _queue
+
+        from ..profiler import stat_set
+
+        self._q = _queue.Queue(maxsize=max(1, depth))
+        self._stop = threading.Event()
+        stat_set("prefetch_depth", max(1, depth))
+
+        def fill():
+            try:
+                for feed in batch_iter:
+                    staged = executor._normalize_feed(program, feed)
+                    while not self._stop.is_set():
+                        try:
+                            self._q.put(staged, timeout=0.1)
+                            break
+                        except _queue.Full:
+                            continue
+                    else:
+                        return
+                self._put(self._END)
+            except BaseException as e:  # noqa: BLE001 - forward to consumer
+                self._put(e)
+
+        self._thread = threading.Thread(target=fill, daemon=True)
+        self._thread.start()
+
+    def _put(self, item):
+        import queue as _queue
+
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return
+            except _queue.Full:
+                continue
+
+    def __iter__(self):
+        try:
+            while True:
+                item = self._q.get()
+                if item is self._END:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            self._stop.set()
 
 
 def _analyze_block(block, feed_names, scope: Scope):
@@ -217,6 +450,21 @@ def _analyze_block(block, feed_names, scope: Scope):
     return reads_before_write, persistable_writes
 
 
+def _nan_flags(fetch_names, fetches, new_state):
+    """Device-side finite scan: one bool per float array, stacked.  Runs
+    INSIDE the jitted step so FLAGS_check_nan_inf costs a fused reduction
+    on device instead of a host round-trip per step."""
+    names, flags = [], []
+    for name, val in list(new_state.items()) + list(zip(fetch_names,
+                                                        fetches)):
+        arr = jnp.asarray(val)
+        if jnp.issubdtype(arr.dtype, jnp.floating):
+            names.append(name)
+            flags.append(jnp.logical_not(jnp.all(jnp.isfinite(arr))))
+    stacked = jnp.stack(flags) if flags else jnp.zeros((0,), bool)
+    return names, stacked
+
+
 class Executor:
     """`Executor(place).run(program, feed, fetch_list)`
     (executor.py:475,914 in the reference)."""
@@ -227,10 +475,20 @@ class Executor:
     # training program is re-hit every step and must never churn.
     CACHE_CAPACITY = 64
 
+    # content-hash device cache for feeds (`_normalize_feed`): a constant
+    # mask fed every step must upload ONCE, not every call.  Bounded LRU;
+    # arrays above the byte cap skip hashing (a fresh batch never hits,
+    # so hashing it would be pure overhead).
+    FEED_CACHE_CAPACITY = 32
+    FEED_CACHE_MAX_BYTES = 8 << 20
+
     def __init__(self, place=None):
         self.place = place
         self._cache: "collections.OrderedDict[tuple, _CompiledEntry]" = \
             collections.OrderedDict()
+        self._feed_cache: "collections.OrderedDict[tuple, Any]" = \
+            collections.OrderedDict()
+        self._nan_monitor = _NanMonitor()
         self._step = 0
 
     # -- public API --------------------------------------------------------
@@ -248,40 +506,25 @@ class Executor:
 
         from ..profiler import stat_add
         stat_add("executor_run_count")
+        # surface any NaN/Inf the async scan caught on earlier steps
+        self._nan_monitor.poll()
         feed_arrays = self._normalize_feed(program, feed)
         fetch_names = [v.name if isinstance(v, Variable) else str(v)
                        for v in fetch_list]
 
         entry = self._prepare(program, feed_arrays, fetch_names, scope)
+        fetches = self._dispatch(entry, scope, feed_arrays)
+        return self._finish(fetches, entry, return_numpy)
 
-        mutable_state = {n: scope.get(n) for n in entry.mutable_in_names}
-        const_state = {n: scope.get(n) for n in entry.const_in_names}
-        seed = self._next_seed(program)
-        fetches, new_state = entry.fn(mutable_state, const_state,
-                                      feed_arrays, seed)
-        for name, val in new_state.items():
-            scope.set(name, val)
-        from .flags import flag
-
-        if flag("check_nan_inf"):
-            # post-run tensor scan (the reference's CheckVarHasNanOrInf,
-            # details/nan_inf_utils — FLAGS_check_nan_inf, flags.cc:44)
-            for name, val in list(new_state.items()) + list(
-                    zip(fetch_names, fetches)):
-                arr = np.asarray(val)
-                if np.issubdtype(arr.dtype, np.floating) \
-                        and not np.isfinite(arr).all():
-                    raise RuntimeError(
-                        f"NaN/Inf detected in variable {name!r} after "
-                        f"Executor.run (FLAGS_check_nan_inf is set)")
-        if return_numpy:
-            return [np.asarray(f) for f in fetches]
-        return list(fetches)
+    def sync(self):
+        """Sanctioned sync boundary: wait for the async NaN scan to catch
+        up and surface anything it parked.  Does NOT transfer fetches."""
+        self._nan_monitor.drain()
 
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
                            fetch_info=None, print_period=100,
-                           fetch_handler=None):
+                           fetch_handler=None, prefetch_depth=None):
         """Dataset-driven training loop (reference executor.py:1642 ->
         C++ Executor::RunFromDataset -> MultiTrainer/HogwildWorker
         threads over DataFeed channels, trainer.h:51).
@@ -290,7 +533,13 @@ class Executor:
         native BlockingQueue) streams batches into the ONE compiled XLA
         train step — host worker threads would only serialize against
         the single device stream, so `thread` configures the parser
-        pool (dataset.set_thread) instead of device workers."""
+        pool (dataset.set_thread) instead of device workers.
+
+        Async hot path: a `_FeedPrefetcher` stages batch N+k on device
+        while batch N computes, steps dispatch with lazy fetches, and
+        fetch materialization happens only at `print_period` boundaries
+        and at loop exit.  `prefetch_depth` bounds how far the host runs
+        ahead (default PADDLE_PREFETCH_DEPTH, 2)."""
         if dataset is None:
             raise ValueError("train_from_dataset needs a dataset")
         if thread:
@@ -298,27 +547,51 @@ class Executor:
         fetch_list = fetch_list or []
         fetch_info = fetch_info or [getattr(v, "name", str(v))
                                     for v in fetch_list]
+        depth = DEFAULT_PREFETCH_DEPTH if prefetch_depth is None \
+            else max(1, int(prefetch_depth))
         monitor = None
         if fetch_handler is not None:
             monitor = FetchHandlerMonitor(scope or global_scope(),
                                           fetch_handler)
             monitor.start()
+        from ..profiler import stat_set
+
         step = 0
         last = None
+        in_flight = collections.deque()
+        prefetcher = _FeedPrefetcher(
+            self, program if program is not None else
+            default_main_program(), dataset.batch_iter(), depth)
         try:
-            for feed in dataset.batch_iter():
+            for feed in prefetcher:
                 outs = self.run(program, feed=feed, fetch_list=fetch_list,
-                                scope=scope)
+                                scope=scope, return_numpy=False)
                 last = outs
                 step += 1
+                in_flight.append(outs)
+                stat_set("in_flight_steps", len(in_flight))
+                if len(in_flight) > depth:
+                    # throttle: the host never runs more than `depth`
+                    # steps ahead — wait on the OLDEST step's fetches
+                    # (device barrier, not a device->host transfer)
+                    oldest = in_flight.popleft()
+                    for h in oldest:
+                        h.block_until_ready()  # sync-ok: dispatch-ahead throttle
                 if debug and fetch_list and step % print_period == 0:
+                    # sanctioned materialization boundary
                     msg = ", ".join(
-                        f"{n}={np.asarray(o).ravel()[:1]}"
+                        f"{n}={o.numpy().ravel()[:1]}"  # sync-ok: print_period boundary
                         for n, o in zip(fetch_info, outs))
                     print(f"[train_from_dataset] step {step}: {msg}")
         finally:
+            stat_set("in_flight_steps", 0)
             if monitor is not None:
                 monitor.stop()
+        # loop exit is a sanctioned boundary: materialize the final
+        # fetches (callers index/float them) and flush the NaN scan
+        self._nan_monitor.drain()
+        if last is not None:
+            last = [h.numpy() for h in last]  # sync-ok: loop exit
         return last
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
@@ -343,13 +616,52 @@ class Executor:
         self._step += 1
         return base
 
-    def _normalize_feed(self, program, feed) -> Dict[str, Any]:
+    def _feed_cached_put(self, arr: np.ndarray):
+        """Content-hash device cache: identical feed bytes (a constant
+        mask, a frozen embedding) upload once and then reuse the device
+        buffer.  Feeds are never donated, so the cached buffer stays
+        valid across steps."""
+        if arr.nbytes > self.FEED_CACHE_MAX_BYTES:
+            return jax.device_put(arr)
+        buf = arr if arr.flags["C_CONTIGUOUS"] else np.ascontiguousarray(arr)
+        key = (hashlib.sha1(buf).hexdigest(), arr.shape, str(arr.dtype))
+        hit = self._feed_cache.get(key)
+        if hit is not None:
+            self._feed_cache.move_to_end(key)
+            from ..profiler import stat_add
+
+            stat_add("feed_cache_hits")
+            return hit
+        dev = jax.device_put(buf)
+        self._feed_cache[key] = dev
+        while len(self._feed_cache) > self.FEED_CACHE_CAPACITY:
+            self._feed_cache.popitem(last=False)
+        return dev
+
+    def _normalize_feed(self, program, feed, stage=True) -> Dict[str, Any]:
+        from ..profiler import timed
+
+        with timed("host_feed_ms"):
+            return self._normalize_feed_inner(program, feed, stage)
+
+    def _normalize_feed_inner(self, program, feed, stage) -> Dict[str, Any]:
         out = {}
         block = program.global_block()
         for name, val in feed.items():
-            if isinstance(val, _VarHolder):
-                val = val.numpy()
-            arr = np.asarray(val)
+            if isinstance(val, (_VarHolder, LazyFetch)):
+                val = val.numpy()  # sync-ok: host-fed handle
+            if _is_device_array(val):
+                # already-staged feed (prefetcher / user device_put):
+                # validate via metadata only — never pull it back
+                self._check_feed_shape(block, name, val.shape,
+                                       np.dtype(val.dtype))
+                want = core.np_dtype(block.var(name).dtype) \
+                    if block.has_var(name) else val.dtype
+                if np.dtype(val.dtype) != np.dtype(want):
+                    val = val.astype(want)  # device-side cast, async
+                out[name] = val
+                continue
+            arr = np.asarray(val)  # sync-ok: host python value
             # TPU-native policy: x64 is off, so 64-bit INTEGER data
             # narrows to 32-bit on device.  Values beyond the narrowed
             # range would wrap SILENTLY (e.g. >2^31-row embedding ids)
@@ -372,36 +684,47 @@ class Executor:
                         f"{info.dtype} range (max {arr.max()}); TPU "
                         f"indices are 32-bit — shard the table or "
                         f"rebase the ids")
-            if block.has_var(name):
-                # rank/shape contract: reference feed checks
-                # (executor.py feed_data shape validation).  A rank
-                # mismatch otherwise surfaces later as a raw jax
-                # broadcasting error deep inside the lowered block —
-                # name the var and the declared shape HERE instead.
-                declared = list(block.var(name).shape or [])
-                if declared and len(declared) != arr.ndim:
-                    raise ValueError(
-                        f"feed {name!r}: rank mismatch — variable "
-                        f"declared with shape {declared} "
-                        f"(rank {len(declared)}), fed array has shape "
-                        f"{list(arr.shape)} (rank {arr.ndim})")
-                if declared and any(
-                        d != -1 and d != s
-                        for d, s in zip(declared, arr.shape)):
-                    raise ValueError(
-                        f"feed {name!r}: shape mismatch — variable "
-                        f"declared {declared} (-1 = any), fed "
-                        f"{list(arr.shape)}")
-                if arr.dtype != want:
-                    arr = arr.astype(want)
-            out[name] = arr
+            self._check_feed_shape(block, name, arr.shape, arr.dtype)
+            if block.has_var(name) and arr.dtype != want:
+                arr = arr.astype(want)
+            # stage onto the device NOW (async): the jit call then takes
+            # device arrays, and identical constant feeds hit the
+            # content-hash cache instead of re-uploading
+            out[name] = self._feed_cached_put(arr) if stage else arr
         return out
 
+    def _check_feed_shape(self, block, name, shape, dtype):
+        """Rank/shape contract: reference feed checks (executor.py
+        feed_data shape validation).  A rank mismatch otherwise surfaces
+        later as a raw jax broadcasting error deep inside the lowered
+        block — name the var and the declared shape HERE instead."""
+        if not block.has_var(name):
+            return
+        declared = list(block.var(name).shape or [])
+        ndim = len(shape)
+        if declared and len(declared) != ndim:
+            raise ValueError(
+                f"feed {name!r}: rank mismatch — variable "
+                f"declared with shape {declared} "
+                f"(rank {len(declared)}), fed array has shape "
+                f"{list(shape)} (rank {ndim})")
+        if declared and any(
+                d != -1 and d != s
+                for d, s in zip(declared, shape)):
+            raise ValueError(
+                f"feed {name!r}: shape mismatch — variable "
+                f"declared {declared} (-1 = any), fed "
+                f"{list(shape)}")
+
     def _cache_key(self, program, feed_arrays, fetch_names, scope):
+        from .flags import flag
+
         feed_sig = tuple(sorted(
             (n, tuple(a.shape), str(a.dtype)) for n, a in feed_arrays.items()))
+        # the NaN scan is compiled INTO the step, so the flag is part of
+        # the program identity
         return (id(program), program.version, feed_sig, tuple(fetch_names),
-                id(scope))
+                id(scope), bool(flag("check_nan_inf")))
 
     def _prepare(self, program: Program, feed_arrays, fetch_names,
                  scope: Scope) -> _CompiledEntry:
@@ -413,8 +736,10 @@ class Executor:
         from ..profiler import stat_add
         stat_add("executor_compile_count")
 
+        from .flags import flag
         from ..ops import registry
 
+        check_nan = bool(flag("check_nan_inf"))
         block = program.global_block()
         reads, persistable_writes = _analyze_block(block, feed_arrays.keys(),
                                                    scope)
@@ -431,6 +756,8 @@ class Executor:
         const_in = sorted(n for n in state_in if n not in set(persistable_writes))
         mutable_out = sorted(set(persistable_writes))
 
+        check_names_box = []
+
         def step_fn(mutable_state, const_state, feeds, seed):
             env: Dict[str, Any] = {}
             env.update(const_state)
@@ -441,6 +768,10 @@ class Executor:
             registry.lower_block(ctx, block, env)
             fetches = [env[n] for n in fetch_names]
             new_state = {n: env[n] for n in mutable_out if n in env}
+            if check_nan:
+                names, flags = _nan_flags(fetch_names, fetches, new_state)
+                check_names_box[:] = names
+                return fetches, new_state, flags
             return fetches, new_state
 
         entry = _CompiledEntry()
@@ -453,10 +784,93 @@ class Executor:
         entry.mutable_out_names = mutable_out
         entry.feed_names = sorted(feed_arrays)
         entry.fetch_names = list(fetch_names)
+        entry.check_nan = check_nan
+        entry.check_names = check_names_box
+        entry.const_src = {}
+        entry.const_dev = {}
+        entry.feed_shardings = None
+        entry.const_shardings = None
+        entry.dispatched = False
         self._cache[key] = entry
         while len(self._cache) > self.CACHE_CAPACITY:
             self._cache.popitem(last=False)
         return entry
 
+    def _const_state(self, entry: _CompiledEntry, scope: Scope):
+        """Device-cached const inputs: vars the program reads but never
+        writes (`const_in_names`) are device_put ONCE per compiled entry
+        and reused by identity every call, instead of re-passed through
+        host normalization each step.  If another program commits a new
+        array to the scope (load_params, a train step that mutates what
+        this program only reads), the identity check refreshes the
+        cached device buffer."""
+        src, dev = entry.const_src, entry.const_dev
+        shardings = entry.const_shardings or {}
+        for n in entry.const_in_names:
+            v = scope.get(n)
+            if src.get(n) is not v:
+                src[n] = v
+                from ..profiler import timed
+
+                with timed("host_feed_ms"):
+                    sh = shardings.get(n)
+                    if sh is not None:
+                        dev[n] = jax.device_put(v, sh)
+                    else:
+                        dev[n] = v if _is_device_array(v) \
+                            else jax.device_put(np.asarray(v))  # sync-ok: host value upload
+        return dev
+
+    def _dispatch(self, entry: _CompiledEntry, scope: Scope, feed_arrays):
+        """The one dispatch point of the hot path (shared with
+        CompiledProgram._run): gather device-resident state, call the
+        compiled step, commit new state, route NaN flags to the async
+        monitor.  Never blocks on the device and never transfers."""
+        from ..profiler import time_add
+
+        t0 = time.perf_counter()
+        mutable_state = {n: scope.get(n) for n in entry.mutable_in_names}
+        const_state = self._const_state(entry, scope)
+        seed = self._next_seed(entry.program)
+        result = entry.fn(mutable_state, const_state, feed_arrays, seed)
+        first_call = not entry.dispatched
+        entry.dispatched = True
+        if entry.check_nan:
+            fetches, new_state, flags = result
+            if entry.check_names:
+                self._nan_monitor.submit(flags, list(entry.check_names))
+        else:
+            fetches, new_state = result
+        for name, val in new_state.items():
+            scope.set(name, val)
+        if entry.mutable_out_names:
+            # donation safety: a fetch of a persistable var the program
+            # writes can share its buffer with the state output just
+            # committed to the scope; next step DONATES that scope
+            # buffer, which would invalidate the user's fetch handle.
+            # Give such fetches their own buffer (device-side copy,
+            # async — not a transfer).
+            mut = set(entry.mutable_out_names)
+            fetches = [jnp.copy(f) if n in mut and _is_device_array(f)
+                       else f
+                       for n, f in zip(entry.fetch_names, fetches)]
+        # the first call traces+compiles inside fn(); book that under
+        # compile_ms so dispatch_ms reflects steady-state host overhead
+        time_add("compile_ms" if first_call else "dispatch_ms",
+                 (time.perf_counter() - t0) * 1e3)
+        return fetches
+
+    def _finish(self, fetches, entry: _CompiledEntry, return_numpy):
+        if return_numpy:
+            from ..profiler import count_sync, timed
+
+            with timed("sync_ms"):
+                count_sync(len(fetches))
+                return [np.asarray(f) for f in fetches]  # sync-ok: return_numpy=True
+        return [LazyFetch(f, n)
+                for n, f in zip(entry.fetch_names, fetches)]
+
     def close(self):
+        self._nan_monitor.drain()
         self._cache.clear()
+        self._feed_cache.clear()

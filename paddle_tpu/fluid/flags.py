@@ -34,9 +34,12 @@ def _define(name, default, help_str="", on_set: Callable = None,
 
 
 def _set_debug_nans(v):
-    import jax
-
-    jax.config.update("jax_debug_nans", bool(v))
+    # Intentionally NOT forwarded to jax_debug_nans anymore: that knob
+    # re-checks every dispatch synchronously, which would defeat the
+    # async dispatch-ahead executor loop (ISSUE 1).  The Executor now
+    # compiles a device-side finite scan into the step and drains it on
+    # a background thread; the dygraph tracer keeps its own eager check.
+    pass
 
 
 def _set_deterministic(v):
@@ -48,7 +51,8 @@ def _set_deterministic(v):
 # -- the flag set (mirrors flags.cc categories) ------------------------------
 _define("check_nan_inf", False,
         "scan op outputs for NaN/Inf after each eager op / executor run "
-        "(flags.cc:44); also enables jax_debug_nans", _set_debug_nans)
+        "(flags.cc:44); the executor scan is device-side + async",
+        _set_debug_nans)
 _define("cudnn_deterministic", False,
         "deterministic kernels (flags.cc:98); TPU/XLA is deterministic",
         _set_deterministic)

@@ -538,12 +538,19 @@ class DataLoader:
             yield from gen
             return
         # double-buffer: issue async device_put one batch ahead
-        # (BufferedReader's prefetch, buffered_reader.cc)
+        # (BufferedReader's prefetch, buffered_reader.cc).  The upload
+        # is async dispatch — host time spent HERE is the feed stage's
+        # true cost, accounted on host_feed_ms like the executor's.
         import jax
+
+        from ..profiler import stat_set, timed
+
+        stat_set("prefetch_depth", 1)
 
         def put(b):
             try:
-                return jax.tree_util.tree_map(jax.device_put, b)
+                with timed("host_feed_ms"):
+                    return jax.tree_util.tree_map(jax.device_put, b)
             except Exception:
                 return b
 

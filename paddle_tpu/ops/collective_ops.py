@@ -25,6 +25,16 @@ from jax import lax
 from .registry import first, register_op
 
 
+def _axis_size(axis):
+    """Static mesh-axis size across jax versions: `lax.axis_size` on
+    current jax, the classic `psum(1, axis)` (which folds to a Python
+    int at trace time) on the 0.4.x line."""
+    try:
+        return lax.axis_size(axis)
+    except AttributeError:
+        return lax.psum(1, axis)
+
+
 def _axis_for(ctx, op):
     """Resolve the mesh axis name for this op's ring_id; None when tracing
     without a mesh (single device)."""
@@ -92,7 +102,7 @@ def _c_reducescatter(ctx, op, ins):
     axis = _axis_for(ctx, op)
     if axis is None:
         return {"Out": [x]}
-    n = lax.axis_size(axis)
+    n = _axis_size(axis)
     return {"Out": [lax.psum_scatter(x, axis, scatter_dimension=0,
                                      tiled=True)]}
 
@@ -113,7 +123,7 @@ def _c_split(ctx, op, ins):
     axis = _axis_for(ctx, op)
     if axis is None:
         return {"Out": [x]}
-    n = lax.axis_size(axis)
+    n = _axis_size(axis)
     idx = lax.axis_index(axis)
     piece = x.shape[-1] // n
     return {"Out": [lax.dynamic_slice_in_dim(x, idx * piece, piece, axis=-1)]}
@@ -130,7 +140,7 @@ def _alltoall(ctx, op, ins):
     axis = _axis_for(ctx, op)
     if axis is None:
         return {"Out": [x]}
-    n = lax.axis_size(axis)
+    n = _axis_size(axis)
     xs = x.reshape((n, x.shape[0] // n) + x.shape[1:])
     out = lax.all_to_all(xs, axis, split_axis=0, concat_axis=0, tiled=False)
     return {"Out": [out.reshape(x.shape)]}
@@ -189,7 +199,7 @@ def _recv_v2(ctx, op, ins):
         axis = _axis_for(ctx, op)
         if axis is not None:
             src = op.attr("peer", 0)
-            n = lax.axis_size(axis)
+            n = _axis_size(axis)
             perm = [(src, d) for d in range(n)]
             return {"Out": [lax.ppermute(x, axis, perm)]}
         return {"Out": [x]}

@@ -64,7 +64,9 @@ def _scale(ctx, op, ins):
     if div_axis is not None:
         axis_name = (ctx.mesh_axes or {}).get(div_axis)
         if axis_name is not None:
-            scale = scale / lax.axis_size(axis_name)
+            from .collective_ops import _axis_size
+
+            scale = scale / _axis_size(axis_name)
     if op.attr("bias_after_scale", True):
         out = x * jnp.asarray(scale, x.dtype) + jnp.asarray(bias, x.dtype)
     else:

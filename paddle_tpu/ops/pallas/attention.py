@@ -46,6 +46,9 @@ try:  # pallas import is deferred-safe for environments without Mosaic
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    # renamed TPUCompilerParams -> CompilerParams across jax versions
+    _CompilerParams = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams", None)
     _HAS_PALLAS = True
 except Exception:  # pragma: no cover
     _HAS_PALLAS = False
@@ -766,9 +769,9 @@ def _compiler_params():
     pipeline DMA across grid steps; if this Mosaic version rejects them
     the probe flips the switch and retries plain — losing the pipelining
     must never cost the whole Pallas path."""
-    if not _USE_DIM_SEMANTICS:
+    if not _USE_DIM_SEMANTICS or _CompilerParams is None:
         return None
-    return pltpu.CompilerParams(
+    return _CompilerParams(
         dimension_semantics=("parallel", "parallel", "arbitrary"))
 
 

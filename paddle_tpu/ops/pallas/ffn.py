@@ -41,6 +41,10 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ._common import round_up
 
+# renamed TPUCompilerParams -> CompilerParams across jax versions
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams", None)
+
 
 def _erf(x):
     """erf via Abramowitz-Stegun 7.1.26 (max abs err 1.5e-7): Mosaic
@@ -177,7 +181,7 @@ def _ffn_forward(x, w1, b1, w2, b2, seed, activation="gelu",
         out_specs=pl.BlockSpec((block_t, H), lambda t, f: (t, 0)),
         out_shape=jax.ShapeDtypeStruct((T, H), x.dtype),
         scratch_shapes=[pltpu.VMEM((block_t, H), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(seed, x, w1, b1.reshape(1, F), w2, b2.reshape(1, H))
@@ -291,7 +295,7 @@ def _ffn_backward(x, w1, b1, w2, b2, seed, g, activation="gelu",
             pltpu.VMEM((1, block_f), jnp.float32),
             pltpu.VMEM((block_f, H), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(seed, x, g, w1, b1r, w2)
@@ -313,7 +317,7 @@ def _ffn_backward(x, w1, b1, w2, b2, seed, g, activation="gelu",
         out_specs=pl.BlockSpec((block_t, H), lambda t, f: (t, 0)),
         out_shape=jax.ShapeDtypeStruct((T, H), x.dtype),
         scratch_shapes=[pltpu.VMEM((block_t, H), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(seed, x, g, w1, b1r, w2)

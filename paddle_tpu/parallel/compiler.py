@@ -23,10 +23,25 @@ import collections
 from typing import Any, Dict, Optional
 
 import jax
-import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from . import mesh as mesh_lib
+
+
+def _shard_map_compat(fn, mesh, in_specs, out_specs):
+    """shard_map across jax versions: `jax.shard_map(check_vma=...)` on
+    current jax, `jax.experimental.shard_map.shard_map(check_rep=...)`
+    on the 0.4.x line — replication checking off in both (collective
+    ops legitimately return per-shard values the checker cannot see
+    through)."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=False)
+    from jax.experimental.shard_map import shard_map as sm_exp
+
+    return sm_exp(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=False)
 
 
 class BuildStrategy:
@@ -87,8 +102,13 @@ class CompiledProgram:
 
     # -- execution (called from Executor.run) ------------------------------
     def _run(self, executor, feed, fetch_list, scope, return_numpy=True):
+        """Same async hot path as Executor.run (ISSUE 1): feeds staged
+        with sharded async device_put, dispatch + state commit + NaN
+        routing shared via Executor._dispatch, fetches lazy unless
+        return_numpy=True.  No per-step device->host transfer."""
         from ..fluid import executor as exec_mod
         from ..fluid.framework import Variable
+        from ..profiler import timed
 
         scope = scope if scope is not None else exec_mod.global_scope()
         feed = feed or {}
@@ -96,8 +116,9 @@ class CompiledProgram:
         if self._mesh is None:
             self._mesh = mesh_lib.make_mesh(None)
 
+        executor._nan_monitor.poll()
         program = self._program
-        feed_arrays = executor._normalize_feed(program, feed)
+        feed_arrays = executor._normalize_feed(program, feed, stage=False)
         fetch_names = [v.name if isinstance(v, Variable) else str(v)
                        for v in fetch_list]
         key = executor._cache_key(program, feed_arrays, fetch_names, scope)
@@ -110,19 +131,12 @@ class CompiledProgram:
                 self._cache.popitem(last=False)
         else:
             self._cache.move_to_end(key)
-        fn, mutable_in, const_in, mutable_out, feed_shardings = entry
 
-        mutable_state = {n: scope.get(n) for n in mutable_in}
-        const_state = {n: scope.get(n) for n in const_in}
-        feeds = {n: jax.device_put(a, feed_shardings[n])
-                 for n, a in feed_arrays.items()}
-        seed = executor._next_seed(program)
-        fetches, new_state = fn(mutable_state, const_state, feeds, seed)
-        for name, val in new_state.items():
-            scope.set(name, val)
-        if return_numpy:
-            return [np.asarray(f) for f in fetches]
-        return list(fetches)
+        with timed("host_feed_ms"):
+            feeds = {n: jax.device_put(a, entry.feed_shardings[n])
+                     for n, a in feed_arrays.items()}
+        fetches = executor._dispatch(entry, scope, feeds)
+        return executor._finish(fetches, entry, return_numpy)
 
     def _has_collective_ops(self, program) -> bool:
         for op in program.global_block().ops:
@@ -139,12 +153,39 @@ class CompiledProgram:
         return self._compile_spmd(executor, program, feed_arrays,
                                   fetch_names, scope)
 
+    def _make_entry(self, program, scope, fn, state_in, mutable_in,
+                    const_in, mutable_out, feed_arrays, fetch_names,
+                    check_nan, check_names_box, feed_shardings,
+                    const_shardings):
+        from ..fluid.executor import _CompiledEntry
+
+        entry = _CompiledEntry()
+        entry.program = program
+        entry.scope = scope
+        entry.fn = fn
+        entry.state_in_names = state_in
+        entry.mutable_in_names = mutable_in
+        entry.const_in_names = const_in
+        entry.mutable_out_names = mutable_out
+        entry.feed_names = sorted(feed_arrays)
+        entry.fetch_names = list(fetch_names)
+        entry.check_nan = check_nan
+        entry.check_names = check_names_box
+        entry.const_src = {}
+        entry.const_dev = {}
+        entry.feed_shardings = feed_shardings
+        entry.const_shardings = const_shardings
+        entry.dispatched = False
+        return entry
+
     def _compile_spmd(self, executor, program, feed_arrays, fetch_names,
                       scope):
-        from ..fluid.executor import _analyze_block
+        from ..fluid.executor import _analyze_block, _nan_flags
+        from ..fluid.flags import flag
         from ..ops import registry
 
         mesh = self._mesh
+        check_nan = bool(flag("check_nan_inf"))
         block = program.global_block()
         reads, persistable_writes = _analyze_block(block, feed_arrays.keys(),
                                                    scope)
@@ -181,6 +222,8 @@ class CompiledProgram:
                     return NamedSharding(mesh, P(ax))
             return repl
 
+        check_names_box = []
+
         def step_fn(mutable_state, const_state, feeds, seed):
             env: Dict[str, Any] = {}
             env.update(const_state)
@@ -190,20 +233,31 @@ class CompiledProgram:
             registry.lower_block(ctx, block, env)
             fetches = [env[n] for n in fetch_names]
             new_state = {n: env[n] for n in mutable_out if n in env}
+            if check_nan:
+                names, flags = _nan_flags(fetch_names, fetches, new_state)
+                check_names_box[:] = names
+                return fetches, new_state, flags
             return fetches, new_state
 
+        out_shardings = (None, {n: state_sharding(n) for n in mutable_out})
+        if check_nan:
+            out_shardings = out_shardings + (None,)
+        const_shardings = {n: state_sharding(n) for n in const_in}
         fn = jax.jit(
             step_fn,
             in_shardings=(
                 {n: state_sharding(n) for n in mutable_in},
-                {n: state_sharding(n) for n in const_in},
+                const_shardings,
                 {n: feed_shardings[n] for n in feed_arrays},
                 None,
             ),
-            out_shardings=(None, {n: state_sharding(n) for n in mutable_out}),
+            out_shardings=out_shardings,
             donate_argnums=(0,),
         )
-        return fn, mutable_in, const_in, mutable_out, feed_shardings
+        return self._make_entry(program, scope, fn, state_in, mutable_in,
+                                const_in, mutable_out, feed_arrays,
+                                fetch_names, check_nan, check_names_box,
+                                feed_shardings, const_shardings)
 
     def _compile_shard_map(self, executor, program, feed_arrays,
                            fetch_names, scope):
@@ -214,10 +268,12 @@ class CompiledProgram:
         (paddle_tpu/ops/collective_ops.py).  This is the per-rank SPMD view
         the reference runs as N processes — here it is N mesh shards in one
         XLA program."""
-        from ..fluid.executor import _analyze_block
+        from ..fluid.executor import _analyze_block, _nan_flags
+        from ..fluid.flags import flag
         from ..ops import registry
 
         mesh = self._mesh
+        check_nan = bool(flag("check_nan_inf"))
         block = program.global_block()
         reads, persistable_writes = _analyze_block(block, feed_arrays.keys(),
                                                    scope)
@@ -245,6 +301,8 @@ class CompiledProgram:
         for ax in mesh.axis_names:
             mesh_axes[ax] = ax
 
+        check_names_box = []
+
         def per_shard(mutable_state, const_state, feeds, seed):
             env = dict(const_state)
             env.update(mutable_state)
@@ -256,21 +314,37 @@ class CompiledProgram:
             registry.lower_block(ctx, block, env)
             fetches = [env[n] for n in fetch_names]
             new_state = {n: env[n] for n in mutable_out if n in env}
+            if check_nan:
+                names, flags = _nan_flags(fetch_names, fetches, new_state)
+                check_names_box[:] = names
+                # replicate across every mesh axis so the out_spec P()
+                # contract holds: a NaN on ANY shard trips the flag
+                import jax.numpy as jnp
+
+                f32 = flags.astype(jnp.int32)
+                for ax in mesh.axis_names:
+                    f32 = jax.lax.pmax(f32, ax)
+                return fetches, new_state, f32.astype(bool)
             return fetches, new_state
 
-        import jax as _jax
-
-        sharded = _jax.shard_map(
+        out_specs = ([repl_spec for _ in fetch_names],
+                     {n: repl_spec for n in mutable_out})
+        if check_nan:
+            out_specs = out_specs + (repl_spec,)
+        sharded = _shard_map_compat(
             per_shard, mesh=mesh,
             in_specs=({n: repl_spec for n in mutable_in},
                       {n: repl_spec for n in const_in},
                       {n: feed_specs[n] for n in feed_arrays},
                       repl_spec),
-            out_specs=([repl_spec for _ in fetch_names],
-                       {n: repl_spec for n in mutable_out}),
-            check_vma=False)
-        fn = _jax.jit(sharded, donate_argnums=(0,))
+            out_specs=out_specs)
+        fn = jax.jit(sharded, donate_argnums=(0,))
 
         feed_shardings = {n: NamedSharding(mesh, feed_specs[n])
                           for n in feed_arrays}
-        return fn, mutable_in, const_in, mutable_out, feed_shardings
+        const_shardings = {n: NamedSharding(mesh, repl_spec)
+                           for n in const_in}
+        return self._make_entry(program, scope, fn, state_in, mutable_in,
+                                const_in, mutable_out, feed_arrays,
+                                fetch_names, check_nan, check_names_box,
+                                feed_shardings, const_shardings)

@@ -184,6 +184,13 @@ def export_chrome_tracing(path):
 _STATS: dict = {}
 _STATS_LOCK = threading.Lock()
 
+# float accumulators for the executor hot-path pipeline stages
+# (host_feed_ms / dispatch_ms / sync_ms): the async dispatch-ahead loop
+# reports where host wall time goes per step, and `executor_sync_count`
+# (a _STATS int) counts every device->host materialization so tests can
+# assert a loop performed ZERO per-step transfers
+_TIMES: dict = {}
+
 
 def stat_add(name: str, value: int = 1) -> None:
     """STAT_ADD equivalent: bump a named global counter."""
@@ -209,3 +216,47 @@ def get_int_stats() -> dict:
     """Snapshot of every counter (reference core.get_int_stats)."""
     with _STATS_LOCK:
         return dict(_STATS)
+
+
+# ---------------------------------------------------------------------------
+# Hot-path pipeline timers (ISSUE 1): millisecond accumulators for the
+# async Executor loop's stages, separate from the RecordEvent table so
+# they cost one lock + one float add per step even when profiling is off
+# ---------------------------------------------------------------------------
+
+def time_add(name: str, ms: float) -> None:
+    """Accumulate `ms` milliseconds on a named pipeline stage
+    (host_feed_ms / dispatch_ms / sync_ms)."""
+    with _STATS_LOCK:
+        _TIMES[name] = _TIMES.get(name, 0.0) + float(ms)
+
+
+def time_reset(name: str = None) -> None:
+    with _STATS_LOCK:
+        if name is None:
+            _TIMES.clear()
+        else:
+            _TIMES.pop(name, None)
+
+
+def get_time_stats() -> dict:
+    """Snapshot of the pipeline stage accumulators, in milliseconds."""
+    with _STATS_LOCK:
+        return dict(_TIMES)
+
+
+@contextlib.contextmanager
+def timed(name: str):
+    """Accumulate the with-block's wall time onto `name` (ms)."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        time_add(name, (time.perf_counter() - t0) * 1e3)
+
+
+def count_sync(n: int = 1) -> None:
+    """Record a device->host materialization on the executor hot path.
+    Every sanctioned sync point calls this; the async-loop test asserts
+    the counter stays flat across steps."""
+    stat_add("executor_sync_count", n)

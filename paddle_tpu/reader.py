@@ -18,8 +18,9 @@ import queue
 import random
 import threading
 
-__all__ = ["cache", "map_readers", "buffered", "compose", "chain",
-           "shuffle", "firstn", "xmap_readers", "multiprocess_reader"]
+__all__ = ["cache", "map_readers", "buffered", "device_buffered", "compose",
+           "chain", "shuffle", "firstn", "xmap_readers",
+           "multiprocess_reader"]
 
 
 def cache(reader):
@@ -139,6 +140,25 @@ def buffered(reader, size):
             stop.set()
 
     return creator
+
+
+def device_buffered(reader, size=2):
+    """`buffered` + async device staging (the executor hot path's feed
+    stage as a reader decorator): the fill thread `jax.device_put`s each
+    sample while the consumer computes on earlier ones, so host->device
+    upload overlaps the device's compute on batch N.  Samples must be
+    arrays / (nested) tuples of arrays.  Host time spent staging is
+    accounted on the profiler's `host_feed_ms`."""
+
+    def stage(sample):
+        import jax
+
+        from .profiler import timed
+
+        with timed("host_feed_ms"):
+            return jax.tree_util.tree_map(jax.device_put, sample)
+
+    return buffered(map_readers(stage, reader), size)
 
 
 def firstn(reader, n):

