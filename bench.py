@@ -492,12 +492,108 @@ def bench_resnet50(jax, jnp, on_tpu, batch=None):
     }
 
 
+SERVING_TARGET_P99_MS = 50.0  # north-star interactive-serving budget
+
+
+def bench_serving(jax, jnp, on_tpu):
+    """Continuous-batching serving scenario (ISSUE 2 satellite): mixed
+    batch-size requests from concurrent clients through the
+    paddle_tpu.serving Engine; emits p50/p99 request latency and batch
+    occupancy in the BENCH JSON detail."""
+    import threading
+
+    from paddle_tpu import profiler
+    from paddle_tpu import serving
+    from paddle_tpu.serving import metrics as smetrics
+
+    d_in, d_h = (1024, 4096) if on_tpu else (64, 256)
+    rng = np.random.RandomState(0)
+    w1 = jnp.asarray(rng.randn(d_in, d_h).astype(np.float32)
+                     / np.sqrt(d_in))
+    w2 = jnp.asarray(rng.randn(d_h, d_in).astype(np.float32)
+                     / np.sqrt(d_h))
+
+    def model(x):
+        return jnp.tanh(x @ w1) @ w2
+
+    cfg = serving.EngineConfig(max_batch_size=16, max_queue_delay_ms=1.0,
+                               max_queue=512, max_in_flight=2)
+    clients, per_client = 4, 64
+    eng = serving.Engine(model, cfg)
+    try:
+        # warm every bucket so the timed window measures dispatch, not
+        # compilation (compiles are counted separately in the detail)
+        for b in cfg.buckets:
+            eng.infer([np.zeros((b, d_in), np.float32)], timeout=120)
+        smetrics.reset_latency("serving_request_ms")
+        smetrics.reset_occupancy()
+        s0 = profiler.get_int_stats()
+
+        def client(seed):
+            r = np.random.RandomState(seed)
+            for _ in range(per_client):
+                rows = int(r.randint(1, cfg.max_batch_size + 1))
+                x = r.randn(rows, d_in).astype(np.float32)
+                eng.infer([x], timeout=120)
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        lat = smetrics.latency_stats("serving_request_ms") or {}
+        s1 = profiler.get_int_stats()
+
+        def delta(name):
+            return s1.get(name, 0) - s0.get(name, 0)
+
+        batches = max(1, delta("serving_batches_total"))
+        n_req = clients * per_client
+        p99 = lat.get("p99_ms", 0.0)
+        detail = {
+            "backend": "tpu" if on_tpu else "cpu",
+            "clients": clients,
+            "requests": n_req,
+            "throughput_rps": round(n_req / wall, 1),
+            "p50_ms": round(lat.get("p50_ms", 0.0), 3),
+            "p99_ms": round(p99, 3),
+            "mean_ms": round(lat.get("mean_ms", 0.0), 3),
+            "batches": batches,
+            "occupancy_mean": round(
+                delta("serving_batch_requests_total") / batches, 2),
+            "occupancy_max": s1.get("serving_batch_occupancy_max", 0),
+            "pad_rows": delta("serving_pad_rows_total"),
+            "rejected": delta("serving_rejected_total"),
+            "trace_count": eng.model.runner.trace_count,
+            "buckets": list(cfg.buckets),
+            "feature_dim": d_in,
+        }
+        return {
+            "metric": "serving_p99_latency_ms",
+            "value": round(p99, 3),
+            "unit": "ms",
+            # latency: lower is better, so the ratio inverts
+            "vs_baseline": round(SERVING_TARGET_P99_MS / p99, 4)
+            if p99 else 0.0,
+            "detail": detail,
+        }
+    finally:
+        eng.shutdown(drain=False)
+
+
 def main():
     import argparse
 
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", choices=["bert", "resnet50", "both"],
                     default="both")
+    ap.add_argument("--mode", choices=["train", "serving"],
+                    default="train",
+                    help="train: MFU bench (default); serving: "
+                    "continuous-batching latency/occupancy bench")
     args = ap.parse_args()
 
     # decide the backend BEFORE jax loads: a wedged tunnel would block
@@ -510,9 +606,13 @@ def main():
     _enable_compile_cache(jax, backend)
     import jax.numpy as jnp
 
-    from paddle_tpu.models import bert
-
     on_tpu = backend == "tpu"
+
+    if args.mode == "serving":
+        print(json.dumps(bench_serving(jax, jnp, on_tpu)))
+        return
+
+    from paddle_tpu.models import bert
 
     if args.model == "resnet50":
         # standalone ResNet line (driver: `python bench.py --model

@@ -41,6 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import core
+from .compile_cache import CompileCache
 from .framework import (EMPTY_VAR_NAME, Program, Variable,
                         default_main_program)
 
@@ -484,10 +485,12 @@ class Executor:
 
     def __init__(self, place=None):
         self.place = place
-        self._cache: "collections.OrderedDict[tuple, _CompiledEntry]" = \
-            collections.OrderedDict()
-        self._feed_cache: "collections.OrderedDict[tuple, Any]" = \
-            collections.OrderedDict()
+        # shared bounded-LRU machinery (fluid/compile_cache.py), the
+        # same class backing CompiledProgram and the serving engine's
+        # bucketed entry cache
+        self._cache: CompileCache = CompileCache(self.CACHE_CAPACITY)
+        self._feed_cache: CompileCache = CompileCache(
+            self.FEED_CACHE_CAPACITY)
         self._nan_monitor = _NanMonitor()
         self._step = 0
 
@@ -627,15 +630,12 @@ class Executor:
         key = (hashlib.sha1(buf).hexdigest(), arr.shape, str(arr.dtype))
         hit = self._feed_cache.get(key)
         if hit is not None:
-            self._feed_cache.move_to_end(key)
             from ..profiler import stat_add
 
             stat_add("feed_cache_hits")
             return hit
         dev = jax.device_put(buf)
-        self._feed_cache[key] = dev
-        while len(self._feed_cache) > self.FEED_CACHE_CAPACITY:
-            self._feed_cache.popitem(last=False)
+        self._feed_cache.put(key, dev)
         return dev
 
     def _normalize_feed(self, program, feed, stage=True) -> Dict[str, Any]:
@@ -731,7 +731,6 @@ class Executor:
         key = self._cache_key(program, feed_arrays, fetch_names, scope)
         entry = self._cache.get(key)
         if entry is not None:
-            self._cache.move_to_end(key)
             return entry
         from ..profiler import stat_add
         stat_add("executor_compile_count")
@@ -791,9 +790,7 @@ class Executor:
         entry.feed_shardings = None
         entry.const_shardings = None
         entry.dispatched = False
-        self._cache[key] = entry
-        while len(self._cache) > self.CACHE_CAPACITY:
-            self._cache.popitem(last=False)
+        self._cache.put(key, entry)
         return entry
 
     def _const_state(self, entry: _CompiledEntry, scope: Scope):

@@ -22,8 +22,18 @@ from __future__ import annotations
 
 import json
 import os
+import warnings
 
 import numpy as np
+
+_WARNED: set = set()
+
+
+def _warn_once(key: str, msg: str) -> None:
+    if key in _WARNED:
+        return
+    _WARNED.add(key)
+    warnings.warn(msg, stacklevel=3)
 
 
 def save_inference_model(path_prefix, layer, input_spec, fold_params=True,
@@ -125,6 +135,12 @@ class Config:
         self.device = None  # default jax device
         self.cipher = None
         self.cipher_key = None
+        # serving-path knobs (ISSUE 2 satellite): these used to be
+        # silently ignored; now they map onto the bucketed runner's
+        # donation / exact-shape compile options
+        self.memory_optim = False
+        self.ir_optim = True
+        self._bound_predictor = None
 
     def set_model(self, prefix):
         self.model_prefix = prefix
@@ -138,11 +154,29 @@ class Config:
         self.cipher_key = key
         self.cipher = cipher or AESCipher("CTR")
 
+    def _flag_changed(self, flag: str) -> None:
+        pred = self._bound_predictor
+        if pred is not None and pred._runner is not None:
+            _warn_once(
+                f"late:{flag}",
+                f"Config.{flag}() called after the predictor compiled "
+                f"its first entry: already-compiled bucket entries keep "
+                f"their old options; only new entries (and new Engines "
+                f"built from this predictor) pick the flag up")
+
     def enable_memory_optim(self):
-        pass  # XLA buffer assignment
+        """Donate feed buffers to XLA on the bucketed serving path, so
+        activations may reuse the feed memory in HBM (the reference's
+        memory-optim pass, re-mapped onto XLA buffer donation)."""
+        self.memory_optim = True
+        self._flag_changed("enable_memory_optim")
 
     def switch_ir_optim(self, flag=True):
-        pass  # XLA pipeline
+        """flag=False compiles exact request shapes instead of padded
+        buckets (the reference's IR-pass toggle, re-mapped onto the
+        bucketing policy; XLA's own pipeline always runs)."""
+        self.ir_optim = bool(flag)
+        self._flag_changed("switch_ir_optim")
 
 
 class Predictor:
@@ -177,21 +211,88 @@ class Predictor:
 
             self._params = pload(os.path.join(
                 os.path.dirname(prefix), self.manifest["params_file"]))
+        self._config = config
+        self._runner = None
+        config._bound_predictor = self
 
     def get_input_names(self):
         return [f"x{i}" for i in range(len(self.manifest["inputs"]))]
 
+    # -- bucketed serving path (ISSUE 2) ----------------------------------
+    def _traceable_fn(self):
+        """The exported computation as a jax-traceable callable —
+        what the serving BucketedRunner / Engine AOT-compiles per
+        bucket.  Unfolded params ride along as trace-time constants."""
+        exported, params = self._exported, self._params
+        if params is not None:
+            return lambda *xs: exported.call(params, *xs)
+        return lambda *xs: exported.call(*xs)
+
+    def _fixed_batch(self):
+        """The export's static leading dim, when every input shares one.
+
+        StableHLO artifacts are exported over concrete shapes, so the
+        batch dim is baked in: the bucketed runner must pad every
+        request UP to this value (and chunk larger ones through it) —
+        exactly one compiled entry per input signature."""
+        shapes = [i["shape"] for i in self.manifest["inputs"]]
+        if shapes and all(len(s) >= 1 for s in shapes):
+            leads = {s[0] for s in shapes}
+            if len(leads) == 1:
+                return int(leads.pop())
+        return None
+
+    def _bucketed_runner(self):
+        if self._runner is None:
+            from ..serving.bucketing import BucketedRunner, bucket_ladder
+
+            fixed = self._fixed_batch()
+            bucketed = self._config.ir_optim
+            if fixed is not None:
+                buckets = [fixed]
+                if not self._config.ir_optim:
+                    _warn_once(
+                        "ir_optim_fixed_export",
+                        "switch_ir_optim(False) requests exact-shape "
+                        "compiles, but this model was exported with a "
+                        "fixed batch dim — requests must be padded to "
+                        "it; the flag is ignored for this predictor")
+                bucketed = True
+            else:
+                buckets = bucket_ladder(8)
+            self._runner = BucketedRunner(
+                self._traceable_fn(), buckets,
+                donate=self._config.memory_optim, bucketed=bucketed)
+        return self._runner
+
+    def _normalize(self, inputs):
+        vals = []
+        for x, spec in zip(inputs, self.manifest["inputs"]):
+            a = np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+            vals.append(a.astype(spec["dtype"], copy=False))
+        return vals
+
+    def run_handles(self, inputs):
+        """ZeroCopyRun through the bucketed compile cache: -> list of
+        LazyFetch handles over DEVICE arrays (no transfer; materialize
+        at the caller's sanctioned boundary).  One compiled entry per
+        (bucket, signature) — a request batch size never seen before
+        pads onto an existing bucket instead of retracing."""
+        from ..fluid.executor import LazyFetch
+
+        vals = self._normalize(inputs)
+        if any(v.ndim == 0 for v in vals):
+            # no batch dim to bucket over: direct exported call
+            out = self._traceable_fn()(*vals)
+            outs = list(out) if isinstance(out, (list, tuple)) else [out]
+        else:
+            outs = self._bucketed_runner().run(vals)
+        return [LazyFetch(o, name=f"fetch{i}")
+                for i, o in enumerate(outs)]
+
     def run(self, inputs):
         """inputs: list of arrays in manifest order -> list of outputs."""
-        vals = [np.asarray(x.numpy() if hasattr(x, "numpy") else x)
-                for x in inputs]
-        if self._params is not None:
-            out = self._exported.call(self._params, *vals)
-        else:
-            out = self._exported.call(*vals)
-        if isinstance(out, (list, tuple)):
-            return [np.asarray(o) for o in out]
-        return [np.asarray(out)]
+        return [h.numpy() for h in self.run_handles(inputs)]
 
 
 def create_predictor(config):

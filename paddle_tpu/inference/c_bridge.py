@@ -28,9 +28,18 @@ def new_predictor(prefix: str) -> Predictor:
 
 
 def run_f32(pred: Predictor, addr: int, shape) -> tuple:
+    """One f32 tensor in, one f32 tensor out, zero avoidable copies.
+
+    The C buffer is viewed (not copied — `device_put` inside the
+    predictor's bucketed dispatch is the one host read, and it happens
+    before this function returns, while the caller's buffer is alive).
+    The output rides a LazyFetch handle end to end and materializes
+    exactly once, here at the ABI boundary — the same sanctioned-sync
+    contract as the training hot path (docs/async_hot_path.md)."""
     n = int(np.prod(shape))
     buf = (ctypes.c_float * n).from_address(int(addr))
-    x = np.ctypeslib.as_array(buf).reshape([int(s) for s in shape]).copy()
-    outs = pred.run([x])
-    out = np.ascontiguousarray(np.asarray(outs[0]), dtype=np.float32)
+    x = np.ctypeslib.as_array(buf).reshape([int(s) for s in shape])
+    handle = pred.run_handles([x])[0]
+    out = np.ascontiguousarray(
+        handle.numpy(), dtype=np.float32)  # sync-ok: ABI boundary
     return out.tobytes(), [int(s) for s in out.shape]

@@ -887,6 +887,41 @@ def _seed_from_key(key):
     return lax.bitcast_convert_type(folded, jnp.int32).reshape((1,))
 
 
+def paged_attention(q, k_pages, v_pages, page_rows, lengths, scale=None):
+    """Attention over PAGED keys/values (serving decode path).
+
+    q: (B, T, H, D) — the T newest query positions per sequence
+    (decode: T == 1); k_pages/v_pages: (P, S, H, D) device-resident
+    page pools (serving/kv_cache.py); page_rows: (B, max_pages) int32
+    page ids per sequence (unused entries -> scratch page 0);
+    lengths: (B,) int32 — valid key count per sequence.
+
+    The pages are gathered into a contiguous (B, Lmax, H, D) view
+    (Lmax = max_pages * S, static) and dispatched through
+    `scaled_dot_product_attention` with an additive key-padding bias,
+    so on TPU the bias runs inside the flash kernel and the gather is
+    XLA's fused dynamic-gather.  A Mosaic kernel that consumes the
+    page table DIRECTLY (no gather materialization — *Ragged Paged
+    Attention*, arxiv 2604.15464) is the documented next step; this
+    entry point is the dispatch seam it will slot into.
+    """
+    b, t, h, d = q.shape
+    p, s = k_pages.shape[0], k_pages.shape[1]
+    max_pages = page_rows.shape[1]
+    lmax = max_pages * s
+    pos = jnp.arange(lmax, dtype=jnp.int32)
+    # flat pool index of logical position `pos` of each sequence
+    gidx = page_rows[:, pos // s] * s + pos % s          # (B, Lmax)
+    kflat = k_pages.reshape(p * s, h, d)
+    vflat = v_pages.reshape(p * s, h, d)
+    k = kflat[gidx]                                      # (B, Lmax, H, D)
+    v = vflat[gidx]
+    bias = jnp.where(pos[None, :] < lengths[:, None], 0.0,
+                     DEFAULT_MASK_VALUE).astype(jnp.float32)
+    return scaled_dot_product_attention(
+        q, k, v, mask=bias[:, None, None, :], scale=scale)
+
+
 def scaled_dot_product_attention(q, k, v, mask=None, is_causal=False,
                                  scale=None, dropout_p=0.0,
                                  dropout_key=None):

@@ -19,13 +19,13 @@ multi-ring NCCL + fused-allreduce passes.
 
 from __future__ import annotations
 
-import collections
 from typing import Any, Dict, Optional
 
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from . import mesh as mesh_lib
+from ..fluid.compile_cache import CompileCache
 
 
 def _shard_map_compat(fn, mesh, in_specs, out_specs):
@@ -81,8 +81,9 @@ class CompiledProgram:
         self._loss_name = None
         self._mesh = None
         self._is_data_parallel = False
-        self._cache: "collections.OrderedDict[tuple, Any]" = \
-            collections.OrderedDict()
+        # shared bounded-LRU machinery (fluid/compile_cache.py) — the
+        # same class backing Executor._cache and the serving engine
+        self._cache: CompileCache = CompileCache(self.CACHE_CAPACITY)
 
     @property
     def program(self):
@@ -126,11 +127,7 @@ class CompiledProgram:
         if entry is None:
             entry = self._compile(executor, program, feed_arrays,
                                   fetch_names, scope)
-            self._cache[key] = entry
-            while len(self._cache) > self.CACHE_CAPACITY:
-                self._cache.popitem(last=False)
-        else:
-            self._cache.move_to_end(key)
+            self._cache.put(key, entry)
 
         with timed("host_feed_ms"):
             feeds = {n: jax.device_put(a, entry.feed_shardings[n])

@@ -1,0 +1,58 @@
+"""paddle_tpu.serving — continuous-batching TPU inference engine.
+
+The serving half of the ROADMAP north star ("serves heavy traffic from
+millions of users"), built on the same discipline as the training hot
+path (docs/async_hot_path.md): one lowered XLA computation per bucket,
+device-resident state between dispatches, and a host that never blocks
+the device.
+
+    from paddle_tpu import serving
+
+    engine = serving.Engine(predictor)           # or any traceable fn
+    resp = engine.submit([x])                    # bounded admission
+    y = resp.result(timeout=5.0)                 # sanctioned sync point
+
+Pipeline: submit() -> DynamicBatcher (coalesce by signature, bounded
+queue, EngineOverloaded at the bound) -> dispatch loop (compiled
+buckets only; cold buckets park with the off-path compiler thread) ->
+completer (the ONE device->host boundary).  `AutoregressiveEngine` adds
+the prefill/decode split over paged device-resident KV state
+(kv_cache.PageTable fronting ops/pallas/attention.paged_attention).
+
+See docs/serving.md for the architecture, bucketing policy, KV paging,
+backpressure contract, and the profiler stat names.
+"""
+
+from .admission import (AdmissionController, EngineClosed,
+                        EngineOverloaded, RequestCancelled)
+from .batcher import DynamicBatcher, Request, Response
+from .bucketing import (BucketedRunner, bucket_for, bucket_ladder,
+                        input_signature, pad_batch)
+from .engine import (AutoregressiveEngine, Engine, EngineConfig,
+                     ProgramModel)
+from .kv_cache import PagedKVCache, PageTable
+from .metrics import latency_stats, mean_occupancy, reset_latency
+
+__all__ = [
+    "AdmissionController",
+    "AutoregressiveEngine",
+    "BucketedRunner",
+    "DynamicBatcher",
+    "Engine",
+    "EngineClosed",
+    "EngineConfig",
+    "EngineOverloaded",
+    "PagedKVCache",
+    "PageTable",
+    "ProgramModel",
+    "Request",
+    "RequestCancelled",
+    "Response",
+    "bucket_for",
+    "bucket_ladder",
+    "input_signature",
+    "latency_stats",
+    "mean_occupancy",
+    "pad_batch",
+    "reset_latency",
+]

@@ -1,0 +1,847 @@
+"""The serving Engine: request queue -> dynamic batcher -> dispatch loop.
+
+Continuous-batching inference over one loaded model (ISSUE 2 tentpole).
+The pipeline mirrors the training hot path's discipline
+(docs/async_hot_path.md), applied to serving:
+
+    submit()            bounded admission (EngineOverloaded at the bound)
+      -> DynamicBatcher coalesce by signature, max_queue_delay_ms
+      -> _dispatch_loop pull batch; compiled bucket? dispatch : park
+      -> _compiler_loop off-path compile of new buckets (request parked,
+                        the dispatch loop keeps serving hot buckets)
+      -> _dispatch_batch pad to bucket, async dispatch, >= 2 batches
+                        in flight (max_in_flight)
+      -> _completer_loop the ONE sanctioned device->host boundary:
+                        materialize, slice per request, fulfill futures
+
+The dispatch loop never blocks on the device and never compiles: both
+would stall every queued request behind one cold bucket.  Models:
+
+  * a `paddle_tpu.inference.Predictor` (StableHLO artifact) — its
+    exported computation is traced into bucketed AOT entries;
+  * any jax-traceable callable `fn(*inputs) -> outputs`;
+  * a `ProgramModel` wrapping an Executor + Program/CompiledProgram —
+    compile caching rides the shared CompileCache machinery inside the
+    executor (fluid/compile_cache.py).
+
+`AutoregressiveEngine` below is the decode half: prefill/decode split
+with per-request KV state held device-resident in fixed-size pages
+(serving/kv_cache.py) and a fused decode step — zero device->host
+transfers per generated token.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+from . import metrics
+from .admission import (AdmissionController, EngineClosed,
+                        EngineOverloaded, RequestCancelled)
+from .batcher import DynamicBatcher, Request, Response
+from .bucketing import (BucketedRunner, bucket_for, bucket_ladder,
+                        input_signature, pad_batch)
+
+_SENTINEL = object()
+
+
+class EngineConfig:
+    """Knobs for the continuous-batching engine.
+
+    max_batch_size     rows coalesced into one dispatch
+    max_queue_delay_ms wait for co-batchable requests after the first
+                       (0 = zero-timeout drain: take what's queued)
+    max_queue          bounded admission (EngineOverloaded beyond it)
+    max_in_flight      batches dispatched but not yet completed; >= 2
+                       keeps the device fed while the host slices
+                       responses (PR 1's dispatch-ahead, serving form)
+    buckets            compiled batch-shape ladder; default: power-of-2
+                       ladder over [min_bucket, max_batch_size]
+    donate             donate feed buffers to XLA
+                       (inference Config.enable_memory_optim)
+    bucketed           False = exact-shape compiles, no padding
+                       (inference Config.switch_ir_optim(False))
+    """
+
+    def __init__(self, max_batch_size: int = 8,
+                 max_queue_delay_ms: float = 2.0, max_queue: int = 64,
+                 max_in_flight: int = 2,
+                 buckets: Optional[Sequence[int]] = None,
+                 min_bucket: int = 8, donate: bool = False,
+                 bucketed: bool = True):
+        self.max_batch_size = int(max_batch_size)
+        self.max_queue_delay_ms = float(max_queue_delay_ms)
+        self.max_queue = int(max_queue)
+        self.max_in_flight = max(1, int(max_in_flight))
+        self.buckets = list(buckets) if buckets else bucket_ladder(
+            self.max_batch_size, min_bucket=min_bucket)
+        self.donate = bool(donate)
+        self.bucketed = bool(bucketed)
+
+
+class _RunnerModel:
+    """BucketedRunner-backed model (callables and Predictors)."""
+
+    def __init__(self, runner: BucketedRunner):
+        self.runner = runner
+        self.buckets = runner.buckets
+
+    def plan(self, inputs):
+        return self.runner.plan(inputs)
+
+    def is_compiled(self, inputs) -> bool:
+        return self.runner.is_compiled(inputs)
+
+    def ensure_compiled(self, inputs) -> None:
+        self.runner.ensure_compiled(inputs)
+
+    def run(self, inputs):
+        return self.runner.run(inputs)
+
+
+class ProgramModel:
+    """Engine model over an Executor + Program/CompiledProgram.
+
+    The executor's own shared-LRU compile cache
+    (fluid/compile_cache.py) is the entry store; bucketing here just
+    pins the feed signatures to the ladder so that cache sees at most
+    `len(buckets)` signatures.  First dispatch of a bucket compiles
+    inline in whichever engine thread runs it — the engine routes
+    unseen buckets through the compiler thread, so that inline compile
+    happens off the dispatch loop with the batch parked."""
+
+    def __init__(self, executor, program, feed_names: Sequence[str],
+                 fetch_list: Sequence, scope=None,
+                 buckets: Optional[Sequence[int]] = None,
+                 bucketed: bool = True):
+        self.executor = executor
+        self.program = program
+        self.feed_names = list(feed_names)
+        self.fetch_list = list(fetch_list)
+        self.scope = scope
+        self.buckets = sorted(buckets) if buckets else bucket_ladder(8)
+        self.bucketed = bucketed
+        self._seen = set()
+
+    def plan(self, inputs):
+        rows = inputs[0].shape[0]
+        if self.bucketed:
+            b = bucket_for(rows, self.buckets)
+            bucket = b if b is not None else self.buckets[-1]
+        else:
+            bucket = rows
+        return bucket, input_signature(inputs)
+
+    def is_compiled(self, inputs) -> bool:
+        return self.plan(inputs) in self._seen
+
+    def ensure_compiled(self, inputs) -> None:
+        pass  # compile happens inside run(); see class docstring
+
+    def run(self, inputs):
+        rows = inputs[0].shape[0]
+        top = self.buckets[-1]
+        if self.bucketed and rows > top:
+            import jax.numpy as jnp
+
+            parts = [self.run([a[lo:min(lo + top, rows)] for a in inputs])
+                     for lo in range(0, rows, top)]
+            return [jnp.concatenate(vals, axis=0)
+                    for vals in zip(*parts)]
+        bucket, sig = self.plan(inputs)
+        padded = [pad_batch(a, bucket) for a in inputs]
+        handles = self.executor.run(
+            self.program, feed=dict(zip(self.feed_names, padded)),
+            fetch_list=self.fetch_list, scope=self.scope,
+            return_numpy=False)
+        self._seen.add((bucket, sig))
+        return [h.jax()[:rows] for h in handles]
+
+
+def _as_model(model, config: EngineConfig):
+    if isinstance(model, (_RunnerModel, ProgramModel)):
+        return model
+    if hasattr(model, "_traceable_fn"):  # inference.Predictor
+        fn = model._traceable_fn()
+        fixed = model._fixed_batch()
+        buckets = [fixed] if fixed is not None else config.buckets
+        # the predictor's inference.Config flags map onto the runner
+        # options (ISSUE 2 satellite): enable_memory_optim -> donation,
+        # switch_ir_optim(False) -> exact-shape compiles
+        pcfg = getattr(model, "_config", None)
+        donate = config.donate or bool(getattr(pcfg, "memory_optim",
+                                               False))
+        bucketed = config.bucketed and bool(getattr(pcfg, "ir_optim",
+                                                    True))
+        return _RunnerModel(BucketedRunner(
+            fn, buckets, donate=donate,
+            bucketed=bucketed if fixed is None else True))
+    if callable(model):
+        return _RunnerModel(BucketedRunner(
+            model, config.buckets, donate=config.donate,
+            bucketed=config.bucketed))
+    raise TypeError(
+        f"Engine model must be a Predictor, a jax-traceable callable, "
+        f"or a ProgramModel; got {type(model).__name__}")
+
+
+class Engine:
+    """Continuous-batching inference engine over one loaded model."""
+
+    def __init__(self, model, config: Optional[EngineConfig] = None,
+                 start: bool = True):
+        self.config = config or EngineConfig()
+        self.model = _as_model(model, self.config)
+        self._batcher = DynamicBatcher(
+            max_batch_size=self.config.max_batch_size,
+            max_queue_delay_ms=self.config.max_queue_delay_ms,
+            max_queue=self.config.max_queue)
+        self._inflight: deque = deque()
+        self._inflight_cond = threading.Condition()
+        self._compile_q: _queue.Queue = _queue.Queue()
+        self._compiling = 0
+        self._stop = threading.Event()
+        self._closed = False
+        self._threads: List[threading.Thread] = []
+        self._started = False
+        if start:
+            self.start()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "Engine":
+        if self._started:
+            return self
+        self._started = True
+        for name, target in (("serving-dispatch", self._dispatch_loop),
+                             ("serving-compile", self._compiler_loop),
+                             ("serving-complete", self._completer_loop)):
+            t = threading.Thread(target=target, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def shutdown(self, drain: bool = True,
+                 timeout: Optional[float] = 30.0) -> None:
+        """Stop accepting work; `drain=True` completes everything
+        already admitted (queued AND in flight) before stopping,
+        `drain=False` cancels what is still queued."""
+        self._closed = True
+        self._batcher.close()
+        if not drain:
+            self._batcher.drain_cancel()
+        if self._started:
+            deadline = None if timeout is None \
+                else time.perf_counter() + timeout
+            while (self._batcher.depth or self._batcher.handed
+                   or self._compiling or len(self._inflight)):
+                if deadline is not None \
+                        and time.perf_counter() > deadline:
+                    break
+                time.sleep(0.002)
+        self._stop.set()
+        self._compile_q.put(_SENTINEL)
+        with self._inflight_cond:
+            self._inflight_cond.notify_all()
+        for t in self._threads:
+            t.join(timeout=5)
+        # anything still unanswered (no-drain shutdown, stuck device)
+        # must not hang its caller forever
+        for item in list(self._inflight):
+            for req in item[0]:
+                req.set_exception(EngineClosed("engine shut down with "
+                                               "request in flight"))
+
+    def __enter__(self) -> "Engine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown(drain=True)
+
+    # -- client surface ----------------------------------------------------
+    def submit(self, inputs: Sequence[Any]) -> Response:
+        """Queue one request (inputs share a leading batch dim).
+        Raises EngineOverloaded at the queue bound, EngineClosed after
+        shutdown."""
+        if self._closed:
+            raise EngineClosed("engine is shut down")
+        arrays = []
+        for a in inputs:
+            a = a if isinstance(a, np.ndarray) else np.asarray(a)
+            if a.ndim == 0:
+                raise ValueError(
+                    "engine inputs need a leading batch dim (got a "
+                    "scalar); wrap single examples as shape (1, ...)")
+            arrays.append(a)
+        return self._batcher.submit(Request(arrays))
+
+    def infer(self, inputs: Sequence[Any],
+              timeout: Optional[float] = None) -> List[np.ndarray]:
+        """Synchronous convenience: submit + wait."""
+        return self.submit(inputs).result(timeout)
+
+    # -- pipeline threads --------------------------------------------------
+    def _dispatch_loop(self):
+        """Hot path: pull coalesced batches and dispatch the compiled
+        ones; park batches whose bucket entry does not exist yet with
+        the compiler thread.  Never compiles, never blocks on the
+        device, never transfers."""
+        while not self._stop.is_set():
+            batch = self._batcher.next_batch(timeout=0.05)
+            if batch is None:
+                continue
+            try:
+                batch = [r for r in batch if not r.cancelled]
+                if not batch:
+                    continue
+                inputs = self._concat(batch)
+                if self.model.is_compiled(inputs):
+                    self._dispatch_batch(batch, inputs)
+                else:
+                    with self._inflight_cond:
+                        self._compiling += 1
+                    self._compile_q.put((batch, inputs))
+            finally:
+                # registered (in flight / parked / discarded): the
+                # shutdown drain check may stop counting it as handed
+                self._batcher.hand_done()
+
+    def _compiler_loop(self):
+        """Off-path compilation: build the bucket entry with the batch
+        parked, then dispatch it.  The dispatch loop keeps serving
+        already-compiled buckets meanwhile."""
+        while True:
+            item = self._compile_q.get()
+            if item is _SENTINEL:
+                return
+            batch, inputs = item
+            try:
+                self.model.ensure_compiled(inputs)
+                self._dispatch_batch(batch, inputs)
+            except BaseException as e:  # noqa: BLE001 - fail the batch
+                for req in batch:
+                    req.set_exception(e)
+            finally:
+                with self._inflight_cond:
+                    self._compiling -= 1
+                    self._inflight_cond.notify_all()
+
+    def _concat(self, batch: List[Request]) -> List[np.ndarray]:
+        if len(batch) == 1:
+            return batch[0].inputs
+        return [np.concatenate([r.inputs[i] for r in batch], axis=0)
+                for i in range(len(batch[0].inputs))]
+
+    def _dispatch_batch(self, batch: List[Request], inputs) -> None:
+        """Dispatch one batch asynchronously; bounded dispatch-ahead:
+        at most max_in_flight batches between here and the completer."""
+        from ..profiler import stat_set, timed
+
+        with self._inflight_cond:
+            while (len(self._inflight) >= self.config.max_in_flight
+                   and not self._stop.is_set()):
+                self._inflight_cond.wait(0.05)
+            if self._stop.is_set() and self._closed:
+                for req in batch:
+                    req.set_exception(
+                        EngineClosed("engine stopped before dispatch"))
+                return
+        rows = inputs[0].shape[0]
+        bucket, _sig = self.model.plan(inputs)
+        with timed("serving_dispatch_ms"):
+            outs = self.model.run(inputs)  # async: device arrays out
+        metrics.observe_batch(len(batch), rows,
+                              max(0, bucket - rows))
+        with self._inflight_cond:
+            self._inflight.append((batch, outs))
+            stat_set("serving_in_flight", len(self._inflight))
+            self._inflight_cond.notify_all()
+
+    def _completer_loop(self):
+        """The sanctioned device->host boundary: materialize the oldest
+        in-flight batch, slice per request, fulfill futures."""
+        from ..profiler import count_sync, stat_add, stat_set, timed
+
+        while True:
+            with self._inflight_cond:
+                while not self._inflight and not self._stop.is_set():
+                    self._inflight_cond.wait(0.05)
+                if not self._inflight:
+                    if self._stop.is_set():
+                        return
+                    continue
+                batch, outs = self._inflight.popleft()
+                stat_set("serving_in_flight", len(self._inflight))
+                self._inflight_cond.notify_all()
+            try:
+                with timed("serving_response_ms"):
+                    count_sync(len(outs))
+                    host = [np.asarray(o) for o in outs]  # sync-ok: response boundary
+            except BaseException as e:  # noqa: BLE001
+                for req in batch:
+                    req.set_exception(e)
+                continue
+            total = sum(r.rows for r in batch)
+            offset = 0
+            now = time.perf_counter()
+            for req in batch:
+                sl = [h[offset:offset + req.rows]
+                      if h.ndim >= 1 and h.shape[0] == total else h
+                      for h in host]
+                offset += req.rows
+                req.set_result(sl)
+                stat_add("serving_completed_total")
+                metrics.record_latency(
+                    "serving_request_ms",
+                    (now - req.submitted_at) * 1e3)
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def queue_depth(self) -> int:
+        return self._batcher.depth
+
+    @property
+    def in_flight(self) -> int:
+        with self._inflight_cond:
+            return len(self._inflight)
+
+
+# ---------------------------------------------------------------------------
+# Autoregressive decode: prefill/decode split over paged KV state
+# ---------------------------------------------------------------------------
+
+class _GenRequest:
+    """One generation request: prompt -> up to max_new_tokens."""
+
+    def __init__(self, prompt: np.ndarray, max_new_tokens: int):
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new_tokens = int(max_new_tokens)
+        self.submitted_at = time.perf_counter()
+        self._event = threading.Event()
+        self._result: Optional[np.ndarray] = None
+        self._exc: Optional[BaseException] = None
+        self._cancelled = False
+
+    def cancel(self) -> bool:
+        if self._event.is_set():
+            return False
+        self._cancelled = True
+        return True
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        if not self._event.wait(timeout):
+            raise TimeoutError("generation not finished")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+    def _finish(self, tokens=None, exc=None):
+        if self._event.is_set():
+            return
+        self._result, self._exc = tokens, exc
+        self._event.set()
+
+
+class AutoregressiveEngine:
+    """Continuous-batching token generation over paged KV state.
+
+    Model contract (single attention layer; stack engines or widen the
+    contract for deep models — ROADMAP open item):
+
+        qkv_fn(tokens, positions) -> (q, k, v)   # (B, T) -> (B, T, H, D)
+        out_fn(attn)              -> logits      # (B, T, H, D) -> (B, T, V)
+
+    Slots: `max_slots` sequences decode together in ONE fused jitted
+    step (greedy argmax), each reading/writing its own KV pages; free
+    slots ride along masked.  Page allocation is all-at-admission
+    (prompt + max_new_tokens), so a request either decodes to
+    completion or is never admitted — no mid-stream OOM; lazy page
+    growth is the documented next step.  Host bookkeeping mirrors
+    lengths exactly, so the decode loop performs ZERO device->host
+    transfers; tokens materialize once, at retirement.
+    """
+
+    def __init__(self, qkv_fn: Callable, out_fn: Callable,
+                 num_heads: int, head_dim: int, *, num_pages: int = 64,
+                 page_size: int = 16, max_slots: int = 4,
+                 max_pages_per_seq: int = 8, max_queue: int = 16,
+                 prompt_buckets: Sequence[int] = (16, 32, 64),
+                 dtype=None):
+        import jax.numpy as jnp
+
+        from ..fluid.compile_cache import CompileCache
+        from .kv_cache import PagedKVCache
+
+        self.qkv_fn, self.out_fn = qkv_fn, out_fn
+        self.max_slots = int(max_slots)
+        self.max_pages_per_seq = int(max_pages_per_seq)
+        self.prompt_buckets = sorted(prompt_buckets)
+        self.kv = PagedKVCache(num_pages, page_size, num_heads,
+                               head_dim, dtype=dtype)
+        self._admission = AdmissionController(
+            max_queue, resource="queue",
+            gauge_stat="serving_queue_depth")
+        self._pending: deque = deque()
+        self._lock = threading.Lock()
+        self._admitting = 0
+        self._closed = False
+        s, w = self.max_slots, self.max_pages_per_seq
+        self._state = {
+            "kc": self.kv.k, "vc": self.kv.v,
+            "page_rows": jnp.zeros((s, w), jnp.int32),
+            "lengths": jnp.zeros((s,), jnp.int32),
+            "last_tok": jnp.zeros((s,), jnp.int32),
+            "gen_counts": jnp.zeros((s,), jnp.int32),
+            "active": jnp.zeros((s,), bool),
+        }
+        self._out_tokens_cap = 0
+        self._slots: List[Optional[_GenRequest]] = [None] * s
+        self._slot_gen: List[int] = [0] * s
+        self._slot_len: List[int] = [0] * s
+        self._prefill_cache = CompileCache(16, stat_prefix="serving")
+        self._decode_step = None
+        self._serve_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- client surface ----------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int = 16) -> _GenRequest:
+        if self._closed:
+            raise EngineClosed("engine is shut down")
+        req = _GenRequest(prompt, max_new_tokens)
+        total = len(req.prompt) + req.max_new_tokens - 1
+        if self.kv.table.pages_needed(total) > self.max_pages_per_seq:
+            raise EngineOverloaded(
+                "kv_pages", self.kv.table.pages_needed(total),
+                self.max_pages_per_seq,
+                detail="request exceeds max_pages_per_seq")
+        self._admission.admit()  # EngineOverloaded at the queue bound
+        from ..profiler import stat_add
+
+        stat_add("serving_requests_total")
+        with self._lock:
+            self._pending.append(req)
+        return req
+
+    def generate(self, prompt, max_new_tokens: int = 16,
+                 timeout: Optional[float] = None) -> np.ndarray:
+        """Synchronous convenience: submit + step to completion."""
+        req = self.submit(prompt, max_new_tokens)
+        if self._serve_thread is None:
+            deadline = None if timeout is None \
+                else time.perf_counter() + timeout
+            while not req.done():
+                self.step()
+                if deadline is not None \
+                        and time.perf_counter() > deadline:
+                    raise TimeoutError("generation not finished")
+        return req.result(timeout)
+
+    # -- engine loop -------------------------------------------------------
+    def step(self) -> bool:
+        """One engine iteration: admit -> decode -> retire.  Returns
+        True while there is (or may be) work left."""
+        self._admit()
+        if any(s is not None for s in self._slots):
+            self._decode()
+        self._retire()
+        with self._lock:
+            return bool(self._pending) or bool(self._admitting) \
+                or any(s is not None for s in self._slots)
+
+    def run_until_idle(self, max_steps: int = 10_000) -> None:
+        for _ in range(max_steps):
+            if not self.step():
+                return
+        raise RuntimeError("run_until_idle: still busy after "
+                           f"{max_steps} steps")
+
+    def start(self) -> "AutoregressiveEngine":
+        """Background serve loop (bench/daemon mode); tests drive
+        step() directly for determinism."""
+        if self._serve_thread is not None:
+            return self
+
+        def loop():
+            while not self._stop.is_set():
+                if not self.step():
+                    time.sleep(0.001)
+
+        self._serve_thread = threading.Thread(
+            target=loop, name="serving-decode", daemon=True)
+        self._serve_thread.start()
+        return self
+
+    def shutdown(self, drain: bool = True,
+                 timeout: Optional[float] = 30.0) -> None:
+        self._closed = True
+        deadline = None if timeout is None \
+            else time.perf_counter() + timeout
+        if drain and self._serve_thread is not None:
+            while True:
+                with self._lock:
+                    busy = bool(self._pending) or bool(self._admitting) \
+                        or any(s is not None for s in self._slots)
+                if not busy or (deadline is not None
+                                and time.perf_counter() > deadline):
+                    break
+                time.sleep(0.002)
+        elif drain:
+            self.run_until_idle()
+        self._stop.set()
+        if self._serve_thread is not None:
+            self._serve_thread.join(timeout=5)
+            self._serve_thread = None
+        with self._lock:
+            pending = list(self._pending)
+            self._pending.clear()
+        for req in pending:
+            self._admission.release()
+            req._finish(exc=EngineClosed("engine shut down"))
+
+    # -- internals ---------------------------------------------------------
+    def _free_slots(self) -> List[int]:
+        return [i for i, s in enumerate(self._slots) if s is None]
+
+    def _admit(self) -> None:
+        import jax.numpy as jnp
+
+        from ..profiler import stat_add
+
+        while True:
+            free = self._free_slots()
+            if not free:
+                return
+            with self._lock:
+                if not self._pending:
+                    return
+                req = self._pending[0]
+                if req._cancelled:
+                    self._pending.popleft()
+                    self._admission.release()
+                    stat_add("serving_cancelled_total")
+                    req._finish(exc=RequestCancelled("cancelled"))
+                    continue
+                total = len(req.prompt) + req.max_new_tokens - 1
+                try:
+                    pages = self.kv.table.allocate(id(req), total)
+                except EngineOverloaded:
+                    return  # pool full: stay pending, retry next step
+                self._pending.popleft()
+                self._admission.release()
+                # visible to the shutdown drain check across the
+                # pending -> slot window
+                self._admitting += 1
+            try:
+                slot = free[0]
+                rows_np = self.kv.table.rows(id(req),
+                                             self.max_pages_per_seq)
+                first_tok, k, v, bucket = self._prefill(req)
+                st = self._state
+                st["kc"], st["vc"] = self._write_prefill_entry(bucket)(
+                    st["kc"], st["vc"], rows_np,
+                    np.int32(len(req.prompt)), k, v)
+                st["page_rows"] = st["page_rows"].at[slot].set(
+                    jnp.asarray(rows_np))
+                st["lengths"] = st["lengths"].at[slot].set(
+                    len(req.prompt))
+                st["last_tok"] = st["last_tok"].at[slot].set(first_tok)
+                st["gen_counts"] = st["gen_counts"].at[slot].set(1)
+                self._ensure_token_buffer(req.max_new_tokens)
+                st["out_tokens"] = st["out_tokens"].at[slot, 0].set(
+                    first_tok)
+                st["active"] = st["active"].at[slot].set(True)
+                self._slots[slot] = req
+                self._slot_gen[slot] = 1
+                self._slot_len[slot] = len(req.prompt)
+            finally:
+                with self._lock:
+                    self._admitting -= 1
+
+    def _ensure_token_buffer(self, max_new: int) -> None:
+        import jax.numpy as jnp
+
+        if max_new <= self._out_tokens_cap:
+            return
+        cap = max(16, 1 << (max_new - 1).bit_length())
+        buf = jnp.zeros((self.max_slots, cap), jnp.int32)
+        if self._out_tokens_cap:
+            buf = buf.at[:, :self._out_tokens_cap].set(
+                self._state["out_tokens"])
+        self._state["out_tokens"] = buf
+        self._out_tokens_cap = cap
+        self._decode_step = None  # shape changed: re-stage the step
+
+    def _pad_prompt(self, req: _GenRequest):
+        t = len(req.prompt)
+        bucket = bucket_for(t, self.prompt_buckets)
+        if bucket is None:
+            bucket = 1 << (t - 1).bit_length()
+        padded = np.zeros((bucket,), np.int32)
+        padded[:t] = req.prompt
+        return padded, bucket
+
+    def _prefill_entry(self, bucket: int):
+        """Fused prefill for one prompt bucket: embed -> causal self
+        attention -> first-token logits; compiled once per bucket."""
+        import jax
+
+        def build():
+            import jax.numpy as jnp
+
+            def prefill(tokens, length):
+                from ..ops.pallas.attention import (
+                    DEFAULT_MASK_VALUE, scaled_dot_product_attention)
+
+                tb = tokens.shape[0]
+                pos = jnp.arange(tb, dtype=jnp.int32)
+                q, k, v = self.qkv_fn(tokens[None], pos[None])
+                bias = jnp.where(pos < length, 0.0,
+                                 DEFAULT_MASK_VALUE)[None]
+                attn = scaled_dot_product_attention(
+                    q, k, v, mask=bias[:, None, None, :],
+                    is_causal=True)
+                logits = self.out_fn(attn)
+                last = logits[0, length - 1]
+                return (jnp.argmax(last).astype(jnp.int32),
+                        k[0], v[0])
+
+            from ..profiler import stat_add, timed
+
+            with timed("serving_compile_ms"):
+                jitted = jax.jit(prefill).lower(
+                    jax.ShapeDtypeStruct((bucket,), np.int32),
+                    jax.ShapeDtypeStruct((), np.int32)).compile()
+            stat_add("serving_trace_count")
+            return jitted
+
+        return self._prefill_cache.get_or_build(("prefill", bucket),
+                                                build)
+
+    def _write_prefill_entry(self, bucket: int):
+        """Compiled page scatter for one prompt bucket (donates the
+        pools so the write is in-place in HBM)."""
+        import jax
+
+        def build():
+            from .kv_cache import write_prefill
+
+            from ..profiler import timed
+
+            kc = self._state["kc"]
+            with timed("serving_compile_ms"):
+                h, d = kc.shape[2], kc.shape[3]
+                return jax.jit(
+                    write_prefill, donate_argnums=(0, 1)).lower(
+                    jax.ShapeDtypeStruct(kc.shape, kc.dtype),
+                    jax.ShapeDtypeStruct(kc.shape, kc.dtype),
+                    jax.ShapeDtypeStruct((self.max_pages_per_seq,),
+                                         np.int32),
+                    jax.ShapeDtypeStruct((), np.int32),
+                    jax.ShapeDtypeStruct((bucket, h, d), kc.dtype),
+                    jax.ShapeDtypeStruct((bucket, h, d),
+                                         kc.dtype)).compile()
+
+        return self._prefill_cache.get_or_build(
+            ("write_prefill", bucket), build)
+
+    def _prefill(self, req: _GenRequest):
+        from ..profiler import stat_add, timed
+
+        padded, bucket = self._pad_prompt(req)
+        entry = self._prefill_entry(bucket)
+        with timed("serving_dispatch_ms"):
+            first_tok, k, v = entry(padded, np.int32(len(req.prompt)))
+        stat_add("serving_prefill_count")
+        return first_tok, k, v, bucket
+
+    def _decode_fn(self, state):
+        """One fused decode step over every slot (traced once)."""
+        import jax.numpy as jnp
+
+        from ..ops.pallas.attention import paged_attention
+        from .kv_cache import append_token
+
+        pos = state["lengths"]
+        q, k, v = self.qkv_fn(state["last_tok"][:, None],
+                              pos[:, None])
+        kc, vc = append_token(state["kc"], state["vc"],
+                              state["page_rows"], pos, k[:, 0],
+                              v[:, 0], state["active"])
+        attn = paged_attention(q, kc, vc, state["page_rows"],
+                               pos + 1)
+        logits = self.out_fn(attn)[:, 0]
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        sl = jnp.arange(self.max_slots)
+        gidx = jnp.minimum(state["gen_counts"],
+                           self._out_tokens_cap - 1)
+        old = state["out_tokens"][sl, gidx]
+        active = state["active"]
+        return {
+            "kc": kc, "vc": vc, "page_rows": state["page_rows"],
+            "lengths": jnp.where(active, pos + 1, pos),
+            "last_tok": jnp.where(active, nxt, state["last_tok"]),
+            "gen_counts": jnp.where(active, state["gen_counts"] + 1,
+                                    state["gen_counts"]),
+            "out_tokens": state["out_tokens"].at[sl, gidx].set(
+                jnp.where(active, nxt, old)),
+            "active": active,
+        }
+
+    def _decode(self) -> None:
+        import jax
+
+        from ..profiler import stat_add, timed
+
+        if self._decode_step is None:
+            with timed("serving_compile_ms"):
+                self._decode_step = jax.jit(self._decode_fn,
+                                            donate_argnums=(0,))
+                # stage the compile eagerly so the steady-state loop
+                # below is dispatch-only
+                self._decode_step = self._decode_step.lower(
+                    {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                     for k, v in self._state.items()}).compile()
+            stat_add("serving_trace_count")
+        with timed("serving_dispatch_ms"):
+            self._state = self._decode_step(self._state)
+        stat_add("serving_decode_steps")
+        for i, req in enumerate(self._slots):
+            if req is not None:
+                self._slot_gen[i] += 1
+                self._slot_len[i] += 1
+
+    def _retire(self) -> None:
+        from ..profiler import count_sync, stat_add, timed
+
+        for i, req in enumerate(self._slots):
+            if req is None:
+                continue
+            done = self._slot_gen[i] >= req.max_new_tokens
+            if not (done or req._cancelled):
+                continue
+            st = self._state
+            if req._cancelled:
+                stat_add("serving_cancelled_total")
+                req._finish(exc=RequestCancelled("cancelled"))
+            else:
+                with timed("serving_response_ms"):
+                    count_sync()
+                    tokens = np.asarray(  # sync-ok: response boundary
+                        st["out_tokens"][i, :self._slot_gen[i]])
+                req._finish(tokens=tokens)
+                stat_add("serving_completed_total")
+                metrics.record_latency(
+                    "serving_request_ms",
+                    (time.perf_counter() - req.submitted_at) * 1e3)
+            self.kv.table.free(id(req))
+            st["active"] = st["active"].at[i].set(False)
+            self._slots[i] = None
+            self._slot_gen[i] = 0
+            self._slot_len[i] = 0

@@ -1,0 +1,207 @@
+"""Paged device-resident KV state for autoregressive serving.
+
+Instead of one contiguous (batch, max_seq, heads, dim) rectangle per
+request — the allocation pattern that OOMs a serving host the moment
+max_seq is honest — K/V live in a single device-resident pool of
+fixed-size PAGES (vLLM's PagedAttention layout; *Ragged Paged
+Attention*, arxiv 2604.15464, is the TPU-kernel end state).  A
+host-side `PageTable` hands pages to sequences at page granularity and
+takes them back at retirement, so HBM held per request is proportional
+to its actual context length, rounded up to one page.
+
+Device-side helpers here are PURE jnp functions (no jit): the serving
+engine composes them INTO its fused prefill/decode steps
+(serving/engine.py) so one XLA computation per step covers embed +
+KV write + paged attention + logits — the paper's
+one-lowered-computation discipline applied to decode.
+
+Page 0 is reserved as a scratch page: masked lanes (inactive slots,
+padded prefill positions) redirect their writes there, which keeps the
+scatter shape static without corrupting live pages.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .admission import EngineOverloaded
+
+
+def cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+class PageTable:
+    """Host-side page allocator: seq_id -> list of device page ids.
+
+    Thread-safe; raises a typed `EngineOverloaded("kv_pages", ...)`
+    when the pool is exhausted instead of letting the device OOM."""
+
+    def __init__(self, num_pages: int, page_size: int):
+        if num_pages < 2:
+            raise ValueError("PageTable needs >= 2 pages (page 0 is "
+                             "the reserved scratch page)")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self._free: deque = deque(range(1, self.num_pages))
+        self._owned: Dict[object, List[int]] = {}
+        self._lock = threading.Lock()
+
+    def pages_needed(self, n_tokens: int) -> int:
+        return cdiv(max(1, int(n_tokens)), self.page_size)
+
+    @property
+    def capacity(self) -> int:
+        return self.num_pages - 1  # page 0 reserved
+
+    @property
+    def available(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        return self.capacity - self.available
+
+    def _publish(self) -> None:
+        from ..profiler import stat_set
+
+        stat_set("serving_kv_pages_in_use",
+                 self.capacity - len(self._free))
+
+    def allocate(self, seq_id, n_tokens: int) -> List[int]:
+        """Pages covering `n_tokens`; all-or-nothing."""
+        k = self.pages_needed(n_tokens)
+        with self._lock:
+            if seq_id in self._owned:
+                raise ValueError(f"seq {seq_id!r} already holds pages")
+            if len(self._free) < k:
+                raise EngineOverloaded(
+                    "kv_pages", self.capacity - len(self._free),
+                    self.capacity,
+                    detail=f"need {k} pages for {n_tokens} tokens")
+            pages = [self._free.popleft() for _ in range(k)]
+            self._owned[seq_id] = pages
+            self._publish()
+            return list(pages)
+
+    def extend(self, seq_id, n: int = 1) -> List[int]:
+        with self._lock:
+            owned = self._owned.get(seq_id)
+            if owned is None:
+                raise KeyError(seq_id)
+            if len(self._free) < n:
+                raise EngineOverloaded(
+                    "kv_pages", self.capacity - len(self._free),
+                    self.capacity, detail="extend")
+            pages = [self._free.popleft() for _ in range(n)]
+            owned.extend(pages)
+            self._publish()
+            return pages
+
+    def pages_of(self, seq_id) -> List[int]:
+        with self._lock:
+            return list(self._owned.get(seq_id, ()))
+
+    def free(self, seq_id) -> int:
+        """Return a sequence's pages to the pool (retirement)."""
+        with self._lock:
+            pages = self._owned.pop(seq_id, None)
+            if pages is None:
+                return 0
+            self._free.extend(pages)
+            self._publish()
+            return len(pages)
+
+    def rows(self, seq_id, width: int) -> np.ndarray:
+        """(width,) int32 page-id row for the device page table;
+        unused entries point at the scratch page 0."""
+        pages = self.pages_of(seq_id)
+        if len(pages) > width:
+            raise ValueError(
+                f"seq {seq_id!r} holds {len(pages)} pages > row width "
+                f"{width} (raise max_pages_per_seq)")
+        out = np.zeros((width,), np.int32)
+        out[:len(pages)] = pages
+        return out
+
+
+class PagedKVCache:
+    """Device-resident paged K/V pool for ONE attention layer.
+
+    k/v: (num_pages, page_size, num_heads, head_dim).  Stack one
+    instance per layer for deep models (a leading layer dim is the
+    obvious extension; the engine contract here is single-layer).
+    The arrays are plain jax device arrays — the engine threads them
+    through its donated step state, so updates are in-place in HBM."""
+
+    def __init__(self, num_pages: int, page_size: int, num_heads: int,
+                 head_dim: int, dtype=None):
+        import jax.numpy as jnp
+
+        dtype = dtype or jnp.float32
+        self.table = PageTable(num_pages, page_size)
+        shape = (num_pages, page_size, num_heads, head_dim)
+        self.k = jnp.zeros(shape, dtype)
+        self.v = jnp.zeros(shape, dtype)
+
+    @property
+    def page_size(self) -> int:
+        return self.table.page_size
+
+
+# -- device-side page ops (pure jnp; composed into the engine's jits) --------
+
+def write_prefill(kc, vc, rows, length, k, v):
+    """Scatter one sequence's prefill K/V into its pages.
+
+    kc/vc: (P, S, H, D) pools; rows: (max_pages,) int32 page ids;
+    length: scalar int32 — positions >= length (prompt padding)
+    redirect to scratch page 0; k/v: (Tb, H, D) padded prompt K/V.
+    Returns the updated pools."""
+    import jax.numpy as jnp
+
+    P, S, H, D = kc.shape
+    tb = k.shape[0]
+    pos = jnp.arange(tb, dtype=jnp.int32)
+    valid = pos < length
+    page_ids = rows[pos // S]
+    flat_idx = jnp.where(valid, page_ids * S + pos % S, 0)
+    kflat = kc.reshape(P * S, H, D)
+    vflat = vc.reshape(P * S, H, D)
+    kw = jnp.where(valid[:, None, None], k.astype(kc.dtype),
+                   kflat[flat_idx])
+    vw = jnp.where(valid[:, None, None], v.astype(vc.dtype),
+                   vflat[flat_idx])
+    kflat = kflat.at[flat_idx].set(kw)
+    vflat = vflat.at[flat_idx].set(vw)
+    return kflat.reshape(kc.shape), vflat.reshape(vc.shape)
+
+
+def append_token(kc, vc, page_rows, positions, k, v, active):
+    """Append one token's K/V per slot at `positions`.
+
+    page_rows: (B, max_pages) int32; positions: (B,) int32 (the index
+    the new token occupies); k/v: (B, H, D); active: (B,) bool —
+    inactive slots redirect to scratch page 0 and rewrite its current
+    value (a no-op).  Returns the updated pools."""
+    import jax.numpy as jnp
+
+    P, S, H, D = kc.shape
+    b = positions.shape[0]
+    page_ids = jnp.take_along_axis(
+        page_rows, (positions[:, None] // S), axis=1)[:, 0]
+    flat_idx = jnp.where(active, page_ids * S + positions % S, 0)
+    kflat = kc.reshape(P * S, H, D)
+    vflat = vc.reshape(P * S, H, D)
+    kw = jnp.where(active[:, None, None], k.astype(kc.dtype),
+                   kflat[flat_idx])
+    vw = jnp.where(active[:, None, None], v.astype(vc.dtype),
+                   vflat[flat_idx])
+    kflat = kflat.at[flat_idx].set(kw)
+    vflat = vflat.at[flat_idx].set(vw)
+    return kflat.reshape(kc.shape), vflat.reshape(vc.shape)

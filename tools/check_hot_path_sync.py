@@ -12,9 +12,15 @@ loop and flags `np.asarray` / `np.array` / `block_until_ready` /
 `# sync-ok` marker (the marker declares a sanctioned sync point and
 should say why, e.g. `# sync-ok: print_period boundary`).
 
+Also covers the serving dispatch loop (ISSUE 2): the engine's hot path
+(paddle_tpu/serving) has the same zero-transfer contract — its
+sanctioned boundaries are the completer's materialization, decode
+retirement, and the C ABI edge.
+
 Pure text+AST: no imports of the checked modules, so it runs in any
-environment.  Wired into tier-1 as tests/test_hot_path_lint.py and
-usable standalone:  python tools/check_hot_path_sync.py
+environment.  Wired into tier-1 via tests/test_async_executor.py and
+tests/test_serving.py, and usable standalone:
+python tools/check_hot_path_sync.py
 """
 
 from __future__ import annotations
@@ -42,6 +48,18 @@ WATCHLIST: List[Tuple[str, str]] = [
     ("paddle_tpu/fluid/executor.py", "LazyFetch.numpy"),
     ("paddle_tpu/parallel/compiler.py", "CompiledProgram._run"),
     ("paddle_tpu/io/__init__.py", "DataLoader.__iter__"),
+    # serving dispatch loop (ISSUE 2): the engine's hot path has the
+    # same zero-transfer contract — the completer/retire boundaries are
+    # the only sanctioned device->host materializations
+    ("paddle_tpu/serving/engine.py", "Engine._dispatch_loop"),
+    ("paddle_tpu/serving/engine.py", "Engine._dispatch_batch"),
+    ("paddle_tpu/serving/engine.py", "Engine._completer_loop"),
+    ("paddle_tpu/serving/engine.py", "AutoregressiveEngine._admit"),
+    ("paddle_tpu/serving/engine.py", "AutoregressiveEngine._decode"),
+    ("paddle_tpu/serving/engine.py", "AutoregressiveEngine._retire"),
+    ("paddle_tpu/serving/batcher.py", "DynamicBatcher.next_batch"),
+    ("paddle_tpu/serving/bucketing.py", "BucketedRunner.run"),
+    ("paddle_tpu/inference/c_bridge.py", "run_f32"),
 ]
 
 # blocking / transferring constructs that must not appear unsanctioned
