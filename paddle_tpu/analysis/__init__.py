@@ -1,0 +1,28 @@
+"""Static analysis for the Program IR and the codebase itself (ISSUE 3).
+
+Two halves:
+
+* `analysis.verifier` — the Program verifier: a pass pipeline checking
+  structural invariants (op registry, def-before-use, block linkage)
+  and dataflow properties (donation/aliasing safety, cross-replica
+  collective order, dead code) over `fluid.framework.Program`, run by
+  the Executor/CompiledProgram once per compile-cache miss under
+  `FLAGS_verify_program`.
+* `analysis.lint` — tpulint, the multi-rule source lint framework
+  (hot-path sync discipline, serving lock order, untraced jit side
+  effects), driven by `tools/tpulint.py` / `tools/run_lints.py` and
+  kept stdlib-only so it runs without importing paddle_tpu.
+
+See docs/static_analysis.md.
+"""
+
+from .verifier import (ERROR, INFO, WARNING, Finding,  # noqa: F401
+                       ProgramVerificationError, VerifyContext,
+                       maybe_verify_program, register_pass,
+                       registered_passes, verify_program)
+
+__all__ = [
+    "ERROR", "WARNING", "INFO", "Finding", "ProgramVerificationError",
+    "VerifyContext", "maybe_verify_program", "register_pass",
+    "registered_passes", "verify_program",
+]

@@ -1,0 +1,165 @@
+"""tpulint: multi-rule AST lint framework (ISSUE 3, part 2).
+
+Generalizes the single-purpose tools/check_hot_path_sync.py into a rule
+registry: each rule is a pure text+AST check over a set of repo files,
+producing `LintFinding`s with file:line provenance.  Rules ship in this
+package (hot_path_sync, lock_order, side_effects) and register
+themselves on import via `@register_rule`.
+
+Design constraints:
+
+* stdlib-only.  Rules parse source; they never import the modules they
+  check, so the framework runs in any environment — including ones
+  without jax.  `tools/tpulint.py` loads this package by file path
+  (importlib) precisely so the CLI works without importing paddle_tpu.
+* per-line suppression.  The generic marker is
+  `# tpulint: disable=<rule>[,<rule>...]`; rules may additionally honor
+  a domain marker (hot-path-sync keeps the historical `# sync-ok: <why>`,
+  lock-order honors `# lock-ok: <why>`, side_effects
+  `# side-effect-ok: <why>`).  A marker should always say WHY — it
+  declares a reviewed, intentional exception, not a mute button
+  (docs/static_analysis.md covers the etiquette).
+* watchlist manifests.  Rules that check a closed set of functions
+  (hot-path-sync) keep that set as module-level data (`WATCHLIST`) so
+  tools and tests can extend or assert over it.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Callable, Dict, List, Optional
+
+# this file lives at paddle_tpu/analysis/lint/__init__.py — four levels
+# below the repo root
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+_SUPPRESS_RE = re.compile(r"#\s*tpulint:\s*disable=([\w,\-]+)")
+
+
+class LintFinding:
+    """One lint hit: rule + file:line + message."""
+
+    __slots__ = ("rule", "path", "line", "message", "severity")
+
+    def __init__(self, rule: str, path: str, line: int, message: str,
+                 severity: str = "error"):
+        self.rule = rule
+        self.path = path
+        self.line = int(line)
+        self.message = message
+        self.severity = severity
+
+    def __str__(self):
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    __repr__ = __str__
+
+
+def suppressed(line_text: str, rule: str, marker: Optional[str] = None) \
+        -> bool:
+    """True when `line_text` carries a suppression for `rule` — the
+    generic `# tpulint: disable=...` form or the rule's own marker."""
+    if marker is not None and marker in line_text:
+        return True
+    m = _SUPPRESS_RE.search(line_text)
+    if m is None:
+        return False
+    names = {n.strip() for n in m.group(1).split(",")}
+    return rule in names or "all" in names
+
+
+class LintContext:
+    """Shared file/AST cache handed to every rule."""
+
+    def __init__(self, root: Optional[str] = None):
+        self.root = os.path.abspath(root or REPO_ROOT)
+        self._src: Dict[str, str] = {}
+        self._tree: Dict[str, ast.Module] = {}
+
+    def exists(self, rel: str) -> bool:
+        return os.path.isfile(os.path.join(self.root, rel))
+
+    def source(self, rel: str) -> str:
+        if rel not in self._src:
+            with open(os.path.join(self.root, rel), encoding="utf-8") as f:
+                self._src[rel] = f.read()
+        return self._src[rel]
+
+    def lines(self, rel: str) -> List[str]:
+        return self.source(rel).splitlines()
+
+    def tree(self, rel: str) -> ast.Module:
+        if rel not in self._tree:
+            self._tree[rel] = ast.parse(self.source(rel))
+        return self._tree[rel]
+
+    def iter_py(self, *subdirs: str) -> List[str]:
+        """Sorted relpaths of every .py file under the given subdirs."""
+        out = []
+        for sub in subdirs:
+            base = os.path.join(self.root, sub)
+            if os.path.isfile(base) and base.endswith(".py"):
+                out.append(os.path.relpath(base, self.root))
+                continue
+            for dirpath, _dirnames, filenames in os.walk(base):
+                for fn in filenames:
+                    if fn.endswith(".py"):
+                        out.append(os.path.relpath(
+                            os.path.join(dirpath, fn), self.root))
+        return sorted(set(out))
+
+    def suppressed(self, rel: str, lineno: int, rule: str,
+                   marker: Optional[str] = None) -> bool:
+        lines = self.lines(rel)
+        if not (1 <= lineno <= len(lines)):
+            return False
+        return suppressed(lines[lineno - 1], rule, marker)
+
+
+# ---------------------------------------------------------------------------
+# Rule registry
+# ---------------------------------------------------------------------------
+
+_RULES: "Dict[str, dict]" = {}
+
+
+def register_rule(name: str, help_str: str = "",
+                  marker: Optional[str] = None):
+    """Register `fn(ctx: LintContext) -> List[LintFinding]` as a rule."""
+
+    def deco(fn: Callable):
+        _RULES[name] = {"fn": fn, "help": help_str, "marker": marker}
+        return fn
+
+    return deco
+
+
+def registered_rules() -> List[str]:
+    return sorted(_RULES)
+
+
+def rule_info(name: str) -> dict:
+    return dict(_RULES[name])
+
+
+def run_rules(root: Optional[str] = None,
+              rules: Optional[List[str]] = None) -> List[LintFinding]:
+    """Run the named rules (default: all) over the repo at `root`."""
+    ctx = LintContext(root)
+    findings: List[LintFinding] = []
+    for name in (rules or registered_rules()):
+        if name not in _RULES:
+            raise ValueError(
+                f"unknown lint rule {name!r}; known: {registered_rules()}")
+        findings.extend(_RULES[name]["fn"](ctx))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+# rule modules register themselves on import
+from . import hot_path_sync  # noqa: E402,F401
+from . import lock_order  # noqa: E402,F401
+from . import side_effects  # noqa: E402,F401

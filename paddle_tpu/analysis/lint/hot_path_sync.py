@@ -1,0 +1,169 @@
+"""Hot-path sync rule (migrated unchanged from tools/check_hot_path_sync.py,
+which is now a thin shim over this module).
+
+The async hot path's contract is that `Executor.run(...,
+return_numpy=False)`, the dataset/dataloader step loops, and the serving
+dispatch loop perform ZERO device->host transfers per step; every
+materialization must happen at a sanctioned sync point.  This rule walks
+the functions that form those loops and flags `np.asarray` / `np.array`
+/ `block_until_ready` / `.numpy()` / `device_get` calls on lines NOT
+annotated with a `# sync-ok` marker (the marker declares a sanctioned
+sync point and should say why, e.g. `# sync-ok: print_period boundary`).
+
+Pure text+AST: no imports of the checked modules, so it runs in any
+environment.  Wired into tier-1 via tests/test_async_executor.py and
+tests/test_serving.py, and standalone via
+`python tools/check_hot_path_sync.py` or `python tools/tpulint.py`.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from . import (LintContext, LintFinding, REPO_ROOT, register_rule,
+               suppressed)
+
+RULE = "hot-path-sync"
+
+# (relative file, dotted qualname) pairs forming the executor hot path —
+# the rule's watchlist manifest.  A qualname that no longer resolves is
+# itself an error — the lint must not silently stop covering a renamed
+# loop.
+WATCHLIST: List[Tuple[str, str]] = [
+    ("paddle_tpu/fluid/executor.py", "Executor.run"),
+    ("paddle_tpu/fluid/executor.py", "Executor._dispatch"),
+    ("paddle_tpu/fluid/executor.py", "Executor._finish"),
+    ("paddle_tpu/fluid/executor.py", "Executor._const_state"),
+    ("paddle_tpu/fluid/executor.py", "Executor._normalize_feed_inner"),
+    ("paddle_tpu/fluid/executor.py", "Executor._feed_cached_put"),
+    ("paddle_tpu/fluid/executor.py", "Executor.train_from_dataset"),
+    ("paddle_tpu/fluid/executor.py", "_FeedPrefetcher"),
+    ("paddle_tpu/fluid/executor.py", "LazyFetch.numpy"),
+    ("paddle_tpu/parallel/compiler.py", "CompiledProgram._run"),
+    ("paddle_tpu/io/__init__.py", "DataLoader.__iter__"),
+    # serving dispatch loop (ISSUE 2): the engine's hot path has the
+    # same zero-transfer contract — the completer/retire boundaries are
+    # the only sanctioned device->host materializations
+    ("paddle_tpu/serving/engine.py", "Engine._dispatch_loop"),
+    ("paddle_tpu/serving/engine.py", "Engine._dispatch_batch"),
+    ("paddle_tpu/serving/engine.py", "Engine._completer_loop"),
+    ("paddle_tpu/serving/engine.py", "AutoregressiveEngine._admit"),
+    ("paddle_tpu/serving/engine.py", "AutoregressiveEngine._decode"),
+    ("paddle_tpu/serving/engine.py", "AutoregressiveEngine._retire"),
+    ("paddle_tpu/serving/batcher.py", "DynamicBatcher.next_batch"),
+    ("paddle_tpu/serving/bucketing.py", "BucketedRunner.run"),
+    ("paddle_tpu/inference/c_bridge.py", "run_f32"),
+]
+
+# blocking / transferring constructs that must not appear unsanctioned
+FORBIDDEN = [
+    re.compile(r"\bnp\.asarray\s*\("),
+    re.compile(r"\bnp\.array\s*\("),
+    re.compile(r"\bnumpy\.asarray\s*\("),
+    re.compile(r"block_until_ready\s*\("),
+    re.compile(r"\bdevice_get\s*\("),
+    re.compile(r"\.numpy\s*\(\s*\)"),
+    re.compile(r"\bjax\.device_get\b"),
+]
+
+SYNC_OK = "# sync-ok"
+
+
+def _function_spans(tree: ast.Module) -> Dict[str, Tuple[int, int]]:
+    """qualname -> (first_line, last_line) for every def/class."""
+    spans: Dict[str, Tuple[int, int]] = {}
+
+    def visit(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                qual = f"{prefix}{child.name}"
+                spans[qual] = (child.lineno, child.end_lineno)
+                visit(child, qual + ".")
+            else:
+                visit(child, prefix)
+
+    visit(tree, "")
+    return spans
+
+
+def _violations(path: str, qualnames: List[str],
+                root: Optional[str] = None) \
+        -> List[Tuple[str, int, str]]:
+    """(relpath, line, message) triples for one file's watched spans."""
+    root = root or REPO_ROOT
+    with open(path) as f:
+        source = f.read()
+    lines = source.splitlines()
+    spans = _function_spans(ast.parse(source))
+    rel = os.path.relpath(path, root)
+    out = []
+    for qual in qualnames:
+        if qual not in spans:
+            out.append((rel, 0,
+                        f"hot-path function {qual!r} not found — update "
+                        f"the WATCHLIST "
+                        f"(paddle_tpu/analysis/lint/hot_path_sync.py) "
+                        f"if it moved"))
+            continue
+        lo, hi = spans[qual]
+        for i in range(lo, hi + 1):
+            line = lines[i - 1]
+            if suppressed(line, RULE, SYNC_OK):
+                continue
+            for pat in FORBIDDEN:
+                if pat.search(line):
+                    out.append((rel, i,
+                                f"unsanctioned sync in {qual}: "
+                                f"{line.strip()!r} (add "
+                                f"'{SYNC_OK}: <why>' only if this is a "
+                                f"designed sync boundary)"))
+    return out
+
+
+def check_file(path: str, qualnames: List[str],
+               root: Optional[str] = None) -> List[str]:
+    """Historical string API (kept for the tools/ shim and tier-1
+    hooks): one formatted message per violation."""
+    out = []
+    for rel, line, msg in _violations(path, qualnames, root):
+        out.append(f"{rel}:{line}: {msg}" if line else f"{rel}: {msg}")
+    return out
+
+
+def check_repo(root: Optional[str] = None) -> List[str]:
+    root = root or REPO_ROOT
+    by_file: Dict[str, List[str]] = {}
+    for rel, qual in WATCHLIST:
+        by_file.setdefault(rel, []).append(qual)
+    violations = []
+    for rel, quals in by_file.items():
+        violations.extend(check_file(os.path.join(root, rel), quals,
+                                     root))
+    return violations
+
+
+@register_rule(RULE,
+               help_str="blocking device->host constructs in the async "
+                        "executor / serving hot path (watchlist in "
+                        "hot_path_sync.WATCHLIST; suppress with "
+                        "'# sync-ok: <why>')",
+               marker=SYNC_OK)
+def rule(ctx: LintContext) -> List[LintFinding]:
+    by_file: Dict[str, List[str]] = {}
+    for rel, qual in WATCHLIST:
+        by_file.setdefault(rel, []).append(qual)
+    findings = []
+    for rel, quals in sorted(by_file.items()):
+        path = os.path.join(ctx.root, rel)
+        if not os.path.isfile(path):
+            findings.append(LintFinding(
+                RULE, rel, 0, "watched file missing — update the "
+                              "WATCHLIST if it moved"))
+            continue
+        for vrel, line, msg in _violations(path, quals, ctx.root):
+            findings.append(LintFinding(RULE, vrel, line, msg))
+    return findings

@@ -1,0 +1,455 @@
+"""Lock-order rule: static lock-acquisition graph over the serving
+threads (ISSUE 3, part 2).
+
+The serving engine runs four-plus threads (dispatch, compiler,
+completer, decode loop) over shared state guarded by half a dozen locks
+spread across Engine / DynamicBatcher / AdmissionController / PageTable
+/ CompileCache.  A lock-order inversion between any two of them is a
+deadlock that only fires under production interleavings; a lock held
+across `jax.device_put` or an XLA compile stalls every sibling thread
+for seconds.  Both are statically visible, so this rule catches them at
+lint time:
+
+1. **Graph construction.**  A lock is any `threading.Lock / RLock /
+   Condition` assigned to a `self.<attr>` (or class-level) slot; its
+   node id is `Class.attr`.  Within a `with <lock>:` body, a direct
+   nested acquisition adds edge A->B, and a call into a method whose
+   transitive lock set (fixpoint over the intra-fileset call graph,
+   `self.`-rooted receivers resolved through constructor assignments
+   like `self._batcher = DynamicBatcher(...)`) contains B adds A->B.
+2. **Cycles** in the edge graph are reported as errors (potential
+   deadlock), as is re-acquiring a non-reentrant `Lock` already held.
+3. **Device work under a lock**: `device_put`, `jax.jit`, `.lower(...)`
+   (with args — `str.lower()` takes none) or `.compile()` (without args
+   — `re.compile(pat)` takes one) reached while holding a lock is an
+   error, UNLESS the lock's id contains "compile" — a dedicated
+   `*compile*` lock exists precisely to serialize compiles
+   (BucketedRunner._compile_lock, CompileCache) and is exempt by that
+   naming convention.
+
+Suppress a line with `# lock-ok: <why>` or
+`# tpulint: disable=lock-order`.  Static limits: receivers that are
+plain local variables are not resolved (the object graph reached from
+`self` covers the real cross-class edges in this codebase).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import LintContext, LintFinding, register_rule, suppressed
+
+RULE = "lock-order"
+LOCK_OK = "# lock-ok"
+
+# files whose threads share locks: the serving subsystem plus the
+# shared compile-cache machinery it leans on
+SCAN = ("paddle_tpu/serving", "paddle_tpu/fluid/compile_cache.py")
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+_REENTRANT_CTORS = {"RLock", "Condition"}  # Condition wraps an RLock
+# methods ON a lock object itself (not acquisitions of another lock)
+_LOCK_METHODS = {"wait", "wait_for", "notify", "notify_all", "acquire",
+                 "release"}
+
+
+def _attr_chain(node) -> Optional[List[str]]:
+    """Name/Attribute chain as ["self", "kv", "table"], or None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return None
+
+
+def _ctor_name(call: ast.Call) -> Optional[str]:
+    """Class name for `X(...)` / `mod.X(...)` calls."""
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+class _ClassInfo:
+    def __init__(self, name: str, rel: str):
+        self.name = name
+        self.rel = rel
+        self.locks: Dict[str, str] = {}  # attr -> ctor name
+        self.attr_types: Dict[str, str] = {}  # attr -> class name
+        self.methods: Dict[str, ast.FunctionDef] = {}
+
+
+def _is_device_call(call: ast.Call) -> Optional[str]:
+    """Name of the device-work construct this call is, or None."""
+    fn = call.func
+    chain = _attr_chain(fn) or []
+    last = chain[-1] if chain else None
+    if last == "device_put":
+        return "device_put"
+    if last == "jit" and len(chain) >= 2 and chain[-2] in ("jax", "pjit"):
+        return "jax.jit"
+    if last == "pjit":
+        return "pjit"
+    if isinstance(fn, ast.Attribute):
+        if fn.attr == "lower" and (call.args or call.keywords):
+            return ".lower(...)"
+        if fn.attr == "compile" and not call.args and not call.keywords:
+            return ".compile()"
+    return None
+
+
+class _MethodScan(ast.NodeVisitor):
+    """One method's direct acquisitions, calls-under-locks, nested
+    acquisitions, and direct device work."""
+
+    def __init__(self, analyzer: "_Analyzer", cls: Optional[_ClassInfo],
+                 rel: str):
+        self.an = analyzer
+        self.cls = cls
+        self.rel = rel
+        self.stack: List[str] = []  # lock ids currently held
+        self.direct: Set[str] = set()
+        # (held-lock, acquired-lock, line)
+        self.edges: List[Tuple[str, str, int]] = []
+        # (callee key, held locks snapshot, line)
+        self.calls: List[Tuple[Tuple[str, str], Tuple[str, ...], int]] = []
+        # (construct, held locks snapshot, line)
+        self.device: List[Tuple[str, Tuple[str, ...], int]] = []
+        self.reacquires: List[Tuple[str, int]] = []
+
+    # -- lock identity -----------------------------------------------------
+    def _lock_id(self, expr) -> Optional[str]:
+        chain = _attr_chain(expr)
+        if not chain or len(chain) < 2:
+            return None
+        owner = self.an.resolve_owner(self.cls, chain[:-1])
+        if owner is None:
+            return None
+        info = self.an.classes.get(owner)
+        if info is not None and chain[-1] in info.locks:
+            return f"{owner}.{chain[-1]}"
+        return None
+
+    def _enter_lock(self, lock: str, node) -> None:
+        line = getattr(node, "lineno", 0)
+        if lock in self.stack:
+            info = self.an.lock_kinds.get(lock)
+            if info not in _REENTRANT_CTORS:
+                self.reacquires.append((lock, line))
+        elif self.stack:
+            self.edges.append((self.stack[-1], lock, line))
+        self.direct.add(lock)
+        self.stack.append(lock)
+
+    # -- visitors ----------------------------------------------------------
+    def visit_With(self, node: ast.With):
+        entered = []
+        for item in node.items:
+            lock = self._lock_id(item.context_expr)
+            if lock is not None:
+                self._enter_lock(lock, node)
+                entered.append(lock)
+            else:
+                self.generic_visit(item)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in entered:
+            self.stack.pop()
+
+    def visit_Call(self, node: ast.Call):
+        line = getattr(node, "lineno", 0)
+        dev = _is_device_call(node)
+        if dev is not None:
+            self.device.append((dev, tuple(self.stack), line))
+        chain = _attr_chain(node.func)
+        if chain is not None:
+            # explicit .acquire() is an acquisition too
+            if (chain[-1] == "acquire"
+                    and isinstance(node.func, ast.Attribute)):
+                lock = self._lock_id(node.func.value)
+                if lock is not None:
+                    self._enter_lock(lock, node)
+                    self.stack.pop()  # conservative: treat as scoped
+            elif not (len(chain) >= 2
+                      and chain[-1] in _LOCK_METHODS
+                      and isinstance(node.func, ast.Attribute)
+                      and self._lock_id(node.func.value) is not None):
+                callee = self.an.resolve_call(self.cls, chain)
+                if callee is not None:
+                    self.calls.append((callee, tuple(self.stack), line))
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        # nested defs are scanned as their own pseudo-methods by the
+        # analyzer; don't double-count their bodies under our lock stack
+        # unless they are immediately called (rare; ignored)
+        return
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+class _Analyzer:
+    def __init__(self, sources: Dict[str, str]):
+        self.sources = sources
+        self.classes: Dict[str, _ClassInfo] = {}
+        self.lock_kinds: Dict[str, str] = {}  # lock id -> ctor name
+        self.scans: Dict[Tuple[str, str], _MethodScan] = {}
+        self._trees: Dict[str, ast.Module] = {
+            rel: ast.parse(src) for rel, src in sources.items()}
+        self._collect()
+        self._scan_methods()
+        self._fixpoint()
+
+    # -- pass 1: classes, locks, attr types, methods ----------------------
+    def _collect(self):
+        for rel, tree in self._trees.items():
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                info = self.classes.setdefault(node.name,
+                                               _ClassInfo(node.name, rel))
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        info.methods[item.name] = item
+                    elif isinstance(item, ast.Assign):
+                        self._record_assign(info, item, class_level=True)
+        # attr assignments inside methods
+        for info in list(self.classes.values()):
+            for meth in info.methods.values():
+                for node in ast.walk(meth):
+                    if isinstance(node, ast.Assign):
+                        self._record_assign(info, node, class_level=False)
+        for cname, info in self.classes.items():
+            for attr, ctor in info.locks.items():
+                self.lock_kinds[f"{cname}.{attr}"] = ctor
+
+    def _record_assign(self, info: _ClassInfo, node: ast.Assign,
+                       class_level: bool):
+        if not isinstance(node.value, ast.Call):
+            return
+        ctor = _ctor_name(node.value)
+        for tgt in node.targets:
+            attr = None
+            if class_level and isinstance(tgt, ast.Name):
+                attr = tgt.id
+            elif (isinstance(tgt, ast.Attribute)
+                  and isinstance(tgt.value, ast.Name)
+                  and tgt.value.id == "self"):
+                attr = tgt.attr
+            if attr is None:
+                continue
+            if ctor in _LOCK_CTORS:
+                info.locks[attr] = ctor
+            elif ctor is not None:
+                info.attr_types[attr] = ctor
+
+    # -- receiver resolution ----------------------------------------------
+    def resolve_owner(self, cls: Optional[_ClassInfo],
+                      chain: List[str]) -> Optional[str]:
+        """Class name owning the object named by `chain` (e.g.
+        ["self","kv","table"] -> "PageTable"), or None."""
+        if not chain:
+            return None
+        if chain[0] == "self":
+            if cls is None:
+                return None
+            cur = cls.name
+            for attr in chain[1:]:
+                info = self.classes.get(cur)
+                if info is None:
+                    return None
+                nxt = info.attr_types.get(attr)
+                if nxt is None:
+                    return None
+                cur = nxt
+            return cur
+        if chain[0] in self.classes and len(chain) >= 1:
+            # ClassName.attr class-level locks
+            cur = chain[0]
+            for attr in chain[1:-1] if len(chain) > 2 else []:
+                info = self.classes.get(cur)
+                nxt = info.attr_types.get(attr) if info else None
+                if nxt is None:
+                    return None
+                cur = nxt
+            return cur
+        return None
+
+    def resolve_call(self, cls: Optional[_ClassInfo],
+                     chain: List[str]) -> Optional[Tuple[str, str]]:
+        """(class, method) for a call chain, or None."""
+        if len(chain) == 1:
+            # bare Name: a constructor of a known class counts as a call
+            # into its __init__
+            if chain[0] in self.classes \
+                    and "__init__" in self.classes[chain[0]].methods:
+                return (chain[0], "__init__")
+            return None
+        owner = self.resolve_owner(cls, chain[:-1])
+        if owner is None:
+            return None
+        info = self.classes.get(owner)
+        if info is not None and chain[-1] in info.methods:
+            return (owner, chain[-1])
+        return None
+
+    # -- pass 2: per-method scans -----------------------------------------
+    def _scan_methods(self):
+        for cname, info in self.classes.items():
+            for mname, meth in info.methods.items():
+                scan = _MethodScan(self, info, info.rel)
+                for stmt in meth.body:
+                    scan.visit(stmt)
+                self.scans[(cname, mname)] = scan
+
+    # -- pass 3: transitive lock / device sets -----------------------------
+    def _fixpoint(self):
+        self.locks_star: Dict[Tuple[str, str], Set[str]] = {
+            k: set(s.direct) for k, s in self.scans.items()}
+        self.device_star: Dict[Tuple[str, str],
+                               Optional[Tuple[str, int, str]]] = {}
+        for k, s in self.scans.items():
+            hit = next((d for d in s.device), None)
+            self.device_star[k] = (hit[0], hit[2], s.rel) if hit else None
+        changed = True
+        while changed:
+            changed = False
+            for k, s in self.scans.items():
+                for callee, _held, line in s.calls:
+                    if callee not in self.scans:
+                        continue
+                    extra = self.locks_star[callee] - self.locks_star[k]
+                    if extra:
+                        self.locks_star[k] |= extra
+                        changed = True
+                    if (self.device_star[k] is None
+                            and self.device_star[callee] is not None):
+                        dev = self.device_star[callee]
+                        self.device_star[k] = dev
+                        changed = True
+
+    # -- findings ----------------------------------------------------------
+    def edges(self) -> Dict[Tuple[str, str], Tuple[str, int]]:
+        """lock-order edges (A held -> B acquired) -> (rel, line)."""
+        out: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        for (cname, mname), scan in self.scans.items():
+            for a, b, line in scan.edges:
+                out.setdefault((a, b), (scan.rel, line))
+            for callee, held, line in scan.calls:
+                if callee not in self.scans or not held:
+                    continue
+                for b in self.locks_star[callee]:
+                    for a in held:
+                        if a != b:
+                            out.setdefault((a, b), (scan.rel, line))
+        return out
+
+
+def _cycles(edges: Dict[Tuple[str, str], Tuple[str, int]]) \
+        -> List[List[str]]:
+    graph: Dict[str, Set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    seen_cycles = set()
+    cycles = []
+
+    def dfs(node, path, on_path):
+        for nxt in sorted(graph.get(node, ())):
+            if nxt in on_path:
+                cyc = path[path.index(nxt):] + [nxt]
+                key = frozenset(cyc)
+                if key not in seen_cycles:
+                    seen_cycles.add(key)
+                    cycles.append(cyc)
+                continue
+            dfs(nxt, path + [nxt], on_path | {nxt})
+
+    for start in sorted(graph):
+        dfs(start, [start], {start})
+    return cycles
+
+
+def check_sources(sources: Dict[str, str]) -> List[LintFinding]:
+    """Run the lock-order analysis over {relpath: source}."""
+    an = _Analyzer(sources)
+    findings: List[LintFinding] = []
+    edges = an.edges()
+
+    for cyc in _cycles(edges):
+        locs = "; ".join(
+            f"{a}->{b} at {edges[(a, b)][0]}:{edges[(a, b)][1]}"
+            for a, b in zip(cyc, cyc[1:]))
+        rel, line = edges[(cyc[0], cyc[1])]
+        findings.append(LintFinding(
+            RULE, rel, line,
+            f"lock-order cycle {' -> '.join(cyc)} (potential deadlock "
+            f"across serving threads): {locs}"))
+
+    for (cname, mname), scan in an.scans.items():
+        for lock, line in scan.reacquires:
+            findings.append(LintFinding(
+                RULE, scan.rel, line,
+                f"non-reentrant lock {lock} re-acquired while already "
+                f"held in {cname}.{mname} (self-deadlock)"))
+        # direct device work under a held lock
+        for dev, held, line in scan.device:
+            for lock in held:
+                if "compile" in lock.lower():
+                    continue
+                findings.append(LintFinding(
+                    RULE, scan.rel, line,
+                    f"{dev} while holding {lock} in {cname}.{mname}: "
+                    f"device transfers/compiles under a shared lock "
+                    f"stall every sibling thread — move it outside the "
+                    f"critical section or use a dedicated *compile* "
+                    f"lock"))
+        # calls that transitively reach device work or re-acquire a
+        # held non-reentrant lock
+        for callee, held, line in scan.calls:
+            if not held or callee not in an.scans:
+                continue
+            for lock in held:
+                if (lock in an.locks_star[callee]
+                        and an.lock_kinds.get(lock)
+                        not in _REENTRANT_CTORS):
+                    findings.append(LintFinding(
+                        RULE, scan.rel, line,
+                        f"call to {callee[0]}.{callee[1]} re-acquires "
+                        f"non-reentrant lock {lock} already held in "
+                        f"{cname}.{mname} (self-deadlock)"))
+            if an.device_star.get(callee) is None:
+                continue
+            dev, _dline, _drel = an.device_star[callee]
+            for lock in held:
+                if "compile" in lock.lower():
+                    continue
+                findings.append(LintFinding(
+                    RULE, scan.rel, line,
+                    f"call to {callee[0]}.{callee[1]} (which performs "
+                    f"{dev}) while holding {lock} in {cname}.{mname}"))
+    return findings
+
+
+@register_rule(RULE,
+               help_str="lock-acquisition cycles and locks held across "
+                        "device_put/compile in paddle_tpu/serving "
+                        "(suppress with '# lock-ok: <why>')",
+               marker=LOCK_OK)
+def rule(ctx: LintContext) -> List[LintFinding]:
+    sources = {}
+    for rel in ctx.iter_py(*SCAN):
+        sources[rel] = ctx.source(rel)
+    out = []
+    for f in check_sources(sources):
+        if not ctx.suppressed(f.path, f.line, RULE, LOCK_OK):
+            out.append(f)
+    return out

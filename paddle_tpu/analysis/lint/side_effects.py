@@ -1,0 +1,207 @@
+"""Untraced-side-effect rule: Python mutation of `self`/globals inside
+functions handed to `jax.jit` / `pjit` (ISSUE 3, part 2).
+
+A jitted function's Python body runs ONCE, at trace time; after that
+XLA replays the compiled computation and the Python statements never
+execute again.  An assignment to `self.<attr>` or to a `global` name
+inside such a function therefore happens exactly once per compile-cache
+entry — a classic silent-staleness bug (a step counter that stops
+counting, a debug flag that never updates, metrics that freeze after
+warmup).  Closure-cell mutation is deliberately exempt: the executor
+uses a closure box (`check_names_box[:] = names`) precisely as a
+trace-time side channel, which is a sanctioned idiom.
+
+Detection is purely syntactic over each module:
+
+* jit targets: `jax.jit(f)` / `jit(f)` / `pjit(f)` call sites (with
+  `functools.partial(f, ...)` unwrapped), and functions decorated with
+  `@jax.jit` / `@jit` / `@pjit` / `@functools.partial(jax.jit, ...)`.
+  A Name argument resolves to a `def` in the same module; a
+  `self.<meth>` argument resolves to a method of a class in the same
+  module.
+* flagged constructs inside the target's body (nested defs included —
+  they run at trace time too if called): assignment / augmented
+  assignment to `self.<attr>` or `self.<attr>[...]`, and assignment to
+  a name declared `global` in that function.
+
+Suppress a line with `# side-effect-ok: <why>` or
+`# tpulint: disable=untraced-side-effect`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from . import LintContext, LintFinding, register_rule, suppressed
+
+RULE = "untraced-side-effect"
+SIDE_EFFECT_OK = "# side-effect-ok"
+
+SCAN = ("paddle_tpu",)
+
+_JIT_NAMES = {"jit", "pjit"}
+
+
+def _is_jit_ref(node) -> bool:
+    """True for `jit` / `pjit` / `jax.jit` / `x.pjit` references."""
+    if isinstance(node, ast.Name):
+        return node.id in _JIT_NAMES
+    if isinstance(node, ast.Attribute):
+        return node.attr in _JIT_NAMES
+    return False
+
+
+def _unwrap_partial(node):
+    """functools.partial(F, ...) -> F (recursively)."""
+    while (isinstance(node, ast.Call)
+           and isinstance(node.func, (ast.Name, ast.Attribute))
+           and (node.func.id if isinstance(node.func, ast.Name)
+                else node.func.attr) == "partial"
+           and node.args):
+        node = node.args[0]
+    return node
+
+
+def _jit_target(call: ast.Call):
+    """The function expression handed to jit, or None."""
+    if not _is_jit_ref(call.func) or not call.args:
+        return None
+    return _unwrap_partial(call.args[0])
+
+
+def _decorated_jit(fn) -> bool:
+    for dec in fn.decorator_list:
+        if _is_jit_ref(dec):
+            return True
+        if isinstance(dec, ast.Call):
+            if _is_jit_ref(dec.func):
+                return True
+            inner = _unwrap_partial(dec)
+            if inner is not dec and _is_jit_ref(inner):
+                return True
+    return False
+
+
+def _self_mutation_target(node) -> Optional[str]:
+    """Attr name if `node` is self.<attr> or self.<attr>[...], else
+    None."""
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _flag_body(fn, rel: str, owner: str) -> List[LintFinding]:
+    findings = []
+    global_names: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Global):
+            global_names.update(node.names)
+    for node in ast.walk(fn):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for tgt in targets:
+            subs = [tgt]
+            if isinstance(tgt, (ast.Tuple, ast.List)):
+                subs = list(tgt.elts)
+            for t in subs:
+                attr = _self_mutation_target(t)
+                if attr is not None:
+                    findings.append(LintFinding(
+                        RULE, rel, getattr(node, "lineno", fn.lineno),
+                        f"{owner} is handed to jax.jit but mutates "
+                        f"self.{attr}: the write runs once at trace "
+                        f"time, then never again — return the value "
+                        f"or move the mutation outside the traced "
+                        f"function"))
+                elif (isinstance(t, ast.Name)
+                      and t.id in global_names):
+                    findings.append(LintFinding(
+                        RULE, rel, getattr(node, "lineno", fn.lineno),
+                        f"{owner} is handed to jax.jit but assigns "
+                        f"global {t.id!r}: the write runs once at "
+                        f"trace time, then never again"))
+    return findings
+
+
+def check_source(rel: str, source: str) -> List[LintFinding]:
+    tree = ast.parse(source)
+    # module-wide def/method tables for resolving jit(F) references
+    defs_by_name: Dict[str, ast.FunctionDef] = {}
+    methods_by_name: Dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, node)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    methods_by_name.setdefault(item.name, item)
+
+    findings: List[LintFinding] = []
+    seen: Set[int] = set()
+
+    def flag(fn, owner):
+        if id(fn) in seen:
+            return
+        seen.add(id(fn))
+        findings.extend(_flag_body(fn, rel, owner))
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and _decorated_jit(node):
+            flag(node, f"{node.name}()")
+        if not isinstance(node, ast.Call):
+            continue
+        target = _jit_target(node)
+        if target is None:
+            continue
+        if isinstance(target, ast.Lambda):
+            # lambdas cannot contain assignments; nothing to flag
+            continue
+        if isinstance(target, ast.Name):
+            fn = defs_by_name.get(target.id)
+            if fn is not None:
+                flag(fn, f"{target.id}()")
+        elif (isinstance(target, ast.Attribute)
+              and isinstance(target.value, ast.Name)
+              and target.value.id == "self"):
+            fn = methods_by_name.get(target.attr)
+            if fn is not None:
+                flag(fn, f"self.{target.attr}()")
+    return findings
+
+
+def check_sources(sources: Dict[str, str]) -> List[LintFinding]:
+    out: List[LintFinding] = []
+    for rel, src in sorted(sources.items()):
+        out.extend(check_source(rel, src))
+    return out
+
+
+@register_rule(RULE,
+               help_str="self/global mutation inside functions handed "
+                        "to jax.jit/pjit (runs once at trace time; "
+                        "suppress with '# side-effect-ok: <why>')",
+               marker=SIDE_EFFECT_OK)
+def rule(ctx: LintContext) -> List[LintFinding]:
+    out = []
+    for rel in ctx.iter_py(*SCAN):
+        try:
+            src = ctx.source(rel)
+        except (OSError, UnicodeDecodeError):
+            continue
+        if "jit" not in src and "pjit" not in src:
+            continue
+        for f in check_source(rel, src):
+            if not ctx.suppressed(f.path, f.line, RULE, SIDE_EFFECT_OK):
+                out.append(f)
+    return out

@@ -735,6 +735,13 @@ class Executor:
         from ..profiler import stat_add
         stat_add("executor_compile_count")
 
+        # ERROR-tier program verification, ONLY on a compile-cache miss
+        # (docs/static_analysis.md): a cache hit above returns before
+        # this line, so steady-state steps pay zero verifier time
+        from ..analysis.verifier import maybe_verify_program
+        maybe_verify_program(program, feed_names=feed_arrays.keys(),
+                             fetch_names=fetch_names, scope=scope)
+
         from .flags import flag
         from ..ops import registry
 

@@ -76,6 +76,15 @@ _define("free_idle_chunk", False, "release idle allocator chunks")
 _define("tracer_profile_fname", "", "imperative tracer profile output")
 _define("check_numerics", False,
         "per-op numeric check, softer than check_nan_inf")
+_define("verify_program", "on",
+        "run the analysis.verifier ERROR-tier passes once per "
+        "compile-cache miss (docs/static_analysis.md): 'on' raises "
+        "ProgramVerificationError on ERROR findings, 'warn' reports "
+        "and continues (the escape hatch), 'off' disables")
+_define("op_callstack", False,
+        "record the Python construction stack on every appended op "
+        "(attrs['op_callstack']); verifier findings then point at the "
+        "user line that built the offending op")
 
 
 def get_flags(flags):

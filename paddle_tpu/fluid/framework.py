@@ -24,11 +24,15 @@ from __future__ import annotations
 
 import contextlib
 import copy
+import itertools
+import os
+import traceback
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
 from . import core, unique_name
+from .flags import flag
 
 GRAD_SUFFIX = "@GRAD"
 EMPTY_VAR_NAME = "@EMPTY@"
@@ -36,6 +40,24 @@ EMPTY_VAR_NAME = "@EMPTY@"
 # Placeholder batch sizes used to probe which output dims depend on dynamic
 # (-1) input dims during build-time shape inference.
 _BATCH_PROBES = (3, 5)
+
+# package root, for filtering framework frames out of recorded op
+# construction stacks (FLAGS_op_callstack)
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _user_callstack(limit: int = 3) -> List[str]:
+    """Nearest non-framework construction frames, innermost last —
+    attached to ops as attrs['op_callstack'] when FLAGS_op_callstack is
+    set, and surfaced by analysis.verifier findings."""
+    out: List[str] = []
+    for fr in reversed(traceback.extract_stack()[:-3]):
+        if os.path.abspath(fr.filename).startswith(_PKG_DIR):
+            continue
+        out.append(f"{fr.filename}:{fr.lineno} ({fr.name})")
+        if len(out) >= limit:
+            break
+    return list(reversed(out))
 
 
 class Variable:
@@ -282,6 +304,8 @@ class Block:
         the quantization transform)."""
         op = Operator(self, self.program._next_op_id(), type, inputs,
                       outputs, attrs)
+        if flag("op_callstack") and "op_callstack" not in op.attrs:
+            op.attrs["op_callstack"] = _user_callstack()
         self.ops.insert(index, op)
         self.program._bump_version()
         if infer_shape:
@@ -345,6 +369,10 @@ class Program:
     Executor compiles (program, feed-signature, fetch-list) pairs to cached
     XLA executables keyed on `(id, version)`."""
 
+    # sequential program identity for greppable verifier provenance
+    # ("program#<id> block<idx> op<idx> (<type>)", analysis/verifier.py)
+    _prog_id_counter = itertools.count()
+
     def __init__(self):
         self.blocks: List[Block] = [Block(self, 0)]
         self.current_block_idx = 0
@@ -353,6 +381,7 @@ class Program:
         self._op_id_counter = 0
         self._seed_counter = 0
         self._is_test = False
+        self.prog_id = next(Program._prog_id_counter)
 
     # -- identity / caching ------------------------------------------------
     @property

@@ -74,6 +74,12 @@ def has_op(op_type: str) -> bool:
     return False
 
 
+def has_grad(op_type: str) -> bool:
+    """Whether a custom grad lowering is registered for `op_type`
+    (consulted by the program verifier's op-registry pass)."""
+    return op_type in _GRAD
+
+
 def registered_ops() -> List[str]:
     return sorted(_FORWARD)
 

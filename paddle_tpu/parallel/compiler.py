@@ -144,6 +144,12 @@ class CompiledProgram:
         return False
 
     def _compile(self, executor, program, feed_arrays, fetch_names, scope):
+        # ERROR-tier program verification on the compile-cache miss
+        # path only, same contract as Executor._prepare
+        # (docs/static_analysis.md)
+        from ..analysis.verifier import maybe_verify_program
+        maybe_verify_program(program, feed_names=feed_arrays.keys(),
+                             fetch_names=fetch_names, scope=scope)
         if self._has_collective_ops(program):
             return self._compile_shard_map(executor, program, feed_arrays,
                                            fetch_names, scope)

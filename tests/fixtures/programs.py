@@ -1,0 +1,148 @@
+"""Fixture Program zoo for the static-analysis tests (ISSUE 3
+satellite): every builder constructs one representative static graph on
+the Program IR — training (backward + optimizer), control flow
+(while sub-blocks), shared parameters, normalization state — and the
+verifier must report zero ERROR findings over each of them
+(tests/test_static_analysis.py), alongside the book-model graphs.
+
+Each builder returns (main_program, startup_program, fetch_list) and
+only BUILDS the graph; nothing here touches the executor, so the zoo
+stays cheap enough to verify exhaustively.
+"""
+
+from __future__ import annotations
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import framework, unique_name
+
+
+def _build(body):
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        with unique_name.guard():
+            fetch = body()
+    return main, startup, fetch
+
+
+def linear_sgd():
+    """fc -> mse -> SGD: forward + backward + optimizer ops."""
+
+    def body():
+        x = fluid.data("x", [-1, 4], "float32")
+        yt = fluid.data("yt", [-1, 1], "float32")
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.loss.square_error_cost(pred, yt))
+        fluid.optimizer.SGD(0.01).minimize(loss)
+        return [loss]
+
+    return _build(body)
+
+
+def mlp_adam():
+    """Deeper net + Adam (optimizer moment state, shared helper vars)."""
+
+    def body():
+        x = fluid.data("x", [-1, 8], "float32")
+        yt = fluid.data("yt", [-1, 1], "float32")
+        h = fluid.layers.fc(x, 16, act="relu")
+        h = fluid.layers.fc(h, 16, act="tanh")
+        pred = fluid.layers.fc(h, 1, bias_attr=False)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.loss.square_error_cost(pred, yt))
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+        return [loss]
+
+    return _build(body)
+
+
+def while_counter():
+    """`while` sub-block with loop-carried state (block linkage +
+    loop-carried def-before-use)."""
+
+    def body():
+        from paddle_tpu.fluid.layers import tensor as t
+
+        i = t.fill_constant([1], "int32", 0)
+        limit = t.fill_constant([1], "int32", 5)
+        acc = t.fill_constant([1], "float32", 0.0)
+        cond = fluid.layers.less_than(i, limit)
+        w = fluid.layers.While(cond)
+        with w.block():
+            from paddle_tpu.fluid.layers.tensor import assign
+
+            ni = fluid.layers.increment(i, value=1, in_place=False)
+            na = fluid.layers.elementwise_add(
+                acc, fluid.layers.cast(ni, "float32"))
+            assign(ni, i)
+            assign(na, acc)
+            assign(fluid.layers.less_than(i, limit), cond)
+        return [acc]
+
+    return _build(body)
+
+
+def shared_embedding_ngram():
+    """word2vec-style shared embedding table (param reuse across ops)."""
+
+    def body():
+        words = [fluid.data(n, [-1, 1], "int64")
+                 for n in ("w0", "w1", "w2")]
+        nxt = fluid.data("nxt", [-1, 1], "int64")
+        embeds = [fluid.layers.embedding(
+            fluid.layers.reshape(w, [-1]), size=[32, 8],
+            param_attr="shared_emb") for w in words]
+        concat = fluid.layers.concat(embeds, axis=1)
+        hidden = fluid.layers.fc(concat, 16, act="sigmoid")
+        logits = fluid.layers.fc(hidden, 32)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.softmax_with_cross_entropy(
+                logits, fluid.layers.reshape(nxt, [-1, 1])))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        return [loss]
+
+    return _build(body)
+
+
+def batchnorm_eval_clone():
+    """batch_norm training graph + its clone(for_test=True) twin
+    (pruned backward ops must still verify)."""
+
+    def body():
+        x = fluid.data("x", [-1, 6], "float32")
+        yt = fluid.data("yt", [-1, 1], "float32")
+        h = fluid.layers.fc(x, 8)
+        h = fluid.layers.batch_norm(h)
+        pred = fluid.layers.fc(h, 1)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.loss.square_error_cost(pred, yt))
+        fluid.optimizer.SGD(0.01).minimize(loss)
+        return [loss]
+
+    main, startup, fetch = _build(body)
+    # the for_test clone is itself a fixture program; callers verify it
+    # via the clone() entry below
+    return main, startup, fetch
+
+
+def batchnorm_for_test():
+    main, startup, fetch = batchnorm_eval_clone()
+    test_prog = main.clone(for_test=True)
+    return test_prog, startup, fetch
+
+
+FIXTURES = {
+    "linear_sgd": linear_sgd,
+    "mlp_adam": mlp_adam,
+    "while_counter": while_counter,
+    "shared_embedding_ngram": shared_embedding_ngram,
+    "batchnorm_train": batchnorm_eval_clone,
+    "batchnorm_for_test": batchnorm_for_test,
+}
+
+
+def build_all():
+    """Yield (name, main, startup, fetch_list) for every fixture."""
+    for name, builder in FIXTURES.items():
+        main, startup, fetch = builder()
+        yield name, main, startup, fetch

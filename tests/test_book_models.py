@@ -15,6 +15,11 @@ Each builds the same static graph on our IR, trains on synthetic data
 with the reference's optimizer choice, and asserts the loss drops — the
 book tests' own convergence criterion (e.g. word2vec trains until
 avg_cost < 5.0).
+
+The graph constructions are exposed as `build_*` functions (registry:
+`BOOK_BUILDERS`) so the program verifier can sweep the whole model zoo
+without training it (tests/test_static_analysis.py).  Each builder
+assumes an active program_guard and returns the fetch vars.
 """
 
 import numpy as np
@@ -47,9 +52,12 @@ def _cos_sim(x, y):
     return out
 
 
-def test_word2vec_ngram_shared_embedding(fresh):
-    main, startup, scope = fresh
-    DICT, EMB, HID = 64, 16, 64
+W2V_DICT, W2V_EMB, W2V_HID = 64, 16, 64
+
+
+def build_word2vec():
+    """word2vec N-gram LM graph (shared embedding table)."""
+    DICT, EMB, HID = W2V_DICT, W2V_EMB, W2V_HID
     words = [fluid.data(n, [-1, 1], "int64")
              for n in ("firstw", "secondw", "thirdw", "forthw")]
     nextw = fluid.data("nextw", [-1, 1], "int64")
@@ -64,6 +72,13 @@ def test_word2vec_ngram_shared_embedding(fresh):
     # the reference trains SGD over 100 corpus passes; synthetic-data
     # CI budget gets the same convergence signal faster with Adam
     fluid.optimizer.Adam(0.02).minimize(avg_cost)
+    return [avg_cost]
+
+
+def test_word2vec_ngram_shared_embedding(fresh):
+    main, startup, scope = fresh
+    DICT = W2V_DICT
+    (avg_cost,) = build_word2vec()
 
     # the embedding table is genuinely shared: ONE parameter node
     emb_params = [v for v in main.global_block().vars.values()
@@ -88,9 +103,13 @@ def test_word2vec_ngram_shared_embedding(fresh):
     assert last < first * 0.7, (first, last)
 
 
-def test_recommender_system_towers(fresh):
-    main, startup, scope = fresh
-    N_USR, N_MOV, N_AGE, N_JOB = 32, 48, 7, 10
+REC_N_USR, REC_N_MOV, REC_N_AGE, REC_N_JOB = 32, 48, 7, 10
+
+
+def build_recommender():
+    """Recommender-system graph: user/movie towers -> cos_sim rating."""
+    N_USR, N_MOV, N_AGE, N_JOB = REC_N_USR, REC_N_MOV, REC_N_AGE, \
+        REC_N_JOB
     uid = fluid.data("user_id", [-1], "int64")
     age = fluid.data("age_id", [-1], "int64")
     job = fluid.data("job_id", [-1], "int64")
@@ -112,6 +131,14 @@ def test_recommender_system_towers(fresh):
     avg_cost = fluid.layers.reduce_mean(
         fluid.layers.loss.square_error_cost(scale_infer, rating))
     fluid.optimizer.SGD(0.2).minimize(avg_cost)
+    return [avg_cost]
+
+
+def test_recommender_system_towers(fresh):
+    main, startup, scope = fresh
+    N_USR, N_MOV, N_AGE, N_JOB = REC_N_USR, REC_N_MOV, REC_N_AGE, \
+        REC_N_JOB
+    (avg_cost,) = build_recommender()
 
     exe = fluid.Executor()
     exe.run(startup)
@@ -135,9 +162,13 @@ def test_recommender_system_towers(fresh):
     assert last < first * 0.5, (first, last)
 
 
-def test_understand_sentiment_conv(fresh):
-    main, startup, scope = fresh
-    DICT, EMB, SEQ, CLASSES = 64, 16, 12, 2
+SENT_DICT, SENT_EMB, SENT_SEQ, SENT_CLASSES = 64, 16, 12, 2
+
+
+def build_sentiment_conv():
+    """understand_sentiment conv net graph."""
+    DICT, EMB, SEQ, CLASSES = SENT_DICT, SENT_EMB, SENT_SEQ, \
+        SENT_CLASSES
     data = fluid.data("words", [-1, SEQ], "int64")
     label = fluid.data("label", [-1, 1], "int64")
     emb = fluid.layers.embedding(data, size=[DICT, EMB])
@@ -148,6 +179,13 @@ def test_understand_sentiment_conv(fresh):
     avg_cost = fluid.layers.reduce_mean(
         fluid.layers.cross_entropy(predict, label))
     fluid.optimizer.Adam(0.01).minimize(avg_cost)
+    return [avg_cost]
+
+
+def test_understand_sentiment_conv(fresh):
+    main, startup, scope = fresh
+    DICT, SEQ = SENT_DICT, SENT_SEQ
+    (avg_cost,) = build_sentiment_conv()
 
     exe = fluid.Executor()
     exe.run(startup)
@@ -165,17 +203,15 @@ def test_understand_sentiment_conv(fresh):
     assert last < first * 0.5, (first, last)
 
 
-def test_label_semantic_roles_crf(fresh):
-    """SRL book chapter (/root/reference/python/paddle/fluid/tests/
-    book/test_label_semantic_roles.py:1): word/predicate/mark feature
-    embeddings -> summed fc projections -> a forward+reverse
-    dynamic_lstm pair -> fc emissions -> linear_chain_crf loss, with
-    crf_decoding sharing the transition parameter by name ('crfw').
-    Reduced depth (the reference stacks 8 LSTMs) but the same graph
-    shape: ragged batches ride a Length feed, train drops the NLL, and
-    Viterbi decode recovers the synthetic tag structure."""
-    main, startup, scope = fresh
-    DICT, MARK, EMB, HID, LABELS, T = 40, 2, 16, 16, 5, 10
+SRL_DICT, SRL_MARK, SRL_EMB, SRL_HID, SRL_LABELS, SRL_T = \
+    40, 2, 16, 16, 5, 10
+
+
+def build_srl_crf():
+    """SRL graph: feature embeddings -> fwd+rev dynamic_lstm ->
+    linear_chain_crf loss + crf_decoding sharing 'crfw'."""
+    DICT, MARK, EMB, HID, LABELS, T = SRL_DICT, SRL_MARK, SRL_EMB, \
+        SRL_HID, SRL_LABELS, SRL_T
 
     word = fluid.data("word", [-1, T], "int64")
     pred = fluid.data("predicate", [-1, T], "int64")
@@ -209,6 +245,21 @@ def test_label_semantic_roles_crf(fresh):
     decode = fluid.layers.crf_decoding(
         emission, param_attr=fluid.ParamAttr(name="crfw"),
         length=length)
+    return [avg_cost, decode]
+
+
+def test_label_semantic_roles_crf(fresh):
+    """SRL book chapter (/root/reference/python/paddle/fluid/tests/
+    book/test_label_semantic_roles.py:1): word/predicate/mark feature
+    embeddings -> summed fc projections -> a forward+reverse
+    dynamic_lstm pair -> fc emissions -> linear_chain_crf loss, with
+    crf_decoding sharing the transition parameter by name ('crfw').
+    Reduced depth (the reference stacks 8 LSTMs) but the same graph
+    shape: ragged batches ride a Length feed, train drops the NLL, and
+    Viterbi decode recovers the synthetic tag structure."""
+    main, startup, scope = fresh
+    DICT, LABELS, T = SRL_DICT, SRL_LABELS, SRL_T
+    avg_cost, decode = build_srl_crf()
 
     # ONE shared transition parameter, created once
     crfw = [v for v in main.global_block().vars.values()
@@ -241,3 +292,15 @@ def test_label_semantic_roles_crf(fresh):
     live = np.arange(T)[None, :] < lens[:, None]
     acc = (path == y)[live].mean()
     assert acc > 0.8, acc
+
+
+# model-zoo registry for the program verifier sweep
+# (tests/test_static_analysis.py): name -> graph builder; each builder
+# assumes an active program_guard + unique_name.guard and returns the
+# fetch vars
+BOOK_BUILDERS = {
+    "word2vec_ngram": build_word2vec,
+    "recommender_towers": build_recommender,
+    "sentiment_conv": build_sentiment_conv,
+    "srl_crf": build_srl_crf,
+}

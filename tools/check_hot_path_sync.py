@@ -1,135 +1,36 @@
 #!/usr/bin/env python
-"""Hot-path sync lint (ISSUE 1 satellite): fail if a blocking
-device->host construct sneaks back into the async dispatch-ahead
-executor loop.
+"""Hot-path sync lint — thin shim over the tpulint framework (ISSUE 3
+satellite).
 
-The async hot path's contract is that `Executor.run(...,
-return_numpy=False)` and the dataset/dataloader step loops perform ZERO
-device->host transfers per step; every materialization must happen at a
-sanctioned sync point.  This lint walks the functions that form that
-loop and flags `np.asarray` / `np.array` / `block_until_ready` /
-`.numpy()` / `device_get` calls on lines NOT annotated with a
-`# sync-ok` marker (the marker declares a sanctioned sync point and
-should say why, e.g. `# sync-ok: print_period boundary`).
+The rule itself lives in paddle_tpu/analysis/lint/hot_path_sync.py
+(rule name "hot-path-sync"); this shim keeps the historical CLI and the
+`check_file` / `check_repo` / `WATCHLIST` surface that
+tests/test_async_executor.py and tests/test_serving.py wire into
+tier-1, with `# sync-ok: <why>` marker semantics unchanged.
 
-Also covers the serving dispatch loop (ISSUE 2): the engine's hot path
-(paddle_tpu/serving) has the same zero-transfer contract — its
-sanctioned boundaries are the completer's materialization, decode
-retirement, and the C ABI edge.
-
-Pure text+AST: no imports of the checked modules, so it runs in any
-environment.  Wired into tier-1 via tests/test_async_executor.py and
-tests/test_serving.py, and usable standalone:
-python tools/check_hot_path_sync.py
+Standalone: python tools/check_hot_path_sync.py
+All rules:  python tools/tpulint.py
 """
 
 from __future__ import annotations
 
-import ast
 import os
-import re
 import sys
-from typing import Dict, List, Tuple
 
-REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
 
-# (relative file, dotted qualname) pairs forming the executor hot path.
-# A qualname that no longer resolves is itself an error — the lint must
-# not silently stop covering a renamed loop.
-WATCHLIST: List[Tuple[str, str]] = [
-    ("paddle_tpu/fluid/executor.py", "Executor.run"),
-    ("paddle_tpu/fluid/executor.py", "Executor._dispatch"),
-    ("paddle_tpu/fluid/executor.py", "Executor._finish"),
-    ("paddle_tpu/fluid/executor.py", "Executor._const_state"),
-    ("paddle_tpu/fluid/executor.py", "Executor._normalize_feed_inner"),
-    ("paddle_tpu/fluid/executor.py", "Executor._feed_cached_put"),
-    ("paddle_tpu/fluid/executor.py", "Executor.train_from_dataset"),
-    ("paddle_tpu/fluid/executor.py", "_FeedPrefetcher"),
-    ("paddle_tpu/fluid/executor.py", "LazyFetch.numpy"),
-    ("paddle_tpu/parallel/compiler.py", "CompiledProgram._run"),
-    ("paddle_tpu/io/__init__.py", "DataLoader.__iter__"),
-    # serving dispatch loop (ISSUE 2): the engine's hot path has the
-    # same zero-transfer contract — the completer/retire boundaries are
-    # the only sanctioned device->host materializations
-    ("paddle_tpu/serving/engine.py", "Engine._dispatch_loop"),
-    ("paddle_tpu/serving/engine.py", "Engine._dispatch_batch"),
-    ("paddle_tpu/serving/engine.py", "Engine._completer_loop"),
-    ("paddle_tpu/serving/engine.py", "AutoregressiveEngine._admit"),
-    ("paddle_tpu/serving/engine.py", "AutoregressiveEngine._decode"),
-    ("paddle_tpu/serving/engine.py", "AutoregressiveEngine._retire"),
-    ("paddle_tpu/serving/batcher.py", "DynamicBatcher.next_batch"),
-    ("paddle_tpu/serving/bucketing.py", "BucketedRunner.run"),
-    ("paddle_tpu/inference/c_bridge.py", "run_f32"),
-]
+from tpulint import load_lint  # noqa: E402
 
-# blocking / transferring constructs that must not appear unsanctioned
-FORBIDDEN = [
-    re.compile(r"\bnp\.asarray\s*\("),
-    re.compile(r"\bnp\.array\s*\("),
-    re.compile(r"\bnumpy\.asarray\s*\("),
-    re.compile(r"block_until_ready\s*\("),
-    re.compile(r"\bdevice_get\s*\("),
-    re.compile(r"\.numpy\s*\(\s*\)"),
-    re.compile(r"\bjax\.device_get\b"),
-]
+_hps = load_lint().hot_path_sync
 
-SYNC_OK = "# sync-ok"
-
-
-def _function_spans(tree: ast.Module) -> Dict[str, Tuple[int, int]]:
-    """qualname -> (first_line, last_line) for every def/class."""
-    spans: Dict[str, Tuple[int, int]] = {}
-
-    def visit(node, prefix):
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                  ast.ClassDef)):
-                qual = f"{prefix}{child.name}"
-                spans[qual] = (child.lineno, child.end_lineno)
-                visit(child, qual + ".")
-            else:
-                visit(child, prefix)
-
-    visit(tree, "")
-    return spans
-
-
-def check_file(path: str, qualnames: List[str]) -> List[str]:
-    with open(path) as f:
-        source = f.read()
-    lines = source.splitlines()
-    spans = _function_spans(ast.parse(source))
-    rel = os.path.relpath(path, REPO_ROOT)
-    violations = []
-    for qual in qualnames:
-        if qual not in spans:
-            violations.append(
-                f"{rel}: hot-path function {qual!r} not found — update "
-                f"tools/check_hot_path_sync.py WATCHLIST if it moved")
-            continue
-        lo, hi = spans[qual]
-        for i in range(lo, hi + 1):
-            line = lines[i - 1]
-            if SYNC_OK in line:
-                continue
-            for pat in FORBIDDEN:
-                if pat.search(line):
-                    violations.append(
-                        f"{rel}:{i}: unsanctioned sync in {qual}: "
-                        f"{line.strip()!r} (add '{SYNC_OK}: <why>' only "
-                        f"if this is a designed sync boundary)")
-    return violations
-
-
-def check_repo(root: str = None) -> List[str]:
-    root = root or REPO_ROOT
-    by_file: Dict[str, List[str]] = {}
-    for rel, qual in WATCHLIST:
-        by_file.setdefault(rel, []).append(qual)
-    violations = []
-    for rel, quals in by_file.items():
-        violations.extend(check_file(os.path.join(root, rel), quals))
-    return violations
+REPO_ROOT = _hps.REPO_ROOT
+WATCHLIST = _hps.WATCHLIST
+FORBIDDEN = _hps.FORBIDDEN
+SYNC_OK = _hps.SYNC_OK
+check_file = _hps.check_file
+check_repo = _hps.check_repo
 
 
 def main() -> int:

@@ -11,8 +11,8 @@ cd "$(dirname "$0")/.."
 echo "== unit tests (8-dev virtual CPU mesh) =="
 python -m pytest tests/ -x -q
 
-echo "== op-test coverage floor =="
-python tools/op_coverage.py --fail-under 85
+echo "== static analysis: tpulint rules + op-test coverage floor =="
+python tools/run_lints.py
 
 # timeout: a wedged TPU tunnel blocks jax.devices() forever — treat a
 # hung probe as "no accelerator" and keep CI moving (rc 124 -> else)

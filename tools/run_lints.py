@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""Static-analysis aggregator (ISSUE 3 satellite): every tpulint rule
+plus the op-coverage gate in one invocation, wired into tier-1 through
+tests/test_static_analysis.py so a rule regression fails the suite.
+
+  python tools/run_lints.py                  # everything
+  python tools/run_lints.py --skip-op-coverage   # AST lints only
+                                                 # (no jax needed)
+
+Exit status: 0 all gates clean, 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+from tpulint import load_lint  # noqa: E402
+
+# op_coverage gate: every registered lowering should be exercised by a
+# test.  The shipped tree sits well above this; the floor exists so the
+# aggregate gate catches a coverage collapse, not day-to-day drift.
+OP_COVERAGE_FAIL_UNDER = 90.0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--skip-op-coverage", action="store_true",
+                    help="skip the op-coverage gate (it imports "
+                         "paddle_tpu.ops.registry, which needs jax)")
+    ap.add_argument("--root", default=None,
+                    help="repo root to lint (default: this repo)")
+    args = ap.parse_args(argv)
+
+    rc = 0
+    lint = load_lint()
+    findings = lint.run_rules(root=args.root)
+    if findings:
+        print(f"run_lints: tpulint reported {len(findings)} finding(s)",
+              file=sys.stderr)
+        for f in findings:
+            print(f"  {f}", file=sys.stderr)
+        rc = 1
+    else:
+        print(f"run_lints: tpulint clean "
+              f"({', '.join(lint.registered_rules())})")
+
+    if not args.skip_op_coverage:
+        import op_coverage
+
+        cov_rc = op_coverage.main(
+            ["--fail-under", str(OP_COVERAGE_FAIL_UNDER)])
+        if cov_rc:
+            print("run_lints: op_coverage gate failed", file=sys.stderr)
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""tpulint CLI: run the paddle_tpu.analysis.lint rule registry over the
+repo (ISSUE 3, part 2).
+
+Rules (see docs/static_analysis.md):
+  hot-path-sync        blocking device->host constructs in the async
+                       executor / serving hot path (# sync-ok marker)
+  lock-order           lock-acquisition cycles and locks held across
+                       device_put/compile in the serving threads
+  untraced-side-effect self/global mutation inside jax.jit'd functions
+
+Usage:
+  python tools/tpulint.py                 # all rules
+  python tools/tpulint.py --rule lock-order --rule hot-path-sync
+  python tools/tpulint.py --list
+
+The lint framework is stdlib-only and is loaded by FILE PATH (not
+`import paddle_tpu`), so this tool runs in environments without jax.
+Exit status: 0 clean, 1 findings, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_LINT_PKG = os.path.join(REPO_ROOT, "paddle_tpu", "analysis", "lint")
+_LINT_MOD = "paddle_tpu_lint"
+
+
+def load_lint():
+    """The lint framework package, loaded by path so that importing it
+    never drags in paddle_tpu (and therefore jax)."""
+    existing = sys.modules.get(_LINT_MOD)
+    if existing is not None:
+        return existing
+    spec = importlib.util.spec_from_file_location(
+        _LINT_MOD, os.path.join(_LINT_PKG, "__init__.py"),
+        submodule_search_locations=[_LINT_PKG])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[_LINT_MOD] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpulint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--rule", action="append", default=None,
+                    help="run only this rule (repeatable)")
+    ap.add_argument("--list", action="store_true",
+                    help="list registered rules and exit")
+    ap.add_argument("--root", default=None,
+                    help="repo root to lint (default: this repo)")
+    args = ap.parse_args(argv)
+
+    lint = load_lint()
+    if args.list:
+        for name in lint.registered_rules():
+            info = lint.rule_info(name)
+            print(f"{name:22s} {info['help']}")
+        return 0
+    try:
+        findings = lint.run_rules(root=args.root, rules=args.rule)
+    except ValueError as e:
+        print(f"tpulint: {e}", file=sys.stderr)
+        return 2
+    for f in findings:
+        print(f"  {f}", file=sys.stderr)
+    ran = args.rule or lint.registered_rules()
+    if findings:
+        print(f"tpulint: {len(findings)} finding(s) from "
+              f"{len(ran)} rule(s)", file=sys.stderr)
+        return 1
+    print(f"tpulint: clean ({len(ran)} rule(s): {', '.join(ran)})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
