@@ -32,6 +32,13 @@ CPU_PEAK_FLOPS = 2e11  # rough; only used for the CPU fallback line
 ONCHIP_RECORD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "bench_onchip.json")
 
+# session cache for the TPU probe verdict: the wedged-tunnel probe costs
+# up to ~4 min of subprocess timeouts, and fallback paths re-run bench.py
+# several times per session — pay that once per TTL window, not per run
+PROBE_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "artifacts", "tpu_probe_cache.json")
+PROBE_CACHE_TTL_S = float(os.environ.get("PADDLE_TPU_PROBE_TTL_S", "1800"))
+
 
 def _tpu_probe_subprocess(timeout_s=75.0, attempts=3, backoff_s=20.0):
     """Probe the TPU backend in a THROWAWAY subprocess.
@@ -62,6 +69,83 @@ def _tpu_probe_subprocess(timeout_s=75.0, attempts=3, backoff_s=20.0):
             if i + 1 < attempts:
                 time.sleep(backoff_s)
     return False
+
+
+def _tpu_probe_cached():
+    """Probe the TPU backend, reusing this session's verdict.
+
+    The 3-attempt probe (`_tpu_probe_subprocess`) is the right call the
+    FIRST time, but it costs 3x the timeout + backoff when the tunnel
+    is wedged — and every fallback re-run of bench.py in the same
+    session paid it again.  The verdict is cached to
+    artifacts/tpu_probe_cache.json with a TTL
+    (PADDLE_TPU_PROBE_TTL_S, default 1800s); delete the file or set
+    the TTL to 0 to force a fresh probe."""
+    try:
+        with open(PROBE_CACHE) as f:
+            rec = json.load(f)
+        age = time.time() - float(rec["at"])
+        if 0 <= age < PROBE_CACHE_TTL_S:
+            print(f"bench: cached TPU probe verdict ok={rec['ok']} "
+                  f"({age:.0f}s old, {PROBE_CACHE})", file=sys.stderr)
+            return bool(rec["ok"])
+    except (OSError, ValueError, KeyError, TypeError):
+        pass
+    ok = _tpu_probe_subprocess()
+    try:
+        os.makedirs(os.path.dirname(PROBE_CACHE), exist_ok=True)
+        with open(PROBE_CACHE, "w") as f:
+            json.dump({"ok": bool(ok), "at": time.time()}, f)
+    except OSError as e:
+        print(f"bench: could not cache probe verdict: {e}",
+              file=sys.stderr)
+    return ok
+
+
+def bench_feed_pipeline(jax, jnp):
+    """Feed-pipeline micro-exercise (ISSUE 4): stream synthetic batches
+    through the per-host sharded pipeline's device ring while a jitted
+    step consumes them, then report the overlap counters.  The numbers
+    make a stall attributable from the BENCH JSON alone
+    (`stall_attribution`: compute-bound = ring backpressure, the
+    healthy state; parser-/transfer-bound = the feed is the
+    bottleneck), and on a pod slice each host's entry lands under its
+    process index in `per_host_feed_ms`."""
+    import numpy as np
+
+    from paddle_tpu import profiler
+    from paddle_tpu.dataset import feed_pipeline as fp
+
+    for name in ("parser_wait_ms", "ring_full_wait_ms",
+                 "ring_empty_wait_ms", "host_feed_ms", "shard_skew_ms"):
+        profiler.time_reset(name)
+    profiler.stat_reset("ring_occupancy_max")
+
+    n_batches = 32
+    rng = np.random.RandomState(0)
+    pool = [{"x": rng.randn(256, 256).astype(np.float32)}
+            for _ in range(8)]
+    source = (pool[i % len(pool)] for i in range(n_batches))
+
+    @jax.jit
+    def step(x):
+        return (x @ x.T).sum()
+
+    def stage(feed):
+        with profiler.timed("host_feed_ms"):
+            return {k: jax.device_put(v) for k, v in feed.items()}
+
+    pipe = fp.FeedPipeline(stage, source)
+    out = None
+    for staged in pipe:
+        out = step(staged["x"])
+    if out is not None:
+        float(out)  # one sanctioned sync, at the end of the stream
+    report = pipe.feed_report()
+    report["batches"] = n_batches
+    report["per_host_feed_ms"] = {str(report["host"]):
+                                  report["host_feed_ms"]}
+    return report
 
 
 def bert_step_flops(cfg, batch, seq, n_masked):
@@ -599,7 +683,7 @@ def main():
     # decide the backend BEFORE jax loads: a wedged tunnel would block
     # this process's backend init for good
     if os.environ.get("JAX_PLATFORMS") != "cpu" \
-            and not _tpu_probe_subprocess():
+            and not _tpu_probe_cached():
         print("bench: TPU unreachable; pinning to CPU", file=sys.stderr)
         os.environ["JAX_PLATFORMS"] = "cpu"
     jax, backend = _init_backend()
@@ -618,7 +702,11 @@ def main():
         # standalone ResNet line (driver: `python bench.py --model
         # resnet50`); the default two-metric path persists on-chip
         # records — this one is print-only
-        print(json.dumps(bench_resnet50(jax, jnp, on_tpu)))
+        out = bench_resnet50(jax, jnp, on_tpu)
+        out["detail"]["feed_pipeline"] = _run_with_watchdog(
+            lambda: bench_feed_pipeline(jax, jnp), timeout_s=120,
+            what="feed pipeline bench")
+        print(json.dumps(out))
         return
     # full production config: attention dropout 0.1 AND a variable-length
     # padding mask — both now run inside the Pallas kernel (round 2), so
@@ -727,6 +815,12 @@ def main():
               "flash_note": flash_note,
               **pipe,
               "loss": final_loss}
+    # pod-scale input-pipeline fields (ISSUE 4): ring occupancy, shard
+    # skew, per-host feed time + stall attribution — measured AFTER the
+    # timed region so they cannot perturb the primary metric
+    detail["feed_pipeline"] = _run_with_watchdog(
+        lambda: bench_feed_pipeline(jax, jnp), timeout_s=120,
+        what="feed pipeline bench")
     result = {
         "metric": ("bert_base_pretrain_mfu" if on_tpu
                    else "bert_tiny_pretrain_mfu_cpu"),

@@ -42,6 +42,14 @@ WATCHLIST: List[Tuple[str, str]] = [
     ("paddle_tpu/fluid/executor.py", "Executor.train_from_dataset"),
     ("paddle_tpu/fluid/executor.py", "_FeedPrefetcher"),
     ("paddle_tpu/fluid/executor.py", "LazyFetch.numpy"),
+    # pod-scale feed pipeline (ISSUE 4): the per-host sharded producer
+    # and the device ring ARE the feed hot path — staging must stay
+    # async (device_put only); materialization belongs to the consumer
+    # at sanctioned boundaries
+    ("paddle_tpu/dataset/feed_pipeline.py", "FeedPipeline.__iter__"),
+    ("paddle_tpu/dataset/feed_pipeline.py", "FeedPipeline._produce"),
+    ("paddle_tpu/dataset/feed_pipeline.py", "DeviceRing.put"),
+    ("paddle_tpu/dataset/feed_pipeline.py", "DeviceRing.get"),
     ("paddle_tpu/parallel/compiler.py", "CompiledProgram._run"),
     ("paddle_tpu/io/__init__.py", "DataLoader.__iter__"),
     # serving dispatch loop (ISSUE 2): the engine's hot path has the

@@ -13,5 +13,6 @@ readers take the local archive paths the class datasets take —
 from . import (cifar, common, conll05, flowers, image, imdb,  # noqa: F401
                imikolov, mnist, movielens, uci_housing, voc2012,
                wmt14, wmt16)
+from . import feed_pipeline  # noqa: F401  (pod-scale input pipeline)
 
 __all__ = []

@@ -14,7 +14,8 @@ import os
 from . import collective  # noqa: F401
 from .collective import (all_gather, all_reduce, barrier, broadcast,  # noqa: F401
                          get_rank, get_world_size, scatter)
-from .parallel import init_parallel_env, ParallelEnv, prepare_context  # noqa: F401
+from .parallel import (init_parallel_env, ParallelEnv, prepare_context,  # noqa: F401
+                       process_count, process_index)
 from .spawn import spawn  # noqa: F401
 from . import launch_utils  # noqa: F401
 

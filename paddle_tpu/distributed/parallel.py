@@ -55,7 +55,7 @@ def _safe_process_index():
 
         return jax.process_index()
     except Exception:
-        return 0
+        return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
 
 
 def _safe_process_count():
@@ -64,7 +64,20 @@ def _safe_process_count():
 
         return jax.process_count()
     except Exception:
-        return 1
+        return int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+
+
+def process_index() -> int:
+    """This host's process index (jax runtime, else the PADDLE_* env
+    contract, else 0).  The key the pod-scale feed pipeline shards
+    datasets by — see paddle_tpu.dataset.feed_pipeline."""
+    return _safe_process_index()
+
+
+def process_count() -> int:
+    """Number of host processes in the job (jax runtime, else env,
+    else 1)."""
+    return _safe_process_count()
 
 
 _initialized = False

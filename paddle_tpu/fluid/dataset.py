@@ -52,6 +52,11 @@ class DatasetBase:
         self._thread = 1
         self._parse_fn = None
         self._drop_last = False
+        self._seed = 0
+        # set by load_into_memory(shard_by_host=True): the store already
+        # holds only this host's shard, so the feed pipeline must not
+        # shard a second time
+        self._host_sharded = False
 
     # -- reference config surface -------------------------------------------
     def set_batch_size(self, batch_size):
@@ -77,6 +82,40 @@ class DatasetBase:
             "set_pipe_command (shell preprocessors) is not supported on "
             "the TPU build; use set_parse_fn(python_fn) instead")
 
+    def set_shuffle_seed(self, seed):
+        """Seeds both the in-memory global shuffle and the per-epoch
+        host-shard permutation (multi-process jobs)."""
+        self._seed = int(seed)
+
+    # -- per-host sharding (pod-scale feed pipeline) -------------------------
+    def _shard_files(self, shard, epoch=0):
+        """(files, keep) for one host's shard of this dataset.
+
+        File-level mode (the normal case, len(filelist) >= host count):
+        a strided slice of the deterministic per-epoch file permutation
+        — each host parses ONLY its own files.  Record fallback (fewer
+        files than hosts): every host reads all files but parses only
+        the lines where `keep(line_idx)` is true — a disjoint,
+        exhaustive slice of each file's records.  Either way the union
+        over hosts is the full dataset and no record lands on two
+        hosts (see dataset/feed_pipeline.shard_plan).
+        """
+        if not shard:
+            return list(self._filelist), None
+        from ..dataset.feed_pipeline import shard_plan
+
+        index, count = shard
+        count = max(1, int(count))
+        if count <= 1:
+            return list(self._filelist), None
+        if len(self._filelist) >= count:
+            order = shard_plan(len(self._filelist), index, count,
+                               epoch=epoch, seed=self._seed)
+            return [self._filelist[i] for i in order], None
+        offset = (int(index) + int(epoch)) % count
+        return list(self._filelist), \
+            lambda li, _c=count, _o=offset: li % _c == _o
+
     # -- parsing -------------------------------------------------------------
     def _parse_line(self, line):
         if self._parse_fn is not None:
@@ -93,15 +132,20 @@ class DatasetBase:
             out.append(np.asarray(vals, dtype=dt))
         return out
 
-    def _iter_samples(self, files):
+    def _iter_samples(self, files, keep=None):
+        """`keep(line_idx)` (record-fallback sharding) filters BEFORE
+        parsing, so this host never parses another host's records."""
         for path in files:
             with open(path) as f:
+                li = 0
                 for line in f:
                     line = line.strip()
                     if line:
-                        yield self._parse_line(line)
+                        if keep is None or keep(li):
+                            yield self._parse_line(line)
+                        li += 1
 
-    def _iter_samples_keyed(self, files, file_base):
+    def _iter_samples_keyed(self, files, file_base, keep=None):
         """(sort_key, sample) pairs so threaded loads can restore the
         deterministic file/line order afterwards."""
         for fi, path in enumerate(files):
@@ -110,7 +154,9 @@ class DatasetBase:
                 for line in f:
                     line = line.strip()
                     if line:
-                        yield (file_base[fi], li), self._parse_line(line)
+                        if keep is None or keep(li):
+                            yield (file_base[fi], li), \
+                                self._parse_line(line)
                         li += 1
 
     def _batch(self, samples):
@@ -125,7 +171,7 @@ class DatasetBase:
             feed[v.name] = a
         return feed
 
-    def batch_iter(self):
+    def batch_iter(self, shard=None, epoch=0):
         raise NotImplementedError
 
 
@@ -135,25 +181,39 @@ class InMemoryDataset(DatasetBase):
     def __init__(self):
         super().__init__()
         self._samples = None
-        self._seed = 0
 
-    def load_into_memory(self):
+    def load_into_memory(self, shard_by_host=False, process_index=None,
+                         process_count=None):
+        """`shard_by_host=True` (pod-slice jobs) loads ONLY this host's
+        file shard (record slices when there are fewer files than
+        hosts), so no host parses — or stores — another host's data.
+        The feed pipeline then iterates the store as-is
+        (`_host_sharded`)."""
         if not self._filelist:
             raise ValueError("set_filelist() before load_into_memory()")
+        keep = None
+        filelist = self._filelist
+        if shard_by_host:
+            from ..dataset.feed_pipeline import host_topology
+
+            index, count = host_topology(process_index, process_count)
+            filelist, keep = self._shard_files((index, count))
+            self._host_sharded = count > 1
         samples = []
-        if self._thread <= 1 or len(self._filelist) <= 1:
-            samples = list(self._iter_samples(self._filelist))
+        if self._thread <= 1 or len(filelist) <= 1:
+            samples = list(self._iter_samples(filelist, keep=keep))
         else:
             from ..core_native import BlockingQueue
 
             q = BlockingQueue(capacity=4096)
-            chunks = [(self._filelist[i::self._thread],
-                       list(range(i, len(self._filelist), self._thread)))
+            chunks = [(filelist[i::self._thread],
+                       list(range(i, len(filelist), self._thread)))
                       for i in range(self._thread)]
             chunks = [c for c in chunks if c[0]]
 
             def worker(files, base):
-                for item in self._iter_samples_keyed(files, base):
+                for item in self._iter_samples_keyed(files, base,
+                                                     keep=keep):
                     q.push(item)
                 q.push(None)  # done marker
 
@@ -177,9 +237,6 @@ class InMemoryDataset(DatasetBase):
             samples = [s for _, s in keyed]
         self._samples = samples
 
-    def set_shuffle_seed(self, seed):
-        self._seed = int(seed)
-
     def global_shuffle(self, fleet=None, thread_num=None):
         """data_set.cc global_shuffle: one permutation over EVERY loaded
         sample (vs local per-file shuffle)."""
@@ -193,12 +250,24 @@ class InMemoryDataset(DatasetBase):
     def get_memory_data_size(self, fleet=None):
         return len(self._samples or [])
 
-    def batch_iter(self):
+    def batch_iter(self, shard=None, epoch=0):
+        """`shard=(index, count)`: yield only this host's disjoint,
+        exhaustive sample slice (strided over the deterministic
+        per-epoch permutation) — unless the store itself was loaded
+        sharded, in which case it is already this host's data."""
         if self._samples is None:
             raise ValueError("load_into_memory() first")
-        n = len(self._samples)
+        samples = self._samples
+        if shard and not self._host_sharded:
+            from ..dataset.feed_pipeline import shard_plan
+
+            index, count = shard
+            order = shard_plan(len(samples), index, count, epoch=epoch,
+                               seed=self._seed)
+            samples = [samples[i] for i in order]
+        n = len(samples)
         for i in range(0, n, self._batch_size):
-            chunk = self._samples[i:i + self._batch_size]
+            chunk = samples[i:i + self._batch_size]
             if self._drop_last and len(chunk) < self._batch_size:
                 break
             yield self._batch(chunk)
@@ -209,18 +278,24 @@ class QueueDataset(DatasetBase):
     background parser pool feeds the native BlockingQueue; batch_iter
     pops without holding the dataset in memory."""
 
-    def batch_iter(self):
+    def batch_iter(self, shard=None, epoch=0):
+        """`shard=(index, count)`: this host's parser pool streams only
+        its own file shard (per-epoch deterministic reshuffle; record
+        slices when files < hosts) — the pod-scale feed path."""
         if not self._filelist:
             raise ValueError("set_filelist() before iterating")
+        filelist, keep = self._shard_files(shard, epoch=epoch)
+        if not filelist:
+            return
         from ..core_native import BlockingQueue
 
         q = BlockingQueue(capacity=1024)
-        chunks = [self._filelist[i::self._thread]
+        chunks = [filelist[i::self._thread]
                   for i in range(self._thread)]
         chunks = [c for c in chunks if c]
 
         def worker(files):
-            for s in self._iter_samples(files):
+            for s in self._iter_samples(files, keep=keep):
                 if not q.push(s):
                     return  # queue closed: consumer abandoned the epoch
             q.push(None)

@@ -359,63 +359,23 @@ class FetchHandlerMonitor:
 
 
 class _FeedPrefetcher:
-    """Overlapped feed stage for the dataset loops: a background thread
-    normalizes + `jax.device_put`s batch N+k while batch N computes (the
-    reference's BufferedReader double-buffer, buffered_reader.cc, lifted
-    to the whole feed dict).  Queue depth = prefetch_depth; upstream
-    exceptions re-raise in the consumer."""
-
-    _END = object()
+    """Overlapped feed stage for the dataset loops (the reference's
+    BufferedReader double-buffer, buffered_reader.cc, lifted to the
+    whole feed dict).  Now a thin adapter over
+    `dataset.feed_pipeline.FeedPipeline`: the staging thread, the
+    device-resident ring with backpressure, and the overlap counters
+    all live there; this name survives for API compatibility and for
+    callers feeding a raw batch iterable (no host sharding)."""
 
     def __init__(self, executor, program, batch_iter, depth):
-        import queue as _queue
+        from ..dataset.feed_pipeline import FeedPipeline
 
-        from ..profiler import stat_set
-
-        self._q = _queue.Queue(maxsize=max(1, depth))
-        self._stop = threading.Event()
-        stat_set("prefetch_depth", max(1, depth))
-
-        def fill():
-            try:
-                for feed in batch_iter:
-                    staged = executor._normalize_feed(program, feed)
-                    while not self._stop.is_set():
-                        try:
-                            self._q.put(staged, timeout=0.1)
-                            break
-                        except _queue.Full:
-                            continue
-                    else:
-                        return
-                self._put(self._END)
-            except BaseException as e:  # noqa: BLE001 - forward to consumer
-                self._put(e)
-
-        self._thread = threading.Thread(target=fill, daemon=True)
-        self._thread.start()
-
-    def _put(self, item):
-        import queue as _queue
-
-        while not self._stop.is_set():
-            try:
-                self._q.put(item, timeout=0.1)
-                return
-            except _queue.Full:
-                continue
+        self._pipe = FeedPipeline(
+            lambda feed: executor._normalize_feed(program, feed),
+            batch_iter, depth=depth)
 
     def __iter__(self):
-        try:
-            while True:
-                item = self._q.get()
-                if item is self._END:
-                    return
-                if isinstance(item, BaseException):
-                    raise item
-                yield item
-        finally:
-            self._stop.set()
+        return iter(self._pipe)
 
 
 def _analyze_block(block, feed_names, scope: Scope):
@@ -538,11 +498,16 @@ class Executor:
         the single device stream, so `thread` configures the parser
         pool (dataset.set_thread) instead of device workers.
 
-        Async hot path: a `_FeedPrefetcher` stages batch N+k on device
-        while batch N computes, steps dispatch with lazy fetches, and
-        fetch materialization happens only at `print_period` boundaries
-        and at loop exit.  `prefetch_depth` bounds how far the host runs
-        ahead (default PADDLE_PREFETCH_DEPTH, 2)."""
+        Async hot path: the pod-scale feed pipeline
+        (`dataset.feed_pipeline.FeedPipeline`) stages batch N+1..N+K
+        into a device-resident ring while batch N computes — on a
+        multi-process pod slice each host's parser pool reads only its
+        own disjoint, exhaustive dataset shard (reshuffled
+        deterministically each epoch) — steps dispatch with lazy
+        fetches, and fetch materialization happens only at
+        `print_period` boundaries and at loop exit.  `prefetch_depth`
+        bounds both the ring and how far the host runs ahead (default
+        PADDLE_PREFETCH_DEPTH, 2)."""
         if dataset is None:
             raise ValueError("train_from_dataset needs a dataset")
         if thread:
@@ -557,14 +522,17 @@ class Executor:
             monitor = FetchHandlerMonitor(scope or global_scope(),
                                           fetch_handler)
             monitor.start()
-        from ..profiler import stat_set
+        from ..dataset.feed_pipeline import FeedPipeline
+        from ..profiler import stat_max, stat_set
 
+        program = program if program is not None else \
+            default_main_program()
         step = 0
         last = None
         in_flight = collections.deque()
-        prefetcher = _FeedPrefetcher(
-            self, program if program is not None else
-            default_main_program(), dataset.batch_iter(), depth)
+        prefetcher = FeedPipeline(
+            lambda feed: self._normalize_feed(program, feed),
+            dataset, depth=depth)
         try:
             for feed in prefetcher:
                 outs = self.run(program, feed=feed, fetch_list=fetch_list,
@@ -573,6 +541,7 @@ class Executor:
                 step += 1
                 in_flight.append(outs)
                 stat_set("in_flight_steps", len(in_flight))
+                stat_max("in_flight_steps_max", len(in_flight))
                 if len(in_flight) > depth:
                     # throttle: the host never runs more than `depth`
                     # steps ahead — wait on the OLDEST step's fetches

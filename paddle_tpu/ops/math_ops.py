@@ -15,7 +15,16 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .registry import first, jdt, register_op
+from .registry import first, jdt, mxu_accum_dtype, register_op
+
+
+def _mm(x, y):
+    """Matmul with the amp-O2 accumulation contract: bf16/f16 operands
+    contract in fp32 on the MXU (`preferred_element_type`) and round
+    once on the way out; full-precision operands are untouched."""
+    pref, out_dt = mxu_accum_dtype(x, y)
+    out = jnp.matmul(x, y, preferred_element_type=pref)
+    return out.astype(out_dt) if out_dt is not None else out
 
 
 def _bcast_y(x, y, axis):
@@ -95,7 +104,7 @@ def _matmul(ctx, op, ins):
         x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
     if op.attr("transpose_Y", False):
         y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
-    out = x @ y
+    out = _mm(x, y)
     alpha = op.attr("alpha", 1.0)
     if alpha != 1.0:
         out = out * jnp.asarray(alpha, out.dtype)
@@ -109,7 +118,7 @@ def _matmul_v2(ctx, op, ins):
         x = jnp.swapaxes(x, -1, -2)
     if op.attr("trans_y", False) and y.ndim > 1:
         y = jnp.swapaxes(y, -1, -2)
-    return {"Out": [x @ y]}
+    return {"Out": [_mm(x, y)]}
 
 
 @register_op("mul")
@@ -119,7 +128,7 @@ def _mul(ctx, op, ins):
     yn = op.attr("y_num_col_dims", 1)
     xm = x.reshape((-1, _prod(x.shape[xn:])))
     ym = y.reshape((int(_prod(y.shape[:yn])), -1))
-    out = xm @ ym
+    out = _mm(xm, ym)
     out_shape = x.shape[:xn] + y.shape[yn:]
     return {"Out": [out.reshape(out_shape)]}
 
@@ -133,7 +142,7 @@ def _prod(t):
 
 @register_op("bmm")
 def _bmm(ctx, op, ins):
-    return {"Out": [jnp.matmul(first(ins, "X"), first(ins, "Y"))]}
+    return {"Out": [_mm(first(ins, "X"), first(ins, "Y"))]}
 
 
 @register_op("dot")
@@ -144,7 +153,7 @@ def _dot(ctx, op, ins):
 
 @register_op("mv")
 def _mv(ctx, op, ins):
-    return {"Out": [first(ins, "X") @ first(ins, "Vec")]}
+    return {"Out": [_mm(first(ins, "X"), first(ins, "Vec"))]}
 
 
 @register_op("addmm")

@@ -22,7 +22,44 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from .registry import first, jdt, register_op
+from .registry import first, jdt, mxu_accum_dtype, register_op
+
+
+def _conv_mxu(x, w, **kw):
+    """`lax.conv_general_dilated` under the amp-O2 accumulation
+    contract: bf16/f16 operands contract in fp32 on the MXU
+    (`preferred_element_type`) and round ONCE on the way out, instead
+    of inheriting bf16 accumulation across the whole K dimension.
+    Full-precision operands take the plain path untouched.
+
+    jax 0.4.x's conv transpose rule rejects the fp32 cotangent that
+    `preferred_element_type` produces (mixed-dtype conv TypeError), so
+    the low-precision path carries a custom_vjp whose backward
+    recomputes through the plain operand-dtype conv — forward
+    activations gain fp32 accumulation; gradient convs keep the
+    operand-dtype accumulation they always had."""
+    pref, out_dt = mxu_accum_dtype(x, w)
+    if pref is None:
+        return lax.conv_general_dilated(x, w, **kw)
+
+    def plain(a, b):
+        return lax.conv_general_dilated(a, b, **kw)
+
+    @jax.custom_vjp
+    def conv(a, b):
+        return lax.conv_general_dilated(
+            a, b, preferred_element_type=pref, **kw).astype(out_dt)
+
+    def fwd(a, b):
+        return conv(a, b), (a, b)
+
+    def bwd(res, g):
+        a, b = res
+        _, vjp = jax.vjp(plain, a, b)
+        return vjp(g.astype(out_dt))
+
+    conv.defvjp(fwd, bwd)
+    return conv(x, w)
 
 
 def _conv_paddings(padding_algorithm, paddings, ksize, dilations):
@@ -55,11 +92,10 @@ def _conv2d(ctx, op, ins):
         w = jnp.transpose(w, (2, 3, 1, 0))
     pads = _conv_paddings(op.attr("padding_algorithm", "EXPLICIT"),
                           op.attr("paddings", [0, 0]), w.shape[-2:], dilations)
-    out = lax.conv_general_dilated(
+    out = _conv_mxu(
         x, w, window_strides=strides, padding=pads,
         rhs_dilation=dilations, dimension_numbers=dn,
         feature_group_count=groups,
-        preferred_element_type=None,
     )
     return {"Output": [out]}
 
@@ -91,7 +127,7 @@ def _conv2d_transpose(ctx, op, ins):
 
 
 def _conv_transpose_flipped(x, w, strides, pads, dilations):
-    return lax.conv_general_dilated(
+    return _conv_mxu(
         x, w[..., ::-1, ::-1],
         window_strides=(1, 1),
         padding=[(dilations[i] * (w.shape[-2:][i] - 1) - pads[i][0],
@@ -118,7 +154,7 @@ def _conv3d(ctx, op, ins):
     dilations = tuple(op.attr("dilations", [1, 1, 1]))
     pads = _conv_paddings(op.attr("padding_algorithm", "EXPLICIT"),
                           op.attr("paddings", [0, 0, 0]), w.shape[-3:], dilations)
-    out = lax.conv_general_dilated(
+    out = _conv_mxu(
         x, w, window_strides=strides, padding=pads,
         rhs_dilation=dilations, dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
         feature_group_count=op.attr("groups", 1))
@@ -146,32 +182,39 @@ def _pool2d(ctx, op, ins):
     x = first(ins, "X")
     fmt = op.attr("data_format", "NCHW")
     ptype = op.attr("pooling_type", "max")
-    assert fmt in ("NCHW", "AnyLayout"), "NHWC pool: transpose at layer level"
+    # NHWC lowers natively (no transpose), mirroring the conv2d NHWC
+    # dimension-number path: the window/stride/padding land on the
+    # spatial axes of whichever layout the data is in
+    h_ax, w_ax = (1, 2) if fmt == "NHWC" else (2, 3)
+    sp_axes = (h_ax, w_ax)
     if op.attr("global_pooling", False) or (
             op.attr("adaptive", False) and list(op.attr("ksize")) == [1, 1]):
         red = jnp.max if ptype == "max" else jnp.mean
-        return {"Out": [red(x, axis=(2, 3), keepdims=True)]}
+        return {"Out": [red(x, axis=sp_axes, keepdims=True)]}
     if op.attr("adaptive", False):
         oh, ow = op.attr("ksize")
-        h, w = x.shape[2], x.shape[3]
+        h, w = x.shape[h_ax], x.shape[w_ax]
         red = jnp.max if ptype == "max" else jnp.mean
-        if h % oh == 0 and w % ow == 0:
+        if h % oh == 0 and w % ow == 0 and fmt != "NHWC":
             x5 = x.reshape(x.shape[0], x.shape[1], oh, h // oh, ow, w // ow)
             return {"Out": [red(x5, axis=(3, 5))]}
         # general interval pooling: see _adaptive_pool_axis
         return {"Out": [_adaptive_pool_axis(
-            _adaptive_pool_axis(x, oh, 2, red), ow, 3, red)]}
+            _adaptive_pool_axis(x, oh, h_ax, red), ow, w_ax, red)]}
     ksize = tuple(op.attr("ksize", [2, 2]))
     strides = tuple(op.attr("strides", [1, 1]))
     pads = _conv_paddings(op.attr("padding_algorithm", "EXPLICIT"),
                           op.attr("paddings", [0, 0]), ksize, (1, 1))
-    if pads == "SAME":
-        pads = "SAME"
-        pad_cfg = None
+    if fmt == "NHWC":
+        window = (1,) + ksize + (1,)
+        strides4 = (1,) + strides + (1,)
+        pad_cfg = None if pads == "SAME" \
+            else [(0, 0)] + list(pads) + [(0, 0)]
     else:
-        pad_cfg = [(0, 0), (0, 0)] + list(pads)
-    window = (1, 1) + ksize
-    strides4 = (1, 1) + strides
+        window = (1, 1) + ksize
+        strides4 = (1, 1) + strides
+        pad_cfg = None if pads == "SAME" \
+            else [(0, 0), (0, 0)] + list(pads)
     if ptype == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
         out = lax.reduce_window(x, init, lax.max, window, strides4,

@@ -143,6 +143,23 @@ def jdt(dtype_name) -> jnp.dtype:
     return dt
 
 
+_LOW_PRECISION = (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float16))
+
+
+def mxu_accum_dtype(*arrays):
+    """(preferred_element_type, out_dtype) for an MXU contraction.
+
+    amp-O2 contract: bf16/f16 operands must ACCUMULATE in fp32 on the
+    MXU (`preferred_element_type=float32`) and round once to the
+    operand precision on the way out — bf16 accumulation loses ~3
+    effective mantissa bits over a long K dimension.  Full-precision
+    operands return (None, None): no override, no extra cast."""
+    dt = jnp.result_type(*arrays)
+    if jnp.dtype(dt) in _LOW_PRECISION:
+        return jnp.float32, jnp.dtype(dt)
+    return None, None
+
+
 def _is_diff(x) -> bool:
     return jnp.issubdtype(jnp.result_type(x), jnp.inexact)
 

@@ -203,6 +203,16 @@ def stat_set(name: str, value: int) -> None:
         _STATS[name] = int(value)
 
 
+def stat_max(name: str, value: int) -> None:
+    """High-water-mark gauge: keep the max ever observed (ring
+    occupancy, in-flight steps) so a test can assert overlap happened
+    without sampling the gauge at exactly the right moment."""
+    with _STATS_LOCK:
+        cur = _STATS.get(name)
+        if cur is None or int(value) > cur:
+            _STATS[name] = int(value)
+
+
 def stat_reset(name: str = None) -> None:
     """STAT_RESET: clear one counter, or all of them."""
     with _STATS_LOCK:
@@ -229,6 +239,14 @@ def time_add(name: str, ms: float) -> None:
     (host_feed_ms / dispatch_ms / sync_ms)."""
     with _STATS_LOCK:
         _TIMES[name] = _TIMES.get(name, 0.0) + float(ms)
+
+
+def time_set(name: str, ms: float) -> None:
+    """Overwrite a pipeline gauge expressed in milliseconds (e.g.
+    `shard_skew_ms`, which is a per-epoch measurement, not a running
+    accumulation)."""
+    with _STATS_LOCK:
+        _TIMES[name] = float(ms)
 
 
 def time_reset(name: str = None) -> None:

@@ -19,7 +19,7 @@ import random
 import threading
 
 __all__ = ["cache", "map_readers", "buffered", "device_buffered", "compose",
-           "chain", "shuffle", "firstn", "xmap_readers",
+           "chain", "shuffle", "shard", "firstn", "xmap_readers",
            "multiprocess_reader"]
 
 
@@ -58,6 +58,25 @@ def shuffle(reader, buf_size):
         if buf:
             random.shuffle(buf)
             yield from buf
+
+    return creator
+
+
+def shard(reader, num_shards=None, shard_id=None):
+    """Per-host disjoint shard of a reader (the reader-decorator face
+    of the pod-scale feed pipeline): sample i is yielded on the host
+    where `i % num_shards == shard_id`.  Defaults come from the live
+    jax process topology, so a pod-slice job feeding through readers
+    stops re-reading every other host's samples.  The union over all
+    hosts is exactly the underlying reader's stream, with no overlap."""
+
+    def creator():
+        from .dataset.feed_pipeline import host_topology
+
+        index, count = host_topology(shard_id, num_shards)
+        for i, s in enumerate(reader()):
+            if i % count == index:
+                yield s
 
     return creator
 

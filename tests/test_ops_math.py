@@ -372,3 +372,37 @@ class TestPowOp(OpTest):
         self.outputs = {"Out": x ** 3.0}
         self.check_output(atol=1e-4)
         self.check_grad(["X"], "Out", max_relative_error=1e-2)
+
+
+class TestMatmulBf16AccumulatesFp32:
+    """ISSUE 4 satellite: bf16 matmuls contract in fp32
+    (preferred_element_type) and round once at the output."""
+
+    def test_pref_and_numerics(self):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops import math_ops
+
+        a = jnp.ones((4, 4096), jnp.bfloat16)
+        b = jnp.full((4096, 2), 2.0 ** -10, jnp.bfloat16)
+        jaxpr = str(jax.make_jaxpr(math_ops._mm)(a, b))
+        assert "preferred_element_type=float32" in jaxpr
+        out = math_ops._mm(a, b)
+        assert out.dtype == jnp.bfloat16
+        # 4096 * 2^-10 = 4.0 exactly; bf16 accumulation would lose the
+        # small addends once the partial sum grows and land well short
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), 4.0, rtol=0.02)
+
+    def test_fp32_matmul_untouched(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops import math_ops
+
+        a = jnp.ones((3, 8), jnp.float32)
+        b = jnp.ones((8, 3), jnp.float32)
+        out = math_ops._mm(a, b)
+        # no downcast sneaks in for full-precision operands
+        assert out.dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(out), 8.0)

@@ -361,3 +361,110 @@ class TestDropoutStats(OpTest):
         assert abs(keep_ratio - 0.7) < 0.05
         kept = out[out != 0]
         np.testing.assert_allclose(kept, 1 / 0.7, rtol=1e-5)
+
+
+class TestPool2dNHWC(OpTest):
+    """ISSUE 4 satellite: NHWC max/avg pool lower natively (no
+    layer-level transpose), matching the conv2d NHWC path — oracle is
+    the NCHW lowering of the transposed input."""
+    op_type = "pool2d"
+
+    def _attrs(self, ptype, fmt, **over):
+        a = {"pooling_type": ptype, "ksize": [2, 2], "strides": [2, 2],
+             "paddings": [0, 0], "global_pooling": False,
+             "adaptive": False, "exclusive": True, "ceil_mode": False,
+             "padding_algorithm": "EXPLICIT", "data_format": fmt}
+        a.update(over)
+        return a
+
+    def test_max(self):
+        # seed 64 = the NCHW TestPool2dMax data: proven free of the
+        # near-ties that break numeric max-pool gradients
+        x = randf(2, 3, 6, 6, seed=64)
+        want = x.reshape(2, 3, 3, 2, 3, 2).max(axis=(3, 5))
+        self.inputs = {"X": np.transpose(x, (0, 2, 3, 1)).copy()}
+        self.attrs = self._attrs("max", "NHWC")
+        self.outputs = {"Out": np.transpose(want, (0, 2, 3, 1)).copy()}
+        self.check_output()
+        self.check_grad(["X"], "Out", max_relative_error=1e-2)
+
+    def test_avg(self):
+        x = randf(2, 3, 6, 6, seed=165)
+        want = x.reshape(2, 3, 3, 2, 3, 2).mean(axis=(3, 5))
+        self.inputs = {"X": np.transpose(x, (0, 2, 3, 1)).copy()}
+        self.attrs = self._attrs("avg", "NHWC")
+        self.outputs = {"Out": np.transpose(want, (0, 2, 3, 1)).copy()}
+        self.check_output(atol=1e-5)
+
+    def test_global(self):
+        x = randf(2, 5, 4, 4, seed=166)
+        self.inputs = {"X": np.transpose(x, (0, 2, 3, 1)).copy()}
+        self.attrs = self._attrs("avg", "NHWC", global_pooling=True,
+                                 ksize=[1, 1], strides=[1, 1])
+        self.outputs = {"Out": np.transpose(
+            x.mean((2, 3), keepdims=True), (0, 2, 3, 1)).copy()}
+        self.check_output()
+
+    def test_adaptive(self):
+        x = randf(1, 2, 6, 6, seed=167)
+        want = x.reshape(1, 2, 3, 2, 3, 2).max(axis=(3, 5))
+        self.inputs = {"X": np.transpose(x, (0, 2, 3, 1)).copy()}
+        self.attrs = self._attrs("max", "NHWC", adaptive=True,
+                                 ksize=[3, 3], strides=[1, 1])
+        self.outputs = {"Out": np.transpose(want, (0, 2, 3, 1)).copy()}
+        self.check_output()
+
+
+class TestConvBf16AccumulatesFp32:
+    """ISSUE 4 satellite: bf16 convs contract in fp32 on the MXU
+    (preferred_element_type) and round once at the output, instead of
+    inheriting bf16 accumulation; output dtype stays bf16 and the
+    lowering stays differentiable."""
+
+    def _kw(self):
+        return dict(window_strides=(1, 1), padding="SAME",
+                    dimension_numbers=("NCHW", "OIHW", "NCHW"),
+                    feature_group_count=1)
+
+    def test_pref_in_lowered_graph_and_out_dtype(self):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops import nn_ops
+
+        x = jnp.ones((1, 8, 4, 4), jnp.bfloat16)
+        w = jnp.ones((2, 8, 3, 3), jnp.bfloat16)
+        fn = lambda a, b: nn_ops._conv_mxu(a, b, **self._kw())  # noqa: E731
+        jaxpr = str(jax.make_jaxpr(fn)(x, w))
+        assert "preferred_element_type=float32" in jaxpr
+        out = fn(x, w)
+        assert out.dtype == jnp.bfloat16
+
+    def test_fp32_conv_untouched(self):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops import nn_ops
+
+        x = jnp.ones((1, 4, 4, 4), jnp.float32)
+        w = jnp.ones((2, 4, 3, 3), jnp.float32)
+        jaxpr = str(jax.make_jaxpr(
+            lambda a, b: nn_ops._conv_mxu(a, b, **self._kw()))(x, w))
+        assert "preferred_element_type=float32" not in jaxpr
+
+    def test_still_differentiable(self):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops import nn_ops
+
+        x = jnp.ones((1, 3, 5, 5), jnp.bfloat16)
+        w = jnp.ones((2, 3, 3, 3), jnp.bfloat16)
+
+        def f(a, b):
+            return nn_ops._conv_mxu(a, b, **self._kw()) \
+                .astype(jnp.float32).sum()
+
+        gx, gw = jax.grad(f, argnums=(0, 1))(x, w)
+        assert gx.dtype == jnp.bfloat16 and gw.dtype == jnp.bfloat16
+        assert bool(jnp.all(jnp.isfinite(gx.astype(jnp.float32))))
