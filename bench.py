@@ -452,6 +452,44 @@ def _resnet_tuned_batch():
         return None
 
 
+def _resnet_layout_detail():
+    """`detail.layout` (ISSUE 5 satellite): what the graph-transform
+    pipeline does to the ResNet-50 Program — layout chosen, interior
+    activation transposes left in the lowered trunk, and the pipeline's
+    wall time.  Measured on a toy-width program OUTSIDE the timed
+    region (shape-only jaxpr trace, no device work); failures degrade
+    to an error string instead of killing the metric."""
+    import time as _time
+
+    try:
+        import paddle_tpu.fluid as pfluid
+        from paddle_tpu import transforms
+        from paddle_tpu.fluid import framework, unique_name
+        from paddle_tpu.models import resnet as presnet
+        from paddle_tpu.transforms import debug as tdebug
+
+        with framework.program_guard(pfluid.Program(), pfluid.Program()), \
+                unique_name.guard():
+            main, _startup, _feeds, fetches = presnet.build_train_program(
+                depth=50, class_num=10, image_shape=(3, 32, 32),
+                batch_size=2, width=4)
+        infer = main.clone(for_test=True)
+        t0 = _time.perf_counter()
+        tprog, stats = transforms.apply_transforms(
+            infer, feed_names=["image", "label"],
+            fetch_names=[fetches[0].name],
+            passes=["layout_optimize", "dead_op_elim"])
+        transform_ms = (_time.perf_counter() - t0) * 1e3
+        rep = tdebug.layout_report(
+            tprog, {"image": ((2, 3, 32, 32), "float32"),
+                    "label": ((2, 1), "int64")},
+            [fetches[0].name], transform_stats=stats)
+        rep["transform_ms"] = round(transform_ms, 2)
+        return rep
+    except Exception as e:  # noqa: BLE001 - detail must not kill the bench
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def bench_resnet50(jax, jnp, on_tpu, batch=None):
     """ResNet-50 train-step throughput, images/sec/chip (BASELINE.md
     row 1; reference anchor: the book image-classification fixture
@@ -572,6 +610,7 @@ def bench_resnet50(jax, jnp, on_tpu, batch=None):
                    "flops_per_step": float(flops),
                    "host_feed_ms": round(host_feed_ms, 3),
                    **pipe,
+                   "layout": _resnet_layout_detail(),
                    "loss": final_loss},
     }
 

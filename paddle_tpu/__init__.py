@@ -38,6 +38,7 @@ from . import vision
 from . import text
 from . import dataset
 from . import inference
+from . import transforms
 from . import profiler
 from . import utils
 from . import reader

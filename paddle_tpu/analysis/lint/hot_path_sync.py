@@ -51,6 +51,11 @@ WATCHLIST: List[Tuple[str, str]] = [
     ("paddle_tpu/dataset/feed_pipeline.py", "DeviceRing.put"),
     ("paddle_tpu/dataset/feed_pipeline.py", "DeviceRing.get"),
     ("paddle_tpu/parallel/compiler.py", "CompiledProgram._run"),
+    # graph-transform pipeline (ISSUE 5): runs ONLY on the compile-
+    # cache-miss path and manipulates Program metadata — it must never
+    # touch device arrays, so the zero-sync contract applies verbatim
+    ("paddle_tpu/transforms/__init__.py", "maybe_transform_program"),
+    ("paddle_tpu/transforms/__init__.py", "apply_transforms"),
     ("paddle_tpu/io/__init__.py", "DataLoader.__iter__"),
     # serving dispatch loop (ISSUE 2): the engine's hot path has the
     # same zero-transfer contract — the completer/retire boundaries are

@@ -687,13 +687,16 @@ class Executor:
 
     def _cache_key(self, program, feed_arrays, fetch_names, scope):
         from .flags import flag
+        from ..transforms import enabled_signature
 
         feed_sig = tuple(sorted(
             (n, tuple(a.shape), str(a.dtype)) for n, a in feed_arrays.items()))
-        # the NaN scan is compiled INTO the step, so the flag is part of
-        # the program identity
+        # the NaN scan is compiled INTO the step and the transform
+        # pipeline decides WHAT gets lowered, so both flags are part of
+        # the program identity — flipping them must be a cache miss
         return (id(program), program.version, feed_sig, tuple(fetch_names),
-                id(scope), bool(flag("check_nan_inf")))
+                id(scope), bool(flag("check_nan_inf")),
+                enabled_signature())
 
     def _prepare(self, program: Program, feed_arrays, fetch_names,
                  scope: Scope) -> _CompiledEntry:
@@ -704,18 +707,29 @@ class Executor:
         from ..profiler import stat_add
         stat_add("executor_compile_count")
 
+        # graph-transform pipeline, ONLY on a compile-cache miss
+        # (docs/graph_transforms.md): rewrites land on a CLONE — the
+        # cache key above is built from the ORIGINAL program identity,
+        # so steady-state steps pay zero transform time — and run
+        # immediately before verification so every rewrite is
+        # verifier-checked
+        from ..transforms import maybe_transform_program
+        lowered = maybe_transform_program(
+            program, feed_names=feed_arrays.keys(),
+            fetch_names=fetch_names, scope=scope)
+
         # ERROR-tier program verification, ONLY on a compile-cache miss
         # (docs/static_analysis.md): a cache hit above returns before
         # this line, so steady-state steps pay zero verifier time
         from ..analysis.verifier import maybe_verify_program
-        maybe_verify_program(program, feed_names=feed_arrays.keys(),
+        maybe_verify_program(lowered, feed_names=feed_arrays.keys(),
                              fetch_names=fetch_names, scope=scope)
 
         from .flags import flag
         from ..ops import registry
 
         check_nan = bool(flag("check_nan_inf"))
-        block = program.global_block()
+        block = lowered.global_block()
         reads, persistable_writes = _analyze_block(block, feed_arrays.keys(),
                                                    scope)
         state_in = []

@@ -81,6 +81,13 @@ _define("verify_program", "on",
         "compile-cache miss (docs/static_analysis.md): 'on' raises "
         "ProgramVerificationError on ERROR findings, 'warn' reports "
         "and continues (the escape hatch), 'off' disables")
+_define("graph_transforms", "on",
+        "Program->Program transform pass pipeline run once per "
+        "compile-cache miss, immediately before verification "
+        "(docs/graph_transforms.md): 'on' runs the default-enabled "
+        "passes (layout_optimize, dead_op_elim), 'off' disables all, "
+        "per-pass overrides compose as e.g. 'on,fold_bn=on' or "
+        "'layout_optimize=off'")
 _define("op_callstack", False,
         "record the Python construction stack on every appended op "
         "(attrs['op_callstack']); verifier findings then point at the "

@@ -88,8 +88,11 @@ def _conv2d(ctx, op, ins):
     if fmt in ("NCHW", "AnyLayout"):
         dn = ("NCHW", "OIHW", "NCHW")
     else:
-        dn = ("NHWC", "HWIO", "NHWC")
-        w = jnp.transpose(w, (2, 3, 1, 0))
+        # NHWC activations, weight STILL OIHW: the conv's dimension
+        # numbers absorb the weight layout, so the layout-optimized
+        # trunk (transforms/layout.py) emits ZERO weight transposes —
+        # XLA tiles the OIHW operand onto the MXU directly
+        dn = ("NHWC", "OIHW", "NHWC")
     pads = _conv_paddings(op.attr("padding_algorithm", "EXPLICIT"),
                           op.attr("paddings", [0, 0]), w.shape[-2:], dilations)
     out = _conv_mxu(
@@ -107,6 +110,7 @@ def _conv2d_transpose(ctx, op, ins):
     strides = tuple(op.attr("strides", [1, 1]))
     dilations = tuple(op.attr("dilations", [1, 1]))
     groups = op.attr("groups", 1)
+    nhwc = op.attr("data_format", "NCHW") == "NHWC"
     pads = _conv_paddings(op.attr("padding_algorithm", "EXPLICIT"),
                           op.attr("paddings", [0, 0]), w.shape[-2:], dilations)
     if pads == "SAME":
@@ -116,17 +120,29 @@ def _conv2d_transpose(ctx, op, ins):
     # the kernel flipped spatially (paddle places x[i,j]*W[ki,kj] at
     # [i*s+ki, j*s+kj], i.e. a correlation against the FLIPPED kernel —
     # reference conv_transpose_op.h col2im path).
-    out = _conv_transpose_flipped(
-        x, w, strides, pads, dilations
-    ) if groups == 1 else _grouped_conv_transpose(x, w, strides, pads, dilations, groups)
+    out = _conv_transpose_flipped(x, w, strides, pads, dilations,
+                                  groups=groups, nhwc=nhwc)
     output_padding = op.attr("output_padding", [])
     if output_padding:
-        cfg = [(0, 0), (0, 0)] + [(0, int(p)) for p in output_padding]
+        sp = [(0, int(p)) for p in output_padding]
+        cfg = [(0, 0)] + sp + [(0, 0)] if nhwc else [(0, 0), (0, 0)] + sp
         out = jnp.pad(out, cfg)
     return {"Output": [out]}
 
 
-def _conv_transpose_flipped(x, w, strides, pads, dilations):
+def _conv_transpose_flipped(x, w, strides, pads, dilations, groups=1,
+                            nhwc=False):
+    if groups > 1:
+        # ONE grouped XLA conv instead of `groups` split/concat convs:
+        # paddle's (C_in, C_out/g, kh, kw) weight regroups to
+        # (C_in/g, C_out, kh, kw) — group i's output block reads group
+        # i's input block, matching the old per-group concat order —
+        # and feature_group_count carries the group structure onto the
+        # MXU without materializing per-group operands.
+        ci, og = w.shape[0], w.shape[1]
+        w = w.reshape((groups, ci // groups, og) + w.shape[2:])
+        w = jnp.transpose(w, (1, 0, 2, 3, 4))
+        w = w.reshape((ci // groups, groups * og) + w.shape[3:])
     return _conv_mxu(
         x, w[..., ::-1, ::-1],
         window_strides=(1, 1),
@@ -135,15 +151,9 @@ def _conv_transpose_flipped(x, w, strides, pads, dilations):
                  for i in range(2)],
         lhs_dilation=strides,
         rhs_dilation=dilations,
-        dimension_numbers=("NCHW", "IOHW", "NCHW"))
-
-
-def _grouped_conv_transpose(x, w, strides, pads, dilations, groups):
-    xs = jnp.split(x, groups, axis=1)
-    ws = jnp.split(w, groups, axis=0)
-    return jnp.concatenate(
-        [_conv_transpose_flipped(xg, wg, strides, pads, dilations)
-         for xg, wg in zip(xs, ws)], axis=1)
+        dimension_numbers=("NHWC", "IOHW", "NHWC") if nhwc
+        else ("NCHW", "IOHW", "NCHW"),
+        feature_group_count=groups)
 
 
 @register_op("conv3d")
@@ -195,7 +205,15 @@ def _pool2d(ctx, op, ins):
         oh, ow = op.attr("ksize")
         h, w = x.shape[h_ax], x.shape[w_ax]
         red = jnp.max if ptype == "max" else jnp.mean
-        if h % oh == 0 and w % ow == 0 and fmt != "NHWC":
+        if h % oh == 0 and w % ow == 0:
+            # divisible-window shortcut: a reshape + one fused reduce
+            # instead of reduce_window, on the spatial axes of EITHER
+            # layout (the NHWC trunk from transforms/layout.py must not
+            # fall back to the slow reduce-window path)
+            if fmt == "NHWC":
+                x6 = x.reshape(x.shape[0], oh, h // oh, ow, w // ow,
+                               x.shape[3])
+                return {"Out": [red(x6, axis=(2, 4))]}
             x5 = x.reshape(x.shape[0], x.shape[1], oh, h // oh, ow, w // ow)
             return {"Out": [red(x5, axis=(3, 5))]}
         # general interval pooling: see _adaptive_pool_axis
@@ -623,7 +641,7 @@ def _interp_apply_axis(x, axis, taps):
     return acc
 
 
-def _interp_out_sizes(op, x, n_spatial):
+def _interp_out_sizes(op, x, n_spatial, sp_off):
     """-> ([out sizes], [scale factors]) per spatial axis; scale is 0
     for size-driven axes so the ratio falls back to in/out."""
     names = ["out_d", "out_h", "out_w"][3 - n_spatial:]
@@ -635,7 +653,7 @@ def _interp_out_sizes(op, x, n_spatial):
         sc = [float(scale or 0.0)] * n_spatial
     if all(s > 0 for s in sizes):
         return sizes, [0.0] * n_spatial
-    in_sizes = x.shape[-n_spatial:]
+    in_sizes = x.shape[sp_off:sp_off + n_spatial]
     outs = [s if s > 0 else int(i * f)
             for s, i, f in zip(sizes, in_sizes, sc)]
     if any(o <= 0 for o in outs):
@@ -654,25 +672,23 @@ def _interp(ctx, op, ins, kind, n_spatial):
     x = first(ins, "X")
     layout = op.attr("data_layout", "NCHW")
     channels_last = layout not in ("NCHW", "NCDHW", "AnyLayout", "NCW")
-    if channels_last:
-        # NHWC/NDHWC: move channels next to batch, interp, move back
-        perm = (0, x.ndim - 1) + tuple(range(1, x.ndim - 1))
-        inv = tuple(int(p) for p in np.argsort(perm))
-        x = jnp.transpose(x, perm)
+    # channels-last lowers NATIVELY: the separable gather chain works on
+    # whichever axes are spatial, so the NHWC trunk keeps channels on
+    # the lanes (no transpose in / out — transforms/layout.py relies on
+    # this when it routes interp chains through NHWC)
+    sp_off = 1 if channels_last else x.ndim - n_spatial
     align_corners = bool(op.attr("align_corners", True))
     align_mode = int(op.attr("align_mode", 1))
-    out_sizes, scales = _interp_out_sizes(op, x, n_spatial)
+    out_sizes, scales = _interp_out_sizes(op, x, n_spatial, sp_off)
     # only v2 reads 1/scale into the ratio (interpolate_v2_op.h:933)
     is_v2 = op.type.endswith("_v2")
     out = x
     for i, osz in enumerate(out_sizes):
-        axis = x.ndim - n_spatial + i
+        axis = sp_off + i
         taps = _interp_axis_taps(x.shape[axis], int(osz), align_corners,
                                  align_mode, kind,
                                  scale=scales[i] if is_v2 else 0.0)
         out = _interp_apply_axis(out, axis, taps)
-    if channels_last:
-        out = jnp.transpose(out, inv)
     return {"Out": [out]}
 
 

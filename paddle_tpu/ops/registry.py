@@ -165,6 +165,64 @@ def _is_diff(x) -> bool:
 
 
 # ---------------------------------------------------------------------------
+# Layout adapters (transforms/layout.py, docs/graph_transforms.md)
+# ---------------------------------------------------------------------------
+#
+# The NHWC layout-optimization pass never inserts transpose OPS into the
+# Program — a separate op would need its own grad op wired into the
+# backward chain.  Instead it annotates existing ops with adapter attrs
+# and the registry applies them around the op's own lowering rule, so
+# jax.vjp differentiates straight through the boundary transposes and
+# the backward pass stays layout-consistent for free:
+#
+#   attrs["nhwc_in"]  = [slot, ...]  transpose those 4-D inputs
+#                                    NCHW->NHWC before the rule runs
+#   attrs["nchw_in"]  = [slot, ...]  transpose NHWC->NCHW (defensive:
+#                                    an NHWC value reaching an op the
+#                                    pass could not rewrite)
+#   attrs["nhwc_out"] = [slot, ...]  the rule computed NHWC; deliver the
+#                                    listed outputs transposed to NCHW
+#
+# Interior ops of a rewritten chain carry none of these: they consume
+# and produce NHWC values directly (their data_format/data_layout attr
+# says so), which is what makes the trunk transpose-free.
+
+_TO_NHWC = (0, 2, 3, 1)
+_TO_NCHW = (0, 3, 1, 2)
+
+
+def _transpose_slot(vals, perm):
+    return [jnp.transpose(v, perm)
+            if v is not None and jnp.ndim(v) == 4 else v for v in vals]
+
+
+def _layout_adapted(fn, op: Operator):
+    """Wrap a lowering rule with the op's layout-adapter attrs; identity
+    when the op carries none (the common case costs one dict probe)."""
+    nhwc_in = op.attr("nhwc_in") or ()
+    nchw_in = op.attr("nchw_in") or ()
+    nhwc_out = op.attr("nhwc_out") or ()
+    if not (nhwc_in or nchw_in or nhwc_out):
+        return fn
+
+    def adapted(ctx, op_, ins):
+        ins = dict(ins)
+        for slot in nhwc_in:
+            if slot in ins:
+                ins[slot] = _transpose_slot(ins[slot], _TO_NHWC)
+        for slot in nchw_in:
+            if slot in ins:
+                ins[slot] = _transpose_slot(ins[slot], _TO_NCHW)
+        outs = fn(ctx, op_, ins)
+        for slot in nhwc_out:
+            if slot in outs:
+                outs[slot] = _transpose_slot(outs[slot], _TO_NCHW)
+        return outs
+
+    return adapted
+
+
+# ---------------------------------------------------------------------------
 # Block tracing
 # ---------------------------------------------------------------------------
 
@@ -217,6 +275,9 @@ def lower_op(ctx: LowerCtx, op: Operator, env: Dict[str, Any]) -> None:
     if fn is None:
         raise NotImplementedError(f"no lowering registered for op {op.type!r}")
 
+    # layout-adapter attrs wrap the rule BEFORE the vjp split so grad
+    # ops differentiate through the boundary transposes automatically
+    fn = _layout_adapted(fn, op)
     if op.id in ctx.need_vjp:
         outs = _eval_with_vjp(ctx, op, fn, _gather_ins(op, env))
     else:

@@ -455,7 +455,7 @@ def _depthwise_conv2d_transpose(ctx, op, ins):
     """Depthwise transposed conv = grouped conv2d_transpose with
     groups == input channels (reference conv_transpose_op.cc registers
     the same col2im kernel)."""
-    from .nn_ops import _conv_paddings, _grouped_conv_transpose
+    from .nn_ops import _conv_paddings, _conv_transpose_flipped
     x = first(ins, "Input")
     w = first(ins, "Filter")
     strides = tuple(int(s) for s in op.attr("strides", [1, 1]))
@@ -467,7 +467,8 @@ def _depthwise_conv2d_transpose(ctx, op, ins):
     if pads == "SAME":
         kh, kw = w.shape[-2:]
         pads = [((kh - 1) // 2, kh // 2), ((kw - 1) // 2, kw // 2)]
-    out = _grouped_conv_transpose(x, w, strides, pads, dilations, groups)
+    out = _conv_transpose_flipped(x, w, strides, pads, dilations,
+                                  groups=groups)
     return {"Output": [out]}
 
 

@@ -144,6 +144,14 @@ class CompiledProgram:
         return False
 
     def _compile(self, executor, program, feed_arrays, fetch_names, scope):
+        # graph-transform pipeline on the compile-cache miss path only
+        # (docs/graph_transforms.md): the cache key is built from the
+        # ORIGINAL program (pinned by self._program); the rewritten
+        # clone is what gets lowered
+        from ..transforms import maybe_transform_program
+        program = maybe_transform_program(
+            program, feed_names=feed_arrays.keys(),
+            fetch_names=fetch_names, scope=scope)
         # ERROR-tier program verification on the compile-cache miss
         # path only, same contract as Executor._prepare
         # (docs/static_analysis.md)

@@ -1,0 +1,227 @@
+"""Program->Program graph-transform pass pipeline (ISSUE 5 tentpole).
+
+The reference framework runs whole-graph rewrites as C++ IR passes
+(multi_devices_graph_pass, the fuse_* family); TensorFlow's Grappler
+makes the same argument for layout + fusion as graph-level passes
+(arxiv 1605.08695).  This package is the TPU-native transform twin of
+the `analysis.verifier` pass pipeline: same registration and provenance
+idioms, but the passes MUTATE the Program they are handed instead of
+reporting findings.
+
+Contract (docs/graph_transforms.md):
+
+* `apply_transforms(program, ...)` clones the program and runs every
+  enabled pass over the CLONE, in registration order — the caller's
+  program is never touched, so the Executor's compile-cache key (built
+  from the original `(id, version)`) stays stable across steps and the
+  pipeline runs exactly once per compile-cache miss.
+* `maybe_transform_program` is the Executor._prepare /
+  CompiledProgram._compile hook: gated by `FLAGS_graph_transforms`,
+  wall time booked on the `transform_ms` profiler timer and per-pass
+  rewrite counts on `transform_<pass>_rewrites` stats — all provably
+  flat on cache-hit steps.
+* Transforms run immediately BEFORE verification, so every rewrite is
+  checked by the PR-3 verifier's ERROR-tier passes.
+
+Shipped passes:
+
+* `layout_optimize` (on) — rewrite NCHW conv/pool/batch_norm/interp
+  chains to NHWC so channels stay on the TPU lanes
+  (transforms/layout.py).
+* `fold_bn` (off) — fold inference-mode batch_norm into the preceding
+  conv's weights/bias (transforms/fold_bn.py).  Off by default because
+  an eval program folded mid-training would not see later updates to
+  the running stats; inference/export paths opt in.
+* `dead_op_elim` (on) — actually remove the dead / write-never-read
+  ops the verifier only warns about (transforms/dce.py).
+
+`FLAGS_graph_transforms` grammar: "on" (default set), "off" (disable
+everything), or comma-separated per-pass overrides —
+"on,fold_bn=on", "layout_optimize=off", "fold_bn=on".
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Callable, Dict, Iterable, List, Optional
+
+_EMPTY = "@EMPTY@"  # framework.EMPTY_VAR_NAME (kept import-free)
+
+# name -> {"fn", "default", "help"}; insertion order is execution order
+_PASSES: "Dict[str, dict]" = {}
+
+
+def register_transform(name: str, default: bool = True, help_str: str = ""):
+    """Register `fn(ctx: TransformContext) -> int` under `name`; the
+    return value is the number of ops the pass rewrote/removed (its
+    `ops_rewritten` counter)."""
+
+    def deco(fn: Callable):
+        _PASSES[name] = {"fn": fn, "default": default, "help": help_str}
+        return fn
+
+    return deco
+
+
+def registered_transforms() -> List[str]:
+    return list(_PASSES)
+
+
+def transform_info(name: str) -> dict:
+    info = _PASSES[name]
+    return {"default": info["default"], "help": info["help"]}
+
+
+class TransformContext:
+    """Everything a pass may consult/mutate.  `feed_names` /
+    `fetch_names` are None when unknown — passes must degrade
+    conservatively (e.g. dead_op_elim is a no-op without fetch info).
+    `scope` is optional and read-only: passes must NOT require runtime
+    values (the pipeline also runs for standalone tooling)."""
+
+    def __init__(self, program, feed_names=None, fetch_names=None,
+                 scope=None):
+        self.program = program
+        self.feed_names = set(feed_names) if feed_names is not None \
+            else None
+        self.fetch_names = list(fetch_names) if fetch_names is not None \
+            else None
+        self.scope = scope
+
+    @property
+    def fetch_set(self):
+        return set(self.fetch_names or ())
+
+
+def _grad_section(op) -> bool:
+    """Backward/optimizer-section ops: synthesized by append_backward /
+    minimize.  The layout pass leaves them alone — gradients flow
+    through jax.vjp of the (rewritten) forward rules, so rewriting the
+    forward is sufficient and the backward stays consistent for free."""
+    if op.attr("fwd_op_id") is not None:
+        return True
+    # OpRole.Backward=1 | Optimize=2 (| Loss=256 combinations)
+    return bool(op.attr("op_role", 0) & 3)
+
+
+def _find_var(block, name: str):
+    try:
+        return block._var_recursive(name)
+    except ValueError:
+        return None
+
+
+# import the pass modules AFTER the registry exists (registration side
+# effect, verifier idiom).  Import order IS execution order: fold_bn
+# must see the NCHW graph (it rewrites conv+bn pairs), layout_optimize
+# then NHWC-ifies whatever survives, dead_op_elim sweeps up.
+from . import fold_bn  # noqa: E402,F401
+from . import layout  # noqa: E402,F401
+from . import dce  # noqa: E402,F401
+
+
+_WARNED_UNKNOWN: set = set()
+_SPEC_CACHE: Dict[str, tuple] = {}
+
+
+def _resolve_spec(spec: str) -> tuple:
+    """Parse a FLAGS_graph_transforms value -> ((name, enabled), ...);
+    memoized per spec string so the per-step cache-key read costs one
+    dict probe."""
+    cached = _SPEC_CACHE.get(spec)
+    if cached is not None:
+        return cached
+    defaults = {n: i["default"] for n, i in _PASSES.items()}
+    if spec in ("off", "0", "false", "no", "none"):
+        out = tuple((n, False) for n in defaults)
+        _SPEC_CACHE[spec] = out
+        return out
+    overrides: Dict[str, bool] = {}
+    for tok in spec.split(","):
+        tok = tok.strip()
+        if not tok or tok in ("on", "1", "true", "yes", "default"):
+            continue
+        if "=" in tok:
+            name, val = (s.strip() for s in tok.split("=", 1))
+            want = val in ("on", "1", "true", "yes")
+        elif tok.startswith(("+", "-")):
+            name, want = tok[1:], tok.startswith("+")
+        else:
+            name, want = tok, True
+        if name not in defaults:
+            if name not in _WARNED_UNKNOWN:
+                _WARNED_UNKNOWN.add(name)
+                warnings.warn(
+                    f"FLAGS_graph_transforms: unknown pass {name!r} "
+                    f"(registered: {sorted(defaults)})", stacklevel=3)
+            continue
+        overrides[name] = want
+    out = tuple((n, overrides.get(n, d)) for n, d in defaults.items())
+    _SPEC_CACHE[spec] = out
+    return out
+
+
+def _current_spec() -> str:
+    from ..fluid.flags import flag
+
+    return str(flag("graph_transforms", "on")).strip().lower()
+
+
+def enabled_passes() -> Dict[str, bool]:
+    """Resolve FLAGS_graph_transforms into {pass_name: enabled}."""
+    return dict(_resolve_spec(_current_spec()))
+
+
+def enabled_signature() -> tuple:
+    """The enabled-pass set as a hashable compile-cache key component:
+    flipping FLAGS_graph_transforms changes what gets lowered, so it is
+    part of the compiled program's identity (Executor._cache_key), the
+    same way FLAGS_check_nan_inf is."""
+    return tuple(n for n, on in _resolve_spec(_current_spec()) if on)
+
+
+def apply_transforms(program, feed_names=None, fetch_names=None,
+                     scope=None, passes: Optional[Iterable[str]] = None):
+    """Run the transform pipeline over a CLONE of `program`.
+
+    Returns `(transformed_program, {pass_name: ops_rewritten})`.  The
+    input program is never mutated; op ids are preserved by the clone so
+    grad-op `fwd_op_id` links stay valid."""
+    wanted = list(passes) if passes is not None else [
+        n for n, on in enabled_passes().items() if on]
+    clone = program.clone()
+    ctx = TransformContext(clone, feed_names=feed_names,
+                           fetch_names=fetch_names, scope=scope)
+    stats: Dict[str, int] = {}
+    for name in _PASSES:
+        if name not in wanted:
+            continue
+        stats[name] = int(_PASSES[name]["fn"](ctx))
+    return clone, stats
+
+
+def maybe_transform_program(program, feed_names=None, fetch_names=None,
+                            scope=None):
+    """Compile-cache-miss hook for Executor._prepare /
+    CompiledProgram._compile: run the enabled passes under the
+    FLAGS_graph_transforms gate, immediately before verification.
+    Returns the transformed clone (or the original program untouched
+    when every pass is disabled).  Never runs on a cache hit — callers
+    sit behind the compile cache — and books its wall time on the
+    `transform_ms` profiler timer plus per-pass
+    `transform_<pass>_rewrites` counters so tests can assert the hot
+    path pays zero transform time."""
+    enabled = [n for n, on in enabled_passes().items() if on]
+    if not enabled:
+        return program
+    from ..profiler import stat_add, timed
+
+    with timed("transform_ms"):
+        out, stats = apply_transforms(program, feed_names=feed_names,
+                                      fetch_names=fetch_names,
+                                      scope=scope, passes=enabled)
+        stat_add("transform_runs")
+        for name, n in stats.items():
+            if n:
+                stat_add(f"transform_{name}_rewrites", n)
+    return out

@@ -1,0 +1,68 @@
+"""dead_op_elim: remove the dead ops the verifier only warns about.
+
+The PR-3 verifier's WARNING-tier `dead-op` / `write-never-read` passes
+diagnose ops whose outputs are never read, fetched, or persisted; XLA
+DCEs the emitted computation anyway, but the ops still cost trace time
+on every compile-cache miss and usually mark graph-construction bugs.
+This pass actually deletes them (global block only; control-flow
+sub-block bodies keep their ops — loop-carried liveness is the
+verifier's harder problem) and iterates to a fixpoint so whole dead
+chains fall out.
+
+Safety mirrors the verifier's dead-op exclusions: effectful ops,
+collectives, and sub-block owners are never removed, and the pass is a
+no-op when the fetch list is unknown.
+"""
+
+from __future__ import annotations
+
+from . import TransformContext, _EMPTY, _find_var, register_transform
+from ..analysis.verifier import _EFFECT_OPS, _is_collective
+
+
+@register_transform(
+    "dead_op_elim", default=True,
+    help_str="delete ops whose outputs are never read, fetched, or "
+             "persisted (the verifier's dead-op/write-never-read "
+             "warnings, enforced)")
+def run(ctx: TransformContext) -> int:
+    if ctx.fetch_names is None:
+        return 0
+    prog = ctx.program
+    block = prog.global_block()
+    fetch = ctx.fetch_set
+    removed = 0
+    changed = True
+    while changed:
+        changed = False
+        reads = {n for blk in prog.blocks for op in blk.ops
+                 for n in op.input_arg_names() if n != _EMPTY}
+        kept = []
+        for op in block.ops:
+            if op.type in _EFFECT_OPS or _is_collective(op.type) \
+                    or op.has_attr("sub_block"):
+                kept.append(op)
+                continue
+            outs = [n for n in op.output_arg_names() if n != _EMPTY]
+            if not outs:
+                kept.append(op)  # no-output ops are presumed effectful
+                continue
+            live = False
+            for n in outs:
+                if n in reads or n in fetch:
+                    live = True
+                    break
+                v = _find_var(block, n)
+                if v is not None and (v.persistable
+                                      or getattr(v, "is_data", False)):
+                    live = True
+                    break
+            if live:
+                kept.append(op)
+            else:
+                removed += 1
+                changed = True
+        block.ops = kept
+    if removed:
+        prog._bump_version()
+    return removed

@@ -1,0 +1,169 @@
+"""fold_bn: fold inference-mode batch_norm into the preceding conv.
+
+For an `is_test` (or `use_global_stats`) batch_norm fed directly by a
+conv whose output nothing else reads,
+
+    y = gamma * (conv(x, W) - mu) / sqrt(var + eps) + beta
+
+is exactly `conv(x, W * s) + (beta - mu * s)` with the per-output-
+channel factor `s = gamma / sqrt(var + eps)` — the reference's
+conv_bn_fuse_pass.  The fold is expressed IN-GRAPH (a handful of [C]
+vector ops plus one weight-sized multiply inserted before the conv),
+not by mutating scope values, so it needs no runtime state, stays
+correct even if the running stats later change, and costs O(|W|) per
+call — noise next to the conv's O(|W| * spatial * batch) — while
+removing the full per-activation BN normalize from the serving path
+(`inference.Predictor` over loaded inference programs, and any
+Executor-run `clone(for_test=True)` graph).
+
+Off by default (`FLAGS_graph_transforms` "fold_bn=on" opts in):
+train-mode programs are never folded, but an eval clone compiled
+mid-training would bake the bn structure out of the graph, and keeping
+that behavioral change opt-in matches the reference's pass toggles.
+
+Skipped entirely for programs that carry grad ops: folding under a
+backward pass would change which residuals exist.
+"""
+
+from __future__ import annotations
+
+from . import TransformContext, _find_var, register_transform
+
+_FOLDABLE_CONVS = ("conv2d", "depthwise_conv2d")
+
+
+def _readers(prog, name):
+    out = []
+    for blk in prog.blocks:
+        for op in blk.ops:
+            if name in op.input_arg_names():
+                out.append(op)
+    return out
+
+
+def _writers(prog, name):
+    out = []
+    for blk in prog.blocks:
+        for op in blk.ops:
+            if name in op.output_arg_names():
+                out.append(op)
+    return out
+
+
+def _fold_one(ctx: TransformContext) -> bool:
+    """Fold the first foldable (conv, batch_norm) pair; returns whether
+    a fold happened (the caller loops to fixpoint so the producer maps
+    stay fresh across structural edits)."""
+    prog = ctx.program
+    block = prog.global_block()
+    fetch = ctx.fetch_set
+    for bn in block.ops:
+        if bn.type != "batch_norm":
+            continue
+        if not (bn.attr("is_test", False)
+                or bn.attr("use_global_stats", False)):
+            continue
+        if bn.attr("data_layout", "NCHW") not in ("NCHW", "AnyLayout"):
+            continue  # runs before layout_optimize; anything else is exotic
+        xs = bn.input("X")
+        if len(xs) != 1:
+            continue
+        xname = xs[0]
+        xvar = _find_var(block, xname)
+        if xvar is None or xvar.persistable or xname in fetch:
+            continue
+        writers = _writers(prog, xname)
+        if len(writers) != 1 or writers[0].type not in _FOLDABLE_CONVS \
+                or writers[0].block is not block:
+            continue
+        conv = writers[0]
+        if conv.attr("data_format", "NCHW") not in ("NCHW", "AnyLayout"):
+            continue
+        if any(r is not bn for r in _readers(prog, xname)):
+            continue  # conv output has another consumer
+        # bn side outputs (SavedMean/SavedVariance/ReserveSpace) vanish
+        # with the op; MeanOut/VarianceOut alias the running stats and
+        # simply stop being rewritten (is_test passes them through
+        # unchanged anyway, and the stats keep flowing as inputs to the
+        # fold ops) — but none of them may be fetched, and the
+        # non-aliasing ones may not be read by any OTHER op
+        yname = bn.output("Y")[0]
+        aliased = set(bn.input_arg_names())
+        side = [n for n in bn.output_arg_names() if n != yname]
+        if any(n in fetch for n in side):
+            continue
+        if any(any(r is not bn for r in _readers(prog, n))
+               for n in side if n not in aliased):
+            continue
+
+        scale_n = bn.input("Scale")[0]
+        beta_n = bn.input("Bias")[0]
+        mean_n = bn.input("Mean")[0]
+        var_n = bn.input("Variance")[0]
+        eps = float(bn.attr("epsilon", 1e-5))
+        w_n = conv.input("Filter")[0]
+        svar = _find_var(block, scale_n)
+        wvar = _find_var(block, w_n)
+        if svar is None or wvar is None or svar.shape is None:
+            continue
+        dtype = svar.dtype
+        uid = f"@fold_bn.{bn.id}"
+
+        def mk(suffix, shape):
+            return block.create_var(name=f"{w_n}{uid}.{suffix}",
+                                    shape=shape, dtype=dtype).name
+
+        veps = mk("veps", svar.shape)
+        inv = mk("inv", svar.shape)
+        s = mk("s", svar.shape)
+        ms = mk("ms", svar.shape)
+        bf = mk("bias", svar.shape)
+        wf = mk("w", wvar.shape)
+
+        pos = block.ops.index(conv)
+        role = {"op_role": conv.attr("op_role", 0)}
+        ins = [
+            ("scale", {"X": [var_n]}, {"Out": [veps]},
+             {"scale": 1.0, "bias": eps, "bias_after_scale": True, **role}),
+            ("rsqrt", {"X": [veps]}, {"Out": [inv]}, dict(role)),
+            ("elementwise_mul", {"X": [scale_n], "Y": [inv]}, {"Out": [s]},
+             {"axis": -1, **role}),
+            # per-output-channel weight scale: W (O, I/g, kh, kw) * s[O]
+            ("elementwise_mul", {"X": [w_n], "Y": [s]}, {"Out": [wf]},
+             {"axis": 0, **role}),
+            ("elementwise_mul", {"X": [mean_n], "Y": [s]}, {"Out": [ms]},
+             {"axis": -1, **role}),
+            ("elementwise_sub", {"X": [beta_n], "Y": [ms]}, {"Out": [bf]},
+             {"axis": -1, **role}),
+        ]
+        for off, (typ, i_, o_, a_) in enumerate(ins):
+            block.insert_op(pos + off, typ, inputs=i_, outputs=o_,
+                            attrs=a_, infer_shape=False)
+        conv.inputs["Filter"] = [wf]
+        bn_pos = block.ops.index(bn)
+        block.insert_op(bn_pos, "elementwise_add",
+                        inputs={"X": [xname], "Y": [bf]},
+                        outputs={"Out": [yname]},
+                        attrs={"axis": 1, **role}, infer_shape=False)
+        block.ops.remove(bn)
+        return True
+    return False
+
+
+@register_transform(
+    "fold_bn", default=False,
+    help_str="fold inference-mode batch_norm into the preceding conv's "
+             "weights/bias (Predictor/serving path; opt in via "
+             "FLAGS_graph_transforms='fold_bn=on')")
+def run(ctx: TransformContext) -> int:
+    prog = ctx.program
+    for blk in prog.blocks:
+        for op in blk.ops:
+            if op.attr("fwd_op_id") is not None:
+                return 0  # training/backward program: never fold
+    folded = 0
+    while _fold_one(ctx):
+        folded += 1
+    if folded:
+        prog._bump_version()
+    return folded

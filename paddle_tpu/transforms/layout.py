@@ -1,0 +1,263 @@
+"""layout_optimize: rewrite NCHW conv/pool/norm/interp chains to NHWC.
+
+Why: `ops/nn_ops.py` lowers the Fluid default NCHW dimension numbers,
+which forces XLA to relayout around every convolution — channels belong
+on the TPU lanes (the minor-most dimension), i.e. NHWC.  BENCH_r05
+measured ResNet-50 at 29.3% MFU with the NCHW trunk while BERT (layout-
+neutral matmuls) sits at 42.3%; the conv stack is the gap.
+
+How: a dataflow rewrite over the global block, in two phases.
+
+1. **Sink analysis** (reverse walk): for every anchor/follower output,
+   decide whether the value may STAY in NHWC — true iff every forward
+   consumer is itself an anchor (consumes the value as its data input)
+   or a layout-agnostic follower whose own outputs may stay NHWC, and
+   the var is not externally visible (fetched, persistable, or read by
+   a control-flow sub-block).
+2. **Rewrite** (forward walk): anchors get their data_format /
+   data_layout attr flipped to NHWC; values entering from NCHW-land
+   (feeds, ineligible producers) are marked with the `nhwc_in` adapter
+   attr, values leaving to NCHW-land with `nhwc_out`
+   (ops/registry.py applies these INSIDE the op's lowering rule, so
+   jax.vjp differentiates through the boundary transposes and the
+   backward chain needs no rewriting at all).  Interior values carry no
+   adapter: the trunk is transpose-free by construction.
+
+Weights are never transposed — the NHWC conv lowering absorbs the OIHW
+weight layout into its dimension numbers (nn_ops._conv2d), so the
+rewritten trunk emits zero weight transposes too.
+
+Gradients/optimizer ops are untouched: grad ops reuse the forward
+rule's vjp (ops/registry.py), so rewriting the forward op IS rewriting
+the backward.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Set
+
+from . import TransformContext, _find_var, _grad_section, register_transform
+
+# anchor op type -> (data input slot, data output slot, format attr name)
+ANCHORS = {
+    "conv2d": ("Input", "Output", "data_format"),
+    "depthwise_conv2d": ("Input", "Output", "data_format"),
+    "conv2d_transpose": ("Input", "Output", "data_format"),
+    "pool2d": ("X", "Out", "data_format"),
+    "batch_norm": ("X", "Y", "data_layout"),
+    "sync_batch_norm": ("X", "Y", "data_layout"),
+    "nearest_interp": ("X", "Out", "data_layout"),
+    "nearest_interp_v2": ("X", "Out", "data_layout"),
+    "bilinear_interp": ("X", "Out", "data_layout"),
+    "bilinear_interp_v2": ("X", "Out", "data_layout"),
+    "bicubic_interp_v2": ("X", "Out", "data_layout"),
+    "bicubic_interp": ("X", "Out", "data_layout"),
+}
+
+# layout-agnostic single-input followers: out shapes mirror X, compute
+# is elementwise — an NHWC value flows straight through
+UNARY_FOLLOWERS = {
+    "relu", "relu6", "leaky_relu", "gelu", "sigmoid", "tanh", "elu",
+    "silu", "swish", "mish", "hard_swish", "hard_sigmoid", "softplus",
+    "scale", "cast", "clip", "dropout", "square", "abs", "sqrt", "exp",
+}
+
+# binary elementwise followers; broadcast semantics are layout-relevant
+# and checked per-op in _elementwise_eligible
+ELEMENTWISE_FOLLOWERS = {
+    "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_div", "elementwise_max", "elementwise_min",
+}
+
+_OUT_SLOTS = {"dropout": ("Out", "Mask")}  # non-trivial follower outputs
+
+
+def _out_slots(op) -> tuple:
+    return _OUT_SLOTS.get(op.type, ("Out",))
+
+
+def _rank(block, name):
+    v = _find_var(block, name)
+    if v is None or v.shape is None:
+        return None
+    return len(v.shape)
+
+
+def _channels(block, name):
+    """Declared channel count of an (NCHW-declared) 4-D var."""
+    v = _find_var(block, name)
+    if v is None or v.shape is None or len(v.shape) != 4:
+        return None
+    return v.shape[1]
+
+
+def _anchor_eligible(block, op) -> bool:
+    in_slot, _out, fmt_attr = ANCHORS[op.type]
+    if op.attr(fmt_attr, "NCHW") not in ("NCHW", "AnyLayout"):
+        return False  # already channels-last (or exotic)
+    ins = op.input(in_slot)
+    if len(ins) != 1 or _rank(block, ins[0]) != 4:
+        return False
+    if op.type.endswith(("_interp", "_interp_v2")):
+        # tensor-valued sizes are rejected by the lowering anyway
+        if op.input("OutSize") or op.input("SizeTensor") \
+                or op.input("Scale"):
+            return False
+    return True
+
+
+def _elementwise_eligible(block, op) -> bool:
+    """NHWC may flow through a binary elementwise op when broadcast
+    semantics survive the permutation: same-shape 4-D operands, a
+    scalar, or a [C] vector bound to the channel axis (axis=1, which
+    the rewrite re-points at the NHWC channel axis)."""
+    xs, ys = op.input("X"), op.input("Y")
+    if len(xs) != 1 or len(ys) != 1:
+        return False
+    xr, yr = _rank(block, xs[0]), _rank(block, ys[0])
+    if xr != 4:
+        return False
+    if yr == 0:
+        return True
+    if yr == 4:
+        vx, vy = _find_var(block, xs[0]), _find_var(block, ys[0])
+        return vx.shape == vy.shape
+    if yr == 1 and op.attr("axis", -1) == 1:
+        vy = _find_var(block, ys[0])
+        return vy.shape[0] == _channels(block, xs[0])
+    return False
+
+
+def _follower_eligible(block, op) -> bool:
+    if op.type in UNARY_FOLLOWERS:
+        return len(op.input("X")) == 1 and _rank(block, op.input("X")[0]) == 4
+    if op.type in ELEMENTWISE_FOLLOWERS:
+        return _elementwise_eligible(block, op)
+    return False
+
+
+def _permute_declared_shape(block, name):
+    v = _find_var(block, name)
+    if v is not None and v.shape is not None and len(v.shape) == 4:
+        s = v.shape
+        v.shape = (s[0], s[2], s[3], s[1])
+
+
+@register_transform(
+    "layout_optimize", default=True,
+    help_str="rewrite NCHW conv/pool/batch_norm/interp chains to NHWC "
+             "so channels stay on the TPU lanes; boundary transposes "
+             "sink/cancel via the registry layout adapters")
+def run(ctx: TransformContext) -> int:
+    prog = ctx.program
+    block = prog.global_block()
+    fwd = [op for op in block.ops if not _grad_section(op)]
+
+    # vars that must be NCHW whenever observed from outside the
+    # rewritten region: fetch targets, anything a control-flow
+    # sub-block touches, and persistable state committed to the scope
+    external: Set[str] = set(ctx.fetch_names or ())
+    for blk in prog.blocks[1:]:
+        for op in blk.ops:
+            external.update(op.input_arg_names())
+            external.update(op.output_arg_names())
+
+    consumers: Dict[str, List] = {}
+    for op in fwd:
+        for n in set(op.input_arg_names()):
+            consumers.setdefault(n, []).append(op)
+
+    def var_may_stay_nhwc(name: str) -> bool:
+        if name in external:
+            return False
+        v = _find_var(block, name)
+        if v is None or v.shape is None or len(v.shape) != 4:
+            return False
+        return not (v.persistable or getattr(v, "is_data", False))
+
+    # eligibility is decided ONCE, against the untouched NCHW-declared
+    # shapes, before phase 2 starts permuting them
+    anchor_ok: Dict[int, bool] = {
+        op.id: _anchor_eligible(block, op)
+        for op in fwd if op.type in ANCHORS}
+    follower_ok: Dict[int, bool] = {
+        op.id: _follower_eligible(block, op)
+        for op in fwd if op.type in UNARY_FOLLOWERS
+        or op.type in ELEMENTWISE_FOLLOWERS}
+
+    # -- phase 1: sink analysis (reverse walk) -----------------------------
+    keep: Dict[int, bool] = {}     # anchor op id -> output stays NHWC
+    out_ok: Dict[int, bool] = {}   # follower op id -> outputs stay NHWC
+
+    def consumer_accepts(c, vname: str) -> bool:
+        if anchor_ok.get(c.id, False):
+            return vname in c.input(ANCHORS[c.type][0])
+        if follower_ok.get(c.id, False):
+            return out_ok.get(c.id, False)
+        return False
+
+    for op in reversed(fwd):
+        if anchor_ok.get(op.id, False):
+            outv = op.output(ANCHORS[op.type][1])[0]
+            keep[op.id] = var_may_stay_nhwc(outv) and all(
+                consumer_accepts(c, outv) for c in consumers.get(outv, []))
+        elif follower_ok.get(op.id, False):
+            outs = [n for slot in _out_slots(op) for n in op.output(slot)]
+            out_ok[op.id] = bool(outs) and all(
+                var_may_stay_nhwc(o) and all(
+                    consumer_accepts(c, o) for c in consumers.get(o, []))
+                for o in outs)
+
+    # -- phase 2: rewrite (forward walk) -----------------------------------
+    nhwc: Set[str] = set()
+    rewrites = 0
+    for op in fwd:
+        if anchor_ok.get(op.id, False):
+            in_slot, out_slot, fmt_attr = ANCHORS[op.type]
+            op.attrs[fmt_attr] = "NHWC"
+            data_in = op.input(in_slot)[0]
+            if data_in not in nhwc:
+                # value arrives NCHW (a feed or an ineligible
+                # producer): transpose it inside this op's lowering
+                op.attrs.setdefault("nhwc_in", []).append(in_slot)
+            outv = op.output(out_slot)[0]
+            if keep.get(op.id, False):
+                nhwc.add(outv)
+                _permute_declared_shape(block, outv)
+            else:
+                op.attrs["nhwc_out"] = [out_slot]
+            rewrites += 1
+        elif follower_ok.get(op.id, False) and any(
+                n in nhwc for n in op.input_arg_names()):
+            if out_ok.get(op.id, False):
+                for slot in ("X", "Y"):
+                    for n in op.input(slot):
+                        if n not in nhwc and _rank(block, n) == 4:
+                            op.attrs.setdefault("nhwc_in", []).append(slot)
+                if op.type in ELEMENTWISE_FOLLOWERS \
+                        and op.attr("axis", -1) == 1 \
+                        and _rank(block, op.input("Y")[0]) == 1:
+                    # [C] operand: channel axis moved to the end
+                    op.attrs["axis"] = -1
+                for slot in _out_slots(op):
+                    for n in op.output(slot):
+                        nhwc.add(n)
+                        _permute_declared_shape(block, n)
+                rewrites += 1
+            else:
+                # defensive: an NHWC value reached a follower whose
+                # outputs cannot stay NHWC — normalize it back
+                op.attrs["nchw_in"] = sorted(
+                    slot for slot, names in op.inputs.items()
+                    if any(n in nhwc for n in names))
+        else:
+            # defensive: any other op reading an NHWC value gets the
+            # value transposed back inside its own lowering
+            slots = sorted(slot for slot, names in op.inputs.items()
+                           if any(n in nhwc for n in names))
+            if slots:
+                op.attrs["nchw_in"] = slots
+
+    if rewrites:
+        prog._bump_version()
+    return rewrites

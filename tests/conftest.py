@@ -33,6 +33,11 @@ def pytest_configure(config):
         "markers",
         "tpu: non-interpret kernel tests that need real TPU hardware "
         "(run with PADDLE_TPU_TEST_LANE=1)")
+    config.addinivalue_line(
+        "markers",
+        "slow: long double-compile tests excluded from the tier-1 "
+        "budget (the gate runs -m 'not slow'); run explicitly with "
+        "-m slow")
 
 
 @pytest.fixture

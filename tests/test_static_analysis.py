@@ -373,6 +373,16 @@ def test_feed_pipeline_on_hot_path_watchlist():
     assert ("paddle_tpu/fluid/executor.py", "_FeedPrefetcher") in watched
 
 
+def test_transforms_on_hot_path_watchlist():
+    """ISSUE 5: the graph-transform entry points are lint-watched —
+    transforms run only on the compile-cache-miss path and manipulate
+    Program metadata, so they carry the zero-sync contract (no device
+    array may ever flow through a pass)."""
+    watched = set(lint.hot_path_sync.WATCHLIST)
+    for qual in ("maybe_transform_program", "apply_transforms"):
+        assert ("paddle_tpu/transforms/__init__.py", qual) in watched
+
+
 def test_hot_path_rule_fires_on_unsanctioned_sync(tmp_path):
     bad = tmp_path / "paddle_tpu" / "fluid"
     bad.mkdir(parents=True)

@@ -34,6 +34,7 @@ PATTERNS = [
     r'append_op\(\s*"([\w@]+)"',
     r'trace_op\(\s*"([\w@]+)"',
     r'\.append_op\(\s*"([\w@]+)"',
+    r'insert_op\([^"]*"([\w@]+)"',
     # collective variants exercised through parametrize tables
     r'"((?:c_|mp_)[a-z_0-9]+)"',
 ]
@@ -59,16 +60,20 @@ LAYER_WRAPPERS = {
 }
 
 
-def tested_ops(test_dir):
+def tested_ops(*scan_dirs):
+    """Ops exercised under the given directories.  Besides tests/, the
+    graph-transform package counts: ops its passes insert (fold_bn's
+    scale/rsqrt/elementwise chain) run under the tier-1 transform
+    parity suite every time the pipeline fires."""
     found = set()
-    for f in glob.glob(os.path.join(test_dir, "**", "*.py"),
-                       recursive=True):
-        s = open(f, encoding="utf-8").read()
-        for pat in PATTERNS:
-            found |= set(re.findall(pat, s))
-        for pat, ops in LAYER_WRAPPERS.items():
-            if re.search(pat, s):
-                found |= set(ops)
+    for d in scan_dirs:
+        for f in glob.glob(os.path.join(d, "**", "*.py"), recursive=True):
+            s = open(f, encoding="utf-8").read()
+            for pat in PATTERNS:
+                found |= set(re.findall(pat, s))
+            for pat, ops in LAYER_WRAPPERS.items():
+                if re.search(pat, s):
+                    found |= set(ops)
     return found
 
 
@@ -84,7 +89,9 @@ def main(argv=None):
     from paddle_tpu.ops import registry  # noqa: E402
 
     ops = set(registry.registered_ops())
-    tested = tested_ops(os.path.join(repo, "tests")) & ops
+    tested = tested_ops(os.path.join(repo, "tests"),
+                        os.path.join(repo, "paddle_tpu",
+                                     "transforms")) & ops
     untested = sorted(ops - tested)
     pct = 100.0 * len(tested) / max(len(ops), 1)
     print(f"registered ops : {len(ops)}")

@@ -3,6 +3,11 @@
 plus the op-coverage gate in one invocation, wired into tier-1 through
 tests/test_static_analysis.py so a rule regression fails the suite.
 
+Coverage spans the whole paddle_tpu tree including the graph-transform
+package (ISSUE 5): the side-effect rule walks paddle_tpu/transforms/,
+hot-path-sync watches its compile-cache-miss entry points, and
+op_coverage counts the ops its passes insert.
+
   python tools/run_lints.py                  # everything
   python tools/run_lints.py --skip-op-coverage   # AST lints only
                                                  # (no jax needed)
