@@ -397,6 +397,18 @@ def _time_step(run_once, steps, reps, warmup_steps=2):
     return best, final_loss, pipe
 
 
+def _obs_detail():
+    """BENCH JSON `detail.obs` (ISSUE 6): the structured observability
+    snapshot — cost gauges (live MFU per program), bytes-on-wire
+    counters, span summary, profiler tables.  Never kills the metric."""
+    try:
+        from paddle_tpu import obs
+
+        return obs.snapshot()
+    except Exception as e:  # noqa: BLE001 - observability is optional
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def _persist_onchip(result):
     try:
         with open(ONCHIP_RECORD, "w") as f:
@@ -566,23 +578,29 @@ def bench_resnet50(jax, jnp, on_tpu, batch=None):
     lr = jnp.float32(0.1)
     state = {"params": params, "vel": vel}
 
+    # MFU numerator from XLA cost_analysis (ISSUE 6): AOT-compile the
+    # step ONCE and read FLOPs off the executable — the compiled
+    # callable replaces the jit path, so this is the same single
+    # compile the first step would have paid, on TPU too (the old
+    # CPU-only lower().compile() double-compiled).  Analytic count
+    # stays as the fallback when the backend reports no cost model.
+    from paddle_tpu.obs import cost as obs_cost
+
     flops = 3 * resnet50_fwd_flops(batch, hw, classes)
-    if not on_tpu:
-        # exact compiled flops are nice-to-have; on TPU lower().compile()
-        # would compile the train step a SECOND time (minutes inside the
-        # bench watchdog), so the chip run keeps the analytic count
-        try:
-            cost = step.lower(state, x, y, lr).compile().cost_analysis()
-            if isinstance(cost, (list, tuple)):  # jax 0.4.x: per-device
-                cost = cost[0] if cost else None
-            if cost and cost.get("flops", 0) > 0:
-                flops = cost["flops"]
-        except Exception:  # noqa: BLE001 - analytic fallback stands
-            pass
+    flops_source = "analytic"
+    compiled, pc = obs_cost.compile_with_cost(
+        step, (state, x, y, lr), "bench.resnet50_step")
+    if compiled is not None:
+        step = compiled
+    if pc is not None and pc.flops > 0:
+        flops = pc.flops
+        flops_source = "xla_cost_analysis"
 
     holder = {"state": state}
 
     def run_once():
+        if pc is not None:
+            pc.observe_dispatch()  # feeds the live mfu_pct gauge
         holder["state"], loss = step(holder["state"], x, y, lr)
         return loss
 
@@ -605,9 +623,11 @@ def bench_resnet50(jax, jnp, on_tpu, batch=None):
         "unit": "images/sec",
         "vs_baseline": round(mfu / 45.0, 4),
         "detail": {"batch": batch, "image_hw": hw,
+                   "device_class": "tpu" if on_tpu else "cpu-fallback",
                    "step_ms": round(best * 1e3, 2),
                    "mfu_pct": round(mfu, 2),
                    "flops_per_step": float(flops),
+                   "flops_source": flops_source,
                    "host_feed_ms": round(host_feed_ms, 3),
                    **pipe,
                    "layout": _resnet_layout_detail(),
@@ -678,6 +698,8 @@ def bench_serving(jax, jnp, on_tpu):
         p99 = lat.get("p99_ms", 0.0)
         detail = {
             "backend": "tpu" if on_tpu else "cpu",
+            "device_class": "tpu" if on_tpu else "cpu-fallback",
+            "obs": _obs_detail(),
             "clients": clients,
             "requests": n_req,
             "throughput_rps": round(n_req / wall, 1),
@@ -745,6 +767,7 @@ def main():
         out["detail"]["feed_pipeline"] = _run_with_watchdog(
             lambda: bench_feed_pipeline(jax, jnp), timeout_s=120,
             what="feed pipeline bench")
+        out["detail"]["obs"] = _obs_detail()
         print(json.dumps(out))
         return
     # full production config: attention dropout 0.1 AND a variable-length
@@ -813,13 +836,28 @@ def main():
             run_step = multi
         else:
             run_step = step
+        # AOT-compile the timed unit once and read its XLA cost_analysis
+        # (ISSUE 6): the executable replaces the jit call, so the MFU
+        # numerator comes from the compiler's own FLOP count — not the
+        # hand-maintained bert_step_flops formula — at no extra compile
+        from paddle_tpu.obs import cost as obs_cost
+
+        compiled, pc = obs_cost.compile_with_cost(
+            run_step, (state, b, lr), "bench.bert_step")
+        if compiled is not None:
+            run_step = compiled
         holder = {"state": state}
 
         def run_once():
+            if pc is not None:
+                pc.observe_dispatch()  # feeds the live mfu_pct gauge
             holder["state"], loss = run_step(holder["state"], b, lr)
             return loss
 
         dt, final_loss, pipe = _time_step(run_once, steps, reps)
+        if pc is not None and pc.flops > 0:
+            # pc covers one run_step call = steps_per_call model steps
+            pipe["flops_cost_analysis"] = pc.flops / steps_per_call
         # normalize the pipeline numbers to per-MODEL-step like dt:
         # one run_once dispatch carries `steps_per_call` scanned steps,
         # and the timed loop keeps steps*steps_per_call of them in
@@ -842,11 +880,16 @@ def main():
         batch = 32
         dt, final_loss, pipe = timed_run(batch)
 
-    flops = bert_step_flops(cfg, batch, seq, n_masked)
+    flops_measured = pipe.pop("flops_cost_analysis", None)
+    flops = flops_measured or bert_step_flops(cfg, batch, seq, n_masked)
     mfu = flops / dt / peak * 100.0
     tokens_per_sec = batch * seq / dt
 
     detail = {"backend": backend, "batch": batch, "seq": seq,
+              "device_class": "tpu" if on_tpu else "cpu-fallback",
+              "flops_per_step": float(flops),
+              "flops_source": ("xla_cost_analysis" if flops_measured
+                               else "analytic"),
               "step_ms": round(dt * 1e3, 2),
               "tokens_per_sec": round(tokens_per_sec, 1),
               "flash_attention": (flash_active
@@ -860,6 +903,7 @@ def main():
     detail["feed_pipeline"] = _run_with_watchdog(
         lambda: bench_feed_pipeline(jax, jnp), timeout_s=120,
         what="feed pipeline bench")
+    detail["obs"] = _obs_detail()
     result = {
         "metric": ("bert_base_pretrain_mfu" if on_tpu
                    else "bert_tiny_pretrain_mfu_cpu"),

@@ -40,6 +40,7 @@ from . import dataset
 from . import inference
 from . import transforms
 from . import profiler
+from . import obs
 from . import utils
 from . import reader
 from .batch import batch

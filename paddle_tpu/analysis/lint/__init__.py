@@ -163,3 +163,4 @@ def run_rules(root: Optional[str] = None,
 from . import hot_path_sync  # noqa: E402,F401
 from . import lock_order  # noqa: E402,F401
 from . import side_effects  # noqa: E402,F401
+from . import span_leak  # noqa: E402,F401

@@ -69,6 +69,14 @@ WATCHLIST: List[Tuple[str, str]] = [
     ("paddle_tpu/serving/batcher.py", "DynamicBatcher.next_batch"),
     ("paddle_tpu/serving/bucketing.py", "BucketedRunner.run"),
     ("paddle_tpu/inference/c_bridge.py", "run_f32"),
+    # obs span/cost layer (ISSUE 6): these run INSIDE every watched loop
+    # above — a sync creeping into the tracer or the live-MFU gauge
+    # would hide in every profile it produces
+    ("paddle_tpu/obs/tracing.py", "Tracer.span"),
+    ("paddle_tpu/obs/tracing.py", "Tracer.add_span"),
+    ("paddle_tpu/obs/tracing.py", "Tracer._record"),
+    ("paddle_tpu/obs/tracing.py", "Span.__exit__"),
+    ("paddle_tpu/obs/cost.py", "ProgramCost.observe_dispatch"),
 ]
 
 # blocking / transferring constructs that must not appear unsanctioned

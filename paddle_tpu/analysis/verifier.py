@@ -605,9 +605,10 @@ def maybe_verify_program(program, feed_names=None, fetch_names=None,
     mode = str(flag("verify_program", "on")).lower()
     if mode in ("off", "0", "false", "no"):
         return
+    from ..obs import span as obs_span
     from ..profiler import stat_add, timed
 
-    with timed("verify_ms"):
+    with obs_span("verifier.run"), timed("verify_ms"):
         findings = verify_program(program, feed=feed_names,
                                   fetch_list=fetch_names, scope=scope,
                                   donated=donated, tiers=(ERROR,))

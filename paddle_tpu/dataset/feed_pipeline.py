@@ -286,7 +286,8 @@ class FeedPipeline:
         self._batch_iter = self._open_source(source, epoch)
         self.epoch_feed_ms = 0.0
         profiler.stat_set("prefetch_depth", self._depth)
-        self._thread = threading.Thread(target=self._produce, daemon=True)
+        self._thread = threading.Thread(target=self._produce, daemon=True,
+                                        name="feed-producer")
         self._thread.start()
 
     # -- source handling ---------------------------------------------------
@@ -312,9 +313,10 @@ class FeedPipeline:
 
     # -- producer (background thread; hot path — lint-watched) -------------
     def _produce(self):
-        from .. import profiler
+        from .. import obs, profiler
 
         ring = self._ring
+        tracer = obs.TRACER
         t_start = time.perf_counter()
         try:
             it = self._batch_iter
@@ -326,8 +328,12 @@ class FeedPipeline:
                     break
                 profiler.time_add("parser_wait_ms",
                                   (time.perf_counter() - t0) * 1e3)
-                staged = self._stage(feed)
-                if not ring.put(staged):
+                # one flow id per batch links the producer's stage span
+                # to the consumer's ring_get span across threads
+                flow = tracer.new_flow() if tracer.enabled else 0
+                with obs.span("feed.stage", flow=flow):
+                    staged = self._stage(feed)
+                if not ring.put((staged, flow)):
                     return  # consumer abandoned the epoch
             self.epoch_feed_ms = (time.perf_counter() - t_start) * 1e3
             ring.put_end()
@@ -340,15 +346,24 @@ class FeedPipeline:
 
     # -- consumer ----------------------------------------------------------
     def __iter__(self):
+        from .. import obs
+
         ring = self._ring
+        tracer = obs.TRACER
         try:
             while True:
+                t0 = time.perf_counter()
                 item = ring.get()
                 if item is DeviceRing._END:
                     break
                 if isinstance(item, BaseException):
                     raise item
-                yield item
+                staged, flow = item
+                # span covers the ring wait: a long feed.ring_get IS the
+                # consumer-starved stall, flow-linked to its producer
+                tracer.add_span("feed.ring_get", t0,
+                                time.perf_counter() - t0, flow=flow)
+                yield staged
         finally:
             ring.close()
             self._finish_epoch()

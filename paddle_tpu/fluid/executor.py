@@ -241,11 +241,15 @@ def scope_guard(scope: Scope):
 class _CompiledEntry:
     # `program`/`scope` pin the originals alive so the id()-based cache key
     # can never collide with a recycled address.
+    # `fn_compiled`/`cost` are the obs cost-attribution seam
+    # (docs/observability.md): the first dispatch AOT-compiles `fn` and
+    # caches the executable plus its XLA cost_analysis here, so
+    # FLOPs/bytes live exactly as long as the CompileCache entry.
     __slots__ = ("fn", "state_in_names", "mutable_in_names", "const_in_names",
                  "mutable_out_names", "feed_names", "fetch_names", "program",
                  "scope", "check_nan", "check_names", "const_src",
                  "const_dev", "feed_shardings", "const_shardings",
-                 "dispatched")
+                 "dispatched", "fn_compiled", "cost", "label")
 
 
 class _NanMonitor:
@@ -376,6 +380,14 @@ class _FeedPrefetcher:
 
     def __iter__(self):
         return iter(self._pipe)
+
+
+def _program_label(program, fetch_names) -> str:
+    """Stable human-greppable identity for cost gauges / tracetool
+    ("MFU per program"): the program id in the verifier's provenance
+    style plus the first fetch target as a hint."""
+    hint = f":{fetch_names[0]}" if fetch_names else ""
+    return f"program#{id(program) & 0xFFFFFF:06x}{hint}"
 
 
 def _analyze_block(block, feed_names, scope: Scope):
@@ -704,8 +716,15 @@ class Executor:
         entry = self._cache.get(key)
         if entry is not None:
             return entry
+        from .. import obs
         from ..profiler import stat_add
         stat_add("executor_compile_count")
+        with obs.span("executor.prepare"):
+            return self._prepare_miss(program, feed_arrays, fetch_names,
+                                      scope, key)
+
+    def _prepare_miss(self, program: Program, feed_arrays, fetch_names,
+                      scope: Scope, key) -> _CompiledEntry:
 
         # graph-transform pipeline, ONLY on a compile-cache miss
         # (docs/graph_transforms.md): rewrites land on a CLONE — the
@@ -780,6 +799,9 @@ class Executor:
         entry.feed_shardings = None
         entry.const_shardings = None
         entry.dispatched = False
+        entry.fn_compiled = None
+        entry.cost = None
+        entry.label = _program_label(program, fetch_names)
         self._cache.put(key, entry)
         return entry
 
@@ -812,15 +834,46 @@ class Executor:
         """The one dispatch point of the hot path (shared with
         CompiledProgram._run): gather device-resident state, call the
         compiled step, commit new state, route NaN flags to the async
-        monitor.  Never blocks on the device and never transfers."""
+        monitor.  Never blocks on the device and never transfers.
+
+        Cost attribution (docs/observability.md): the FIRST call of an
+        entry compiles AOT (`lower().compile()` — the same single
+        compile the jit call would have performed) so the executable's
+        XLA cost_analysis lands in `entry.cost`; steady-state calls go
+        straight to the cached executable and feed the live MFU gauge
+        with their inter-dispatch interval — no sync, no transfer."""
+        from .. import obs
         from ..profiler import time_add
 
         t0 = time.perf_counter()
         mutable_state = {n: scope.get(n) for n in entry.mutable_in_names}
         const_state = self._const_state(entry, scope)
         seed = self._next_seed(entry.program)
-        result = entry.fn(mutable_state, const_state, feed_arrays, seed)
         first_call = not entry.dispatched
+        if first_call and entry.fn_compiled is None:
+            from ..obs.cost import compile_with_cost
+
+            entry.fn_compiled, entry.cost = compile_with_cost(
+                entry.fn, (mutable_state, const_state, feed_arrays, seed),
+                entry.label)
+        with obs.span("executor.dispatch"):
+            if entry.fn_compiled is not None:
+                try:
+                    result = entry.fn_compiled(mutable_state, const_state,
+                                               feed_arrays, seed)
+                except TypeError:
+                    # argument signature drifted from the compiled avals
+                    # (a scope var replaced with a new shape/dtype): fall
+                    # back to the jit wrapper permanently, which retraces
+                    # — the exact behavior this entry had pre-obs
+                    entry.fn_compiled = None
+                    result = entry.fn(mutable_state, const_state,
+                                      feed_arrays, seed)
+            else:
+                result = entry.fn(mutable_state, const_state, feed_arrays,
+                                  seed)
+        if entry.cost is not None:
+            entry.cost.observe_dispatch(t0)
         entry.dispatched = True
         if entry.check_nan:
             fetches, new_state, flags = result

@@ -1,0 +1,111 @@
+"""paddle_tpu.obs — end-to-end observability (ISSUE 6 tentpole).
+
+One layer, three surfaces:
+
+* **Span tracing** (`obs.span` / flow ids / `obs.export_trace`): causal
+  wall-time spans across every thread of the stack — Executor dispatch,
+  compile-cache misses (transform -> verify -> XLA compile), the feed
+  pipeline's producer/ring, and the serving engine's admission ->
+  coalesce -> dispatch -> complete pipeline, linked across threads by
+  flow ids.  Export is Chrome-trace/Perfetto JSON: ONE file shows a
+  train step or a serving request end to end.
+
+* **Cost attribution** (`obs.cost`): per-executable FLOPs/bytes from
+  XLA `cost_analysis`, cached with the CompileCache entry at compile
+  time and combined with measured dispatch intervals into live
+  `mfu_pct` / `hbm_bw_pct` gauges; plus the `collective_bytes_<type>`
+  bytes-on-wire counters the quantized-collectives ROADMAP item will
+  assert against.
+
+* **Snapshot** (`obs.snapshot()`): one structured export — span
+  summary + every profiler timer/counter + the cost gauges — embedded
+  by bench.py in BENCH JSON `detail.obs` and by `obs.export_trace`
+  in the trace file's otherData (so `tools/tracetool.py` can attribute
+  stalls and report MFU from the trace alone).
+
+Enable/disable at runtime (`obs.enable()` / `obs.disable()`); disabled
+tracing is a single attribute check per site — the async hot path's
+zero-sync, zero-transfer contract is untouched either way
+(docs/observability.md).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from . import cost
+from .tracing import NULL_SPAN, TRACER, Tracer  # noqa: F401
+
+__all__ = ["span", "add_span", "new_flow", "attach_flow", "current_span",
+           "enable", "disable", "enabled", "reset", "snapshot",
+           "export_trace", "cost", "TRACER", "NULL_SPAN", "Tracer"]
+
+
+def enable(reset: bool = False) -> None:
+    """Turn span recording on (optionally clearing the buffer)."""
+    TRACER.enable(reset=reset)
+
+
+def disable() -> None:
+    TRACER.disable()
+
+
+def enabled() -> bool:
+    return TRACER.enabled
+
+
+def reset() -> None:
+    """Clear the span buffer and drop counter (enabled state kept)."""
+    TRACER.reset()
+
+
+def span(name: str, flow=None, attrs: Optional[dict] = None):
+    """Context manager recording one span on this thread's track; the
+    shared no-op singleton while tracing is disabled."""
+    return TRACER.span(name, flow=flow, attrs=attrs)
+
+
+def add_span(name: str, t0: float, dur: float, flow=None,
+             attrs: Optional[dict] = None) -> None:
+    """Record a span retroactively (perf_counter seconds)."""
+    TRACER.add_span(name, t0, dur, flow=flow, attrs=attrs)
+
+
+def new_flow() -> int:
+    """Mint a process-unique flow id linking spans across threads."""
+    return TRACER.new_flow()
+
+
+def attach_flow(flow) -> None:
+    TRACER.attach_flow(flow)
+
+
+def current_span():
+    return TRACER.current_span()
+
+
+def snapshot() -> Dict[str, Any]:
+    """One structured observability export: span summary, every
+    profiler counter/timer, cost gauges, bytes-on-wire counters."""
+    from .. import profiler
+
+    stats = profiler.get_int_stats()
+    times = profiler.get_time_stats()
+    return {
+        "spans": TRACER.summary(),
+        "counters": dict(stats),
+        "timers_ms": {k: round(float(v), 3) for k, v in times.items()},
+        "cost": cost.snapshot(),
+    }
+
+
+def export_trace(path: str, include_snapshot: bool = True) -> int:
+    """Write the recorded spans as Chrome-trace/Perfetto JSON.  The
+    snapshot rides in otherData so tracetool can summarize MFU and
+    stall attribution from the one file.  Returns the span count."""
+    other = None
+    if include_snapshot:
+        snap = snapshot()
+        snap.pop("spans", None)  # the events ARE the span detail
+        other = {"snapshot": snap}
+    return TRACER.export(path, other_data=other)

@@ -45,12 +45,35 @@ def _axis_for(ctx, op):
     return axes.get("data")
 
 
+def _record_wire(ctx, op, x):
+    """Bytes-on-wire counter (docs/observability.md): the logical
+    payload bytes this collective moves over ICI, recorded at lowering
+    (trace) time — once per compiled program — under
+    `collective_bytes_<op_type>`.  This is the seam the quantized-
+    allreduce ROADMAP item (EQuARX, arxiv 2506.17615) asserts against:
+    an int8 lowering shrinks exactly this number.  Skipped during
+    abstract InferShape traces so a payload is never double-counted."""
+    if getattr(ctx, "abstract", False):
+        return
+    try:
+        size = 1
+        for d in jnp.shape(x):
+            size *= int(d)
+        nbytes = size * jnp.dtype(jnp.result_type(x)).itemsize
+        from ..obs.cost import record_collective
+
+        record_collective(op.type, nbytes)
+    except Exception:  # noqa: BLE001 - accounting must never break a trace
+        pass
+
+
 def _allreduce(reduce_fn):
     def lower(ctx, op, ins):
         x = first(ins, "X")
         axis = _axis_for(ctx, op)
         if axis is None:
             return {"Out": [x]}
+        _record_wire(ctx, op, x)
         return {"Out": [reduce_fn(x, axis)]}
 
     return lower
@@ -71,6 +94,8 @@ def _c_reduce_sum(ctx, op, ins):
     # for the root rank's consumers).
     x = first(ins, "X")
     axis = _axis_for(ctx, op)
+    if axis is not None:
+        _record_wire(ctx, op, x)
     return {"Out": [x if axis is None else lax.psum(x, axis)]}
 
 
@@ -80,6 +105,7 @@ def _c_broadcast(ctx, op, ins):
     axis = _axis_for(ctx, op)
     if axis is None:
         return {"Out": [x]}
+    _record_wire(ctx, op, x)
     root = op.attr("root", 0)
     idx = lax.axis_index(axis)
     masked = jnp.where(idx == root, x, jnp.zeros_like(x))
@@ -92,6 +118,7 @@ def _c_allgather(ctx, op, ins):
     axis = _axis_for(ctx, op)
     if axis is None:
         return {"Out": [x]}
+    _record_wire(ctx, op, x)
     g = lax.all_gather(x, axis)  # (nranks, ...) leading axis
     return {"Out": [g.reshape((-1,) + x.shape[1:])]}
 
@@ -102,6 +129,7 @@ def _c_reducescatter(ctx, op, ins):
     axis = _axis_for(ctx, op)
     if axis is None:
         return {"Out": [x]}
+    _record_wire(ctx, op, x)
     n = _axis_size(axis)
     return {"Out": [lax.psum_scatter(x, axis, scatter_dimension=0,
                                      tiled=True)]}
@@ -113,6 +141,7 @@ def _c_concat(ctx, op, ins):
     axis = _axis_for(ctx, op)
     if axis is None:
         return {"Out": [x]}
+    _record_wire(ctx, op, x)
     g = lax.all_gather(x, axis)
     return {"Out": [jnp.concatenate(list(g), axis=-1)]}
 
@@ -140,6 +169,7 @@ def _alltoall(ctx, op, ins):
     axis = _axis_for(ctx, op)
     if axis is None:
         return {"Out": [x]}
+    _record_wire(ctx, op, x)
     n = _axis_size(axis)
     xs = x.reshape((n, x.shape[0] // n) + x.shape[1:])
     out = lax.all_to_all(xs, axis, split_axis=0, concat_axis=0, tiled=False)

@@ -125,8 +125,11 @@ class CompiledProgram:
         key = executor._cache_key(program, feed_arrays, fetch_names, scope)
         entry = self._cache.get(key)
         if entry is None:
-            entry = self._compile(executor, program, feed_arrays,
-                                  fetch_names, scope)
+            from .. import obs
+
+            with obs.span("compiled_program.compile"):
+                entry = self._compile(executor, program, feed_arrays,
+                                      fetch_names, scope)
             self._cache.put(key, entry)
 
         with timed("host_feed_ms"):
@@ -187,6 +190,11 @@ class CompiledProgram:
         entry.feed_shardings = feed_shardings
         entry.const_shardings = const_shardings
         entry.dispatched = False
+        entry.fn_compiled = None
+        entry.cost = None
+        from ..fluid.executor import _program_label
+
+        entry.label = _program_label(program, fetch_names)
         return entry
 
     def _compile_spmd(self, executor, program, feed_arrays, fetch_names,

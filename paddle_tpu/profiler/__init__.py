@@ -12,6 +12,14 @@ this module provides (a) the RecordEvent host-marker API bridged onto
 jax.profiler.TraceAnnotation so host phases appear inside the XLA trace,
 (b) a host-side event table with the reference's summary-report shape,
 and (c) start/stop entry points that drive jax.profiler.
+
+Since ISSUE 6, RecordEvent and `export_chrome_tracing` are thin
+adapters over the span layer in `paddle_tpu.obs` — ONE trace format,
+one event path (docs/observability.md).  The aggregate event table
+(the reference's summary report) and the StatRegistry/timer tables
+below are unchanged; `timed()` additionally records a span when
+tracing is enabled, so every instrumented pipeline stage shows up in
+the obs trace for free.
 """
 
 from __future__ import annotations
@@ -27,12 +35,23 @@ _EVENTS = defaultdict(lambda: {"calls": 0, "total": 0.0, "min": None,
                                "max": 0.0})
 _EVENTS_LOCK = threading.Lock()
 _TRACE_DIR = [None]
-# per-event timeline for chrome://tracing export (the reference's
-# tools/timeline.py path); bounded so a long profiled run cannot grow
-# host memory without limit — overflow is counted, not silently lost
-_TIMELINE: list = []
-_TIMELINE_CAP = 200_000
-_TIMELINE_DROPPED = [0]
+# True when start_profiler itself enabled obs tracing (and should
+# therefore disable it again on stop); an obs session the user opened
+# explicitly is never touched
+_OBS_OWNED = [False]
+
+_OBS = None
+
+
+def _tracing():
+    """The obs span tracer module, lazily bound (import-cycle safe:
+    obs.cost imports this module lazily too)."""
+    global _OBS
+    if _OBS is None:
+        from ..obs import tracing as _mod
+
+        _OBS = _mod
+    return _OBS
 
 
 class RecordEvent:
@@ -67,11 +86,11 @@ class RecordEvent:
                 e["total"] += dt
                 e["min"] = dt if e["min"] is None else min(e["min"], dt)
                 e["max"] = max(e["max"], dt)
-                if len(_TIMELINE) < _TIMELINE_CAP:
-                    _TIMELINE.append((self.name, self._t0, dt,
-                                      threading.get_ident()))
-                else:
-                    _TIMELINE_DROPPED[0] += 1
+        # the span layer is the one timeline path (ISSUE 6): a
+        # RecordEvent is just a span recorded retroactively — begin/end
+        # pairs may legally cross threads, so it never touches the
+        # thread-local span stack
+        _tracing().TRACER.add_span(self.name, self._t0, dt)
         self._t0 = None
 
     def __enter__(self):
@@ -88,9 +107,12 @@ def start_profiler(state="All", tracer_option="Default", trace_dir=None):
     _ENABLED[0] = True
     with _EVENTS_LOCK:
         _EVENTS.clear()
-        # a fresh session must not export the previous session's spans
-        del _TIMELINE[:]
-        _TIMELINE_DROPPED[0] = 0
+    tr = _tracing().TRACER
+    if not tr.enabled:
+        # a fresh session must not export the previous session's spans;
+        # an obs session the user opened explicitly stays untouched
+        tr.enable(reset=True)
+        _OBS_OWNED[0] = True
     if trace_dir is not None:
         import jax
 
@@ -102,6 +124,9 @@ def stop_profiler(sorted_key="total", profile_path=None):
     """(reference: fluid/profiler.py:255).  Prints the event table and
     stops the XLA trace; returns the table rows."""
     _ENABLED[0] = False
+    if _OBS_OWNED[0]:
+        _tracing().TRACER.disable()
+        _OBS_OWNED[0] = False
     if _TRACE_DIR[0] is not None:
         import jax
 
@@ -142,38 +167,20 @@ def profiler(state="All", sorted_key="total", profile_path=None,
 def reset_profiler():
     with _EVENTS_LOCK:
         _EVENTS.clear()
-        del _TIMELINE[:]
-        _TIMELINE_DROPPED[0] = 0
+    _tracing().TRACER.reset()
 
 
 def export_chrome_tracing(path):
-    """Write the recorded host events as a chrome://tracing /
-    Perfetto-loadable JSON file — the reference's tools/timeline.py
-    (profiler proto -> chrome trace) re-designed over the host event
-    buffer.  Device-side events live in the XLA trace jax.profiler
-    writes to `trace_dir` (TensorBoard/perfetto format); this export
-    covers the RecordEvent host phases, one track per thread.
+    """Write the recorded spans as a chrome://tracing / Perfetto JSON
+    file.  Thin adapter (ISSUE 6) over `paddle_tpu.obs.export_trace` —
+    RecordEvent phases, executor/serving/feed-pipeline spans and their
+    cross-thread flow links all land in the ONE trace.  Device-side
+    events live in the XLA trace jax.profiler writes to `trace_dir`.
 
-    Returns the number of events written."""
-    import json
+    Returns the number of span events written."""
+    from .. import obs
 
-    with _EVENTS_LOCK:
-        events = list(_TIMELINE)
-        dropped = _TIMELINE_DROPPED[0]
-    tids = {}
-    trace = []
-    for name, t0, dt, tid in events:
-        tids.setdefault(tid, len(tids))
-        trace.append({"ph": "X", "cat": "host", "name": name,
-                      "ts": t0 * 1e6, "dur": dt * 1e6,
-                      "pid": 0, "tid": tids[tid]})
-    doc = {"traceEvents": trace,
-           "displayTimeUnit": "ms",
-           "otherData": {"producer": "paddle_tpu.profiler",
-                         "dropped_events": dropped}}
-    with open(path, "w") as f:
-        json.dump(doc, f)
-    return len(trace)
+    return obs.export_trace(path)
 
 
 # ---------------------------------------------------------------------------
@@ -265,12 +272,18 @@ def get_time_stats() -> dict:
 
 @contextlib.contextmanager
 def timed(name: str):
-    """Accumulate the with-block's wall time onto `name` (ms)."""
+    """Accumulate the with-block's wall time onto `name` (ms).  When
+    span tracing is on, the interval is also recorded as a span, so
+    every timed pipeline stage (host_feed_ms, compile_ms, sync_ms,
+    serving_*_ms, ...) appears in the obs trace without a second
+    instrumentation site."""
     t0 = time.perf_counter()
     try:
         yield
     finally:
-        time_add(name, (time.perf_counter() - t0) * 1e3)
+        dt = time.perf_counter() - t0
+        time_add(name, dt * 1e3)
+        _tracing().TRACER.add_span(name, t0, dt)
 
 
 def count_sync(n: int = 1) -> None:

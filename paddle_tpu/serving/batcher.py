@@ -58,12 +58,17 @@ class Request:
     _ids_lock = threading.Lock()
 
     def __init__(self, inputs: Sequence[Any]):
+        from ..obs import TRACER
+
         with Request._ids_lock:
             self.id = next(Request._ids)
         self.inputs = list(inputs)
         self.rows = int(self.inputs[0].shape[0]) if self.inputs[0].shape \
             else 1
         self.sig = input_signature(self.inputs)
+        # flow id linking this request's spans (admit -> coalesce ->
+        # dispatch -> complete) across the engine's threads
+        self.flow = TRACER.new_flow() if TRACER.enabled else 0
         self.submitted_at = time.perf_counter()
         self._event = threading.Event()
         self._result: Optional[List[np.ndarray]] = None
@@ -157,19 +162,21 @@ class DynamicBatcher:
         return n
 
     def submit(self, req: Request) -> Response:
+        from ..obs import span as obs_span
         from ..profiler import stat_add
 
-        with self._cond:
-            if self._closed:
-                raise EngineClosed("engine is shut down")
-            if req.rows > self.max_batch_size:
-                # oversize requests are legal (the bucketed runner
-                # chunks them) but they occupy a whole batch
-                pass
-            self._admission.admit()  # raises EngineOverloaded at bound
-            self._q.append(req)
-            stat_add("serving_requests_total")
-            self._cond.notify()
+        with obs_span("serving.admit", flow=req.flow):
+            with self._cond:
+                if self._closed:
+                    raise EngineClosed("engine is shut down")
+                if req.rows > self.max_batch_size:
+                    # oversize requests are legal (the bucketed runner
+                    # chunks them) but they occupy a whole batch
+                    pass
+                self._admission.admit()  # raises EngineOverloaded at bound
+                self._q.append(req)
+                stat_add("serving_requests_total")
+                self._cond.notify()
         return Response(req)
 
     def _pop_matching(self, sig, budget: int) -> Optional[Request]:

@@ -115,6 +115,9 @@ class BucketedRunner:
         self._cache = cache if cache is not None else CompileCache(
             self.CACHE_CAPACITY, stat_prefix="serving")
         self._compile_lock = threading.Lock()
+        # bucket key -> obs ProgramCost gauge (flops from the AOT
+        # entry's cost_analysis; run() feeds it dispatch intervals)
+        self._costs: dict = {}
 
     # -- compile management ------------------------------------------------
     def _key(self, bucket: int, sig: Tuple) -> Tuple:
@@ -170,6 +173,14 @@ class BucketedRunner:
                     warnings.filterwarnings(
                         "ignore", message=".*donated buffer.*")
                     entry = jitted.lower(*specs).compile()
+            # the entry is already AOT: reading its XLA cost_analysis
+            # into the obs gauge registry is free (no extra compile) —
+            # serving MFU reports per bucket (docs/observability.md)
+            from ..obs import cost as obs_cost
+
+            self._costs[key] = obs_cost.register_program(
+                f"serving.bucket{bucket}",
+                obs_cost.cost_of_compiled(entry))
             stat_add(TRACE_STAT)
             self._cache.put(key, entry)
             return entry
@@ -192,6 +203,9 @@ class BucketedRunner:
             return self._run_chunked(inputs, rows, top)
         bucket, sig = self.plan(inputs)
         entry = self._entry(bucket, sig, inputs)
+        pc = self._costs.get(self._key(bucket, sig))
+        if pc is not None:
+            pc.observe_dispatch()
         padded = [pad_batch(a, bucket) for a in inputs]
         outs = self._call(entry, padded)
         return [o[:rows] if hasattr(o, "shape") and o.shape

@@ -290,7 +290,10 @@ class Engine:
         ones; park batches whose bucket entry does not exist yet with
         the compiler thread.  Never compiles, never blocks on the
         device, never transfers."""
+        from .. import obs
+
         while not self._stop.is_set():
+            t0 = time.perf_counter()
             batch = self._batcher.next_batch(timeout=0.05)
             if batch is None:
                 continue
@@ -298,6 +301,11 @@ class Engine:
                 batch = [r for r in batch if not r.cancelled]
                 if not batch:
                     continue
+                # retroactive span: the coalesce wait only turns out to
+                # be one once a batch actually formed
+                obs.add_span("serving.coalesce", t0,
+                             time.perf_counter() - t0,
+                             flow=[r.flow for r in batch])
                 inputs = self._concat(batch)
                 if self.model.is_compiled(inputs):
                     self._dispatch_batch(batch, inputs)
@@ -314,13 +322,17 @@ class Engine:
         """Off-path compilation: build the bucket entry with the batch
         parked, then dispatch it.  The dispatch loop keeps serving
         already-compiled buckets meanwhile."""
+        from .. import obs
+
         while True:
             item = self._compile_q.get()
             if item is _SENTINEL:
                 return
             batch, inputs = item
             try:
-                self.model.ensure_compiled(inputs)
+                with obs.span("serving.compile",
+                              flow=[r.flow for r in batch]):
+                    self.model.ensure_compiled(inputs)
                 self._dispatch_batch(batch, inputs)
             except BaseException as e:  # noqa: BLE001 - fail the batch
                 for req in batch:
@@ -339,6 +351,7 @@ class Engine:
     def _dispatch_batch(self, batch: List[Request], inputs) -> None:
         """Dispatch one batch asynchronously; bounded dispatch-ahead:
         at most max_in_flight batches between here and the completer."""
+        from .. import obs
         from ..profiler import stat_set, timed
 
         with self._inflight_cond:
@@ -352,7 +365,9 @@ class Engine:
                 return
         rows = inputs[0].shape[0]
         bucket, _sig = self.model.plan(inputs)
-        with timed("serving_dispatch_ms"):
+        with obs.span("serving.dispatch",
+                      flow=[r.flow for r in batch]), \
+                timed("serving_dispatch_ms"):
             outs = self.model.run(inputs)  # async: device arrays out
         metrics.observe_batch(len(batch), rows,
                               max(0, bucket - rows))
@@ -364,6 +379,7 @@ class Engine:
     def _completer_loop(self):
         """The sanctioned device->host boundary: materialize the oldest
         in-flight batch, slice per request, fulfill futures."""
+        from .. import obs
         from ..profiler import count_sync, stat_add, stat_set, timed
 
         while True:
@@ -378,7 +394,9 @@ class Engine:
                 stat_set("serving_in_flight", len(self._inflight))
                 self._inflight_cond.notify_all()
             try:
-                with timed("serving_response_ms"):
+                with obs.span("serving.complete",
+                              flow=[r.flow for r in batch]), \
+                        timed("serving_response_ms"):
                     count_sync(len(outs))
                     host = [np.asarray(o) for o in outs]  # sync-ok: response boundary
             except BaseException as e:  # noqa: BLE001

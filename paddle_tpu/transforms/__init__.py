@@ -214,9 +214,10 @@ def maybe_transform_program(program, feed_names=None, fetch_names=None,
     enabled = [n for n, on in enabled_passes().items() if on]
     if not enabled:
         return program
+    from ..obs import span as obs_span
     from ..profiler import stat_add, timed
 
-    with timed("transform_ms"):
+    with obs_span("transforms.apply"), timed("transform_ms"):
         out, stats = apply_transforms(program, feed_names=feed_names,
                                       fetch_names=fetch_names,
                                       scope=scope, passes=enabled)

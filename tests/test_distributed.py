@@ -572,6 +572,25 @@ def test_zero_reduce_scatter_hlo_on_tpu_topology():
     from jax.sharding import Mesh, NamedSharding
     from jax.sharding import PartitionSpec as P
 
+    # The axon plugin's topology call WEDGES (blocks in C, no raise)
+    # when the TPU tunnel is down — observed eating most of the tier-1
+    # budget mid-suite.  Probe it in a THROWAWAY subprocess first (the
+    # bench.py probe idiom) so a wedge costs 45s, not 800.
+    import subprocess
+    import sys
+
+    probe = ("from jax.experimental import topologies\n"
+             "t = topologies.get_topology_desc(platform='tpu', "
+             "topology_name='v5e:2x4')\n"
+             "assert len(list(t.devices)) == 8\n")
+    try:
+        r = subprocess.run([sys.executable, "-c", probe],
+                           capture_output=True, timeout=45)
+    except subprocess.TimeoutExpired:
+        pytest.skip("topology AOT probe wedged (tunnel down)")
+    if r.returncode != 0:
+        pytest.skip("topology AOT unavailable: "
+                    f"{r.stderr.decode(errors='replace')[-200:]}")
     try:
         from jax.experimental import topologies
 

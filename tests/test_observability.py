@@ -147,9 +147,9 @@ class TestChromeTracingExport:
         n = prof.export_chrome_tracing(str(out))
         assert n == 3
         doc = json.loads(out.read_text())
-        evs = doc["traceEvents"]
+        evs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
         assert {e["name"] for e in evs} == {"forward", "attention"}
-        assert all(e["ph"] == "X" and e["dur"] >= 0 for e in evs)
+        assert all(e["dur"] >= 0 for e in evs)
         # nesting: attention lies within one forward span
         att = next(e for e in evs if e["name"] == "attention")
         fwd = [e for e in evs if e["name"] == "forward"]
@@ -158,20 +158,28 @@ class TestChromeTracingExport:
                    for f in fwd)
         assert doc["otherData"]["dropped_events"] == 0
 
-    def test_timeline_cap_counts_drops(self):
+    def test_timeline_cap_counts_drops(self, tmp_path):
+        """The bounded span buffer behind export_chrome_tracing counts
+        overflow instead of losing it silently (the RecordEvent path
+        now records into paddle_tpu.obs — ISSUE 6)."""
+        import json
+
+        from paddle_tpu import obs
         from paddle_tpu import profiler as prof
 
         prof.reset_profiler()
-        old_cap = prof._TIMELINE_CAP
-        prof._TIMELINE_CAP = 2
+        old_cap = obs.TRACER.capacity
+        obs.TRACER.capacity = 2
         try:
             prof.start_profiler()
             for _ in range(5):
                 with prof.RecordEvent("e"):
                     pass
             prof.stop_profiler(profile_path=None)
-            assert len(prof._TIMELINE) == 2
-            assert prof._TIMELINE_DROPPED[0] == 3
+            out = tmp_path / "capped.json"
+            assert prof.export_chrome_tracing(str(out)) == 2
+            doc = json.loads(out.read_text())
+            assert doc["otherData"]["dropped_events"] == 3
         finally:
-            prof._TIMELINE_CAP = old_cap
+            obs.TRACER.capacity = old_cap
             prof.reset_profiler()

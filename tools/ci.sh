@@ -14,6 +14,9 @@ python -m pytest tests/ -x -q
 echo "== static analysis: tpulint rules + op-test coverage floor =="
 python tools/run_lints.py
 
+echo "== observability: tracetool selftest (span layer end to end) =="
+python tools/tracetool.py selftest
+
 # timeout: a wedged TPU tunnel blocks jax.devices() forever — treat a
 # hung probe as "no accelerator" and keep CI moving (rc 124 -> else)
 if timeout 90 python - <<'EOF'

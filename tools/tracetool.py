@@ -1,0 +1,332 @@
+#!/usr/bin/env python
+"""tracetool: summarize / diff / selftest paddle_tpu.obs trace files.
+
+The obs layer exports one Chrome-trace/Perfetto JSON per run
+(`obs.export_trace`, also `profiler.export_chrome_tracing`) with the
+structured snapshot riding in otherData.  This CLI answers the
+questions the ROADMAP perf items keep asking WITHOUT opening a trace
+viewer:
+
+  summarize  top spans by total time, per-thread tracks, cross-thread
+             flow links, MFU per program (from the embedded cost
+             gauges) and stall attribution (from the embedded feed
+             pipeline timers)
+  diff       per-span-name total/count deltas between two traces
+             (before/after a perf change — the measurement half of
+             "measure the layout win, then fuse")
+  selftest   build a synthetic multi-thread trace through the span
+             layer, export it, summarize it, and verify the
+             invariants end to end (wired into tools/ci.sh)
+
+stdlib-only; paddle_tpu.obs.tracing is loaded by FILE PATH (the
+tpulint idiom), so this tool runs in environments without jax.
+Exit status: 0 ok, 1 findings/failure, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import tempfile
+import threading
+from typing import Dict, List, Optional
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TRACING = os.path.join(REPO_ROOT, "paddle_tpu", "obs", "tracing.py")
+
+
+def load_tracing():
+    """paddle_tpu/obs/tracing.py by file path — no paddle_tpu (and so
+    no jax) import."""
+    name = "paddle_tpu_obs_tracing"
+    mod = sys.modules.get(name)
+    if mod is not None:
+        return mod
+    spec = importlib.util.spec_from_file_location(name, _TRACING)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def load_trace(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    if "traceEvents" not in doc:
+        raise ValueError(f"{path}: not a Chrome-trace document "
+                         "(no traceEvents)")
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# summarize
+# ---------------------------------------------------------------------------
+
+def attribute_stall(times_ms: Dict[str, float]) -> str:
+    """Feed-pipeline stall classification from the counters alone —
+    the same logic as dataset.feed_pipeline.attribute_stall, duplicated
+    here ON PURPOSE so the tool stays importable without jax."""
+    full = float(times_ms.get("ring_full_wait_ms", 0.0))
+    empty = float(times_ms.get("ring_empty_wait_ms", 0.0))
+    parser = float(times_ms.get("parser_wait_ms", 0.0))
+    stage = float(times_ms.get("host_feed_ms", 0.0))
+    if full < 1e-6 and empty < 1e-6:
+        return "balanced"
+    if full >= empty:
+        return "compute-bound"
+    return "parser-bound" if parser >= stage else "transfer-bound"
+
+
+def summarize(doc: dict, top: int = 15) -> dict:
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    metas = {e["tid"]: e.get("args", {}).get("name", "")
+             for e in doc["traceEvents"]
+             if e.get("ph") == "M" and e.get("name") == "thread_name"}
+    flows = [e for e in doc["traceEvents"] if e.get("cat") == "flow"]
+
+    by_name: Dict[str, dict] = {}
+    by_tid: Dict[int, dict] = {}
+    for e in spans:
+        n = by_name.setdefault(e["name"], {"count": 0, "total_ms": 0.0,
+                                           "max_ms": 0.0})
+        ms = e.get("dur", 0.0) / 1e3
+        n["count"] += 1
+        n["total_ms"] += ms
+        n["max_ms"] = max(n["max_ms"], ms)
+        t = by_tid.setdefault(e["tid"], {"events": 0, "busy_ms": 0.0})
+        t["events"] += 1
+        t["busy_ms"] += ms
+
+    flow_ids: Dict[int, set] = {}
+    for e in flows:
+        flow_ids.setdefault(e.get("id"), set()).add(e.get("tid"))
+    cross = sum(1 for tids in flow_ids.values() if len(tids) > 1)
+
+    top_spans = sorted(
+        ({"name": k, **{kk: (round(vv, 3) if isinstance(vv, float) else vv)
+                        for kk, vv in v.items()}}
+         for k, v in by_name.items()),
+        key=lambda r: -r["total_ms"])[:top]
+
+    other = doc.get("otherData", {})
+    snap = other.get("snapshot", {})
+    cost = snap.get("cost", {})
+    mfu = [{"label": p.get("label"), "mfu_pct": p.get("mfu_pct"),
+            "hbm_bw_pct": p.get("hbm_bw_pct"),
+            "step_ms": p.get("step_ms"),
+            "dispatches": p.get("dispatches")}
+           for p in cost.get("programs", [])]
+    return {
+        "spans": len(spans),
+        "span_names": len(by_name),
+        "threads": [{"tid": tid, "name": metas.get(tid, ""),
+                     "events": t["events"],
+                     "busy_ms": round(t["busy_ms"], 3)}
+                    for tid, t in sorted(by_tid.items())],
+        "flows": len(flow_ids),
+        "cross_thread_flows": cross,
+        "dropped_events": other.get("dropped_events", 0),
+        "top_spans": top_spans,
+        "device_class": cost.get("device_class"),
+        "mfu_per_program": mfu,
+        "live_mfu_pct": cost.get("mfu_pct"),
+        "collective_bytes": cost.get("collective_bytes", {}),
+        "stall_attribution": attribute_stall(snap.get("timers_ms", {})),
+    }
+
+
+def print_summary(s: dict) -> None:
+    print(f"spans: {s['spans']} ({s['span_names']} names), "
+          f"threads: {len(s['threads'])}, flows: {s['flows']} "
+          f"({s['cross_thread_flows']} cross-thread), "
+          f"dropped: {s['dropped_events']}")
+    for t in s["threads"]:
+        print(f"  tid {t['tid']:>3} {t['name']:<24} "
+              f"{t['events']:>6} ev {t['busy_ms']:>10.3f} ms busy")
+    print(f"{'span':<32}{'count':>8}{'total_ms':>12}{'max_ms':>10}")
+    for r in s["top_spans"]:
+        print(f"{r['name']:<32}{r['count']:>8}{r['total_ms']:>12.3f}"
+              f"{r['max_ms']:>10.3f}")
+    if s.get("device_class"):
+        print(f"device_class: {s['device_class']}  "
+              f"live MFU: {s.get('live_mfu_pct')}%  "
+              f"stall: {s['stall_attribution']}")
+    for p in s["mfu_per_program"]:
+        print(f"  {p['label']:<40} mfu {p['mfu_pct']:>8}% "
+              f"hbm {p['hbm_bw_pct']:>8}% step {p['step_ms']} ms "
+              f"x{p['dispatches']}")
+    for ctype, nbytes in sorted(s["collective_bytes"].items()):
+        print(f"  bytes-on-wire {ctype}: {nbytes}")
+
+
+# ---------------------------------------------------------------------------
+# diff
+# ---------------------------------------------------------------------------
+
+def diff_traces(a: dict, b: dict) -> List[dict]:
+    def totals(doc):
+        out: Dict[str, dict] = {}
+        for e in doc["traceEvents"]:
+            if e.get("ph") != "X":
+                continue
+            r = out.setdefault(e["name"], {"count": 0, "total_ms": 0.0})
+            r["count"] += 1
+            r["total_ms"] += e.get("dur", 0.0) / 1e3
+        return out
+
+    ta, tb = totals(a), totals(b)
+    rows = []
+    for name in sorted(set(ta) | set(tb)):
+        ra = ta.get(name, {"count": 0, "total_ms": 0.0})
+        rb = tb.get(name, {"count": 0, "total_ms": 0.0})
+        rows.append({"name": name,
+                     "a_ms": round(ra["total_ms"], 3),
+                     "b_ms": round(rb["total_ms"], 3),
+                     "delta_ms": round(rb["total_ms"] - ra["total_ms"], 3),
+                     "a_count": ra["count"], "b_count": rb["count"]})
+    rows.sort(key=lambda r: -abs(r["delta_ms"]))
+    return rows
+
+
+def print_diff(rows: List[dict]) -> None:
+    print(f"{'span':<32}{'a_ms':>12}{'b_ms':>12}{'delta_ms':>12}"
+          f"{'a#':>7}{'b#':>7}")
+    for r in rows:
+        print(f"{r['name']:<32}{r['a_ms']:>12.3f}{r['b_ms']:>12.3f}"
+              f"{r['delta_ms']:>12.3f}{r['a_count']:>7}{r['b_count']:>7}")
+
+
+# ---------------------------------------------------------------------------
+# selftest
+# ---------------------------------------------------------------------------
+
+def selftest(verbose: bool = True) -> int:
+    """Build a 3-thread trace with flow links through the span layer,
+    export, summarize, and assert every invariant the real subsystems
+    rely on.  Returns 0 on success."""
+    tracing = load_tracing()
+    tr = tracing.Tracer(capacity=1000)
+    tr.enable()
+
+    flows = [tr.new_flow() for _ in range(4)]
+
+    def producer():
+        for f in flows:
+            with tr.span("feed.stage", flow=f):
+                pass
+
+    def consumer():
+        for f in flows:
+            with tr.span("executor.dispatch", flow=f):
+                with tr.span("executor.prepare"):
+                    pass
+
+    def completer():
+        for f in flows:
+            tr.add_span("serving.complete", 0.0, 1e-4, flow=f)
+
+    threads = [threading.Thread(target=fn, name=nm)
+               for fn, nm in ((producer, "feed-producer"),
+                              (consumer, "serving-dispatch"),
+                              (completer, "serving-complete"))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # exception safety: the span must record even when the body raises
+    try:
+        with tr.span("raises"):
+            raise RuntimeError("boom")
+    except RuntimeError:
+        pass
+
+    fd, path = tempfile.mkstemp(suffix=".trace.json")
+    os.close(fd)
+    try:
+        n = tr.export(path, other_data={
+            "snapshot": {"cost": {"device_class": "selftest",
+                                  "mfu_pct": 1.0,
+                                  "programs": [{"label": "p", "mfu_pct": 1.0,
+                                                "hbm_bw_pct": 0.0,
+                                                "step_ms": 1.0,
+                                                "dispatches": 2}]},
+                         "timers_ms": {"ring_full_wait_ms": 1.0}}})
+        s = summarize(load_trace(path))
+        # 4 stage + 4 dispatch + 4 prepare + 4 complete + 1 raises
+        checks = [
+            ("span count", n == 17 and s["spans"] == 17),
+            ("all three threads present",
+             {"feed-producer", "serving-dispatch", "serving-complete"}
+             <= {t["name"] for t in s["threads"]}),
+            ("flows link across threads",
+             s["flows"] == 4 and s["cross_thread_flows"] == 4),
+            ("exception-path span recorded",
+             any(r["name"] == "raises" for r in s["top_spans"])),
+            ("nothing dropped", s["dropped_events"] == 0),
+            ("mfu per program surfaced",
+             s["mfu_per_program"] and s["mfu_per_program"][0]["mfu_pct"]
+             == 1.0),
+            ("stall attribution computed",
+             s["stall_attribution"] == "compute-bound"),
+        ]
+        failed = [name for name, ok in checks if not ok]
+        if verbose:
+            for name, ok in checks:
+                print(f"  [{'ok' if ok else 'FAIL'}] {name}")
+        if failed:
+            print(f"tracetool selftest: {len(failed)} check(s) failed: "
+                  f"{failed}", file=sys.stderr)
+            return 1
+        print("tracetool selftest: ok "
+              f"({s['spans']} spans, {len(s['threads'])} threads, "
+              f"{s['cross_thread_flows']} cross-thread flows)")
+        return 0
+    finally:
+        os.unlink(path)
+
+
+# ---------------------------------------------------------------------------
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tracetool", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = ap.add_subparsers(dest="cmd")
+    p_sum = sub.add_parser("summarize", help="summarize one trace file")
+    p_sum.add_argument("trace")
+    p_sum.add_argument("--top", type=int, default=15)
+    p_sum.add_argument("--json", action="store_true",
+                       help="machine-readable output")
+    p_diff = sub.add_parser("diff", help="diff two trace files (a -> b)")
+    p_diff.add_argument("trace_a")
+    p_diff.add_argument("trace_b")
+    p_diff.add_argument("--json", action="store_true")
+    sub.add_parser("selftest", help="exercise the span layer end to end")
+    args = ap.parse_args(argv)
+
+    if args.cmd == "summarize":
+        s = summarize(load_trace(args.trace), top=args.top)
+        if args.json:
+            print(json.dumps(s))
+        else:
+            print_summary(s)
+        return 0
+    if args.cmd == "diff":
+        rows = diff_traces(load_trace(args.trace_a),
+                           load_trace(args.trace_b))
+        if args.json:
+            print(json.dumps(rows))
+        else:
+            print_diff(rows)
+        return 0
+    if args.cmd == "selftest":
+        return selftest()
+    ap.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
