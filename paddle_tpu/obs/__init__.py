@@ -1,6 +1,6 @@
-"""paddle_tpu.obs — end-to-end observability (ISSUE 6 tentpole).
+"""paddle_tpu.obs — end-to-end observability (ISSUE 6 + 7 tentpoles).
 
-One layer, three surfaces:
+One layer, four surfaces:
 
 * **Span tracing** (`obs.span` / flow ids / `obs.export_trace`): causal
   wall-time spans across every thread of the stack — Executor dispatch,
@@ -17,11 +17,23 @@ One layer, three surfaces:
   bytes-on-wire counters the quantized-collectives ROADMAP item will
   assert against.
 
+* **Per-op attribution** (`obs.opprof` / `obs.op_profile(program)`):
+  every op lowers inside `jax.named_scope` with its greppable
+  `program#<id>/block<idx>/op<id>:<type>[pass=...]` provenance, and
+  each compile-cache miss walks the AOT executable's HLO to fold
+  per-instruction FLOPs/bytes/fusions/relayouts back onto source
+  Program ops — through the transform pipeline's rewrites — so the
+  whole-program MFU number decomposes into named ops
+  (`tools/tracetool.py top-ops`, BENCH `detail.op_profile`).
+
 * **Snapshot** (`obs.snapshot()`): one structured export — span
-  summary + every profiler timer/counter + the cost gauges — embedded
-  by bench.py in BENCH JSON `detail.obs` and by `obs.export_trace`
-  in the trace file's otherData (so `tools/tracetool.py` can attribute
-  stalls and report MFU from the trace alone).
+  summary + every profiler timer/counter + the cost gauges + the
+  per-op profiles — tagged with this host's process index
+  (`all_hosts=True` gathers every host's tables into one merged
+  view), embedded by bench.py in BENCH JSON `detail.obs` and by
+  `obs.export_trace` in the trace file's otherData (so
+  `tools/tracetool.py` can attribute stalls and report MFU from the
+  trace alone).
 
 Enable/disable at runtime (`obs.enable()` / `obs.disable()`); disabled
 tracing is a single attribute check per site — the async hot path's
@@ -34,11 +46,13 @@ from __future__ import annotations
 from typing import Any, Dict, Optional
 
 from . import cost
+from . import opprof
 from .tracing import NULL_SPAN, TRACER, Tracer  # noqa: F401
 
 __all__ = ["span", "add_span", "new_flow", "attach_flow", "current_span",
            "enable", "disable", "enabled", "reset", "snapshot",
-           "export_trace", "cost", "TRACER", "NULL_SPAN", "Tracer"]
+           "export_trace", "op_profile", "cost", "opprof", "TRACER",
+           "NULL_SPAN", "Tracer"]
 
 
 def enable(reset: bool = False) -> None:
@@ -84,19 +98,91 @@ def current_span():
     return TRACER.current_span()
 
 
-def snapshot() -> Dict[str, Any]:
-    """One structured observability export: span summary, every
-    profiler counter/timer, cost gauges, bytes-on-wire counters."""
+def op_profile(program=None, label: Optional[str] = None) \
+        -> Optional[Dict[str, Any]]:
+    """The per-op cost-attribution table for `program` (matched by the
+    SOURCE prog_id its rows attribute to), for an exact executable
+    `label`, or the most recently compiled executable when neither is
+    given.  None until a compile-cache miss has captured one.  Rows
+    carry `program#<id>/block<idx>/op<id>:<type>[pass=...]` provenance
+    plus flops/bytes shares, fusion membership, transpose/relayout
+    counts and collective payload bytes (docs/observability.md)."""
+    prog_id = getattr(program, "prog_id", None) \
+        if program is not None else None
+    return opprof.profile_for(prog_id=prog_id, label=label)
+
+
+def _process_index() -> int:
+    try:
+        from ..distributed.parallel import _safe_process_index
+
+        return int(_safe_process_index())
+    except Exception:  # noqa: BLE001 - no jax/dist: single host
+        return 0
+
+
+def _local_tables() -> Dict[str, Any]:
     from .. import profiler
 
     stats = profiler.get_int_stats()
     times = profiler.get_time_stats()
     return {
-        "spans": TRACER.summary(),
         "counters": dict(stats),
         "timers_ms": {k: round(float(v), 3) for k, v in times.items()},
-        "cost": cost.snapshot(),
     }
+
+
+def _gather_host_tables(local: Dict[str, Any]) -> Dict[str, Any]:
+    """All-gather each host's counter/timer tables (the shard_skew_ms
+    epoch-boundary idiom from dataset.feed_pipeline: fine OFF the hot
+    path, degrades to the local view when gathering is unavailable).
+    Tables are variable-length, so the JSON payload is length-gathered
+    first, then gathered as padded byte arrays."""
+    import json as _json
+
+    from ..dataset.feed_pipeline import host_topology
+
+    index, count = host_topology()
+    if count <= 1:
+        return {str(index): local}
+    try:
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        data = _json.dumps(local).encode()
+        lens = np.asarray(multihost_utils.process_allgather(
+            np.int32(len(data)))).ravel()
+        buf = np.zeros(int(lens.max()), np.uint8)
+        buf[:len(data)] = np.frombuffer(data, np.uint8)
+        bufs = np.asarray(multihost_utils.process_allgather(buf))
+        out = {}
+        for i, n in enumerate(lens):
+            out[str(i)] = _json.loads(
+                bytes(bufs[i, :int(n)]).decode())  # sync-ok: snapshot boundary
+        return out
+    except Exception:  # noqa: BLE001 - observability, not control flow
+        return {str(index): local}
+
+
+def snapshot(all_hosts: bool = False) -> Dict[str, Any]:
+    """One structured observability export: span summary, every
+    profiler counter/timer, cost gauges, bytes-on-wire counters, and
+    the per-op cost-attribution tables.  Tagged with this host's
+    `jax.process_index()`; `all_hosts=True` additionally all-gathers
+    every host's counter/timer tables into `hosts` (a collective —
+    every process of a pod run must call it, e.g. at an epoch/export
+    boundary) so the pod exports ONE merged view."""
+    local = _local_tables()
+    snap = {
+        "host": _process_index(),
+        "spans": TRACER.summary(),
+        "cost": cost.snapshot(),
+        "op_profile": opprof.snapshot(),
+        **local,
+    }
+    if all_hosts:
+        snap["hosts"] = _gather_host_tables(local)
+    return snap
 
 
 def export_trace(path: str, include_snapshot: bool = True) -> int:

@@ -210,7 +210,14 @@ def compile_with_cost(jitted, args: Tuple, label: str):
             compiled = jitted.lower(*args).compile()
     except Exception:  # noqa: BLE001 - cost capture must never break a run
         return None, None
-    return compiled, register_program(label, cost_of_compiled(compiled))
+    cost = cost_of_compiled(compiled)
+    # per-op attribution (obs/opprof.py): walk the executable's HLO
+    # once, here on the compile-cache miss, and fold per-instruction
+    # FLOPs/bytes back onto the Program ops named in the metadata
+    from . import opprof
+
+    opprof.profile_compiled(compiled, label, cost=cost)
+    return compiled, register_program(label, cost)
 
 
 def record_collective(op_type: str, nbytes: int) -> None:

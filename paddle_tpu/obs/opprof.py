@@ -1,0 +1,541 @@
+"""Per-op cost attribution: Program->HLO provenance folded back onto ops.
+
+PR 6 gave the stack whole-program FLOPs/bytes (`obs.cost`); a 42.3%-MFU
+BERT step was still ONE opaque number.  This module closes the loop the
+TF paper (arxiv 1605.08695) treats as a first-class dataflow concern —
+graph-node-level cost attribution:
+
+* **Provenance threading** happens at lowering time: `ops/registry`
+  wraps every op's lowering rule in `jax.named_scope` with the op's
+  greppable provenance string (`program#<id>/block<idx>/op<id>:<type>`,
+  the PR-3 verifier's identity in scope-path form), so every HLO
+  instruction XLA emits for that op carries the source op in its
+  `metadata={op_name=...}` — and survives XLA's own fusion/rewrites,
+  because metadata is propagated through them.
+
+* **The HLO walk** (`profile_hlo_text`) parses the AOT-compiled
+  executable's optimized HLO (`compiled.as_text()`, captured once per
+  compile-cache miss by `obs.cost.compile_with_cost`) and folds
+  per-instruction FLOP/byte estimates, fusion membership, transpose/
+  relayout copies and collective payload bytes back onto the Program
+  ops named in the metadata.  Instruction FLOPs use the standard
+  analytic model (dot = 2*M*N*K, conv = 2*out*kernel/Cout, elementwise
+  = |out|); totals are then normalized to the executable's own XLA
+  `cost_analysis` numbers so the table sums to the whole-program truth
+  and per-op rows are shares of it (`flops_raw` keeps the unscaled
+  estimate).  Instructions with no provenance metadata land in the
+  `unattributed` bin — never silently dropped.
+
+* **Transform survival**: `transforms.apply_transforms` stamps every
+  cloned op with its SOURCE program's provenance before passes run, and
+  rewriting passes append `[pass=<name>]` tags — so the table answers
+  "which op still relayouts after NHWC" directly, against source-op
+  identities the user can grep in their build script.
+
+stdlib-only ON PURPOSE (the tracing.py idiom): `tools/tracetool.py
+top-ops` loads this module by file path and can profile a raw HLO dump
+in environments without jax.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import re
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+_OPPROF_ENV = "PADDLE_OBS_OPPROF"
+
+# provenance minted by ops/registry.op_provenance and stamped by
+# transforms; the [pass=...] suffix is appended by rewriting passes
+PROVENANCE_RE = re.compile(
+    r"program#(\d+)/block(\d+)/op(\d+):([A-Za-z0-9_.]+)"
+    r"(?:\[pass=([A-Za-z0-9_,.\-]+)\])?")
+
+UNATTRIBUTED = "unattributed"
+
+
+def opprof_enabled() -> bool:
+    return os.environ.get(_OPPROF_ENV, "1").lower() not in ("0", "off",
+                                                            "false")
+
+
+def format_provenance(prog_id: int, block_idx: int, op_id: int,
+                      op_type: str, passes: Iterable[str] = ()) -> str:
+    s = f"program#{prog_id}/block{block_idx}/op{op_id}:{op_type}"
+    passes = [p for p in passes if p]
+    if passes:
+        s += f"[pass={','.join(passes)}]"
+    return s
+
+
+def parse_provenance(s: str) -> Optional[dict]:
+    """Last (deepest-scoped) provenance occurrence in `s`, or None."""
+    last = None
+    for m in PROVENANCE_RE.finditer(s):
+        last = m
+    if last is None:
+        return None
+    prog, blk, op, typ, passes = last.groups()
+    return {"prog": int(prog), "block": int(blk), "op": int(op),
+            "type": typ, "passes": passes.split(",") if passes else []}
+
+
+# ---------------------------------------------------------------------------
+# HLO text parsing
+# ---------------------------------------------------------------------------
+
+_DTYPE_BYTES = {
+    "pred": 1, "s2": 1, "u2": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2, "s32": 4, "u32": 4,
+    "f32": 4, "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+    "f8e5m2": 1, "f8e4m3": 1, "f8e4m3fn": 1, "f8e4m3b11fnuz": 1,
+    "f8e5m2fnuz": 1, "f8e4m3fnuz": 1, "token": 0, "opaque": 0,
+}
+
+_INSTR_RE = re.compile(r"^\s*(?:ROOT\s+)?%([\w.\-]+)\s*=\s*")
+_COMP_RE = re.compile(r"^\s*(ENTRY\s+)?%?([\w.\-]+)\s+\([^=]*\)\s*->")
+_OPNAME_RE = re.compile(r'op_name="([^"]*)"')
+_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+_LHS_CDIMS_RE = re.compile(r"lhs_contracting_dims=\{([0-9,]*)\}")
+_DIM_LABELS_RE = re.compile(r"dim_labels=([a-z0-9?]+)_([a-z0-9?]+)->"
+                            r"([a-z0-9?]+)")
+_CALLS_RE = re.compile(r"calls=%([\w.\-]+)")
+
+# out-elems-cost elementwise/transcendental opcodes (1 flop/elem, the
+# same convention xla::HloCostAnalysis uses)
+_ELEMENTWISE = {
+    "add", "subtract", "multiply", "divide", "maximum", "minimum",
+    "and", "or", "xor", "not", "negate", "abs", "sign", "compare",
+    "select", "clamp", "exponential", "exponential-minus-one", "log",
+    "log-plus-one", "logistic", "tanh", "sine", "cosine", "tan",
+    "sqrt", "rsqrt", "cbrt", "power", "atan2", "remainder", "floor",
+    "ceil", "round-nearest-afz", "round-nearest-even", "is-finite",
+    "shift-left", "shift-right-arithmetic", "shift-right-logical",
+    "popcnt", "clz", "erf", "expm1", "log1p",
+}
+_REDUCES = {"reduce", "reduce-window", "select-and-scatter"}
+_RELAYOUT = {"transpose", "copy"}
+_COLLECTIVES = {"all-reduce", "all-gather", "reduce-scatter",
+                "all-to-all", "collective-permute", "all-reduce-start",
+                "all-gather-start", "collective-permute-start"}
+# free/bookkeeping opcodes: never cost flops or bytes
+_FREE = {"parameter", "constant", "bitcast", "tuple",
+         "get-tuple-element", "after-all", "reshape", "broadcast",
+         "iota", "custom-call", "fusion", "call", "while",
+         "conditional", "get-dimension-size", "partition-id",
+         "replica-id", "rng-bit-generator", "rng", "infeed", "outfeed",
+         "optimization-barrier", "domain", "add-dependency"}
+
+
+class _Shape:
+    __slots__ = ("elems", "nbytes")
+
+    def __init__(self, elems: int, nbytes: int):
+        self.elems = elems
+        self.nbytes = nbytes
+
+
+def _parse_shape(text: str) -> _Shape:
+    """Element/byte count of a result type string ('f32[64,256]{1,0}',
+    '(f32[2]{0}, s32[])', 'token[]' ...).  Tuples sum their leaves."""
+    elems = 0
+    nbytes = 0
+    for m in _SHAPE_RE.finditer(text):
+        dtype, dims = m.groups()
+        if dtype not in _DTYPE_BYTES:
+            continue  # layout annotations like {1,0:T(8,128)} match too
+        n = 1
+        if dims:
+            for d in dims.split(","):
+                n *= int(d)
+        elems += n
+        nbytes += n * _DTYPE_BYTES[dtype]
+    return _Shape(elems, nbytes)
+
+
+def _take_balanced(s: str, start: int) -> Tuple[str, int]:
+    """Substring of `s` from the '(' at `start` through its matching
+    ')'; returns (inner_text, index_after)."""
+    depth = 0
+    for i in range(start, len(s)):
+        if s[i] == "(":
+            depth += 1
+        elif s[i] == ")":
+            depth -= 1
+            if depth == 0:
+                return s[start + 1:i], i + 1
+    return s[start + 1:], len(s)
+
+
+class _Instr:
+    __slots__ = ("name", "opcode", "shape", "operands", "args",
+                 "op_name", "line", "comp")
+
+    def __init__(self, name, opcode, shape, operands, args, op_name,
+                 line, comp):
+        self.name = name
+        self.opcode = opcode
+        self.shape = shape
+        self.operands = operands
+        self.args = args
+        self.op_name = op_name
+        self.line = line
+        self.comp = comp
+
+
+def _parse_instructions(text: str) -> List[_Instr]:
+    out: List[_Instr] = []
+    comp = ""
+    for raw in text.splitlines():
+        line = raw.rstrip()
+        if not line or line.lstrip().startswith(("//", "#")):
+            continue
+        if line.endswith("{") and "=" not in line.split("{")[0]:
+            mc = _COMP_RE.match(line)
+            if mc:
+                comp = mc.group(2)
+            continue
+        m = _INSTR_RE.match(line)
+        if m is None:
+            continue
+        name = m.group(1)
+        rest = line[m.end():]
+        # result type: balanced parens for tuple shapes, else one token
+        if rest.startswith("("):
+            shape_txt, idx = _take_balanced(rest, 0)
+        else:
+            idx = rest.find(" ")
+            if idx < 0:
+                continue
+            shape_txt = rest[:idx]
+        tail = rest[idx:].lstrip()
+        mo = re.match(r"([a-zA-Z][\w\-]*)\s*\(", tail)
+        if mo is None:
+            continue
+        opcode = mo.group(1)
+        args, _ = _take_balanced(tail, mo.end() - 1)
+        operands = re.findall(r"%([\w.\-]+)", args)
+        mn = _OPNAME_RE.search(line)
+        out.append(_Instr(name, opcode, _parse_shape(shape_txt),
+                          operands, args, mn.group(1) if mn else "",
+                          line, comp))
+    return out
+
+
+def _instr_flops(ins: _Instr, shapes: Dict[str, _Shape]) -> float:
+    op = ins.opcode
+    if op == "dot":
+        # contraction size K from the lhs operand's declared type,
+        # which rides in the args text: dot(f32[64,128]{1,0} %a, ...)
+        k = 1
+        m = _LHS_CDIMS_RE.search(ins.line)
+        dims_m = _SHAPE_RE.search(ins.args)
+        if m and dims_m and dims_m.group(2):
+            lhs_dims = [int(d) for d in dims_m.group(2).split(",")]
+            for di in (m.group(1) or "").split(","):
+                if di and int(di) < len(lhs_dims):
+                    k *= lhs_dims[int(di)]
+        return 2.0 * ins.shape.elems * k
+    if op == "convolution":
+        kernel_elems = None
+        if len(ins.operands) >= 2:
+            kshape = shapes.get(ins.operands[1])
+            if kshape is not None:
+                kernel_elems = kshape.elems
+        if kernel_elems is None:
+            return 2.0 * ins.shape.elems
+        out_features = 1
+        ml = _DIM_LABELS_RE.search(ins.line)
+        if ml:
+            out_labels = ml.group(3)
+            f_idx = out_labels.find("f")
+            mo = _SHAPE_RE.search(ins.line)
+            if f_idx >= 0 and mo and mo.group(2):
+                dims = [int(d) for d in mo.group(2).split(",")]
+                if f_idx < len(dims):
+                    out_features = max(1, dims[f_idx])
+        return 2.0 * ins.shape.elems * kernel_elems / out_features
+    if op in _ELEMENTWISE:
+        return float(ins.shape.elems)
+    if op in _REDUCES:
+        src = shapes.get(ins.operands[0]) if ins.operands else None
+        return float(src.elems if src is not None else ins.shape.elems)
+    return 0.0
+
+
+def _instr_bytes(ins: _Instr, shapes: Dict[str, _Shape]) -> float:
+    """HBM-traffic estimate for one top-level instruction: output bytes
+    plus every operand's bytes (fused interiors are excluded by the
+    caller — only computation-boundary values move memory)."""
+    total = float(ins.shape.nbytes)
+    for o in ins.operands:
+        s = shapes.get(o)
+        if s is not None:
+            total += s.nbytes
+    return total
+
+
+def _new_row(key: str) -> dict:
+    return {"op": key, "flops_raw": 0.0, "bytes_raw": 0.0,
+            "instructions": 0, "fusions": 0, "transposes": 0,
+            "transpose_bytes": 0.0, "collective_bytes": 0.0}
+
+
+def profile_hlo_text(text: str, label: str = "",
+                     cost: Optional[Dict[str, float]] = None) -> dict:
+    """Fold an optimized-HLO dump into a per-Program-op cost table.
+
+    `cost` is the executable's own `cost_analysis` {"flops",
+    "bytes_accessed"}; when present the raw estimates are normalized so
+    the table sums to the compiler's whole-program numbers (per-op rows
+    become shares of the truth; `*_raw` keeps the estimate)."""
+    instrs = _parse_instructions(text)
+    shapes = {i.name: i.shape for i in instrs}
+
+    # computations reached via a fusion's calls= are interior: their
+    # instructions cost flops (with their own metadata) but move no
+    # HBM bytes; the fusion instruction itself moves the bytes
+    fused_comps = set()
+    fusion_instr: Dict[str, _Instr] = {}  # fused comp -> fusion instr
+    for ins in instrs:
+        if ins.opcode == "fusion":
+            mc = _CALLS_RE.search(ins.line)
+            if mc:
+                fused_comps.add(mc.group(1))
+                fusion_instr[mc.group(1)] = ins
+
+    # direct provenance, then consumer inheritance: XLA rewrites
+    # (conv canonicalization, layout copies) create metadata-less
+    # relayout chains — a transpose/copy/fusion with no provenance of
+    # its own inherits from its consumers when they all agree, so
+    # "which op still relayouts" points at the op PAYING for the
+    # relayout instead of an anonymous bin
+    prov_of: Dict[str, Optional[dict]] = {
+        i.name: parse_provenance(i.op_name) for i in instrs}
+    consumers: Dict[str, List[str]] = collections.defaultdict(list)
+    for ins in instrs:
+        if ins.comp in fused_comps:
+            continue
+        for o in ins.operands:
+            consumers[o].append(ins.name)
+    _INHERIT_OPS = _RELAYOUT | {"fusion", "bitcast", "reshape",
+                                "broadcast", "convert"}
+    for _ in range(3):  # fixpoint over short copy->fusion->op chains
+        changed = False
+        for ins in instrs:
+            if prov_of.get(ins.name) is not None \
+                    or ins.comp in fused_comps \
+                    or ins.opcode not in _INHERIT_OPS:
+                continue
+            got = {format_provenance(p["prog"], p["block"], p["op"],
+                                     p["type"], p["passes"]): p
+                   for c in consumers.get(ins.name, ())
+                   for p in [prov_of.get(c)] if p is not None}
+            if len(got) == 1:
+                prov_of[ins.name] = next(iter(got.values()))
+                changed = True
+        if not changed:
+            break
+
+    rows: Dict[str, dict] = collections.OrderedDict()
+    fusion_sets: Dict[str, set] = collections.defaultdict(set)
+    raw_flops_total = 0.0
+    raw_bytes_total = 0.0
+
+    for ins in instrs:
+        in_fused = ins.comp in fused_comps
+        prov = prov_of.get(ins.name)
+        if prov is None and in_fused:
+            # interior instruction without metadata: inherit the
+            # fusion's representative provenance
+            fi = fusion_instr.get(ins.comp)
+            prov = prov_of.get(fi.name) if fi is not None else None
+        key = (format_provenance(prov["prog"], prov["block"],
+                                 prov["op"], prov["type"],
+                                 prov["passes"])
+               if prov else UNATTRIBUTED)
+
+        flops = _instr_flops(ins, shapes)
+        nbytes = 0.0
+        if not in_fused and ins.opcode not in ("parameter", "constant",
+                                               "tuple",
+                                               "get-tuple-element",
+                                               "bitcast"):
+            nbytes = _instr_bytes(ins, shapes)
+        if flops <= 0.0 and nbytes <= 0.0 \
+                and ins.opcode not in _RELAYOUT \
+                and ins.opcode not in _COLLECTIVES:
+            continue
+
+        row = rows.get(key)
+        if row is None:
+            row = rows[key] = _new_row(key)
+            if prov:
+                row["source"] = prov
+        row["instructions"] += 1
+        row["flops_raw"] += flops
+        row["bytes_raw"] += nbytes
+        raw_flops_total += flops
+        raw_bytes_total += nbytes
+        if ins.opcode == "fusion":
+            row["fusions"] += 1
+        elif in_fused:
+            fusion_sets[key].add(ins.comp)
+        if ins.opcode in _RELAYOUT:
+            row["transposes"] += 1
+            row["transpose_bytes"] += ins.shape.nbytes
+        if ins.opcode in _COLLECTIVES:
+            row["collective_bytes"] += ins.shape.nbytes
+
+    for key, comps in fusion_sets.items():
+        rows[key]["fusions"] = max(rows[key]["fusions"], len(comps))
+
+    cost = cost or {}
+    cost_flops = float(cost.get("flops", 0.0) or 0.0)
+    cost_bytes = float(cost.get("bytes_accessed", 0.0) or 0.0)
+    fscale = cost_flops / raw_flops_total \
+        if cost_flops > 0.0 and raw_flops_total > 0.0 else 1.0
+    bscale = cost_bytes / raw_bytes_total \
+        if cost_bytes > 0.0 and raw_bytes_total > 0.0 else 1.0
+
+    table: List[dict] = []
+    attributed_flops = 0.0
+    for key, row in rows.items():
+        row["flops"] = row["flops_raw"] * fscale
+        row["bytes"] = row["bytes_raw"] * bscale
+        row["flops_pct"] = (row["flops_raw"] / raw_flops_total * 100.0
+                            if raw_flops_total > 0.0 else 0.0)
+        if key != UNATTRIBUTED:
+            attributed_flops += row["flops_raw"]
+        table.append(row)
+    table.sort(key=lambda r: -r["flops_raw"])
+
+    return {
+        "label": label,
+        "rows": table,
+        "instruction_count": len(instrs),
+        "total_flops": cost_flops or raw_flops_total,
+        "total_flops_raw": raw_flops_total,
+        "total_bytes": cost_bytes or raw_bytes_total,
+        "total_bytes_raw": raw_bytes_total,
+        "attributed_flops_pct": (
+            attributed_flops / raw_flops_total * 100.0
+            if raw_flops_total > 0.0 else 0.0),
+        "transposes": sum(r["transposes"] for r in table),
+        "collective_bytes": sum(r["collective_bytes"] for r in table),
+    }
+
+
+def top_ops(profile: dict, k: int = 10,
+            key: str = "flops") -> List[dict]:
+    """Top-k rows of a profile by `key` (flops | bytes | transposes |
+    collective_bytes), unattributed bin excluded."""
+    rows = [r for r in profile.get("rows", []) if r["op"] != UNATTRIBUTED]
+    rows.sort(key=lambda r: -float(r.get(key, 0.0) or 0.0))
+    return rows[:k]
+
+
+def trim_profile(profile: dict, k: int = 12) -> dict:
+    """Snapshot-sized view: top-k rows + the unattributed bin + totals
+    (the full table stays in the registry)."""
+    keep = top_ops(profile, k)
+    unattr = [r for r in profile.get("rows", [])
+              if r["op"] == UNATTRIBUTED]
+    out = {kk: v for kk, v in profile.items() if kk != "rows"}
+    out["rows"] = [_round_row(r) for r in keep + unattr]
+    for f in ("total_flops", "total_flops_raw", "total_bytes",
+              "total_bytes_raw", "attributed_flops_pct"):
+        if f in out:
+            out[f] = round(float(out[f]), 3)
+    return out
+
+
+def _round_row(r: dict) -> dict:
+    out = dict(r)
+    for f in ("flops", "flops_raw", "bytes", "bytes_raw", "flops_pct",
+              "transpose_bytes", "collective_bytes"):
+        if f in out:
+            out[f] = round(float(out[f]), 3)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Profile registry (the ProgramCost idiom: bounded, insertion-ordered)
+# ---------------------------------------------------------------------------
+
+_PROFILES: "collections.OrderedDict[str, dict]" = \
+    collections.OrderedDict()
+_PROFILES_LOCK = threading.Lock()
+_PROFILES_CAP = 64
+
+
+def register_profile(label: str, profile: dict) -> dict:
+    with _PROFILES_LOCK:
+        _PROFILES[label] = profile
+        _PROFILES.move_to_end(label)
+        while len(_PROFILES) > _PROFILES_CAP:
+            _PROFILES.popitem(last=False)
+    return profile
+
+
+def profiles() -> "collections.OrderedDict[str, dict]":
+    with _PROFILES_LOCK:
+        return collections.OrderedDict(_PROFILES)
+
+
+def reset_profiles() -> None:
+    with _PROFILES_LOCK:
+        _PROFILES.clear()
+
+
+def profile_for(prog_id: Optional[int] = None,
+                label: Optional[str] = None) -> Optional[dict]:
+    """Most recent registered profile, optionally filtered by the
+    SOURCE program id its rows attribute to, or by exact label."""
+    with _PROFILES_LOCK:
+        items = list(_PROFILES.items())
+    for lab, prof in reversed(items):
+        if label is not None:
+            if lab == label:
+                return prof
+            continue
+        if prog_id is None:
+            return prof
+        for row in prof.get("rows", []):
+            src = row.get("source")
+            if src and src.get("prog") == prog_id:
+                return prof
+    return None
+
+
+def profile_compiled(compiled, label: str,
+                     cost: Optional[Dict[str, float]] = None,
+                     register: bool = True) -> Optional[dict]:
+    """Walk an AOT-compiled executable's HLO and register the per-op
+    table.  Duck-typed on `.as_text()` so this module stays jax-free;
+    returns None (never raises) when the backend can't dump HLO."""
+    if not opprof_enabled():
+        return None
+    try:
+        text = compiled.as_text()
+    except Exception:  # noqa: BLE001 - optional on some PJRT plugins
+        return None
+    if not text:
+        return None
+    try:
+        prof = profile_hlo_text(text, label=label, cost=cost)
+    except Exception:  # noqa: BLE001 - attribution must never break a run
+        return None
+    if register:
+        register_profile(label, prof)
+    return prof
+
+
+def snapshot(top: int = 12) -> Dict[str, Any]:
+    """The op-profile block of obs.snapshot(): one trimmed table per
+    registered executable, most recent last."""
+    with _PROFILES_LOCK:
+        items = list(_PROFILES.items())
+    return {label: trim_profile(prof, top) for label, prof in items}

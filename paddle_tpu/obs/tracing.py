@@ -122,6 +122,10 @@ class Span:
 class Tracer:
     """Span buffer + per-thread stacks + flow id allocator."""
 
+    # flow ids remembered as incomplete once the buffer starts dropping
+    # their spans; bounded so a pathological run cannot grow the set
+    DROPPED_FLOWS_CAP = 8192
+
     def __init__(self, capacity: int = DEFAULT_CAPACITY):
         self.enabled = False
         self.capacity = int(capacity)
@@ -129,6 +133,10 @@ class Tracer:
         self._lock = threading.Lock()
         # records: (name, tid, thread_name, t0, dur, flows, attrs)
         self._spans: List[tuple] = []
+        # flow ids that lost >=1 span to a buffer drop: their exported
+        # flow arrows would dangle (e.g. an "f" finish whose "s" start
+        # never made it into the buffer), so export suppresses them
+        self._dropped_flows: set = set()
         self._tls = threading.local()
         self._flow_ids = itertools.count(1)
 
@@ -145,6 +153,7 @@ class Tracer:
         with self._lock:
             self._spans = []
             self.dropped = 0
+            self._dropped_flows = set()
 
     # -- span API ----------------------------------------------------------
     def _stack(self) -> list:
@@ -186,6 +195,11 @@ class Tracer:
         with self._lock:
             if len(self._spans) >= self.capacity:
                 self.dropped += 1
+                if flows and len(self._dropped_flows) < \
+                        self.DROPPED_FLOWS_CAP:
+                    # this flow is now incomplete: a surviving span of
+                    # it must not export a dangling flow arrow
+                    self._dropped_flows.update(flows)
                 return
             self._spans.append((name, th.ident, th.name, t0, dur,
                                 flows, attrs))
@@ -203,6 +217,8 @@ class Tracer:
         """Aggregate view for obs.snapshot(): per-name totals, thread
         count, flow count, drop counter."""
         recs = self.records()
+        with self._lock:
+            dropped_flows = set(self._dropped_flows)
         by_name: Dict[str, Dict[str, float]] = {}
         tids = set()
         flows = set()
@@ -223,6 +239,9 @@ class Tracer:
             e["max_ms"] = round(e["max_ms"], 3)
         return {"count": len(recs), "dropped": self.dropped,
                 "threads": len(tids), "flows": len(flows),
+                # flows whose arrows the exporter suppresses because
+                # the buffer dropped part of them mid-run
+                "orphaned_flows": len(flows & dropped_flows),
                 "by_name": by_name}
 
     # -- export ------------------------------------------------------------
@@ -232,6 +251,8 @@ class Tracer:
         thread_name metadata, and "s"/"t"/"f" flow events linking spans
         that share a flow id (the cross-thread arrows)."""
         recs = self.records()
+        with self._lock:
+            dropped_flows = set(self._dropped_flows)
         # track key is (ident, thread name): idents are reused once a
         # thread exits, and two engine threads must never share a track
         tid_map: Dict[tuple, int] = {}
@@ -251,7 +272,15 @@ class Tracer:
         for vt, nm in tname.items():
             events.append({"ph": "M", "name": "thread_name", "pid": 0,
                            "tid": vt, "args": {"name": nm}})
+        orphaned = 0
         for fid, spans in flow_spans.items():
+            if fid in dropped_flows:
+                # the buffer dropped part of this flow: whichever span
+                # survived would emit a dangling arrow (e.g. an "f"
+                # finish with no "s" start) — drop the flow's events
+                # entirely and count it
+                orphaned += 1
+                continue
             if len(spans) < 2:
                 continue  # a link needs two ends
             spans.sort()
@@ -267,7 +296,8 @@ class Tracer:
                            "pid": 0, "tid": vt})
                 events.append(ev)
         other = {"producer": "paddle_tpu.obs",
-                 "dropped_events": self.dropped}
+                 "dropped_events": self.dropped,
+                 "orphaned_flows": orphaned}
         if other_data:
             other.update(other_data)
         return {"traceEvents": events, "displayTimeUnit": "ms",

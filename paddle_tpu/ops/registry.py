@@ -223,6 +223,31 @@ def _layout_adapted(fn, op: Operator):
 
 
 # ---------------------------------------------------------------------------
+# Provenance threading (obs/opprof.py, docs/observability.md)
+# ---------------------------------------------------------------------------
+#
+# Every op lowers inside jax.named_scope(op_provenance(op)), so each
+# HLO instruction XLA emits for it carries the source op in its
+# metadata op_name — the seam obs.op_profile folds per-instruction
+# FLOPs/bytes back through.  Transform passes stamp `op_provenance`
+# attrs on rewritten clones (with the SOURCE program's identity plus a
+# [pass=...] tag); un-transformed ops compute it from their own ids.
+
+def op_provenance(op: Operator) -> str:
+    """Greppable provenance string for `op`
+    (`program#<id>/block<idx>/op<id>:<type>`, the verifier identity in
+    scope-path form).  A transform-stamped `op_provenance` attr wins —
+    it names the SOURCE op a rewritten clone descends from."""
+    prov = op.attrs.get("op_provenance")
+    if prov:
+        return prov
+    blk = op.block
+    prog_id = getattr(getattr(blk, "program", None), "prog_id", 0)
+    blk_idx = getattr(blk, "idx", 0)
+    return f"program#{prog_id}/block{blk_idx}/op{op.id}:{op.type}"
+
+
+# ---------------------------------------------------------------------------
 # Block tracing
 # ---------------------------------------------------------------------------
 
@@ -268,6 +293,14 @@ def _bind_outs(op: Operator, outs: InsOuts, env) -> None:
 
 
 def lower_op(ctx: LowerCtx, op: Operator, env: Dict[str, Any]) -> None:
+    # provenance scope: every jax op this rule emits carries the source
+    # Program op in its HLO metadata (obs.op_profile's attribution seam)
+    with jax.named_scope(op_provenance(op)):
+        _lower_op_inner(ctx, op, env)
+
+
+def _lower_op_inner(ctx: LowerCtx, op: Operator,
+                    env: Dict[str, Any]) -> None:
     if op.attr("fwd_op_id", None) is not None:
         _lower_grad_op(ctx, op, env)
         return

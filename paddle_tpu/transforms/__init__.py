@@ -111,6 +111,58 @@ def _find_var(block, name: str):
         return None
 
 
+# ---------------------------------------------------------------------------
+# Provenance stamping (obs/opprof.py, docs/observability.md)
+# ---------------------------------------------------------------------------
+#
+# apply_transforms clones the program, and the clone gets a FRESH
+# prog_id — so before any pass runs, every cloned op is stamped with
+# its SOURCE program's provenance (`op_provenance` attr, consumed by
+# ops/registry.op_provenance at lowering).  Passes that rewrite an op
+# call tag_provenance(op, pass_name) to append a `[pass=<name>]` tag,
+# and passes that INSERT ops call inherit_provenance(new_op, src_op,
+# pass_name) so the synthesized op attributes to the source op it
+# replaces — obs.op_profile then reports rewritten/folded cost against
+# identities the user can grep in their build script.
+
+def stamp_provenance(program, src_prog_id: int) -> None:
+    """Stamp every op of `program` (a fresh clone) with provenance
+    naming `src_prog_id`; ops already carrying one keep it (a clone of
+    a transformed program keeps pointing at the ORIGINAL source)."""
+    for blk in program.blocks:
+        for op in blk.ops:
+            if not op.attrs.get("op_provenance"):
+                op.attrs["op_provenance"] = (
+                    f"program#{src_prog_id}/block{blk.idx}"
+                    f"/op{op.id}:{op.type}")
+
+
+def tag_provenance(op, pass_name: str) -> None:
+    """Append `[pass=<name>]` to the op's provenance (merging into an
+    existing tag list), marking it rewritten by `pass_name`."""
+    from ..ops.registry import op_provenance
+
+    prov = op_provenance(op)
+    if prov.endswith("]") and "[pass=" in prov:
+        base, tags = prov[:-1].rsplit("[pass=", 1)
+        names = tags.split(",")
+        if pass_name not in names:
+            names.append(pass_name)
+        prov = f"{base}[pass={','.join(names)}]"
+    else:
+        prov = f"{prov}[pass={pass_name}]"
+    op.attrs["op_provenance"] = prov
+
+
+def inherit_provenance(new_op, src_op, pass_name: str) -> None:
+    """A pass-synthesized op attributes to the source op it replaces,
+    tagged with the pass that minted it."""
+    from ..ops.registry import op_provenance
+
+    new_op.attrs["op_provenance"] = op_provenance(src_op)
+    tag_provenance(new_op, pass_name)
+
+
 # import the pass modules AFTER the registry exists (registration side
 # effect, verifier idiom).  Import order IS execution order: fold_bn
 # must see the NCHW graph (it rewrites conv+bn pairs), layout_optimize
@@ -190,6 +242,9 @@ def apply_transforms(program, feed_names=None, fetch_names=None,
     wanted = list(passes) if passes is not None else [
         n for n, on in enabled_passes().items() if on]
     clone = program.clone()
+    # provenance must name the SOURCE program (the clone's prog_id is
+    # fresh), and must be stamped BEFORE passes rewrite anything
+    stamp_provenance(clone, program.prog_id)
     ctx = TransformContext(clone, feed_names=feed_names,
                            fetch_names=fetch_names, scope=scope)
     stats: Dict[str, int] = {}

@@ -27,7 +27,8 @@ backward pass would change which residuals exist.
 
 from __future__ import annotations
 
-from . import TransformContext, _find_var, register_transform
+from . import (TransformContext, _find_var, inherit_provenance,
+               register_transform, tag_provenance)
 
 _FOLDABLE_CONVS = ("conv2d", "depthwise_conv2d")
 
@@ -137,14 +138,21 @@ def _fold_one(ctx: TransformContext) -> bool:
              {"axis": -1, **role}),
         ]
         for off, (typ, i_, o_, a_) in enumerate(ins):
-            block.insert_op(pos + off, typ, inputs=i_, outputs=o_,
-                            attrs=a_, infer_shape=False)
+            folded_op = block.insert_op(pos + off, typ, inputs=i_,
+                                        outputs=o_, attrs=a_,
+                                        infer_shape=False)
+            # the fold ops ARE the batch_norm, re-expressed: attribute
+            # their cost to the source bn op (obs.op_profile)
+            inherit_provenance(folded_op, bn, "fold_bn")
         conv.inputs["Filter"] = [wf]
+        tag_provenance(conv, "fold_bn")
         bn_pos = block.ops.index(bn)
-        block.insert_op(bn_pos, "elementwise_add",
-                        inputs={"X": [xname], "Y": [bf]},
-                        outputs={"Out": [yname]},
-                        attrs={"axis": 1, **role}, infer_shape=False)
+        add_op = block.insert_op(bn_pos, "elementwise_add",
+                                 inputs={"X": [xname], "Y": [bf]},
+                                 outputs={"Out": [yname]},
+                                 attrs={"axis": 1, **role},
+                                 infer_shape=False)
+        inherit_provenance(add_op, bn, "fold_bn")
         block.ops.remove(bn)
         return True
     return False

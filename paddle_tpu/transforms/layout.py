@@ -36,7 +36,8 @@ from __future__ import annotations
 
 from typing import Dict, List, Set
 
-from . import TransformContext, _find_var, _grad_section, register_transform
+from . import (TransformContext, _find_var, _grad_section,
+               register_transform, tag_provenance)
 
 # anchor op type -> (data input slot, data output slot, format attr name)
 ANCHORS = {
@@ -226,6 +227,7 @@ def run(ctx: TransformContext) -> int:
                 _permute_declared_shape(block, outv)
             else:
                 op.attrs["nhwc_out"] = [out_slot]
+            tag_provenance(op, "layout_optimize")
             rewrites += 1
         elif follower_ok.get(op.id, False) and any(
                 n in nhwc for n in op.input_arg_names()):
@@ -243,6 +245,7 @@ def run(ctx: TransformContext) -> int:
                     for n in op.output(slot):
                         nhwc.add(n)
                         _permute_declared_shape(block, n)
+                tag_provenance(op, "layout_optimize")
                 rewrites += 1
             else:
                 # defensive: an NHWC value reached a follower whose
@@ -250,6 +253,7 @@ def run(ctx: TransformContext) -> int:
                 op.attrs["nchw_in"] = sorted(
                     slot for slot, names in op.inputs.items()
                     if any(n in nhwc for n in names))
+                tag_provenance(op, "layout_optimize")
         else:
             # defensive: any other op reading an NHWC value gets the
             # value transposed back inside its own lowering
@@ -257,6 +261,7 @@ def run(ctx: TransformContext) -> int:
                            if any(n in nhwc for n in names))
             if slots:
                 op.attrs["nchw_in"] = slots
+                tag_provenance(op, "layout_optimize")
 
     if rewrites:
         prog._bump_version()

@@ -441,9 +441,12 @@ class TestTracetoolRoundTrip:
 
     def test_snapshot_shape(self, clean_tracer):
         snap = obs.snapshot()
-        assert set(snap) == {"spans", "counters", "timers_ms", "cost"}
+        assert set(snap) == {"spans", "counters", "timers_ms", "cost",
+                             "host", "op_profile"}
         assert {"device_class", "peak_flops", "mfu_pct",
                 "programs", "collective_bytes"} <= set(snap["cost"])
+        assert snap["host"] == 0  # tagged with jax.process_index()
+        assert "orphaned_flows" in snap["spans"]
 
 
 # ---------------------------------------------------------------------------

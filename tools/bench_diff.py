@@ -1,0 +1,286 @@
+#!/usr/bin/env python
+"""bench_diff: the perf-regression gate over BENCH JSON (ISSUE 7).
+
+PR 6 made the bench output machine-readable (cost_analysis-derived MFU,
+`device_class` labels, embedded obs snapshot); this tool is the first
+ENFORCEMENT layer over that trajectory: diff the current BENCH JSON
+against a committed baseline (artifacts/bench_baseline.json) with
+per-metric thresholds and fail CI on a regression.
+
+Metrics compared (each only when present in BOTH files):
+
+  mfu              headline value of a *_mfu metric    (drop  > 5% rel)
+  step_ms          detail.step_ms                      (rise  > 10% rel)
+  resnet50_mfu     detail.resnet50.detail.mfu_pct      (drop  > 5% rel)
+  resnet50_step_ms detail.resnet50.detail.step_ms      (rise  > 10% rel)
+  serving_p99_ms   headline of serving_p99_latency_ms  (rise  > 15% rel)
+  collective_bytes sum of detail.obs.cost.collective_bytes (rise > 10%)
+  interior_transposes  detail...layout.interior_transposes (ANY rise)
+  op_attribution_pct   detail...op_profile.attributed_flops_pct
+                                                       (drop > 5 abs)
+
+Exit status: 1 when any regression fires AND the current run is
+on-chip; under `device_class: cpu-fallback` (or a stale re-emitted
+on-chip record — detail.stale_s / detail.cpu_fallback_now) the gate is
+WARN-ONLY (exit 0): CPU-fallback numbers are environment noise, not
+perf signal.  --strict fails regardless; --warn-only never fails.
+
+stdlib-only (the tracetool/tpulint idiom) so CI can run it before any
+jax import.  `--selftest` proves the gate trips on a synthetic 10% MFU
+regression and passes an identical pair.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+# metric -> (direction, relative threshold, absolute floor)
+# direction "up" = bigger is better (regression when it DROPS),
+# "down" = smaller is better (regression when it RISES).
+# The absolute floor suppresses noise-level absolute deltas.
+DEFAULT_THRESHOLDS = {
+    "mfu": ("up", 0.05, 0.05),
+    "step_ms": ("down", 0.10, 0.05),
+    "resnet50_mfu": ("up", 0.05, 0.05),
+    "resnet50_step_ms": ("down", 0.10, 0.05),
+    "serving_p99_ms": ("down", 0.15, 0.5),
+    "collective_bytes": ("down", 0.10, 1024.0),
+    "interior_transposes": ("down", 0.0, 0.0),
+    "op_attribution_pct": ("up", 0.0, 5.0),
+}
+
+
+def _get(d: dict, *path, default=None):
+    cur = d
+    for p in path:
+        if not isinstance(cur, dict):
+            return default
+        cur = cur.get(p)
+    return cur if cur is not None else default
+
+
+def extract_metrics(doc: dict) -> Dict[str, float]:
+    """Flatten one BENCH JSON into the comparable metric table."""
+    out: Dict[str, float] = {}
+    metric = str(doc.get("metric", ""))
+    value = doc.get("value")
+    detail = doc.get("detail") or {}
+    if isinstance(value, (int, float)):
+        if "_mfu" in metric:
+            out["mfu"] = float(value)
+        elif metric == "serving_p99_latency_ms":
+            out["serving_p99_ms"] = float(value)
+    if isinstance(_get(detail, "step_ms"), (int, float)):
+        out["step_ms"] = float(detail["step_ms"])
+    rd = _get(detail, "resnet50", "detail", default={})
+    if isinstance(_get(rd, "mfu_pct"), (int, float)):
+        out["resnet50_mfu"] = float(rd["mfu_pct"])
+    if isinstance(_get(rd, "step_ms"), (int, float)):
+        out["resnet50_step_ms"] = float(rd["step_ms"])
+    coll = _get(detail, "obs", "cost", "collective_bytes") \
+        or _get(rd, "obs", "cost", "collective_bytes")
+    if isinstance(coll, dict) and coll:
+        out["collective_bytes"] = float(sum(coll.values()))
+    for layout in (_get(rd, "layout"), _get(detail, "layout")):
+        it = _get(layout or {}, "interior_transposes")
+        if isinstance(it, (int, float)):
+            out["interior_transposes"] = float(it)
+            break
+    for opp in (_get(rd, "op_profile"), _get(detail, "op_profile")):
+        ap = _get(opp or {}, "attributed_flops_pct")
+        if isinstance(ap, (int, float)):
+            out["op_attribution_pct"] = float(ap)
+            break
+    return out
+
+
+def is_fallback(doc: dict) -> bool:
+    """Whether the current run's numbers came from a cpu-fallback (or a
+    re-emitted stale on-chip record) — warn-only regimes."""
+    detail = doc.get("detail") or {}
+    if str(_get(detail, "device_class", default="")) == "cpu-fallback":
+        return True
+    if "stale_s" in detail or "cpu_fallback_now" in detail:
+        return True
+    return str(doc.get("metric", "")).endswith("_cpu")
+
+
+def diff(baseline: dict, current: dict,
+         thresholds: Optional[dict] = None) -> List[dict]:
+    """Rows for every shared metric; each carries a `regressed` bool."""
+    thresholds = thresholds or DEFAULT_THRESHOLDS
+    base_m = extract_metrics(baseline)
+    cur_m = extract_metrics(current)
+    rows: List[dict] = []
+    for name, (direction, rel, floor) in thresholds.items():
+        if name not in base_m or name not in cur_m:
+            continue
+        b, c = base_m[name], cur_m[name]
+        delta = c - b
+        bad = delta < 0 if direction == "up" else delta > 0
+        magnitude = abs(delta)
+        rel_delta = magnitude / abs(b) if b else (1.0 if magnitude
+                                                 else 0.0)
+        regressed = bool(bad and magnitude > floor
+                         and rel_delta > rel)
+        rows.append({"metric": name, "baseline": b, "current": c,
+                     "delta": round(delta, 4),
+                     "rel_pct": round(rel_delta * 100.0, 2),
+                     "direction": direction, "regressed": regressed})
+    return rows
+
+
+def _load(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    # driver-wrapper files (BENCH_r*.json) hold the bench line under
+    # "parsed"; accept both shapes
+    if "metric" not in doc and isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]
+    if "metric" not in doc:
+        raise ValueError(f"{path}: not a BENCH JSON (no 'metric')")
+    return doc
+
+
+def run_gate(baseline_path: str, current_path: str, strict: bool,
+             warn_only: bool, as_json: bool) -> int:
+    baseline = _load(baseline_path)
+    current = _load(current_path)
+    rows = diff(baseline, current)
+    fallback = is_fallback(current)
+    regressions = [r for r in rows if r["regressed"]]
+    enforce = (strict or not fallback) and not warn_only
+
+    if as_json:
+        print(json.dumps({"rows": rows, "fallback": fallback,
+                          "enforced": enforce,
+                          "regressions": len(regressions)}))
+    else:
+        print(f"{'metric':<22}{'baseline':>14}{'current':>14}"
+              f"{'delta':>12}{'rel%':>8}  verdict")
+        for r in rows:
+            verdict = "REGRESSED" if r["regressed"] else "ok"
+            print(f"{r['metric']:<22}{r['baseline']:>14.3f}"
+                  f"{r['current']:>14.3f}{r['delta']:>12.3f}"
+                  f"{r['rel_pct']:>8.2f}  {verdict}")
+        if not rows:
+            print("bench_diff: no comparable metrics "
+                  "(different benchmark variants?)")
+        mode = "ENFORCING" if enforce else \
+            "warn-only (cpu-fallback run)" if fallback else "warn-only"
+        print(f"bench_diff: {len(regressions)} regression(s), "
+              f"mode: {mode}")
+    return 1 if regressions and enforce else 0
+
+
+# ---------------------------------------------------------------------------
+# selftest
+# ---------------------------------------------------------------------------
+
+def _synthetic(mfu: float, step_ms: float, transposes: int = 0,
+               coll_bytes: int = 4096, device_class: str = "tpu") -> dict:
+    return {
+        "metric": "bert_base_pretrain_mfu",
+        "value": mfu, "unit": "%", "vs_baseline": mfu / 45.0,
+        "detail": {
+            "device_class": device_class,
+            "step_ms": step_ms,
+            "obs": {"cost": {"collective_bytes":
+                             {"c_allreduce_sum": coll_bytes}}},
+            "resnet50": {"metric": "resnet50_images_per_sec_per_chip",
+                         "value": 1000.0,
+                         "detail": {"mfu_pct": 30.0, "step_ms": 50.0,
+                                    "layout": {"interior_transposes":
+                                               transposes}}},
+        },
+    }
+
+
+def selftest(verbose: bool = True) -> int:
+    base = _synthetic(mfu=42.0, step_ms=100.0)
+    checks = []
+
+    # 1. identical pair passes
+    rows = diff(base, base)
+    checks.append(("identical pair passes",
+                   rows and not any(r["regressed"] for r in rows)))
+    # 2. a 10% MFU drop trips the gate on-chip
+    cur = _synthetic(mfu=42.0 * 0.9, step_ms=100.0)
+    rows = diff(base, cur)
+    checks.append(("10% MFU regression fires",
+                   any(r["metric"] == "mfu" and r["regressed"]
+                       for r in rows)))
+    checks.append(("on-chip run enforces", not is_fallback(cur)))
+    # 3. the same drop under cpu-fallback is warn-only
+    cur_cpu = _synthetic(mfu=42.0 * 0.9, step_ms=100.0,
+                         device_class="cpu-fallback")
+    checks.append(("cpu-fallback is warn-only", is_fallback(cur_cpu)))
+    # 4. a within-threshold wiggle does not fire
+    cur_ok = _synthetic(mfu=42.0 * 0.98, step_ms=103.0)
+    rows = diff(base, cur_ok)
+    checks.append(("2% wiggle passes",
+                   not any(r["regressed"] for r in rows)))
+    # 5. step_ms rise fires
+    cur_slow = _synthetic(mfu=42.0, step_ms=125.0)
+    rows = diff(base, cur_slow)
+    checks.append(("25% step_ms rise fires",
+                   any(r["metric"] == "step_ms" and r["regressed"]
+                       for r in rows)))
+    # 6. any new interior transpose fires (the NHWC win is guarded)
+    cur_tr = _synthetic(mfu=42.0, step_ms=100.0, transposes=2)
+    rows = diff(base, cur_tr)
+    checks.append(("new interior transpose fires",
+                   any(r["metric"] == "interior_transposes"
+                       and r["regressed"] for r in rows)))
+    # 7. collective bytes growth fires (the EQuARX guard direction)
+    cur_coll = _synthetic(mfu=42.0, step_ms=100.0, coll_bytes=16384)
+    rows = diff(base, cur_coll)
+    checks.append(("4x collective bytes fires",
+                   any(r["metric"] == "collective_bytes"
+                       and r["regressed"] for r in rows)))
+    # 8. stale re-emitted on-chip record is warn-only
+    stale = dict(base)
+    stale["detail"] = dict(base["detail"], stale_s=1234)
+    checks.append(("stale on-chip record is warn-only",
+                   is_fallback(stale)))
+
+    failed = [name for name, ok in checks if not ok]
+    if verbose:
+        for name, ok in checks:
+            print(f"  [{'ok' if ok else 'FAIL'}] {name}")
+    if failed:
+        print(f"bench_diff selftest: {len(failed)} check(s) failed: "
+              f"{failed}", file=sys.stderr)
+        return 1
+    print(f"bench_diff selftest: ok ({len(checks)} checks)")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench_diff", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--baseline",
+                    default="artifacts/bench_baseline.json")
+    ap.add_argument("--current")
+    ap.add_argument("--strict", action="store_true",
+                    help="fail on regression even off-chip")
+    ap.add_argument("--warn-only", action="store_true",
+                    help="never fail, only report")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--selftest", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        return selftest()
+    if not args.current:
+        ap.error("--current is required (or use --selftest)")
+    return run_gate(args.baseline, args.current, args.strict,
+                    args.warn_only, args.json)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

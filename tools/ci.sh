@@ -14,8 +14,11 @@ python -m pytest tests/ -x -q
 echo "== static analysis: tpulint rules + op-test coverage floor =="
 python tools/run_lints.py
 
-echo "== observability: tracetool selftest (span layer end to end) =="
+echo "== observability: tracetool selftest (spans + op-profile walk) =="
 python tools/tracetool.py selftest
+
+echo "== perf gate: bench_diff selftest (regression detection) =="
+python tools/bench_diff.py --selftest
 
 # timeout: a wedged TPU tunnel blocks jax.devices() forever — treat a
 # hung probe as "no accelerator" and keep CI moving (rc 124 -> else)
@@ -33,5 +36,11 @@ echo "== benchmark =="
 python bench.py | tee /tmp/bench_out.json
 python tools/check_op_benchmark_result.py --current /tmp/bench_out.json \
   ${1:+--baseline "$1"}
+
+echo "== perf gate: bench_diff vs committed baseline =="
+# exits nonzero on an on-chip regression; warn-only when the run fell
+# back to CPU (device_class / stale-record detection in bench_diff.py)
+python tools/bench_diff.py --current /tmp/bench_out.json \
+  --baseline "${1:-artifacts/bench_baseline.json}"
 
 echo "CI PASS"

@@ -14,13 +14,20 @@ viewer:
   diff       per-span-name total/count deltas between two traces
              (before/after a perf change — the measurement half of
              "measure the layout win, then fuse")
+  top-ops    per-op cost attribution (ISSUE 7): top Program ops by
+             FLOPs / bytes / transposes from an op_profile table —
+             found in a trace's embedded snapshot, a BENCH JSON, a
+             saved profile JSON, or computed fresh from a raw
+             optimized-HLO dump (obs/opprof.py walks it)
   selftest   build a synthetic multi-thread trace through the span
-             layer, export it, summarize it, and verify the
-             invariants end to end (wired into tools/ci.sh)
+             layer, export it, summarize it, verify the invariants
+             end to end, and run the op-profile HLO walk + top-ops
+             rendering over a synthetic HLO dump (wired into
+             tools/ci.sh)
 
-stdlib-only; paddle_tpu.obs.tracing is loaded by FILE PATH (the
-tpulint idiom), so this tool runs in environments without jax.
-Exit status: 0 ok, 1 findings/failure, 2 usage error.
+stdlib-only; paddle_tpu.obs.tracing and obs.opprof are loaded by FILE
+PATH (the tpulint idiom), so this tool runs in environments without
+jax.  Exit status: 0 ok, 1 findings/failure, 2 usage error.
 """
 
 from __future__ import annotations
@@ -36,20 +43,28 @@ from typing import Dict, List, Optional
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _TRACING = os.path.join(REPO_ROOT, "paddle_tpu", "obs", "tracing.py")
+_OPPROF = os.path.join(REPO_ROOT, "paddle_tpu", "obs", "opprof.py")
 
 
-def load_tracing():
-    """paddle_tpu/obs/tracing.py by file path — no paddle_tpu (and so
-    no jax) import."""
-    name = "paddle_tpu_obs_tracing"
+def _load_by_path(name: str, path: str):
+    """Load a stdlib-only paddle_tpu module by file path — no
+    paddle_tpu (and so no jax) import."""
     mod = sys.modules.get(name)
     if mod is not None:
         return mod
-    spec = importlib.util.spec_from_file_location(name, _TRACING)
+    spec = importlib.util.spec_from_file_location(name, path)
     mod = importlib.util.module_from_spec(spec)
     sys.modules[name] = mod
     spec.loader.exec_module(mod)
     return mod
+
+
+def load_tracing():
+    return _load_by_path("paddle_tpu_obs_tracing", _TRACING)
+
+
+def load_opprof():
+    return _load_by_path("paddle_tpu_obs_opprof", _OPPROF)
 
 
 def load_trace(path: str) -> dict:
@@ -200,8 +215,148 @@ def print_diff(rows: List[dict]) -> None:
 
 
 # ---------------------------------------------------------------------------
+# top-ops
+# ---------------------------------------------------------------------------
+
+def find_profiles(path: str) -> Dict[str, dict]:
+    """op_profile tables from any artifact that carries them:
+
+    * a raw optimized-HLO dump (non-JSON) -> walk it fresh via opprof
+    * a saved profile JSON (has "rows")
+    * a BENCH JSON (detail.op_profile / detail.resnet50... — bench
+      embeds a trimmed summary, full tables live in obs.snapshot())
+    * a trace / snapshot JSON (otherData.snapshot.op_profile or a bare
+      snapshot with "op_profile")
+    """
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        # not JSON: treat as an optimized-HLO text dump
+        opprof = load_opprof()
+        return {os.path.basename(path):
+                opprof.profile_hlo_text(text, label=path)}
+    if isinstance(doc, dict) and isinstance(doc.get("rows"), list):
+        return {doc.get("label") or os.path.basename(path): doc}
+    profs: Dict[str, dict] = {}
+
+    def walk(node):
+        if not isinstance(node, dict):
+            return
+        op = node.get("op_profile")
+        if isinstance(op, dict):
+            if isinstance(op.get("rows"), list):
+                profs[op.get("label") or "op_profile"] = op
+            else:
+                for label, prof in op.items():
+                    if isinstance(prof, dict) \
+                            and isinstance(prof.get("rows"), list):
+                        profs[label] = prof
+        for v in node.values():
+            if isinstance(v, dict):
+                walk(v)
+
+    walk(doc)
+    return profs
+
+
+def print_top_ops(label: str, prof: dict, top: int, key: str) -> None:
+    opprof = load_opprof()
+    rows = opprof.top_ops(prof, top, key)
+    attributed = prof.get("attributed_flops_pct")
+    print(f"== {label}  (total_flops={prof.get('total_flops', 0):.4g}, "
+          f"attributed {attributed if attributed is None else round(attributed, 2)}%"
+          f", {prof.get('instruction_count', '?')} instructions)")
+    print(f"{'op':<56}{'flops':>12}{'pct':>7}{'bytes':>12}"
+          f"{'fus':>5}{'transp':>7}{'coll_B':>10}")
+    for r in rows:
+        print(f"{r['op']:<56}{r.get('flops', 0):>12.4g}"
+              f"{r.get('flops_pct', 0):>7.2f}{r.get('bytes', 0):>12.4g}"
+              f"{r.get('fusions', 0):>5}{r.get('transposes', 0):>7}"
+              f"{r.get('collective_bytes', 0):>10.4g}")
+    unattr = [r for r in prof.get("rows", [])
+              if r.get("op") == opprof.UNATTRIBUTED]
+    if unattr:
+        r = unattr[0]
+        print(f"{'(unattributed)':<56}{r.get('flops', 0):>12.4g}"
+              f"{r.get('flops_pct', 0):>7.2f}")
+
+
+def top_ops_cmd(path: str, top: int, key: str, as_json: bool) -> int:
+    profs = find_profiles(path)
+    if not profs:
+        print(f"tracetool top-ops: no op_profile table found in {path} "
+              "(need a trace/BENCH JSON with an embedded snapshot, a "
+              "profile JSON, or a raw HLO dump)", file=sys.stderr)
+        return 1
+    if as_json:
+        print(json.dumps({label: {**prof,
+                                  "rows": prof.get("rows", [])[:top]}
+                          for label, prof in profs.items()}))
+        return 0
+    for label, prof in profs.items():
+        print_top_ops(label, prof, top, key)
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # selftest
 # ---------------------------------------------------------------------------
+
+_SELFTEST_HLO = """\
+HloModule selftest, entry_computation_layout={(f32[64,128]{1,0})->f32[64,64]{1,0}}
+
+%fused_computation (param_0: f32[64,64]) -> f32[64,64] {
+  %param_0 = f32[64,64]{1,0} parameter(0)
+  %constant.1 = f32[] constant(0)
+  %broadcast.1 = f32[64,64]{1,0} broadcast(f32[] %constant.1), dimensions={}, metadata={op_name="jit(f)/program#7/block0/op2:relu[pass=layout_optimize]/max"}
+  ROOT %maximum.1 = f32[64,64]{1,0} maximum(f32[64,64]{1,0} %param_0, f32[64,64]{1,0} %broadcast.1), metadata={op_name="jit(f)/program#7/block0/op2:relu[pass=layout_optimize]/max"}
+}
+
+ENTRY %main (Arg_0.1: f32[64,128]) -> f32[64,64] {
+  %Arg_0.1 = f32[64,128]{1,0} parameter(0)
+  %constant.9 = f32[128,64]{1,0} constant({...})
+  %transpose.2 = f32[128,64]{0,1} transpose(f32[128,64]{1,0} %constant.9), dimensions={1,0}
+  %dot.4 = f32[64,64]{1,0} dot(f32[64,128]{1,0} %Arg_0.1, f32[128,64]{0,1} %transpose.2), lhs_contracting_dims={1}, rhs_contracting_dims={0}, metadata={op_name="jit(f)/program#7/block0/op1:mul/dot_general"}
+  %all-reduce = f32[64,64]{1,0} all-reduce(f32[64,64]{1,0} %dot.4), replica_groups={}, to_apply=%region_0, metadata={op_name="jit(f)/program#7/block0/op3:c_allreduce_sum/psum"}
+  ROOT %relu_fusion = f32[64,64]{1,0} fusion(f32[64,64]{1,0} %all-reduce), kind=kLoop, calls=%fused_computation, metadata={op_name="jit(f)/program#7/block0/op2:relu[pass=layout_optimize]/max"}
+}
+"""
+
+
+def _opprof_selftest_checks() -> List[tuple]:
+    """The op-profile half of the selftest: walk a synthetic HLO dump
+    through opprof (loaded by file path) and assert the attribution
+    invariants top-ops relies on."""
+    opprof = load_opprof()
+    prof = opprof.profile_hlo_text(_SELFTEST_HLO, label="selftest",
+                                   cost={"flops": 2.0 * 64 * 64 * 128,
+                                         "bytes_accessed": 0.0})
+    by_op = {r["op"]: r for r in prof["rows"]}
+    dot = by_op.get("program#7/block0/op1:mul", {})
+    relu = by_op.get(
+        "program#7/block0/op2:relu[pass=layout_optimize]", {})
+    coll = by_op.get("program#7/block0/op3:c_allreduce_sum", {})
+    top = opprof.top_ops(prof, 3, "flops")
+    return [
+        ("op-profile: dot attributed with K-scaled flops",
+         dot.get("flops_raw") == 2.0 * 64 * 64 * 128),
+        ("op-profile: pass tag survives into the table",
+         relu.get("source", {}).get("passes") == ["layout_optimize"]),
+        ("op-profile: fusion membership counted",
+         relu.get("fusions", 0) >= 1),
+        ("op-profile: metadata-less transpose inherits its consumer",
+         dot.get("transposes", 0) >= 1),
+        ("op-profile: collective bytes attributed",
+         coll.get("collective_bytes", 0) == 64 * 64 * 4),
+        ("op-profile: >=95% of flops attributed",
+         prof["attributed_flops_pct"] >= 95.0),
+        ("op-profile: normalized total matches cost_analysis",
+         abs(prof["total_flops"] - 2.0 * 64 * 64 * 128) < 1e-6),
+        ("top-ops: dot ranks first by flops",
+         bool(top) and top[0]["op"] == "program#7/block0/op1:mul"),
+    ]
 
 def selftest(verbose: bool = True) -> int:
     """Build a 3-thread trace with flow links through the span layer,
@@ -272,6 +427,7 @@ def selftest(verbose: bool = True) -> int:
             ("stall attribution computed",
              s["stall_attribution"] == "compute-bound"),
         ]
+        checks += _opprof_selftest_checks()
         failed = [name for name, ok in checks if not ok]
         if verbose:
             for name, ok in checks:
@@ -304,7 +460,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_diff.add_argument("trace_a")
     p_diff.add_argument("trace_b")
     p_diff.add_argument("--json", action="store_true")
-    sub.add_parser("selftest", help="exercise the span layer end to end")
+    p_top = sub.add_parser(
+        "top-ops", help="per-op cost attribution from a trace/BENCH/"
+        "profile JSON or raw HLO dump")
+    p_top.add_argument("artifact")
+    p_top.add_argument("--top", type=int, default=10)
+    p_top.add_argument("--key", default="flops",
+                       choices=["flops", "bytes", "transposes",
+                                "collective_bytes"])
+    p_top.add_argument("--json", action="store_true")
+    sub.add_parser("selftest", help="exercise the span layer + the "
+                                    "op-profile HLO walk end to end")
     args = ap.parse_args(argv)
 
     if args.cmd == "summarize":
@@ -322,6 +488,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         else:
             print_diff(rows)
         return 0
+    if args.cmd == "top-ops":
+        return top_ops_cmd(args.artifact, args.top, args.key,
+                           args.json)
     if args.cmd == "selftest":
         return selftest()
     ap.print_help()
