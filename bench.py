@@ -435,6 +435,72 @@ def _persist_onchip(result):
         print(f"bench: could not persist record: {e}", file=sys.stderr)
 
 
+def bench_checkpoint(jax, jnp):
+    """`detail.ckpt` (ISSUE 8 satellite): async-checkpoint overhead on
+    a live train loop.  Times N jitted steps with auto-checkpointing
+    OFF, then the same N with a save every 2 steps, and reports the
+    subsystem's own timers — save_ms (writer thread), stall_ms (the
+    only training-thread cost: snapshot + backpressure) and the
+    in-flight overlap high-water — so tools/bench_diff.py can gate
+    checkpoint overhead once an on-chip record exists."""
+    import tempfile
+
+    import numpy as np
+
+    from paddle_tpu import profiler
+    from paddle_tpu.ckpt import CheckpointManager
+
+    for name in ("ckpt_save_ms", "ckpt_stall_ms"):
+        profiler.time_reset(name)
+    for name in ("ckpt_inflight_max", "ckpt_saves_total"):
+        profiler.stat_reset(name)
+
+    rng = np.random.RandomState(0)
+    state = {f"w_{i}": jax.device_put(
+        rng.randn(256, 256).astype(np.float32)) for i in range(4)}
+
+    @jax.jit
+    def step(s):
+        return {k: v + 1e-3 * (v @ v.T) for k, v in s.items()}
+
+    state = step(state)  # compile outside the timed windows
+    jax.block_until_ready(state["w_0"])
+    n_steps, every = 16, 2
+
+    def loop(mgr):
+        s = state
+        t0 = time.perf_counter()
+        for i in range(1, n_steps + 1):
+            s = step(s)
+            if mgr is not None and i % every == 0:
+                mgr.save_async(s, step=i)
+        jax.block_until_ready(s["w_0"])
+        if mgr is not None:
+            mgr.wait()
+        return (time.perf_counter() - t0) * 1e3 / n_steps
+
+    step_ms_off = loop(None)
+    with tempfile.TemporaryDirectory() as root:
+        mgr = CheckpointManager(root, keep=2)
+        step_ms_on = loop(mgr)
+        mgr.close()
+    times = profiler.get_time_stats()
+    stats = profiler.get_int_stats()
+    overhead = (step_ms_on / step_ms_off - 1.0) * 100.0 \
+        if step_ms_off > 0 else 0.0
+    return {
+        "steps": n_steps,
+        "every_steps": every,
+        "save_ms": round(times.get("ckpt_save_ms", 0.0), 3),
+        "stall_ms": round(times.get("ckpt_stall_ms", 0.0), 3),
+        "inflight_max": stats.get("ckpt_inflight_max", 0),
+        "saves": stats.get("ckpt_saves_total", 0),
+        "step_ms_off": round(step_ms_off, 4),
+        "step_ms_on": round(step_ms_on, 4),
+        "overhead_pct": round(overhead, 2),
+    }
+
+
 def _run_with_watchdog(fn, timeout_s, what):
     """Run fn() in a daemon thread: if the tunnel wedges mid-call (the
     axon failure mode — blocks, not raises), the caller still gets
@@ -856,6 +922,9 @@ def main():
         out["detail"]["feed_pipeline"] = _run_with_watchdog(
             lambda: bench_feed_pipeline(jax, jnp), timeout_s=120,
             what="feed pipeline bench")
+        out["detail"]["ckpt"] = _run_with_watchdog(
+            lambda: bench_checkpoint(jax, jnp), timeout_s=120,
+            what="checkpoint bench")
         out["detail"]["obs"] = _obs_detail()
         print(json.dumps(out))
         return
@@ -992,6 +1061,11 @@ def main():
     detail["feed_pipeline"] = _run_with_watchdog(
         lambda: bench_feed_pipeline(jax, jnp), timeout_s=120,
         what="feed pipeline bench")
+    # checkpoint-overlap numbers (ISSUE 8): measured AFTER the timed
+    # region like the feed-pipeline fields, so they cannot perturb MFU
+    detail["ckpt"] = _run_with_watchdog(
+        lambda: bench_checkpoint(jax, jnp), timeout_s=120,
+        what="checkpoint bench")
     detail["obs"] = _obs_detail()
     result = {
         "metric": ("bert_base_pretrain_mfu" if on_tpu

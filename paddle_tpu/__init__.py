@@ -41,6 +41,7 @@ from . import inference
 from . import transforms
 from . import profiler
 from . import obs
+from . import ckpt
 from . import utils
 from . import reader
 from .batch import batch

@@ -72,6 +72,14 @@ WATCHLIST: List[Tuple[str, str]] = [
     # obs span/cost layer (ISSUE 6): these run INSIDE every watched loop
     # above — a sync creeping into the tracer or the live-MFU gauge
     # would hide in every profile it produces
+    # checkpoint writer entry points (ISSUE 8): save_async/_snapshot
+    # run ON the training thread at step boundaries — the only stall
+    # they may add is the device-side snapshot copy and bounded
+    # backpressure; the device->host transfer belongs to the writer
+    # thread (WriterPool._loop / CheckpointManager._write_job)
+    ("paddle_tpu/ckpt/manager.py", "CheckpointManager.save_async"),
+    ("paddle_tpu/ckpt/manager.py", "CheckpointManager._snapshot"),
+    ("paddle_tpu/ckpt/writer.py", "WriterPool.submit"),
     ("paddle_tpu/obs/tracing.py", "Tracer.span"),
     ("paddle_tpu/obs/tracing.py", "Tracer.add_span"),
     ("paddle_tpu/obs/tracing.py", "Tracer._record"),

@@ -1,0 +1,42 @@
+"""paddle_tpu.ckpt — fault-tolerant training checkpoints (ISSUE 8).
+
+Snapshot-consistent, async, per-host sharded checkpointing as a
+first-class dataflow concern (the TensorFlow paper's fault-tolerance
+design, arxiv 1605.08695) rather than a wrapper script:
+
+* `CheckpointManager` — device-side snapshot at a step boundary handed
+  to a background `WriterPool` (bounded in-flight, backpressure), so
+  serialization and disk I/O fully overlap the next steps' compute;
+  atomic multi-file commits (per-host shard + fsync'd manifest renamed
+  last); retention GC of old and half-written checkpoint dirs.
+* Deterministic mid-epoch resume — `Executor.train_from_dataset`
+  persists `(feed_epoch, step_in_epoch, executor_step, feed_seed)` in
+  the manifest and re-deals the feed order through
+  `dataset.feed_pipeline.shard_plan`/`epoch_order`, so a killed and
+  resumed run replays the exact remaining data order.
+* `serving.Engine.reload_weights(path)` — the model-hot-swap seam:
+  swap a live engine's parameters from a checkpoint without draining
+  in-flight requests.
+
+Knobs: `FLAGS_ckpt_*` in fluid/flags.py, seeded from `PADDLE_CKPT_*`
+env vars.  Walkthrough + manifest format: docs/fault_tolerance.md.
+The legacy `paddle_tpu.io.checkpoint` save/load API is a thin compat
+shim over this package.
+"""
+
+from __future__ import annotations
+
+from .manifest import (CKPT_PREFIX, CheckpointError,  # noqa: F401
+                       MANIFEST_FILE, MANIFEST_FORMAT, TMP_PREFIX,
+                       latest_checkpoint, list_checkpoints,
+                       shard_assignment)
+from .manager import (CheckpointManager, read_state,  # noqa: F401
+                      write_state)
+from .writer import WriterPool  # noqa: F401
+
+__all__ = [
+    "CheckpointManager", "CheckpointError", "WriterPool",
+    "latest_checkpoint", "list_checkpoints", "shard_assignment",
+    "read_state", "write_state", "MANIFEST_FILE", "MANIFEST_FORMAT",
+    "CKPT_PREFIX", "TMP_PREFIX",
+]

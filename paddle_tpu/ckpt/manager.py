@@ -1,0 +1,352 @@
+"""CheckpointManager: snapshot-consistent, async, per-host sharded
+checkpoints (docs/fault_tolerance.md).
+
+The save path is split across two threads so checkpointing overlaps
+training instead of stalling it:
+
+* **Training thread** (`save_async`, hot-path lint-watched): take a
+  donation-safe DEVICE-side snapshot of this host's shard of the state
+  — `jnp.copy` per array, async dispatch only, no transfer — and hand
+  it to the `WriterPool`.  The only stall the training loop can ever
+  see is this copy dispatch plus backpressure when `max_in_flight`
+  snapshots are already pending (`ckpt_stall_ms`).  The copy matters:
+  the Executor donates scope buffers to the next step, so a snapshot
+  by reference would read deleted buffers.
+
+* **Writer thread** (`_write_job`): materialize the snapshot to host
+  (`np.asarray` — the transfer overlaps the next steps' compute),
+  serialize to `shard_<host>.npz`, fsync, write the manifest LAST,
+  fsync, and publish the tmp dir with one atomic rename
+  (`ckpt.manifest` protocol).  Then garbage-collect checkpoints older
+  than `keep` and any half-written tmp dirs a killed run left behind.
+
+Restore (`restore`) refuses partial and topology-mismatched
+checkpoints with a clear error, loads every shard (training state is
+replicated across hosts today — the shard map is the ZeRO on-ramp, not
+yet a partition of live memory), and returns `(state, manifest)` so
+callers can re-seat the executor step / feed epoch for deterministic
+mid-epoch resume.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import time
+import warnings
+from typing import Any, Dict, Optional, Tuple
+
+from . import manifest as mf
+from .manifest import CheckpointError
+from .writer import WriterPool
+
+
+def _host_topology(process_index, process_count) -> Tuple[int, int]:
+    from ..dataset.feed_pipeline import host_topology
+
+    return host_topology(process_index, process_count)
+
+
+def _barrier(count: int, tag: str) -> None:
+    """Pod-wide rendezvous before host 0 commits: every shard must be
+    on (shared) disk before the manifest names it.  Single process (and
+    any environment without the multihost runtime): no-op."""
+    if count <= 1:
+        return
+    try:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)
+    except Exception:  # noqa: BLE001 - mocked topologies have no runtime
+        pass
+
+
+class CheckpointManager:
+    """Async per-host sharded checkpoint writer/reader for one
+    checkpoint root directory."""
+
+    def __init__(self, root: str, keep: Optional[int] = None,
+                 max_in_flight: Optional[int] = None,
+                 process_index: Optional[int] = None,
+                 process_count: Optional[int] = None):
+        from ..fluid.flags import flag
+
+        self.root = os.path.abspath(root)
+        self.keep = int(flag("ckpt_keep", 3) if keep is None else keep)
+        self._index, self._count = _host_topology(process_index,
+                                                  process_count)
+        mif = int(flag("ckpt_max_in_flight", 2)
+                  if max_in_flight is None else max_in_flight)
+        self._pool = WriterPool(max_in_flight=mif)
+        os.makedirs(self.root, exist_ok=True)
+
+    # -- save (training thread; hot-path lint-watched) ---------------------
+    def save_async(self, state: Dict[str, Any], step: int,
+                   meta: Optional[Dict[str, Any]] = None) -> None:
+        """Snapshot `state` at this step boundary and return; the write
+        happens on the writer thread.  Blocks only for the device-side
+        copy dispatch and (if `max_in_flight` snapshots are pending)
+        backpressure — both accounted as `ckpt_stall_ms`."""
+        from .. import obs, profiler
+
+        flow = obs.new_flow() if obs.TRACER.enabled else 0
+        # ckpt_stall_ms = the ONLY training-thread cost: the snapshot
+        # copy dispatch here, plus submit()'s own backpressure wait
+        # (WriterPool accounts that side itself)
+        with obs.span("ckpt.snapshot", flow=flow), \
+                profiler.timed("ckpt_stall_ms"):
+            snap, var_meta = self._snapshot(state)
+        job_meta = dict(meta or {})
+        step = int(step)
+        self._pool.submit(
+            lambda: self._write_job(snap, var_meta, step, job_meta),
+            flow=flow)
+        profiler.stat_add("ckpt_snapshots_total")
+
+    def save(self, state: Dict[str, Any], step: int,
+             meta: Optional[Dict[str, Any]] = None) -> str:
+        """Synchronous save: snapshot, write, commit; returns the
+        committed checkpoint path."""
+        self.save_async(state, step, meta)
+        self.wait()
+        return os.path.join(self.root, mf.checkpoint_dir_name(step))
+
+    def _snapshot(self, state: Dict[str, Any]):
+        """Donation-safe device-side snapshot of THIS host's shard.
+        `jnp.copy` dispatches an async device copy — no transfer, no
+        block; host values are referenced as-is (the executor commits
+        fresh arrays to the scope, it never mutates them in place).
+        Var metadata covers the FULL state so host 0's manifest can
+        describe every shard."""
+        import jax
+        import numpy as np
+
+        assignment = mf.shard_assignment(state.keys(), self._count)
+        snap, var_meta = {}, {}
+        for name in sorted(state):
+            val = state[name]
+            if val is None:
+                continue
+            if isinstance(val, jax.Array):
+                shape = tuple(val.shape)
+                dtype = str(np.dtype(val.dtype))
+            else:
+                val = np.asarray(val)  # sync-ok: host python value
+                shape = tuple(val.shape)
+                dtype = str(val.dtype)
+            var_meta[name] = {"shape": list(shape), "dtype": dtype,
+                              "shard": assignment[name]}
+            if assignment[name] == self._index:
+                snap[name] = val.copy() if isinstance(val, jax.Array) \
+                    else val
+        return snap, var_meta
+
+    # -- write (writer thread) ---------------------------------------------
+    def _write_job(self, snap, var_meta, step: int,
+                   meta: Dict[str, Any]) -> None:
+        import numpy as np
+
+        from .. import profiler
+
+        tmp = os.path.join(self.root, mf.tmp_dir_name(step))
+        os.makedirs(tmp, exist_ok=True)
+        arrays = {mf.encode_name(k): np.asarray(v)
+                  for k, v in snap.items()}  # device->host, off hot path
+        mf.write_npz_atomic(os.path.join(tmp, mf.shard_file(self._index)),
+                            arrays)
+        _barrier(self._count, f"ckpt-shards-{step}")
+        if self._index != 0:
+            # host 0 owns the commit; this host's shard is on disk
+            profiler.stat_add("ckpt_saves_total")
+            return
+        manifest = {
+            "format": mf.MANIFEST_FORMAT,
+            "step": step,
+            "time": time.time(),
+            "process_count": self._count,
+            "shards": [mf.shard_file(i) for i in range(self._count)],
+            "vars": var_meta,
+            "flag_signature": mf.flag_signature(),
+            "meta": meta,
+        }
+        mf.write_manifest(tmp, manifest)
+        final = os.path.join(self.root, mf.checkpoint_dir_name(step))
+        if os.path.exists(final):
+            shutil.rmtree(final)
+        os.replace(tmp, final)  # atomic publish: manifest exists => complete
+        mf.fsync_dir(self.root)
+        profiler.stat_add("ckpt_saves_total")
+        self._gc(step)
+
+    def _gc(self, committed_step: int) -> None:
+        """Retention: keep the newest `keep` complete checkpoints, and
+        sweep half-written tmp dirs (a SIGKILL mid-write leaves one)
+        whose step is no newer than what just committed."""
+        from .. import profiler
+
+        done = mf.list_checkpoints(self.root)
+        for _, path in done[:-self.keep] if self.keep > 0 else []:
+            shutil.rmtree(path, ignore_errors=True)
+            profiler.stat_add("ckpt_gc_count")
+        for name in os.listdir(self.root):
+            if not name.startswith(mf.TMP_PREFIX):
+                continue
+            try:
+                stale_step = int(name[len(mf.TMP_PREFIX):])
+            except ValueError:
+                continue
+            if stale_step <= committed_step:
+                shutil.rmtree(os.path.join(self.root, name),
+                              ignore_errors=True)
+                profiler.stat_add("ckpt_gc_count")
+
+    # -- lifecycle ---------------------------------------------------------
+    def wait(self) -> None:
+        """Drain in-flight writes; re-raises writer-thread errors."""
+        self._pool.wait()
+
+    def close(self) -> None:
+        self._pool.close()
+
+    @property
+    def in_flight(self) -> int:
+        return self._pool.in_flight
+
+    # -- restore -----------------------------------------------------------
+    def latest(self) -> Optional[str]:
+        return mf.latest_checkpoint(self.root)
+
+    def read_meta(self, path: str) -> Dict[str, Any]:
+        """Manifest of one committed checkpoint (no array loads)."""
+        manifest = mf.read_manifest(path)
+        mf.validate_complete(path, manifest)
+        return manifest
+
+    def restore(self, path: Optional[str] = None,
+                strict_topology: bool = True
+                ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        """Load `(state, manifest)` from `path` (default: the newest
+        complete checkpoint under the root).  Refuses half-written /
+        partial checkpoints and — when `strict_topology` — checkpoints
+        written by a different host count, each with a clear error."""
+        from .. import profiler
+
+        if path is None:
+            path = self.latest()
+            if path is None:
+                raise CheckpointError(
+                    f"{self.root}: no complete checkpoint to restore")
+        manifest = self.read_meta(path)
+        saved_count = int(manifest.get("process_count", 1))
+        if strict_topology and saved_count != self._count:
+            raise CheckpointError(
+                f"{path}: topology mismatch — checkpoint was written by "
+                f"{saved_count} host(s), this job runs {self._count}; "
+                f"per-host shards do not re-deal across host counts "
+                f"(restore with strict_topology=False to load weights "
+                f"only, e.g. for serving reload)")
+        state = _load_shards(path, manifest)
+        sig = mf.flag_signature()
+        saved_sig = manifest.get("flag_signature", "")
+        if saved_sig and sig and saved_sig != sig:
+            warnings.warn(
+                f"checkpoint {path} was written under different "
+                f"compile-relevant flags ({saved_sig} vs {sig}); the "
+                f"resumed numerics may not match the saved run")
+        profiler.stat_add("ckpt_restore_count")
+        return state, manifest
+
+
+def _load_shards(path: str, manifest: Dict[str, Any]) -> Dict[str, Any]:
+    import numpy as np
+
+    var_meta = manifest.get("vars", {})
+    state: Dict[str, Any] = {}
+    for shard in manifest.get("shards", []):
+        with np.load(os.path.join(path, shard)) as data:
+            for key in data.files:
+                name = mf.decode_name(key)
+                arr = data[key]
+                meta = var_meta.get(name)
+                if meta is not None:
+                    arr = mf.restore_dtype(arr, meta["dtype"])
+                state[name] = arr
+    missing = [n for n in var_meta if n not in state]
+    if missing:
+        raise CheckpointError(
+            f"{path}: partial checkpoint — manifest describes vars "
+            f"{missing[:5]}{'...' if len(missing) > 5 else ''} that no "
+            f"shard contains; refusing to load partial state")
+    return state
+
+
+# ---------------------------------------------------------------------------
+# single-directory state API (the legacy io.checkpoint surface rides this)
+# ---------------------------------------------------------------------------
+
+def write_state(path: str, state: Dict[str, Any],
+                meta: Optional[Dict[str, Any]] = None,
+                process_index: Optional[int] = None,
+                process_count: Optional[int] = None) -> None:
+    """Atomically write one checkpoint AT `path` (the directory itself,
+    not a step-numbered child): same shard/manifest/commit protocol as
+    the manager, no retention.  No caller can ever observe a torn or
+    half-written state dir."""
+    import numpy as np
+
+    index, count = _host_topology(process_index, process_count)
+    path = os.path.abspath(path)
+    parent = os.path.dirname(path) or "."
+    os.makedirs(parent, exist_ok=True)
+    tmp = os.path.join(parent,
+                       f"{mf.TMP_PREFIX}{os.path.basename(path)}")
+    os.makedirs(tmp, exist_ok=True)
+    assignment = mf.shard_assignment(state.keys(), count)
+    var_meta, arrays = {}, {}
+    for name in sorted(state):
+        val = state[name]
+        if val is None:
+            continue
+        arr = np.asarray(val)
+        var_meta[name] = {"shape": list(arr.shape),
+                          "dtype": str(arr.dtype),
+                          "shard": assignment[name]}
+        if assignment[name] == index:
+            arrays[mf.encode_name(name)] = arr
+    mf.write_npz_atomic(os.path.join(tmp, mf.shard_file(index)), arrays)
+    _barrier(count, f"ckpt-state-{os.path.basename(path)}")
+    if index != 0:
+        return
+    mf.write_manifest(tmp, {
+        "format": mf.MANIFEST_FORMAT,
+        "step": -1,
+        "time": time.time(),
+        "process_count": count,
+        "shards": [mf.shard_file(i) for i in range(count)],
+        "vars": var_meta,
+        "flag_signature": mf.flag_signature(),
+        "meta": dict(meta or {}),
+    })
+    if os.path.exists(path):
+        shutil.rmtree(path)
+    os.replace(tmp, path)
+    mf.fsync_dir(parent)
+
+
+def read_state(path: str) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Load `(state, manifest)` from a state dir written by
+    `write_state` OR from a checkpoint root/step dir: given a root,
+    the newest complete child checkpoint is used.  Topology is NOT
+    checked — this is the weights-only path (serving reload, tools)."""
+    path = os.path.abspath(path)
+    if not os.path.isfile(os.path.join(path, mf.MANIFEST_FILE)):
+        newest = mf.latest_checkpoint(path)
+        if newest is None:
+            raise CheckpointError(
+                f"{path}: neither a committed checkpoint (no "
+                f"{mf.MANIFEST_FILE}) nor a checkpoint root with a "
+                f"complete child checkpoint")
+        path = newest
+    manifest = mf.read_manifest(path)
+    mf.validate_complete(path, manifest)
+    return _load_shards(path, manifest), manifest

@@ -1,0 +1,232 @@
+"""Checkpoint directory layout, manifest format, and the atomic
+multi-file commit protocol (docs/fault_tolerance.md).
+
+One checkpoint is one directory:
+
+    <root>/ckpt-00000042/
+        shard_00000.npz     host 0's slice of the state
+        shard_00001.npz     host 1's slice ...
+        manifest.json       written + fsync'd + renamed LAST
+
+The manifest is the commit record: a checkpoint without a readable
+manifest, or whose manifest lists a shard file that is missing, is NOT
+a checkpoint — `latest_checkpoint` skips it and `read_manifest` /
+`validate_complete` raise `CheckpointError` with the reason.  Writers
+stage everything under `<root>/.tmp-ckpt-<step>` and publish with one
+`os.replace`, so a reader can never observe a torn checkpoint and a
+SIGKILL mid-write leaves only a tmp dir the next commit garbage
+collects.
+
+The shard map is the ZeRO on-ramp (ROADMAP, arxiv 2004.13336): state
+entries are deterministically assigned to hosts by sorted name, so a
+later cross-replica sharding pass can adopt the same partition layout.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+MANIFEST_FORMAT = "paddle_tpu.ckpt.v1"
+MANIFEST_FILE = "manifest.json"
+CKPT_PREFIX = "ckpt-"
+TMP_PREFIX = ".tmp-ckpt-"
+_STEP_RE = re.compile(r"^ckpt-(\d+)$")
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint could not be written, validated, or restored."""
+
+
+# ---------------------------------------------------------------------------
+# names / dtypes (npz-safe encodings)
+# ---------------------------------------------------------------------------
+
+def encode_name(name: str) -> str:
+    """npz member names must not contain '/' (zip path separators);
+    paddle var names may (e.g. scope-prefixed params)."""
+    return name.replace("/", "%2F")
+
+
+def decode_name(name: str) -> str:
+    return name.replace("%2F", "/")
+
+
+def np_dtype_of(name: str):
+    """np.dtype for a manifest dtype string, including the ml_dtypes
+    extended types (bfloat16 & friends) numpy cannot name natively."""
+    import numpy as np
+
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def restore_dtype(arr, dtype_name: str):
+    """Undo npz's dtype erasure: extended dtypes (bfloat16) round-trip
+    through np.save as raw void bytes; view them back."""
+    want = np_dtype_of(dtype_name)
+    if arr.dtype == want:
+        return arr
+    if arr.dtype.kind == "V" and arr.dtype.itemsize == want.itemsize:
+        return arr.view(want)
+    return arr.astype(want)
+
+
+# ---------------------------------------------------------------------------
+# shard map
+# ---------------------------------------------------------------------------
+
+def shard_assignment(names, count: int) -> Dict[str, int]:
+    """Deterministic var -> host assignment: round-robin over the
+    sorted name list.  Disjoint and exhaustive for any count; identical
+    on every host (same name set, same sort); stable enough that the
+    SPMD item can key its partition layout off the same function."""
+    count = max(1, int(count))
+    return {n: i % count for i, n in enumerate(sorted(names))}
+
+
+def shard_file(index: int) -> str:
+    return f"shard_{int(index):05d}.npz"
+
+
+# ---------------------------------------------------------------------------
+# fsync'd writes
+# ---------------------------------------------------------------------------
+
+def fsync_dir(path: str) -> None:
+    """Durability for the rename itself (POSIX: renaming is atomic,
+    persisting it needs the parent dir fsync'd)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return  # platforms without dir fds: rename atomicity still holds
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def write_file_atomic(path: str, data: bytes) -> None:
+    """write tmp + flush + fsync + rename: no reader can see a torn
+    file, and the bytes are on disk before the name exists."""
+    tmp = f"{path}.partial.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def write_npz_atomic(path: str, arrays: Dict[str, Any]) -> None:
+    import numpy as np
+
+    tmp = f"{path}.partial.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+# ---------------------------------------------------------------------------
+# manifest read / validate
+# ---------------------------------------------------------------------------
+
+def write_manifest(ckpt_dir: str, manifest: Dict[str, Any]) -> None:
+    data = json.dumps(manifest, indent=1, sort_keys=True).encode()
+    write_file_atomic(os.path.join(ckpt_dir, MANIFEST_FILE), data)
+    fsync_dir(ckpt_dir)
+
+
+def read_manifest(path: str) -> Dict[str, Any]:
+    mf = os.path.join(path, MANIFEST_FILE)
+    if not os.path.isfile(mf):
+        raise CheckpointError(
+            f"{path}: no {MANIFEST_FILE} — this is not a committed "
+            f"checkpoint (a half-written tmp dir, or not a checkpoint "
+            f"at all); refusing to load partial state")
+    try:
+        with open(mf) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointError(f"{path}: unreadable manifest: {e}") from e
+    fmt = manifest.get("format")
+    if fmt != MANIFEST_FORMAT:
+        raise CheckpointError(
+            f"{path}: manifest format {fmt!r} is not {MANIFEST_FORMAT!r}")
+    return manifest
+
+
+def validate_complete(path: str, manifest: Dict[str, Any]) -> None:
+    """Refuse partial checkpoints: every shard the manifest names must
+    exist.  (The manifest is written last, so this only fires when
+    files were deleted/corrupted after the commit.)"""
+    missing = [s for s in manifest.get("shards", [])
+               if not os.path.isfile(os.path.join(path, s))]
+    if missing:
+        raise CheckpointError(
+            f"{path}: partial checkpoint — manifest lists shard(s) "
+            f"{missing} that do not exist; refusing to load partial "
+            f"state")
+
+
+def step_of(name: str) -> Optional[int]:
+    m = _STEP_RE.match(name)
+    return int(m.group(1)) if m else None
+
+
+def checkpoint_dir_name(step: int) -> str:
+    return f"{CKPT_PREFIX}{int(step):08d}"
+
+
+def tmp_dir_name(step: int) -> str:
+    return f"{TMP_PREFIX}{int(step):08d}"
+
+
+def list_checkpoints(root: str) -> List[Tuple[int, str]]:
+    """(step, path) of every COMPLETE checkpoint under root, ascending
+    by step.  Half-written tmp dirs and dirs failing validation are
+    skipped (they are GC fodder, not restore candidates)."""
+    if not os.path.isdir(root):
+        return []
+    out = []
+    for name in sorted(os.listdir(root)):
+        step = step_of(name)
+        if step is None:
+            continue
+        path = os.path.join(root, name)
+        try:
+            validate_complete(path, read_manifest(path))
+        except CheckpointError:
+            continue
+        out.append((step, path))
+    out.sort()
+    return out
+
+
+def latest_checkpoint(root: str) -> Optional[str]:
+    """Path of the newest complete checkpoint under `root`, or None."""
+    done = list_checkpoints(root)
+    return done[-1][1] if done else None
+
+
+def flag_signature() -> str:
+    """The compile-relevant flag state a checkpoint was trained under
+    (restore warns on mismatch — a flipped transform pipeline means the
+    resumed numerics may differ from the saved run's)."""
+    try:
+        from ..fluid.flags import flag
+        from ..transforms import enabled_signature
+
+        return json.dumps({
+            "check_nan_inf": bool(flag("check_nan_inf")),
+            "graph_transforms": list(enabled_signature()),
+        }, sort_keys=True)
+    except Exception:  # noqa: BLE001 - signature is advisory
+        return ""

@@ -274,12 +274,20 @@ class FeedPipeline:
                  depth: Optional[int] = None,
                  process_index: Optional[int] = None,
                  process_count: Optional[int] = None,
-                 epoch: Optional[int] = None):
+                 epoch: Optional[int] = None,
+                 skip_batches: int = 0):
         from .. import profiler
 
         self._stage = stage_fn
         self._depth = DEFAULT_PREFETCH_DEPTH if depth is None \
             else max(1, int(depth))
+        # deterministic mid-epoch resume (paddle_tpu.ckpt,
+        # docs/fault_tolerance.md): the first `skip_batches` batches of
+        # the epoch were consumed before the checkpoint — discard them
+        # on the producer thread BEFORE staging, so a resumed run
+        # replays exactly the remaining data order (the order itself is
+        # already deterministic via shard_plan/epoch_order)
+        self._skip = max(0, int(skip_batches))
         self._index, self._count = host_topology(process_index,
                                                  process_count)
         self._ring = DeviceRing(self._depth)
@@ -298,7 +306,12 @@ class FeedPipeline:
         if self._count <= 1 or getattr(source, "_host_sharded", False):
             # single host, or the dataset was already shard-loaded
             # (load_into_memory(shard_by_host=True)) — re-sharding
-            # would drop data
+            # would drop data.  The epoch counter still advances: the
+            # checkpoint subsystem keys mid-epoch resume off it
+            # (docs/fault_tolerance.md), single- and multi-host alike.
+            if epoch is None:
+                epoch = getattr(source, "_feed_epoch", -1) + 1
+            source._feed_epoch = epoch
             return batch_iter()
         if epoch is None:
             # one pipeline = one pass: auto-advance the dataset's epoch
@@ -320,6 +333,15 @@ class FeedPipeline:
         t_start = time.perf_counter()
         try:
             it = self._batch_iter
+            skipped = 0
+            while skipped < self._skip:
+                try:
+                    next(it)  # resume: already-consumed batch, not staged
+                except StopIteration:
+                    break
+                skipped += 1
+            if skipped:
+                profiler.stat_add("feed_skipped_batches", skipped)
             while True:
                 t0 = time.perf_counter()
                 try:

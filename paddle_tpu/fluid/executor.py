@@ -382,6 +382,158 @@ class _FeedPrefetcher:
         return iter(self._pipe)
 
 
+class _AutoCheckpoint:
+    """Auto-checkpoint driver for `train_from_dataset`
+    (docs/fault_tolerance.md): owns the CheckpointManager, the
+    every-N-steps/seconds cadence, and preemption-safe resume.
+
+    Resume semantics against the dataset's epoch counter (each
+    train_from_dataset call consumes one feed epoch):
+
+    * checkpoint's feed_epoch == this pass's epoch — mid-epoch resume:
+      restore state, re-deal the same epoch order, skip the consumed
+      batches;
+    * checkpoint's feed_epoch is LATER — this whole pass already ran
+      in the checkpointed job: restore state, consume the epoch
+      counter, and skip the pass (`skip_pass`);
+    * checkpoint is OLDER than the live in-process state — ignore it
+      (never move a running job backwards).
+    """
+
+    def __init__(self, exe, program, scope, dataset, manager,
+                 every_steps: int, every_secs: float):
+        self._exe = exe
+        self._program = program
+        self._scope = scope
+        self._dataset = dataset
+        self.manager = manager
+        self.every_steps = every_steps
+        self.every_secs = every_secs
+        self.epoch: Optional[int] = None
+        self.step_in_epoch = 0
+        self.skip_pass = False
+        self.restored_from: Optional[str] = None
+        self._steps_since_save = 0
+        self._last_save_t = time.perf_counter()
+
+    @staticmethod
+    def setup(exe, program, scope, dataset, checkpoint_dir, every_steps,
+              every_secs, keep, resume) -> Optional["_AutoCheckpoint"]:
+        from .flags import flag
+
+        if checkpoint_dir is None:
+            checkpoint_dir = flag("ckpt_dir", "") or None
+        if not checkpoint_dir:
+            return None
+        if not hasattr(program, "list_vars"):
+            # CompiledProgram: checkpoint the wrapped Program's state
+            program = getattr(program, "_program", program)
+        from ..ckpt import CheckpointManager
+
+        every_steps = int(flag("ckpt_every_steps", 0)
+                          if every_steps is None else every_steps)
+        every_secs = float(flag("ckpt_every_secs", 0.0)
+                           if every_secs is None else every_secs)
+        resume = bool(flag("ckpt_resume", True)) if resume is None \
+            else bool(resume)
+        manager = CheckpointManager(checkpoint_dir, keep=keep)
+        self = _AutoCheckpoint(exe, program, scope, dataset, manager,
+                               every_steps, every_secs)
+        if resume:
+            self._try_resume()
+        return self
+
+    # -- resume ------------------------------------------------------------
+    def _try_resume(self) -> None:
+        import warnings
+
+        path = self.manager.latest()
+        if path is None:
+            return
+        manifest = self.manager.read_meta(path)
+        meta = manifest.get("meta", {})
+        feed_epoch = int(meta.get("feed_epoch", 0))
+        ds_next = int(getattr(self._dataset, "_feed_epoch", -1)) + 1
+        if feed_epoch < ds_next:
+            return  # live in-process state is ahead of the checkpoint
+        state, _ = self.manager.restore(path)
+        self._apply_state(state)
+        self._exe._step = int(meta.get("executor_step", 0))
+        saved_seed = meta.get("feed_seed")
+        live_seed = int(getattr(self._dataset, "_seed", 0))
+        if saved_seed is not None and int(saved_seed) != live_seed:
+            warnings.warn(
+                f"checkpoint {path} was written with feed seed "
+                f"{saved_seed}, the dataset uses {live_seed}: the "
+                f"resumed data order will NOT match the saved run")
+        if feed_epoch > ds_next:
+            # this pass completed before the preemption: consume its
+            # epoch index so later passes line up, run nothing
+            self._dataset._feed_epoch = ds_next
+            self.skip_pass = True
+        else:
+            self.epoch = feed_epoch
+            self.step_in_epoch = int(meta.get("step_in_epoch", 0))
+        self.restored_from = path
+        from ..profiler import stat_add
+
+        stat_add("ckpt_resume_count")
+
+    def _apply_state(self, state) -> None:
+        from . import core
+
+        persist = {v.name: v for v in self._program.list_vars()
+                   if v.persistable}
+        for name, val in state.items():
+            var = persist.get(name)
+            if var is None:
+                continue
+            want = core.np_dtype(var.dtype)
+            if val.dtype != want:
+                val = val.astype(want)
+            self._scope.set(name, val)
+
+    def bind_epoch(self, dataset) -> None:
+        """Record the feed epoch the pipeline actually opened (it
+        advances the dataset's counter itself on a fresh pass)."""
+        if self.epoch is None:
+            self.epoch = int(getattr(dataset, "_feed_epoch", 0) or 0)
+
+    # -- save cadence ------------------------------------------------------
+    def on_step(self) -> None:
+        self.step_in_epoch += 1
+        self._steps_since_save += 1
+        due = (self.every_steps > 0
+               and self._steps_since_save >= self.every_steps)
+        if not due and self.every_secs > 0:
+            due = (time.perf_counter() - self._last_save_t
+                   >= self.every_secs)
+        if due:
+            self._save_now()
+
+    def on_pass_end(self) -> None:
+        if self._steps_since_save > 0:
+            self._save_now()
+        self.manager.wait()  # surface writer-thread errors
+
+    def _save_now(self) -> None:
+        from .io import _persistable_names
+
+        scope = self._scope
+        state = {}
+        for name in _persistable_names(self._program):
+            if scope.has(name) and scope.get(name) is not None:
+                state[name] = scope.get(name)
+        self.manager.save_async(state, step=self._exe._step, meta={
+            "feed_epoch": int(self.epoch or 0),
+            "step_in_epoch": self.step_in_epoch,
+            "executor_step": int(self._exe._step),
+            "feed_seed": int(getattr(self._dataset, "_seed", 0)),
+        })
+        self._steps_since_save = 0
+        self._last_save_t = time.perf_counter()
+
+
 def _program_label(program, fetch_names) -> str:
     """Stable human-greppable identity for cost gauges / tracetool
     ("MFU per program"): the program id in the verifier's provenance
@@ -499,7 +651,12 @@ class Executor:
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
                            fetch_info=None, print_period=100,
-                           fetch_handler=None, prefetch_depth=None):
+                           fetch_handler=None, prefetch_depth=None,
+                           checkpoint_dir=None,
+                           checkpoint_every_steps=None,
+                           checkpoint_every_secs=None,
+                           checkpoint_keep=None, resume=None,
+                           step_callback=None):
         """Dataset-driven training loop (reference executor.py:1642 ->
         C++ Executor::RunFromDataset -> MultiTrainer/HogwildWorker
         threads over DataFeed channels, trainer.h:51).
@@ -519,7 +676,22 @@ class Executor:
         fetches, and fetch materialization happens only at
         `print_period` boundaries and at loop exit.  `prefetch_depth`
         bounds both the ring and how far the host runs ahead (default
-        PADDLE_PREFETCH_DEPTH, 2)."""
+        PADDLE_PREFETCH_DEPTH, 2).
+
+        Fault tolerance (docs/fault_tolerance.md): with
+        `checkpoint_dir` (or FLAGS_ckpt_dir / PADDLE_CKPT_DIR) set, the
+        loop saves async per-host sharded checkpoints at step
+        boundaries — every `checkpoint_every_steps` steps and/or
+        `checkpoint_every_secs` seconds, plus once at loop exit — and,
+        with `resume` (default on), restores the newest complete
+        checkpoint first: scope state, the executor's step/seed
+        counter, and the EXACT remaining feed order (the manifest's
+        `(feed_epoch, step_in_epoch, feed_seed)` re-deal the epoch
+        permutation via shard_plan and skip the consumed batches).  A
+        SIGKILL at any step boundary therefore resumes to the same
+        loss trajectory as an uninterrupted run.  `step_callback(step,
+        step_in_epoch, fetches)` runs after each dispatched step (and
+        after any due checkpoint save) with LazyFetch handles."""
         if dataset is None:
             raise ValueError("train_from_dataset needs a dataset")
         if thread:
@@ -539,12 +711,27 @@ class Executor:
 
         program = program if program is not None else \
             default_main_program()
+        ckpt = _AutoCheckpoint.setup(
+            self, program, scope if scope is not None else global_scope(),
+            dataset, checkpoint_dir, checkpoint_every_steps,
+            checkpoint_every_secs, checkpoint_keep, resume)
+        if ckpt is not None and ckpt.skip_pass:
+            # the restored checkpoint is from a LATER epoch than this
+            # pass: the work this call represents already happened —
+            # the epoch counter was consumed, nothing to run
+            if monitor is not None:
+                monitor.stop()
+            return None
         step = 0
         last = None
         in_flight = collections.deque()
         prefetcher = FeedPipeline(
             lambda feed: self._normalize_feed(program, feed),
-            dataset, depth=depth)
+            dataset, depth=depth,
+            epoch=None if ckpt is None else ckpt.epoch,
+            skip_batches=0 if ckpt is None else ckpt.step_in_epoch)
+        if ckpt is not None:
+            ckpt.bind_epoch(dataset)
         try:
             for feed in prefetcher:
                 outs = self.run(program, feed=feed, fetch_list=fetch_list,
@@ -561,6 +748,12 @@ class Executor:
                     oldest = in_flight.popleft()
                     for h in oldest:
                         h.block_until_ready()  # sync-ok: dispatch-ahead throttle
+                if ckpt is not None:
+                    ckpt.on_step()
+                if step_callback is not None:
+                    step_callback(self._step,
+                                  step if ckpt is None
+                                  else ckpt.step_in_epoch, outs)
                 if debug and fetch_list and step % print_period == 0:
                     # sanctioned materialization boundary
                     msg = ", ".join(
@@ -571,6 +764,10 @@ class Executor:
             stat_set("in_flight_steps", 0)
             if monitor is not None:
                 monitor.stop()
+        if ckpt is not None:
+            # end-of-pass step boundary: persist the final state and
+            # surface any writer-thread error before declaring success
+            ckpt.on_pass_end()
         # loop exit is a sanctioned boundary: materialize the final
         # fetches (callers index/float them) and flush the NaN scan
         self._nan_monitor.drain()

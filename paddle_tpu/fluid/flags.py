@@ -18,9 +18,15 @@ _REGISTRY: Dict[str, dict] = {}
 
 
 def _define(name, default, help_str="", on_set: Callable = None,
-            typ=None):
+            typ=None, env_var=None):
+    """`env_var` names an additional environment source checked BEFORE
+    the generic FLAGS_<name> (the PADDLE_CKPT_* contract rides this)."""
     typ = typ or type(default)
-    env = os.environ.get(f"FLAGS_{name}")
+    env = None
+    if env_var is not None:
+        env = os.environ.get(env_var)
+    if env is None:
+        env = os.environ.get(f"FLAGS_{name}")
     value = default
     if env is not None:
         if typ is bool:
@@ -88,6 +94,32 @@ _define("graph_transforms", "on",
         "passes (layout_optimize, dead_op_elim), 'off' disables all, "
         "per-pass overrides compose as e.g. 'on,fold_bn=on' or "
         "'layout_optimize=off'")
+# -- fault-tolerant training (paddle_tpu.ckpt, docs/fault_tolerance.md):
+# the PADDLE_CKPT_* env contract configures the auto-checkpoint loop on
+# Executor.train_from_dataset without touching the training script
+_define("ckpt_dir", "",
+        "auto-checkpoint root for train_from_dataset: when set, the "
+        "loop saves async sharded checkpoints and resumes from the "
+        "newest complete one (paddle_tpu.ckpt)", env_var="PADDLE_CKPT_DIR")
+_define("ckpt_every_steps", 0,
+        "auto-checkpoint every N steps (0 = only the end-of-pass save)",
+        env_var="PADDLE_CKPT_EVERY_STEPS")
+_define("ckpt_every_secs", 0.0,
+        "auto-checkpoint every N seconds (0 = disabled; composes with "
+        "ckpt_every_steps — whichever fires first)",
+        env_var="PADDLE_CKPT_EVERY_SECS")
+_define("ckpt_keep", 3,
+        "retention: newest N complete checkpoints kept, older ones and "
+        "half-written tmp dirs garbage-collected on each commit",
+        env_var="PADDLE_CKPT_KEEP")
+_define("ckpt_max_in_flight", 2,
+        "bounded checkpoint write queue: beyond N pending snapshots "
+        "save_async backpressures (ckpt_stall_ms)",
+        env_var="PADDLE_CKPT_MAX_IN_FLIGHT")
+_define("ckpt_resume", True,
+        "resume train_from_dataset from the newest complete checkpoint "
+        "under ckpt_dir (scope state + executor step + exact remaining "
+        "feed order)", env_var="PADDLE_CKPT_RESUME")
 _define("op_callstack", False,
         "record the Python construction stack on every appended op "
         "(attrs['op_callstack']); verifier findings then point at the "

@@ -1,21 +1,26 @@
-"""Sharded / async array checkpointing (orbax-backed).
+"""Legacy array-checkpoint surface — thin compat shims over
+`paddle_tpu.ckpt` (docs/fault_tolerance.md).
 
-The reference's checkpoint path is per-var save/load ops executed by a
-generated program (save_op.cc / load_op.cc via fluid/io.py) plus
-fleet sharded-state saves (dist_sharding_save.py).  TPU-native
-re-design (SURVEY.md §5.4: "pytree checkpoints + sharded array save"):
-orbax writes each jax.Array in its native layout — a ZeRO-sharded or
-mesh-sharded param saves WITHOUT gathering to one host, and multi-host
-jobs write cooperatively.  `async_save` overlaps the write with
-training (the reference has no async path).
+Historically this module pickled/orbax-wrote state dirs directly,
+which left two robustness holes the fault-tolerance subsystem closes:
+a save interrupted mid-write could leave a torn dir a later
+`load_state` happily half-loaded, and `AsyncSaver` parked writer-thread
+exceptions where a caller that never re-saved would never see them.
 
-Plain numpy/python leaves round-trip too, so this serves as the one
-checkpoint engine for scopes, state_dicts, and train states.
+Now:
+
+* `save_state` routes through `ckpt.write_state` — per-host shard +
+  fsync'd manifest + atomic rename, so NO caller can ever observe a
+  torn or partial state dir (restore refuses them with a clear error).
+* `load_state` reads the ckpt manifest format, falling back to the
+  legacy orbax layout for dirs written before this subsystem existed.
+* `AsyncSaver` rides the `ckpt.WriterPool`: `save()` snapshots and
+  returns, `wait()` joins the in-flight write and RE-RAISES anything
+  the writer thread hit.
 """
 
 from __future__ import annotations
 
-import os
 import threading
 from typing import Any, Dict, Optional
 
@@ -23,26 +28,25 @@ _async_mgr = None
 _async_lock = threading.Lock()
 
 
-def _checkpointer():
-    import orbax.checkpoint as ocp
-
-    return ocp.PyTreeCheckpointer()
-
-
 def save_state(state: Dict[str, Any], path: str):
-    """Synchronous sharded-aware save of a flat {name: array} tree."""
-    import jax
+    """Synchronous atomic save of a flat {name: array} tree (sharded
+    per host on a pod; commit protocol in paddle_tpu.ckpt.manifest)."""
+    from ..ckpt import write_state
 
-    path = os.path.abspath(path)
     state = {k: v for k, v in state.items() if v is not None}
     if not state:
         raise ValueError(
             "save_state: empty state — nothing to checkpoint (did you "
             "pass the right program/scope? persistables resolve against "
             "the DEFAULT program unless one is given)")
-    # orbax forbids keys with '/', which paddle var names may contain
-    enc = {k.replace("/", "%2F"): v for k, v in state.items()}
-    _checkpointer().save(path, enc)
+    write_state(path, state)
+
+
+def _legacy_orbax_load(path: str, enc_target=None):
+    """Dirs written before the ckpt subsystem (orbax PyTree layout)."""
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer().restore(path, item=enc_target)
 
 
 def load_state(path: str, target: Optional[Dict[str, Any]] = None
@@ -50,53 +54,76 @@ def load_state(path: str, target: Optional[Dict[str, Any]] = None
     """Restore a tree saved by save_state.  With `target` (name ->
     abstract array or concrete example), arrays restore with the
     target's sharding/dtype — the multi-host resume path."""
+    import os
+
+    from ..ckpt import MANIFEST_FILE, latest_checkpoint, read_state
+
     path = os.path.abspath(path)
-    enc_target = None
+    if os.path.isfile(os.path.join(path, MANIFEST_FILE)) \
+            or latest_checkpoint(path) is not None:
+        out, _ = read_state(path)
+    else:
+        enc_target = None
+        if target is not None:
+            enc_target = {k.replace("/", "%2F"): v
+                          for k, v in target.items()}
+        raw = _legacy_orbax_load(path, enc_target)
+        out = {k.replace("%2F", "/"): v for k, v in raw.items()}
     if target is not None:
-        enc_target = {k.replace("/", "%2F"): v for k, v in target.items()}
-    out = _checkpointer().restore(path, item=enc_target)
-    return {k.replace("%2F", "/"): v for k, v in out.items()}
+        out = _apply_target(out, target)
+    return out
+
+
+def _apply_target(state: Dict[str, Any], target: Dict[str, Any]
+                  ) -> Dict[str, Any]:
+    """Re-seat restored arrays on the target's sharding/dtype when one
+    is given (device placement is the caller's contract; plain numpy
+    targets pass through)."""
+    import numpy as np
+
+    out = {}
+    for k, v in state.items():
+        t = target.get(k)
+        sharding = getattr(t, "sharding", None)
+        if sharding is not None:
+            import jax
+
+            dtype = getattr(t, "dtype", None)
+            arr = np.asarray(v)
+            if dtype is not None and arr.dtype != np.dtype(dtype):
+                arr = arr.astype(dtype)
+            v = jax.device_put(arr, sharding)
+        out[k] = v
+    return out
 
 
 class AsyncSaver:
-    """Background-thread checkpoint writer: `save()` returns
-    immediately, `wait()` (or the next save) joins the in-flight write.
-    One outstanding write at a time — the overlap the reference lacks
-    and preemptible TPUs want."""
+    """Background checkpoint writer: `save()` snapshots and returns
+    immediately, `wait()` (or the next save) joins the in-flight write
+    and re-raises any writer-thread exception.  One outstanding write
+    at a time — the overlap the reference lacks and preemptible TPUs
+    want."""
 
     def __init__(self):
-        self._thread = None
-        self._err = None
+        from ..ckpt import WriterPool
+
+        self._pool = WriterPool(max_in_flight=1, name="io-async-saver")
 
     def save(self, state: Dict[str, Any], path: str):
         import jax
 
-        self.wait()
-        # snapshot device arrays to host BEFORE returning so training
-        # may donate/overwrite them while the writer runs
+        # snapshot device arrays BEFORE returning so training may
+        # donate/overwrite them while the writer runs (device-side
+        # copy: async dispatch, no transfer on this thread)
         snap = {}
         for k, v in state.items():
             if v is None:
                 continue
-            snap[k] = (jax.device_get(v)
-                       if isinstance(v, jax.Array) else v)
-
-        def run():
-            try:
-                save_state(snap, path)
-            except BaseException as e:  # surfaced on wait()
-                self._err = e
-
-        self._thread = threading.Thread(target=run, daemon=True)
-        self._thread.start()
+            snap[k] = v.copy() if isinstance(v, jax.Array) else v
+        self._pool.submit(lambda: save_state(snap, path))
 
     def wait(self):
-        if self._thread is not None:
-            self._thread.join()
-            self._thread = None
-        if self._err is not None:
-            err, self._err = self._err, None
-            raise err
+        self._pool.wait()
 
 
 def async_save(state: Dict[str, Any], path: str) -> AsyncSaver:

@@ -143,6 +143,34 @@ class ProgramModel:
     def ensure_compiled(self, inputs) -> None:
         pass  # compile happens inside run(); see class docstring
 
+    def reload_weights(self, path: str) -> int:
+        """Swap this model's parameters from a checkpoint
+        (paddle_tpu.ckpt dir or checkpoint root — newest complete one
+        wins).  The scope commit is the whole swap: the executor's
+        const-state identity check re-uploads changed arrays on the
+        NEXT dispatch, batches already in flight complete with the old
+        weights, and nothing drains or blocks.  Returns the number of
+        parameters swapped."""
+        from ..ckpt import read_state
+        from ..fluid import core
+        from ..fluid.executor import global_scope
+
+        state, _ = read_state(path)
+        scope = self.scope if self.scope is not None else global_scope()
+        persist = {v.name: v for v in self.program.list_vars()
+                   if v.persistable}
+        count = 0
+        for name, val in state.items():
+            var = persist.get(name)
+            if var is None:
+                continue
+            want = core.np_dtype(var.dtype)
+            if val.dtype != want:
+                val = val.astype(want)
+            scope.set(name, val)
+            count += 1
+        return count
+
     def run(self, inputs):
         rows = inputs[0].shape[0]
         top = self.buckets[-1]
@@ -283,6 +311,32 @@ class Engine:
               timeout: Optional[float] = None) -> List[np.ndarray]:
         """Synchronous convenience: submit + wait."""
         return self.submit(inputs).result(timeout)
+
+    def reload_weights(self, path: str) -> int:
+        """Model hot-swap (docs/fault_tolerance.md): load a
+        paddle_tpu.ckpt checkpoint's parameters into the LIVE engine
+        without draining — requests already dispatched complete with
+        the old weights, requests dispatched after this call use the
+        new ones, and admission never pauses.  Only ProgramModel-backed
+        engines have the parameter seam (scope state); closure-baked
+        callables/Predictors bake weights into the traced computation
+        and must be re-created instead.  Returns the number of
+        parameters swapped."""
+        from .. import obs
+        from ..profiler import stat_add
+
+        swap = getattr(self.model, "reload_weights", None)
+        if swap is None:
+            raise TypeError(
+                "reload_weights needs a ProgramModel-backed engine "
+                "(parameters live in the scope); "
+                f"{type(self.model).__name__} bakes its weights into "
+                "the traced computation — rebuild the Engine to swap "
+                "models")
+        with obs.span("ckpt.reload"):
+            count = swap(path)
+        stat_add("ckpt_reload_count")
+        return count
 
     # -- pipeline threads --------------------------------------------------
     def _dispatch_loop(self):

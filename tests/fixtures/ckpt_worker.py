@@ -1,0 +1,92 @@
+"""Crash-injection training worker (tests/test_checkpoint.py).
+
+Runs a deterministic tiny regression job through
+`Executor.train_from_dataset` with the auto-checkpoint loop configured
+ENTIRELY through the PADDLE_CKPT_* environment contract
+(fluid/flags.py), so the test also proves the env wiring.  Each step
+appends one fsync'd line to the output file:
+
+    <executor_step> <loss> <batch_x_mean>
+
+`<batch_x_mean>` is fetched from the program itself, so the line is
+direct evidence of WHICH batch fed that step — a resumed run that
+replayed the wrong remaining data order cannot match the golden file.
+
+env:
+    DATA_DIR        directory of MultiSlot part files (written by the test)
+    EPOCHS          passes over the dataset (default 1)
+    BATCH_SIZE      rows per step (default 10)
+    KILL_AT_STEP    SIGKILL self at this executor step boundary (-1: never);
+                    fires AFTER the step's checkpoint-cadence hook, so a
+                    kill can land mid-async-write (half-written tmp dir)
+    PADDLE_CKPT_*   auto-checkpoint knobs (dir, cadence, retention)
+argv:
+    [1] output losses file (appended; the test merges runs by step)
+"""
+
+import os
+import signal
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu.fluid as fluid  # noqa: E402
+from paddle_tpu.fluid import framework, unique_name  # noqa: E402
+from paddle_tpu.fluid.executor import Scope, scope_guard  # noqa: E402
+
+
+def main():
+    out_path = sys.argv[1]
+    data_dir = os.environ["DATA_DIR"]
+    epochs = int(os.environ.get("EPOCHS", "1"))
+    batch_size = int(os.environ.get("BATCH_SIZE", "10"))
+    kill_at = int(os.environ.get("KILL_AT_STEP", "-1"))
+    files = sorted(os.path.join(data_dir, f)
+                   for f in os.listdir(data_dir) if f.endswith(".txt"))
+
+    main_prog, startup = framework.Program(), framework.Program()
+    main_prog.random_seed = 123
+    scope = Scope()
+    with framework.program_guard(main_prog, startup), \
+            unique_name.guard(), scope_guard(scope):
+        x = fluid.data("x", [-1, 8], "float32")
+        y = fluid.data("y", [-1, 1], "float32")
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.loss.square_error_cost(pred, y))
+        xmean = fluid.layers.reduce_mean(x)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+
+        ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+        ds.set_batch_size(batch_size)
+        ds.set_use_var([x, y])
+        ds.set_filelist(files)
+        ds.set_shuffle_seed(7)
+        ds.load_into_memory()
+
+        exe = fluid.Executor()
+        exe.run(startup)
+
+        out = open(out_path, "a")
+
+        def on_step(step, step_in_epoch, fetches):
+            line = (f"{step} {float(fetches[0].numpy().ravel()[0]):.9g} "
+                    f"{float(fetches[1].numpy().ravel()[0]):.9g}\n")
+            out.write(line)
+            out.flush()
+            os.fsync(out.fileno())
+            if step == kill_at:
+                os.kill(os.getpid(), signal.SIGKILL)  # preemption
+
+        for _ in range(epochs):
+            exe.train_from_dataset(main_prog, ds,
+                                   fetch_list=[loss, xmean],
+                                   step_callback=on_step)
+        out.close()
+    print("worker done")
+
+
+if __name__ == "__main__":
+    main()

@@ -8,6 +8,12 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+echo "== fault tolerance: kill-and-resume smoke (docs/fault_tolerance.md) =="
+# SIGKILL a training subprocess mid-epoch and prove it resumes from the
+# newest complete checkpoint with a contiguous step trajectory — the
+# fast canary for the crash-injection suite in tests/test_checkpoint.py
+python -m pytest tests/test_checkpoint.py -q -k smoke
+
 echo "== unit tests (8-dev virtual CPU mesh) =="
 python -m pytest tests/ -x -q
 
