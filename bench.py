@@ -427,6 +427,36 @@ def _obs_detail():
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def bench_telemetry():
+    """`detail.telemetry` (ISSUE 10 satellite): the live-telemetry
+    sampler's own cost.  Drives Collector.sample_once over the REAL
+    in-process sources (profiler tables + cost gauges — exactly what
+    the background thread folds every PADDLE_OBS_SAMPLE_S seconds) and
+    reports the mean per-sample overhead so tools/bench_diff.py can
+    gate it, plus samples/drops/rules_fired for the record.  Never
+    kills the metric."""
+    try:
+        from paddle_tpu.obs import telemetry
+
+        wd = telemetry.Watchdog(artifacts_dir=None)
+        col = telemetry.Collector(sources=telemetry.default_sources(),
+                                  sample_s=1.0, watchdog=wd)
+        n = 50
+        fired = 0
+        for _ in range(n):
+            fired += len(col.sample_once())
+        return {
+            "sampler_overhead_ms": round(col.sampler_overhead_ms / n,
+                                         4),
+            "samples": col.samples,
+            "drops": col.drops(),
+            "rules_fired": fired,
+            "series": len(col.store.names()),
+        }
+    except Exception as e:  # noqa: BLE001 - observability is optional
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def _persist_onchip(result):
     try:
         with open(ONCHIP_RECORD, "w") as f:
@@ -926,6 +956,8 @@ def main():
             lambda: bench_checkpoint(jax, jnp), timeout_s=120,
             what="checkpoint bench")
         out["detail"]["obs"] = _obs_detail()
+        out["detail"]["telemetry"] = _run_with_watchdog(
+            bench_telemetry, timeout_s=120, what="telemetry bench")
         print(json.dumps(out))
         return
     # full production config: attention dropout 0.1 AND a variable-length
@@ -1067,6 +1099,10 @@ def main():
         lambda: bench_checkpoint(jax, jnp), timeout_s=120,
         what="checkpoint bench")
     detail["obs"] = _obs_detail()
+    # live-telemetry sampler cost (ISSUE 10): measured AFTER the timed
+    # region over the real in-process sources, gated by bench_diff
+    detail["telemetry"] = _run_with_watchdog(
+        bench_telemetry, timeout_s=120, what="telemetry bench")
     result = {
         "metric": ("bert_base_pretrain_mfu" if on_tpu
                    else "bert_tiny_pretrain_mfu_cpu"),

@@ -85,6 +85,16 @@ WATCHLIST: List[Tuple[str, str]] = [
     ("paddle_tpu/obs/tracing.py", "Tracer._record"),
     ("paddle_tpu/obs/tracing.py", "Span.__exit__"),
     ("paddle_tpu/obs/cost.py", "ProgramCost.observe_dispatch"),
+    # live telemetry (ISSUE 10): the sampler thread, the watchdog
+    # evaluator and the HTTP handler all run CONCURRENTLY with every
+    # watched loop above — they read host-side ring buffers and counter
+    # tables only; a sync here would stall training/serving from the
+    # monitoring plane
+    ("paddle_tpu/obs/telemetry.py", "Collector.sample_once"),
+    ("paddle_tpu/obs/telemetry.py", "Collector._loop"),
+    ("paddle_tpu/obs/telemetry.py", "Watchdog.evaluate"),
+    ("paddle_tpu/obs/telemetry.py", "Watchdog.observe"),
+    ("paddle_tpu/obs/telemetry.py", "_Handler.do_GET"),
 ]
 
 # blocking / transferring constructs that must not appear unsanctioned

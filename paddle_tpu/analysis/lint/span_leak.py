@@ -39,6 +39,8 @@ MARKER = "# span-ok"
 # files/dirs whose span() call sites the rule enforces
 WATCHED = [
     "paddle_tpu/obs",
+    "paddle_tpu/obs/telemetry.py",  # explicit: the live-telemetry layer
+    # stays covered even if the obs dir entry is ever narrowed
     "paddle_tpu/ckpt",
     "paddle_tpu/profiler",
     "paddle_tpu/fluid/executor.py",

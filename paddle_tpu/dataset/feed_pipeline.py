@@ -402,6 +402,14 @@ class FeedPipeline:
         skew = compute_shard_skew(
             gather_host_feed_ms(self.epoch_feed_ms, self._count))
         profiler.time_set("shard_skew_ms", skew)
+        try:
+            # ride the collective boundary every host already reaches:
+            # refresh the telemetry endpoint's pod-merged /snapshot view
+            from .. import obs
+
+            obs.telemetry_epoch_refresh()
+        except Exception:  # noqa: BLE001 - observability, not control
+            pass
 
     # -- observability -----------------------------------------------------
     def feed_report(self) -> Dict[str, Any]:

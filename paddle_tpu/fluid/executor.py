@@ -286,6 +286,14 @@ class _NanMonitor:
                 except Exception as e:  # noqa: BLE001 - deleted buffer etc.
                     hits = [f"<flag materialization failed: {e}>"]
                 if hits:
+                    try:
+                        from ..profiler import stat_add
+
+                        # the watchdog's non_finite_loss rule samples
+                        # this counter (obs.telemetry)
+                        stat_add("nan_inf_hits_total", len(hits))
+                    except Exception:  # noqa: BLE001 - telemetry only
+                        pass
                     with self._lock:
                         self._errs.append(
                             f"NaN/Inf detected in variable {hits[0]!r} "
@@ -715,12 +723,23 @@ class Executor:
             self, program, scope if scope is not None else global_scope(),
             dataset, checkpoint_dir, checkpoint_every_steps,
             checkpoint_every_secs, checkpoint_keep, resume)
+        # PADDLE_OBS_HTTP_PORT auto-attach: live /metrics + /healthz +
+        # watchdog for this training pass (refcounted; None when unset)
+        telemetry = None
+        try:
+            from .. import obs
+
+            telemetry = obs.maybe_start_telemetry()
+        except Exception:  # noqa: BLE001 - observability, not control
+            pass
         if ckpt is not None and ckpt.skip_pass:
             # the restored checkpoint is from a LATER epoch than this
             # pass: the work this call represents already happened —
             # the epoch counter was consumed, nothing to run
             if monitor is not None:
                 monitor.stop()
+            if telemetry is not None:
+                telemetry.close()
             return None
         step = 0
         last = None
@@ -764,6 +783,8 @@ class Executor:
             stat_set("in_flight_steps", 0)
             if monitor is not None:
                 monitor.stop()
+            if telemetry is not None:
+                telemetry.close()
         if ckpt is not None:
             # end-of-pass step boundary: persist the final state and
             # surface any writer-thread error before declaring success

@@ -120,6 +120,33 @@ _define("ckpt_resume", True,
         "resume train_from_dataset from the newest complete checkpoint "
         "under ckpt_dir (scope state + executor step + exact remaining "
         "feed order)", env_var="PADDLE_CKPT_RESUME")
+# -- live telemetry (paddle_tpu.obs.telemetry, docs/observability.md):
+# the PADDLE_OBS_* env contract turns on the always-on metrics sampler,
+# /metrics + /healthz endpoint and anomaly watchdog without touching
+# the training or serving script
+_define("obs_sample_s", 1.0,
+        "telemetry sampler period in seconds: the background collector "
+        "folds profiler counters/timers and cost gauges into bounded "
+        "ring-buffer time series every N seconds",
+        env_var="PADDLE_OBS_SAMPLE_S")
+_define("obs_http_port", -1,
+        "telemetry HTTP port serving /metrics, /healthz, /snapshot and "
+        "/debug/trace on train_from_dataset and serving.Engine "
+        "(0 = ephemeral port, -1 = telemetry off)",
+        env_var="PADDLE_OBS_HTTP_PORT")
+_define("obs_flight_dir", "artifacts/flight",
+        "flight-recorder artifacts dir: a firing watchdog rule "
+        "atomically publishes a post-mortem bundle (trace + snapshot + "
+        "op-profile + series window) here",
+        env_var="PADDLE_OBS_FLIGHT_DIR")
+_define("obs_flight_keep", 5,
+        "flight-recorder retention: newest N bundles kept, older ones "
+        "and half-written tmp dirs garbage-collected on each dump",
+        env_var="PADDLE_OBS_FLIGHT_KEEP")
+_define("obs_flight_min_interval_s", 60.0,
+        "flight-recorder rate limit: at most one bundle per N seconds "
+        "(further firings only update /healthz)",
+        env_var="PADDLE_OBS_FLIGHT_MIN_INTERVAL_S")
 _define("op_callstack", False,
         "record the Python construction stack on every appended op "
         "(attrs['op_callstack']); verifier findings then point at the "

@@ -45,13 +45,20 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional
 
+import json as _json
+import os as _os
+import threading as _threading
+
 from . import cost
 from . import opprof
+from . import telemetry
 from .tracing import NULL_SPAN, TRACER, Tracer  # noqa: F401
 
 __all__ = ["span", "add_span", "new_flow", "attach_flow", "current_span",
            "enable", "disable", "enabled", "reset", "snapshot",
-           "export_trace", "op_profile", "cost", "opprof", "TRACER",
+           "export_trace", "op_profile", "cost", "opprof", "telemetry",
+           "start_telemetry", "stop_telemetry", "maybe_start_telemetry",
+           "telemetry_epoch_refresh", "telemetry_handle", "TRACER",
            "NULL_SPAN", "Tracer"]
 
 
@@ -183,6 +190,166 @@ def snapshot(all_hosts: bool = False) -> Dict[str, Any]:
     if all_hosts:
         snap["hosts"] = _gather_host_tables(local)
     return snap
+
+
+# ---------------------------------------------------------------------------
+# Live telemetry session (ISSUE 10 tentpole wiring).  The stdlib-only
+# machinery lives in obs/telemetry.py; this is the in-process glue:
+# flag/env resolution, the profiler/cost source bundle, the watchdog's
+# export callbacks, and a refcounted singleton so a training loop and a
+# serving engine in one process share a sampler + endpoint.
+# ---------------------------------------------------------------------------
+
+class _TelemetryHandle:
+    """One live telemetry session: sampler thread + optional HTTP
+    endpoint + watchdog.  `port` is the bound port (None without
+    HTTP); close() is refcount-aware via stop_telemetry()."""
+
+    def __init__(self, collector, server, watchdog):
+        self.collector = collector
+        self.server = server
+        self.watchdog = watchdog
+        self.port = server.port if server is not None else None
+
+    def close(self) -> None:
+        stop_telemetry()
+
+
+_TELEMETRY: Optional[_TelemetryHandle] = None
+_TELEMETRY_REFS = 0
+_TELEMETRY_LOCK = _threading.Lock()
+
+
+def _obs_flag(name: str, env_var: str, default, typ):
+    """Resolve a PADDLE_OBS_* knob: fluid flag first (which itself was
+    env-seeded at import), then a late env read for processes that set
+    the variable after paddle_tpu import, then the default."""
+    try:
+        from ..fluid import flags as _flags
+
+        entry = _flags._REGISTRY.get(name)
+        if entry is not None and entry["value"] != entry["default"]:
+            return typ(entry["value"])
+    except Exception:  # noqa: BLE001 - flags registry unavailable
+        pass
+    env = _os.environ.get(env_var)
+    if env is not None:
+        try:
+            return typ(env)
+        except ValueError:
+            pass
+    return default
+
+
+def start_telemetry(port: Optional[int] = None,
+                    sample_s: Optional[float] = None,
+                    flight_dir: Optional[str] = None,
+                    flight_keep: Optional[int] = None,
+                    flight_min_interval_s: Optional[float] = None,
+                    thresholds: Optional[dict] = None) -> _TelemetryHandle:
+    """Start (or join) the process-wide telemetry session: background
+    sampler over the profiler/cost tables, anomaly watchdog + flight
+    recorder, and — when `port` >= 0 (0 = ephemeral) — the /metrics +
+    /healthz + /snapshot + /debug/trace HTTP endpoint.  Refcounted:
+    every start_telemetry() must be paired with a stop_telemetry() (or
+    handle.close()); the session tears down on the last one."""
+    global _TELEMETRY, _TELEMETRY_REFS
+    with _TELEMETRY_LOCK:
+        if _TELEMETRY is not None:
+            _TELEMETRY_REFS += 1
+            return _TELEMETRY
+        if port is None:
+            port = _obs_flag("obs_http_port", "PADDLE_OBS_HTTP_PORT",
+                             -1, int)
+        if sample_s is None:
+            sample_s = _obs_flag("obs_sample_s", "PADDLE_OBS_SAMPLE_S",
+                                 telemetry.DEFAULT_SAMPLE_S, float)
+        if flight_dir is None:
+            flight_dir = _obs_flag("obs_flight_dir",
+                                   "PADDLE_OBS_FLIGHT_DIR",
+                                   "artifacts/flight", str)
+        if flight_keep is None:
+            flight_keep = _obs_flag("obs_flight_keep",
+                                    "PADDLE_OBS_FLIGHT_KEEP", 5, int)
+        if flight_min_interval_s is None:
+            flight_min_interval_s = _obs_flag(
+                "obs_flight_min_interval_s",
+                "PADDLE_OBS_FLIGHT_MIN_INTERVAL_S", 60.0, float)
+        watchdog = telemetry.Watchdog(
+            thresholds=thresholds,
+            artifacts_dir=flight_dir or None,
+            keep=flight_keep,
+            min_interval_s=flight_min_interval_s,
+            trace_cb=export_trace,
+            snapshot_cb=snapshot,
+            op_profile_cb=opprof.snapshot)
+        collector = telemetry.Collector(
+            sources=telemetry.default_sources(),
+            sample_s=sample_s, watchdog=watchdog)
+
+        def _overhead(ms: float) -> None:
+            from .. import profiler
+
+            profiler.time_add("telemetry_sample_ms", ms)
+
+        collector.overhead_cb = _overhead
+        collector.snapshot_cb = snapshot
+        collector.trace_json_cb = TRACER.chrome_trace
+        server = None
+        if port is not None and port >= 0:
+            server = telemetry.TelemetryServer(collector,
+                                               port=port).start()
+        collector.start()
+        _TELEMETRY = _TelemetryHandle(collector, server, watchdog)
+        _TELEMETRY_REFS = 1
+        return _TELEMETRY
+
+
+def stop_telemetry() -> None:
+    """Release one reference on the telemetry session; the sampler and
+    endpoint shut down when the last holder releases."""
+    global _TELEMETRY, _TELEMETRY_REFS
+    with _TELEMETRY_LOCK:
+        if _TELEMETRY is None:
+            return
+        _TELEMETRY_REFS -= 1
+        if _TELEMETRY_REFS > 0:
+            return
+        handle, _TELEMETRY, _TELEMETRY_REFS = _TELEMETRY, None, 0
+    handle.collector.stop()
+    if handle.server is not None:
+        handle.server.stop()
+
+
+def maybe_start_telemetry() -> Optional[_TelemetryHandle]:
+    """The PADDLE_OBS_HTTP_PORT auto-attach seam used by
+    Executor.train_from_dataset and serving.Engine: starts (or joins)
+    the telemetry session when the port knob is set (>= 0), returns
+    None — no thread, no endpoint, no overhead — when it is not."""
+    port = _obs_flag("obs_http_port", "PADDLE_OBS_HTTP_PORT", -1, int)
+    if port is None or port < 0:
+        return None
+    return start_telemetry(port=port)
+
+
+def telemetry_handle() -> Optional[_TelemetryHandle]:
+    return _TELEMETRY
+
+
+def telemetry_epoch_refresh() -> None:
+    """Refresh the telemetry endpoint's pod-merged `/snapshot` view.
+    Rides the existing epoch-boundary collective (the shard_skew_ms
+    gather in dataset.feed_pipeline._finish_epoch) so the all-gather
+    happens where every host already participates; a no-op without a
+    live session."""
+    handle = _TELEMETRY
+    if handle is None:
+        return
+    try:
+        handle.collector.refresh_merged(
+            lambda: snapshot(all_hosts=True))
+    except Exception:  # noqa: BLE001 - observability, not control flow
+        pass
 
 
 def export_trace(path: str, include_snapshot: bool = True) -> int:

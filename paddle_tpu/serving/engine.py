@@ -245,6 +245,15 @@ class Engine:
         if self._started:
             return self
         self._started = True
+        # PADDLE_OBS_HTTP_PORT auto-attach: live /metrics + /healthz +
+        # watchdog for this engine (refcounted; None when unset)
+        self._telemetry = None
+        try:
+            from .. import obs
+
+            self._telemetry = obs.maybe_start_telemetry()
+        except Exception:  # noqa: BLE001 - observability, not control
+            pass
         for name, target in (("serving-dispatch", self._dispatch_loop),
                              ("serving-compile", self._compiler_loop),
                              ("serving-complete", self._completer_loop)):
@@ -283,6 +292,10 @@ class Engine:
             for req in item[0]:
                 req.set_exception(EngineClosed("engine shut down with "
                                                "request in flight"))
+        telemetry = getattr(self, "_telemetry", None)
+        if telemetry is not None:
+            self._telemetry = None
+            telemetry.close()
 
     def __enter__(self) -> "Engine":
         return self
@@ -639,6 +652,13 @@ class AutoregressiveEngine:
         step() directly for determinism."""
         if self._serve_thread is not None:
             return self
+        if getattr(self, "_telemetry", None) is None:
+            try:
+                from .. import obs
+
+                self._telemetry = obs.maybe_start_telemetry()
+            except Exception:  # noqa: BLE001 - observability only
+                self._telemetry = None
 
         def loop():
             while not self._stop.is_set():
@@ -676,6 +696,10 @@ class AutoregressiveEngine:
         for req in pending:
             self._admission.release()
             req._finish(exc=EngineClosed("engine shut down"))
+        telemetry = getattr(self, "_telemetry", None)
+        if telemetry is not None:
+            self._telemetry = None
+            telemetry.close()
 
     # -- internals ---------------------------------------------------------
     def _free_slots(self) -> List[int]:

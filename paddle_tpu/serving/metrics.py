@@ -16,6 +16,7 @@ Int stats (get_int_stats):
 | serving_completed_total       | requests answered                       |
 | serving_batches_total         | batches dispatched                      |
 | serving_batch_rows_total      | summed request rows over all batches    |
+| serving_batch_requests_total  | summed request count over all batches   |
 | serving_batch_occupancy_max   | largest per-batch request count seen    |
 | serving_queue_depth           | gauge: requests currently queued        |
 | serving_in_flight             | gauge: batches dispatched, not complete |
@@ -66,11 +67,15 @@ def record_latency(name: str, ms: float) -> None:
 def latency_stats(name: str = "serving_request_ms") -> Optional[dict]:
     """{count, mean_ms, p50_ms, p99_ms, max_ms} for `name`, or None if
     nothing was recorded."""
+    # copy under the lock, sort OUTSIDE it: an 8192-entry sort inside
+    # _LAT_LOCK would block the completer thread's record_latency on
+    # every stats scrape (the telemetry sampler polls this per sample)
     with _LAT_LOCK:
         q = _LAT.get(name)
-        vals = sorted(q) if q else None
+        vals = list(q) if q else None
     if not vals:
         return None
+    vals.sort()
 
     def pct(p):
         i = min(len(vals) - 1, int(round(p / 100.0 * (len(vals) - 1))))

@@ -383,6 +383,21 @@ def test_transforms_on_hot_path_watchlist():
         assert ("paddle_tpu/transforms/__init__.py", qual) in watched
 
 
+def test_telemetry_on_hot_path_watchlist():
+    """ISSUE 10: the live-telemetry entry points are lint-watched — the
+    sampler thread, the watchdog evaluator and the HTTP handler run
+    concurrently with every training/serving loop and must read
+    host-side tables only; obs/telemetry.py is also in the span-leak
+    watched set, and test_shipped_tree_is_lint_clean above proves the
+    shipped tree honors both."""
+    watched = set(lint.hot_path_sync.WATCHLIST)
+    for qual in ("Collector.sample_once", "Collector._loop",
+                 "Watchdog.evaluate", "Watchdog.observe",
+                 "_Handler.do_GET"):
+        assert ("paddle_tpu/obs/telemetry.py", qual) in watched
+    assert "paddle_tpu/obs/telemetry.py" in lint.span_leak.WATCHED
+
+
 def test_hot_path_rule_fires_on_unsanctioned_sync(tmp_path):
     bad = tmp_path / "paddle_tpu" / "fluid"
     bad.mkdir(parents=True)

@@ -18,6 +18,9 @@ Metrics compared (each only when present in BOTH files):
   interior_transposes  detail...layout.interior_transposes (ANY rise)
   op_attribution_pct   detail...op_profile.attributed_flops_pct
                                                        (drop > 5 abs)
+  telemetry_overhead_ms  detail.telemetry.sampler_overhead_ms
+                         (rise > 50% rel AND > 2 ms abs — the live
+                         sampler must stay invisible next to a step)
 
 Exit status: 1 when any regression fires AND the current run is
 on-chip; under `device_class: cpu-fallback` (or a stale re-emitted
@@ -50,6 +53,7 @@ DEFAULT_THRESHOLDS = {
     "collective_bytes": ("down", 0.10, 1024.0),
     "interior_transposes": ("down", 0.0, 0.0),
     "op_attribution_pct": ("up", 0.0, 5.0),
+    "telemetry_overhead_ms": ("down", 0.5, 2.0),
 }
 
 
@@ -94,6 +98,9 @@ def extract_metrics(doc: dict) -> Dict[str, float]:
         if isinstance(ap, (int, float)):
             out["op_attribution_pct"] = float(ap)
             break
+    tel = _get(detail, "telemetry", "sampler_overhead_ms")
+    if isinstance(tel, (int, float)):
+        out["telemetry_overhead_ms"] = float(tel)
     return out
 
 
@@ -181,13 +188,17 @@ def run_gate(baseline_path: str, current_path: str, strict: bool,
 # ---------------------------------------------------------------------------
 
 def _synthetic(mfu: float, step_ms: float, transposes: int = 0,
-               coll_bytes: int = 4096, device_class: str = "tpu") -> dict:
+               coll_bytes: int = 4096, device_class: str = "tpu",
+               telemetry_ms: float = 0.5) -> dict:
     return {
         "metric": "bert_base_pretrain_mfu",
         "value": mfu, "unit": "%", "vs_baseline": mfu / 45.0,
         "detail": {
             "device_class": device_class,
             "step_ms": step_ms,
+            "telemetry": {"sampler_overhead_ms": telemetry_ms,
+                          "samples": 50, "drops": 0,
+                          "rules_fired": 0},
             "obs": {"cost": {"collective_bytes":
                              {"c_allreduce_sum": coll_bytes}}},
             "resnet50": {"metric": "resnet50_images_per_sec_per_chip",
@@ -241,7 +252,19 @@ def selftest(verbose: bool = True) -> int:
     checks.append(("4x collective bytes fires",
                    any(r["metric"] == "collective_bytes"
                        and r["regressed"] for r in rows)))
-    # 8. stale re-emitted on-chip record is warn-only
+    # 8. telemetry sampler-overhead blowup fires; a sub-floor wiggle
+    # does not (the sampler gate must not flap on sub-ms noise)
+    cur_tel = _synthetic(mfu=42.0, step_ms=100.0, telemetry_ms=5.0)
+    rows = diff(base, cur_tel)
+    checks.append(("10x telemetry overhead fires",
+                   any(r["metric"] == "telemetry_overhead_ms"
+                       and r["regressed"] for r in rows)))
+    cur_tel_ok = _synthetic(mfu=42.0, step_ms=100.0, telemetry_ms=1.2)
+    rows = diff(base, cur_tel_ok)
+    checks.append(("sub-floor telemetry wiggle passes",
+                   not any(r["metric"] == "telemetry_overhead_ms"
+                           and r["regressed"] for r in rows)))
+    # 9. stale re-emitted on-chip record is warn-only
     stale = dict(base)
     stale["detail"] = dict(base["detail"], stale_s=1234)
     checks.append(("stale on-chip record is warn-only",

@@ -20,7 +20,7 @@ python -m pytest tests/ -x -q
 echo "== static analysis: tpulint rules + op-test coverage floor =="
 python tools/run_lints.py
 
-echo "== observability: tracetool selftest (spans + op-profile walk) =="
+echo "== observability: tracetool selftest (spans + op-profile walk + telemetry metrics replay) =="
 python tools/tracetool.py selftest
 
 echo "== perf gate: bench_diff selftest (regression detection) =="

@@ -19,15 +19,22 @@ viewer:
              found in a trace's embedded snapshot, a BENCH JSON, a
              saved profile JSON, or computed fresh from a raw
              optimized-HLO dump (obs/opprof.py walks it)
+  metrics    live-telemetry post-mortem (ISSUE 10): per-metric
+             min/mean/max/last over a telemetry JSON dump (a flight
+             bundle's series.json or the /metrics?format=json body
+             saved to a file) plus which watchdog rules WOULD have
+             fired replayed over the series
   selftest   build a synthetic multi-thread trace through the span
              layer, export it, summarize it, verify the invariants
-             end to end, and run the op-profile HLO walk + top-ops
-             rendering over a synthetic HLO dump (wired into
-             tools/ci.sh)
+             end to end, run the op-profile HLO walk + top-ops
+             rendering over a synthetic HLO dump, and drive the
+             telemetry collector/watchdog/flight-recorder over
+             scripted sources (wired into tools/ci.sh)
 
-stdlib-only; paddle_tpu.obs.tracing and obs.opprof are loaded by FILE
-PATH (the tpulint idiom), so this tool runs in environments without
-jax.  Exit status: 0 ok, 1 findings/failure, 2 usage error.
+stdlib-only; paddle_tpu.obs.tracing, obs.opprof and obs.telemetry are
+loaded by FILE PATH (the tpulint idiom), so this tool runs in
+environments without jax.  Exit status: 0 ok, 1 findings/failure,
+2 usage error.
 """
 
 from __future__ import annotations
@@ -44,6 +51,7 @@ from typing import Dict, List, Optional
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _TRACING = os.path.join(REPO_ROOT, "paddle_tpu", "obs", "tracing.py")
 _OPPROF = os.path.join(REPO_ROOT, "paddle_tpu", "obs", "opprof.py")
+_TELEMETRY = os.path.join(REPO_ROOT, "paddle_tpu", "obs", "telemetry.py")
 
 
 def _load_by_path(name: str, path: str):
@@ -65,6 +73,10 @@ def load_tracing():
 
 def load_opprof():
     return _load_by_path("paddle_tpu_obs_opprof", _OPPROF)
+
+
+def load_telemetry():
+    return _load_by_path("paddle_tpu_obs_telemetry", _TELEMETRY)
 
 
 def load_trace(path: str) -> dict:
@@ -301,6 +313,65 @@ def top_ops_cmd(path: str, top: int, key: str, as_json: bool) -> int:
 
 
 # ---------------------------------------------------------------------------
+# metrics (live-telemetry dump post-mortem)
+# ---------------------------------------------------------------------------
+
+def load_metrics_doc(path: str) -> dict:
+    """A telemetry JSON dump: Collector.to_json() output — a flight
+    bundle's series.json, or the /metrics?format=json body saved to a
+    file.  A flight-bundle DIRECTORY is accepted too (reads its
+    series.json)."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "series.json")
+    with open(path) as f:
+        doc = json.load(f)
+    if "series" not in doc:
+        raise ValueError(f"{path}: not a telemetry dump (no 'series'; "
+                         "expected Collector.to_json() output)")
+    return doc
+
+
+def print_metrics(doc: dict, rows: List[dict],
+                  fired: List[dict]) -> None:
+    health = doc.get("health") or {}
+    print(f"samples: {doc.get('samples', '?')} every "
+          f"{doc.get('sample_s', '?')} s, series: {len(rows)}, "
+          f"drops: {doc.get('drops', 0)}, sampler overhead: "
+          f"{doc.get('sampler_overhead_ms', 0)} ms total")
+    if health:
+        state = "healthy" if health.get("healthy") else "UNHEALTHY"
+        print(f"health at dump: {state}"
+              + (f" ({health['reason']})" if health.get("reason")
+                 else ""))
+    print(f"{'metric':<36}{'kind':>8}{'count':>7}{'min':>12}"
+          f"{'mean':>12}{'max':>12}{'last':>12}{'drop':>6}")
+    for r in rows:
+        print(f"{r['metric']:<36}{r['kind']:>8}{r['count']:>7}"
+              f"{r['min']:>12.4g}{r['mean']:>12.4g}{r['max']:>12.4g}"
+              f"{r['last']:>12.4g}{r['dropped']:>6}")
+    if fired:
+        print("watchdog replay: rules that would have fired:")
+        for f in fired:
+            print(f"  [{f['rule']}] at sample {f['sample']}: "
+                  f"{f['reason']}")
+    else:
+        print("watchdog replay: no rule fires over this series")
+
+
+def metrics_cmd(path: str, as_json: bool) -> int:
+    telemetry = load_telemetry()
+    doc = load_metrics_doc(path)
+    rows = telemetry.series_stats(doc)
+    fired = telemetry.replay_rules(doc)
+    if as_json:
+        print(json.dumps({"stats": rows, "fired": fired,
+                          "health": doc.get("health")}))
+    else:
+        print_metrics(doc, rows, fired)
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # selftest
 # ---------------------------------------------------------------------------
 
@@ -357,6 +428,106 @@ def _opprof_selftest_checks() -> List[tuple]:
         ("top-ops: dot ranks first by flops",
          bool(top) and top[0]["op"] == "program#7/block0/op1:mul"),
     ]
+
+def _telemetry_selftest_checks() -> List[tuple]:
+    """The live-telemetry half of the selftest: drive the collector,
+    watchdog and flight recorder (loaded by file path — no jax) over
+    scripted sources, then replay the rules from the JSON dump the
+    `metrics` subcommand consumes."""
+    import shutil as _shutil
+
+    telemetry = load_telemetry()
+    checks: List[tuple] = []
+
+    # scripted sources: a healthy ramp, then a step-time spike + a NaN
+    state = {"steps": 0, "step_ms": 10.0, "nan_hits": 0}
+
+    def sources():
+        state["steps"] += 100
+        return {"counters": {"executor_steps_total": state["steps"],
+                             "nan_inf_hits_total": state["nan_hits"]},
+                "timers_ms": {},
+                "gauges": {"step_ms": state["step_ms"],
+                           "mfu_pct": 40.0}}
+
+    tmpdir = tempfile.mkdtemp(prefix="tracetool_telemetry_")
+    try:
+        clock = {"t": 1000.0}
+        wd = telemetry.Watchdog(artifacts_dir=tmpdir, keep=2,
+                                min_interval_s=30.0,
+                                clock=lambda: clock["t"])
+        col = telemetry.Collector(sources=sources, sample_s=1.0,
+                                  capacity=16, watchdog=wd,
+                                  clock=lambda: clock["t"])
+        for _ in range(8):
+            clock["t"] += 1.0
+            col.sample_once()
+        checks.append(("telemetry: healthy run fires nothing",
+                       wd.healthy and not os.listdir(tmpdir)))
+        checks.append(("telemetry: counters sampled as deltas",
+                       col.store.vals("executor_steps_total")[1:]
+                       == [100.0] * 7))
+        checks.append(("telemetry: gauges sampled as levels",
+                       col.store.last("step_ms") == 10.0))
+
+        state["step_ms"] = 200.0   # 20x the rolling median
+        state["nan_hits"] = 3      # non-finite loss
+        clock["t"] += 1.0
+        fired = col.sample_once()
+        rules = {f["rule"] for f in fired}
+        checks.append(("telemetry: step spike + NaN fire the watchdog",
+                       {"step_time_spike", "non_finite_loss"} <= rules))
+        checks.append(("telemetry: /healthz flips with a reason",
+                       not wd.healthy and "step_ms"
+                       in (wd.reason or "")))
+        bundles = [n for n in os.listdir(tmpdir)
+                   if n.startswith(telemetry.BUNDLE_PREFIX)]
+        checks.append(("telemetry: flight bundle published",
+                       len(bundles) == 1))
+        bundle = os.path.join(tmpdir, bundles[0]) if bundles else None
+        checks.append(("telemetry: bundle carries reason + series",
+                       bundle is not None
+                       and os.path.exists(os.path.join(bundle,
+                                                       "reason.json"))
+                       and os.path.exists(os.path.join(bundle,
+                                                       "series.json"))))
+
+        # rate limit: an immediate second anomaly must NOT dump again
+        clock["t"] += 1.0
+        col.sample_once()
+        checks.append(("telemetry: second dump rate-limited",
+                       wd.dumps_rate_limited >= 1
+                       and wd.bundles_written == 1))
+        # past the window: dumps again, retention keeps newest `keep`
+        for _ in range(3):
+            clock["t"] += 31.0
+            col.sample_once()
+        bundles = [n for n in os.listdir(tmpdir)
+                   if n.startswith(telemetry.BUNDLE_PREFIX)]
+        checks.append(("telemetry: GC keeps newest bundles",
+                       wd.bundles_written >= 3 and len(bundles) == 2))
+
+        # the metrics-subcommand surface over the same dump
+        doc = col.to_json()
+        rows = telemetry.series_stats(doc)
+        by_name = {r["metric"]: r for r in rows}
+        checks.append(("telemetry: series_stats rows complete",
+                       by_name.get("step_ms", {}).get("max") == 200.0
+                       and by_name.get("executor_steps_total",
+                                       {}).get("last") == 100.0))
+        replay = {f["rule"] for f in telemetry.replay_rules(doc)}
+        checks.append(("telemetry: replay re-fires the rules",
+                       {"step_time_spike", "non_finite_loss"}
+                       <= replay))
+        prom = telemetry.prometheus_text(col)
+        checks.append(("telemetry: prometheus text renders",
+                       "# TYPE paddle_tpu_step_ms gauge" in prom
+                       and "paddle_tpu_healthy 0" in prom
+                       and "paddle_tpu_executor_steps_total" in prom))
+    finally:
+        _shutil.rmtree(tmpdir, ignore_errors=True)
+    return checks
+
 
 def selftest(verbose: bool = True) -> int:
     """Build a 3-thread trace with flow links through the span layer,
@@ -428,6 +599,7 @@ def selftest(verbose: bool = True) -> int:
              s["stall_attribution"] == "compute-bound"),
         ]
         checks += _opprof_selftest_checks()
+        checks += _telemetry_selftest_checks()
         failed = [name for name, ok in checks if not ok]
         if verbose:
             for name, ok in checks:
@@ -469,8 +641,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                        choices=["flops", "bytes", "transposes",
                                 "collective_bytes"])
     p_top.add_argument("--json", action="store_true")
-    sub.add_parser("selftest", help="exercise the span layer + the "
-                                    "op-profile HLO walk end to end")
+    p_met = sub.add_parser(
+        "metrics", help="per-metric stats + watchdog-rule replay over "
+        "a telemetry JSON dump (or a flight-bundle dir)")
+    p_met.add_argument("dump")
+    p_met.add_argument("--json", action="store_true")
+    sub.add_parser("selftest", help="exercise the span layer, the "
+                                    "op-profile HLO walk and the "
+                                    "telemetry collector/watchdog end "
+                                    "to end")
     args = ap.parse_args(argv)
 
     if args.cmd == "summarize":
@@ -491,6 +670,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.cmd == "top-ops":
         return top_ops_cmd(args.artifact, args.top, args.key,
                            args.json)
+    if args.cmd == "metrics":
+        return metrics_cmd(args.dump, args.json)
     if args.cmd == "selftest":
         return selftest()
     ap.print_help()
