@@ -1,6 +1,7 @@
-"""Static analysis for the Program IR and the codebase itself (ISSUE 3).
+"""Static analysis for the Program IR and the codebase itself (ISSUE 3,
+ISSUE 11).
 
-Two halves:
+Three halves:
 
 * `analysis.verifier` — the Program verifier: a pass pipeline checking
   structural invariants (op registry, def-before-use, block linkage)
@@ -8,6 +9,14 @@ Two halves:
   collective order, dead code) over `fluid.framework.Program`, run by
   the Executor/CompiledProgram once per compile-cache miss under
   `FLAGS_verify_program`.
+* `analysis.shape_check` + `analysis.collective_order` — the
+  post-transform passes (ISSUE 11): `shape-consistency` replays
+  shape/dtype inference op-by-op over the FINAL (transformed) graph
+  via an abstract interpreter with loop-carried-var widening, and
+  `cross-program-collective-order` diffs collective issue-order
+  signatures across programs in one clone family (train step vs eval
+  clone on the same mesh).  Importing this package registers both in
+  the verifier pipeline.
 * `analysis.lint` — tpulint, the multi-rule source lint framework
   (hot-path sync discipline, serving lock order, untraced jit side
   effects), driven by `tools/tpulint.py` / `tools/run_lints.py` and
@@ -19,10 +28,23 @@ See docs/static_analysis.md.
 from .verifier import (ERROR, INFO, WARNING, Finding,  # noqa: F401
                        ProgramVerificationError, VerifyContext,
                        maybe_verify_program, register_pass,
-                       registered_passes, verify_program)
+                       registered_passes, reset_finding_dedup,
+                       verify_program)
+from .shape_check import (FALLBACK_SHAPE_RULES, ShapeInferBail,  # noqa: F401
+                          ShapeInferSkip, check_program,
+                          check_program_dict, infer_op_outputs,
+                          log_bailout_once)
+from .collective_order import (collective_signature,  # noqa: F401
+                               reset_ring_registry,
+                               ring_registry_snapshot)
 
 __all__ = [
     "ERROR", "WARNING", "INFO", "Finding", "ProgramVerificationError",
     "VerifyContext", "maybe_verify_program", "register_pass",
-    "registered_passes", "verify_program",
+    "registered_passes", "reset_finding_dedup", "verify_program",
+    "FALLBACK_SHAPE_RULES", "ShapeInferBail", "ShapeInferSkip",
+    "check_program", "check_program_dict", "infer_op_outputs",
+    "log_bailout_once",
+    "collective_signature", "reset_ring_registry",
+    "ring_registry_snapshot",
 ]

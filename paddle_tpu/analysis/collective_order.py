@@ -1,0 +1,193 @@
+"""Cross-program collective-order verification (ISSUE 11, pass 2).
+
+The per-program `collective-order` pass (verifier.py) proves one
+program issues ring collectives in a replica-uniform order.  It cannot
+see ACROSS programs: a train step and its eval clone run on the same
+mesh and the same rings, and if host A is in the train step while
+host B is already in eval (or the two programs simply interleave
+collectives differently after a transform rewrote one of them), the
+ring pairing deadlocks or silently mixes tensors.  TensorFlow's
+placement-time graph checks (arxiv 1605.08695) catch this class before
+launch; we do the same at the compile-cache-miss seam.
+
+Mechanism: a process-wide **ring registry**.  Every time
+`Executor._prepare` / `CompiledProgram._compile` verifies a program
+(once per compile-cache miss, via `maybe_verify_program`), this pass
+
+1. computes the program's **collective signature** — the issue-order
+   sequence of `(ring_id, op_type)` over every block, p2p send/recv
+   excluded (the pairing queue owns those);
+2. diffs it against the signatures of other programs in the same
+   **clone family** (`Program.clone_root` — a program and its
+   `clone()`s, i.e. exactly the train-step/eval-clone pairs that share
+   a mesh; unrelated programs that merely default to ring 0 are not
+   compared, so independent models in one process stay independent);
+3. errors on an **interleave mismatch**: after projecting both
+   signatures onto their shared rings, the shorter must be an ordered
+   subsequence of the longer (an eval clone that pruned its backward
+   collectives is fine; a reordering is not).
+
+Only programs that verify clean are recorded, so one bad rewrite does
+not poison every later comparison.  The registry is bounded and
+resettable (`reset_ring_registry`, used by tests and program zoo
+sweeps).
+
+Stdlib-only at module scope — loadable by tools/shapecheck.py without
+jax, like shape_check.py.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from .verifier import (ERROR, Finding, VerifyContext, _P2P,
+                       _is_collective, register_pass)
+
+# one signature entry: (ring_id, op_type, block_idx, op_id)
+SigEntry = Tuple[int, str, int, int]
+
+# clone_root -> {prog_id: (version, signature)}; only clean programs
+_RING_REGISTRY: Dict[int, Dict[int, Tuple[int, List[SigEntry]]]] = {}
+
+_MAX_FAMILIES = 256  # long-running multi-tenant process backstop
+
+
+def collective_signature(program) -> List[SigEntry]:
+    """The issue-order ring-collective sequence over every block,
+    sub-blocks inlined at their owner op's position (that IS the issue
+    order under the lowering), p2p ops excluded."""
+    sig: List[SigEntry] = []
+
+    def walk(blk, visited):
+        for op in blk.ops:
+            if _is_collective(op.type) and op.type not in _P2P:
+                ring = op.attr("ring_id", 0)
+                sig.append((int(ring or 0), op.type, blk.idx, op.id))
+            sb = op.attr("sub_block")
+            if isinstance(sb, int) and 0 < sb < len(program.blocks) \
+                    and sb not in visited:
+                walk(program.blocks[sb], visited | {sb})
+
+    if getattr(program, "blocks", None):
+        walk(program.blocks[0], {0})
+    return sig
+
+
+def _project(sig: List[SigEntry], rings) -> List[SigEntry]:
+    return [e for e in sig if e[0] in rings]
+
+
+def _embed_mismatch(short: List[SigEntry],
+                    long: List[SigEntry]) -> Optional[int]:
+    """Greedy subsequence embedding of `short` into `long`; returns the
+    index of the first `short` entry that cannot be matched in order,
+    or None when `short` embeds completely."""
+    j = 0
+    for i, e in enumerate(short):
+        key = (e[0], e[1])
+        while j < len(long) and (long[j][0], long[j][1]) != key:
+            j += 1
+        if j >= len(long):
+            return i
+        j += 1
+    return None
+
+
+def _diff_signatures(cur: List[SigEntry], other: List[SigEntry]):
+    """Interleave-compatibility of two signatures over their shared
+    rings.  Returns None when compatible, else
+    (mismatch_entry_in_cur, cur_proj, other_proj)."""
+    shared = {e[0] for e in cur} & {e[0] for e in other}
+    if not shared:
+        return None
+    pc, po = _project(cur, shared), _project(other, shared)
+    if len(pc) <= len(po):
+        i = _embed_mismatch(pc, po)
+        if i is None:
+            return None
+        return pc[i], pc, po
+    i = _embed_mismatch(po, pc)
+    if i is None:
+        return None
+    # `other` (the shorter) fails to embed into the current program:
+    # anchor provenance on the current op where matching got stuck —
+    # the first current entry the other sequence's unmatched op
+    # should have aligned with
+    key = (po[i][0], po[i][1])
+    for e in pc:
+        if (e[0], e[1]) == key:
+            return e, pc, po
+    return pc[-1] if pc else po[i], pc, po
+
+
+def _fmt(sig: List[SigEntry], limit: int = 8) -> str:
+    s = ", ".join(f"{t}@ring{r}" for r, t, _b, _o in sig[:limit])
+    if len(sig) > limit:
+        s += f", ... ({len(sig)} total)"
+    return s or "<empty>"
+
+
+def _op_by_id(program, block_idx: int, op_id: int):
+    try:
+        for op in program.blocks[block_idx].ops:
+            if op.id == op_id:
+                return op
+    except Exception:  # noqa: BLE001 - provenance lookup must not raise
+        pass
+    return None
+
+
+@register_pass("cross-program-collective-order")
+def cross_program_collective_order(ctx: VerifyContext) -> List[Finding]:
+    """ERROR-tier pass: diff this program's collective signature against
+    every previously-verified program in its clone family."""
+    prog = ctx.program
+    family = getattr(prog, "clone_root", None)
+    if family is None:
+        return []
+    sig = collective_signature(prog)
+    if not sig:
+        return []  # no collectives: trivially compatible, not recorded
+    prog_id = getattr(prog, "prog_id", id(prog))
+    version = getattr(prog, "version", 0)
+
+    findings: List[Finding] = []
+    fam = _RING_REGISTRY.get(family, {})
+    for other_id, (other_ver, other_sig) in fam.items():
+        if other_id == prog_id:
+            continue
+        diff = _diff_signatures(sig, other_sig)
+        if diff is None:
+            continue
+        entry, pc, po = diff
+        ring, op_type, block_idx, op_id = entry
+        op = _op_by_id(prog, block_idx, op_id)
+        findings.append(ctx.finding(
+            ERROR, "cross-program-collective-order",
+            f"collective issue order diverges from program#{other_id} "
+            f"(v{other_ver}, same clone family — e.g. a train step vs "
+            f"its eval clone on one mesh): this program issues "
+            f"[{_fmt(pc)}] where the other issues [{_fmt(po)}] on the "
+            f"shared ring(s); replicas running different programs "
+            f"would pair mismatched collectives and deadlock — make "
+            f"the shorter sequence an ordered subsequence of the "
+            f"longer", op=op,
+            var=f"ring{ring}" if op is None else None))
+        break  # one diff per verify call is enough signal
+
+    if not findings:
+        if len(_RING_REGISTRY) >= _MAX_FAMILIES \
+                and family not in _RING_REGISTRY:
+            _RING_REGISTRY.clear()
+        _RING_REGISTRY.setdefault(family, {})[prog_id] = (version, sig)
+    return findings
+
+
+def ring_registry_snapshot() -> Dict[int, Dict[int, Tuple[int, list]]]:
+    """Debug/tooling view of the recorded signatures."""
+    return {fam: dict(progs) for fam, progs in _RING_REGISTRY.items()}
+
+
+def reset_ring_registry() -> None:
+    """Forget all recorded signatures (tests, program-zoo sweeps)."""
+    _RING_REGISTRY.clear()

@@ -14,9 +14,14 @@ lint time:
    Condition` assigned to a `self.<attr>` (or class-level) slot; its
    node id is `Class.attr`.  Within a `with <lock>:` body, a direct
    nested acquisition adds edge A->B, and a call into a method whose
-   transitive lock set (fixpoint over the intra-fileset call graph,
-   `self.`-rooted receivers resolved through constructor assignments
-   like `self._batcher = DynamicBatcher(...)`) contains B adds A->B.
+   transitive lock set (fixpoint over the intra-fileset call graph)
+   contains B adds A->B.  Receivers resolve through constructor
+   assignments (`self._batcher = DynamicBatcher(...)`), module-level
+   constructor assignments in the same file (`_ENGINE = Engine()`),
+   and **plain locals**: `b = self._batcher` / `b = DynamicBatcher()`
+   / `b = _ENGINE` type the local, and `lk = self._lock` aliases the
+   lock itself — so `with b._lock:` and `with lk:` are real
+   acquisitions, not blind spots.
 2. **Cycles** in the edge graph are reported as errors (potential
    deadlock), as is re-acquiring a non-reentrant `Lock` already held.
 3. **Device work under a lock**: `device_put`, `jax.jit`, `.lower(...)`
@@ -28,9 +33,10 @@ lint time:
    naming convention.
 
 Suppress a line with `# lock-ok: <why>` or
-`# tpulint: disable=lock-order`.  Static limits: receivers that are
-plain local variables are not resolved (the object graph reached from
-`self` covers the real cross-class edges in this codebase).
+`# tpulint: disable=lock-order`.  Static limits: local typing is
+flow-insensitive (the last compatible assignment in the method wins)
+and receivers flowing through function parameters or containers are
+not resolved.
 """
 
 from __future__ import annotations
@@ -114,6 +120,8 @@ class _MethodScan(ast.NodeVisitor):
         self.an = analyzer
         self.cls = cls
         self.rel = rel
+        self.local_types: Dict[str, str] = {}  # local name -> class
+        self.local_locks: Dict[str, str] = {}  # local name -> lock id
         self.stack: List[str] = []  # lock ids currently held
         self.direct: Set[str] = set()
         # (held-lock, acquired-lock, line)
@@ -124,12 +132,57 @@ class _MethodScan(ast.NodeVisitor):
         self.device: List[Tuple[str, Tuple[str, ...], int]] = []
         self.reacquires: List[Tuple[str, int]] = []
 
+    # -- plain-local receiver typing ---------------------------------------
+    def prime(self, fn_node) -> None:
+        """Pre-pass over the method body typing plain locals so they
+        resolve as receivers: `b = DynamicBatcher(...)` /
+        `b = self._batcher` / `b = _MODULE_SINGLETON` type `b`, and
+        `lk = self._lock` makes `lk` a lock alias.  Flow-insensitive
+        (lint-grade): later assignments win."""
+        for node in ast.walk(fn_node):
+            if not isinstance(node, ast.Assign) \
+                    or len(node.targets) != 1 \
+                    or not isinstance(node.targets[0], ast.Name):
+                continue
+            name = node.targets[0].id
+            val = node.value
+            if isinstance(val, ast.Call):
+                ctor = _ctor_name(val)
+                if ctor in self.an.classes:
+                    self.local_types[name] = ctor
+                continue
+            chain = _attr_chain(val)
+            if not chain:
+                continue
+            if len(chain) == 1:
+                src = chain[0]
+                t = (self.local_types.get(src)
+                     or self.an.module_types.get(src))
+                if t is not None:
+                    self.local_types[name] = t
+                elif src in self.local_locks:
+                    self.local_locks[name] = self.local_locks[src]
+                continue
+            lock = self._lock_id(val)
+            if lock is not None:
+                self.local_locks[name] = lock
+                continue
+            owner = self.an.resolve_owner(self.cls, chain[:-1],
+                                          self.local_types)
+            info = self.an.classes.get(owner) if owner else None
+            t = info.attr_types.get(chain[-1]) if info else None
+            if t is not None:
+                self.local_types[name] = t
+
     # -- lock identity -----------------------------------------------------
     def _lock_id(self, expr) -> Optional[str]:
         chain = _attr_chain(expr)
-        if not chain or len(chain) < 2:
+        if not chain:
             return None
-        owner = self.an.resolve_owner(self.cls, chain[:-1])
+        if len(chain) == 1:
+            return self.local_locks.get(chain[0])
+        owner = self.an.resolve_owner(self.cls, chain[:-1],
+                                      self.local_types)
         if owner is None:
             return None
         info = self.an.classes.get(owner)
@@ -181,7 +234,8 @@ class _MethodScan(ast.NodeVisitor):
                       and chain[-1] in _LOCK_METHODS
                       and isinstance(node.func, ast.Attribute)
                       and self._lock_id(node.func.value) is not None):
-                callee = self.an.resolve_call(self.cls, chain)
+                callee = self.an.resolve_call(self.cls, chain,
+                                              self.local_types)
                 if callee is not None:
                     self.calls.append((callee, tuple(self.stack), line))
         self.generic_visit(node)
@@ -200,6 +254,7 @@ class _Analyzer:
         self.sources = sources
         self.classes: Dict[str, _ClassInfo] = {}
         self.lock_kinds: Dict[str, str] = {}  # lock id -> ctor name
+        self.module_types: Dict[str, str] = {}  # module var -> class
         self.scans: Dict[Tuple[str, str], _MethodScan] = {}
         self._trees: Dict[str, ast.Module] = {
             rel: ast.parse(src) for rel, src in sources.items()}
@@ -227,6 +282,20 @@ class _Analyzer:
                 for node in ast.walk(meth):
                     if isinstance(node, ast.Assign):
                         self._record_assign(info, node, class_level=False)
+        # module-level singletons: `_ENGINE = Engine()` at top level
+        # types the module var, so plain locals assigned from it (and
+        # lock accesses through it) resolve
+        for rel, tree in self._trees.items():
+            for node in tree.body:
+                if not isinstance(node, ast.Assign) \
+                        or not isinstance(node.value, ast.Call):
+                    continue
+                ctor = _ctor_name(node.value)
+                if ctor not in self.classes:
+                    continue
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        self.module_types[tgt.id] = ctor
         for cname, info in self.classes.items():
             for attr, ctor in info.locks.items():
                 self.lock_kinds[f"{cname}.{attr}"] = ctor
@@ -253,9 +322,14 @@ class _Analyzer:
 
     # -- receiver resolution ----------------------------------------------
     def resolve_owner(self, cls: Optional[_ClassInfo],
-                      chain: List[str]) -> Optional[str]:
+                      chain: List[str],
+                      local_types: Optional[Dict[str, str]] = None) \
+            -> Optional[str]:
         """Class name owning the object named by `chain` (e.g.
-        ["self","kv","table"] -> "PageTable"), or None."""
+        ["self","kv","table"] -> "PageTable"), or None.  `local_types`
+        maps plain-local receiver names to class names (from
+        `_MethodScan.prime`); module-level singletons resolve through
+        `module_types`."""
         if not chain:
             return None
         if chain[0] == "self":
@@ -271,20 +345,30 @@ class _Analyzer:
                     return None
                 cur = nxt
             return cur
-        if chain[0] in self.classes and len(chain) >= 1:
+        cur = None
+        rest: List[str] = []
+        if local_types and chain[0] in local_types:
+            cur, rest = local_types[chain[0]], chain[1:]
+        elif chain[0] in self.module_types:
+            cur, rest = self.module_types[chain[0]], chain[1:]
+        elif chain[0] in self.classes:
             # ClassName.attr class-level locks
             cur = chain[0]
-            for attr in chain[1:-1] if len(chain) > 2 else []:
-                info = self.classes.get(cur)
-                nxt = info.attr_types.get(attr) if info else None
-                if nxt is None:
-                    return None
-                cur = nxt
-            return cur
-        return None
+            rest = chain[1:-1] if len(chain) > 2 else []
+        if cur is None:
+            return None
+        for attr in rest:
+            info = self.classes.get(cur)
+            nxt = info.attr_types.get(attr) if info else None
+            if nxt is None:
+                return None
+            cur = nxt
+        return cur
 
     def resolve_call(self, cls: Optional[_ClassInfo],
-                     chain: List[str]) -> Optional[Tuple[str, str]]:
+                     chain: List[str],
+                     local_types: Optional[Dict[str, str]] = None) \
+            -> Optional[Tuple[str, str]]:
         """(class, method) for a call chain, or None."""
         if len(chain) == 1:
             # bare Name: a constructor of a known class counts as a call
@@ -293,7 +377,7 @@ class _Analyzer:
                     and "__init__" in self.classes[chain[0]].methods:
                 return (chain[0], "__init__")
             return None
-        owner = self.resolve_owner(cls, chain[:-1])
+        owner = self.resolve_owner(cls, chain[:-1], local_types)
         if owner is None:
             return None
         info = self.classes.get(owner)
@@ -306,6 +390,7 @@ class _Analyzer:
         for cname, info in self.classes.items():
             for mname, meth in info.methods.items():
                 scan = _MethodScan(self, info, info.rel)
+                scan.prime(meth)
                 for stmt in meth.body:
                     scan.visit(stmt)
                 self.scans[(cname, mname)] = scan

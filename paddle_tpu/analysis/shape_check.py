@@ -1,0 +1,749 @@
+"""Whole-program shape/dtype verification over the transformed graph
+(ISSUE 11 tentpole).
+
+Build-time inference (`Block._infer_shapes`) runs once per op at
+construction and never again — yet the transform pipeline
+(`layout_optimize`, `fold_bn`, `dead_op_elim`) rewrites the graph
+AFTER it, and a bad rewrite (an NHWC adapter attr on the wrong slot, a
+synthesized fold chain that drops the dtype, DCE removing a writer
+something still reads) surfaces as an unreadable JAX trace error with
+no op-level provenance.  This module replays shape/dtype inference
+op-by-op over the FINAL (post-transform) Program:
+
+* a per-block **abstract env** of `(shape, dtype)` keyed by var name,
+  where `-1` dims are symbolic (the batch dimension and anything
+  derived from it) — block envs chain to their parent like
+  `Block._var_recursive`;
+* inference is driven by `registry.eval_op_shape` (two-probe dynamic
+  dim detection, layout-adapter aware) with a **declarative fallback
+  table** for ops whose lowering cannot be abstractly evaluated — the
+  case `_infer_shapes` silently skipped before this PR;
+* `while` / `conditional_block` sub-blocks are flowed through with
+  **loop-carried-var widening**: a loop body that changes a carried
+  var's shape widens the differing dims to symbolic and re-runs once;
+  a carried dtype change is an ERROR.
+
+The same engine now backs `Block._infer_shapes` (framework.py), so
+build-time inference and post-transform verification cannot drift.
+
+Registered as the ERROR-tier verifier pass `shape-consistency`
+(analysis/verifier.py), which `Executor._prepare` /
+`CompiledProgram._compile` run once per compile-cache miss, AFTER
+`apply_transforms` — findings carry `program#<id> block<idx> op<id>`
+provenance plus the rewriting pass's `[pass=...]` tag from the op's
+`op_provenance` attr.
+
+This module imports ONLY the stdlib at module scope (jax/registry are
+imported lazily inside the eval path), so `tools/shapecheck.py` can
+load it by file path on a box without jax and still check the
+fallback-table subset — the tpulint loading idiom.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .verifier import ERROR, Finding, VerifyContext, register_pass
+
+_EMPTY = "@EMPTY@"  # framework.EMPTY_VAR_NAME (kept import-free)
+_GRAD_SUFFIX = "@GRAD"
+
+logger = logging.getLogger("paddle_tpu.shape_check")
+
+# (shape tuple with -1 = symbolic dim, canonical dtype string)
+AbstractVal = Tuple[tuple, str]
+
+# x32 policy twin of ops/registry.jdt, stdlib-only: 64-bit narrows to
+# 32-bit so declared "int64" compares equal to an int32 eval result
+_NARROW_64 = {"int64": "int32", "uint64": "uint32", "float64": "float32",
+              "complex128": "complex64"}
+
+
+def canon_dtype(name) -> str:
+    s = str(name)
+    return _NARROW_64.get(s, s)
+
+
+class ShapeInferBail(Exception):
+    """The op could not be abstractly evaluated (value-dependent
+    lowering, jax unavailable, ...) and has no fallback rule; declared
+    shapes stay authoritative for its outputs."""
+
+    def __init__(self, op_type: str, reason: str):
+        self.op_type = op_type
+        self.reason = reason
+        super().__init__(f"{op_type}: {reason}")
+
+
+class ShapeInferSkip(ShapeInferBail):
+    """No lowering rule is registered for the op type at all — the
+    caller owns the shapes by contract (not counted as a bailout)."""
+
+
+# ---------------------------------------------------------------------------
+# Declarative fallback shape rules
+# ---------------------------------------------------------------------------
+#
+# rule(op, ins) -> {slot: [(shape, dtype) | None, ...]}, where `ins`
+# maps input slots to abstract values (None = unknown/empty input).
+# Rules are pure stdlib — they are the subset tools/shapecheck.py can
+# evaluate without jax — and cover ops whose lowering is either
+# mesh-dependent (collectives: under `jax.eval_shape` there are no mesh
+# axes, so the lowering's shape behavior does not reflect a real pod
+# run) or value-dependent (recv_v2's payload pairing).
+
+def _first_in(ins, slot="X") -> Optional[AbstractVal]:
+    vals = ins.get(slot) or []
+    return vals[0] if vals else None
+
+
+def _identity_rule(op, ins):
+    return {"Out": list(ins.get("X") or [])}
+
+
+def _no_output_rule(op, ins):
+    return {}
+
+
+def _unknown_rule(op, ins):
+    # mesh-dependent result shape (the factor is the mesh axis size,
+    # which does not exist statically) and data-parallel programs mix
+    # global-shaped feeds with per-shard-declared interiors — the only
+    # honest abstract answer is "unknown"
+    return {"Out": [None]}
+
+
+def _allgather_rule(op, ins):
+    x = _first_in(ins)
+    n = int(op.attr("nranks", 0) or 0)
+    if x is None or n <= 1:
+        return {"Out": [None]}  # no static nranks: mesh decides
+    shape, dt = x
+    d0 = shape[0] if shape else 1
+    out = ((-1 if d0 == -1 else d0 * n),) + tuple(shape[1:])
+    return {"Out": [(out, dt)]}
+
+
+def _reducescatter_rule(op, ins):
+    x = _first_in(ins)
+    n = int(op.attr("nranks", 0) or 0)
+    if x is None or n <= 1:
+        return {"Out": [None]}  # no static nranks: mesh decides
+    shape, dt = x
+    d0 = shape[0] if shape else 1
+    out = ((-1 if d0 == -1 else d0 // n),) + tuple(shape[1:])
+    return {"Out": [(out, dt)]}
+
+
+def _recv_v2_rule(op, ins):
+    x = _first_in(ins)
+    if x is not None:
+        return {"Out": [x]}
+    shape = op.attr("out_shape")
+    dtype = op.attr("dtype", "float32")
+    if not shape:
+        return {"Out": [None]}
+    return {"Out": [(tuple(int(d) for d in shape), canon_dtype(dtype))]}
+
+
+FALLBACK_SHAPE_RULES: Dict[str, Callable] = {
+    # ring collectives: elementwise across replicas, shape-preserving
+    "c_allreduce_sum": _identity_rule,
+    "c_allreduce_max": _identity_rule,
+    "c_allreduce_min": _identity_rule,
+    "c_allreduce_prod": _identity_rule,
+    "mp_allreduce_sum": _identity_rule,
+    "c_reduce_sum": _identity_rule,
+    "c_broadcast": _identity_rule,
+    "c_identity": _identity_rule,
+    "barrier": _identity_rule,
+    "c_sync_calc_stream": _identity_rule,
+    "c_sync_comm_stream": _identity_rule,
+    # shape-changing collectives: a static nranks attr decides the
+    # factor; without one the mesh does, and the abstract answer is
+    # "unknown"
+    "c_allgather": _allgather_rule,
+    "c_reducescatter": _reducescatter_rule,
+    # shard-convention-changing collectives: their declared outputs are
+    # per-shard while feeds are global — never statically comparable
+    "alltoall": _unknown_rule,
+    "c_split": _unknown_rule,
+    "c_concat": _unknown_rule,
+    # p2p: send produces nothing; recv's shape is its out_shape attr
+    "send_v2": _no_output_rule,
+    "recv_v2": _recv_v2_rule,
+    # comm bootstrap no-ops
+    "c_comm_init": _no_output_rule,
+    "c_comm_init_all": _no_output_rule,
+    "c_gen_nccl_id": _no_output_rule,
+    "c_wait_calc_stream": _no_output_rule,
+    "c_wait_comm_stream": _no_output_rule,
+}
+
+# Ops whose declared output metadata is authoritative by contract: the
+# checker seeds their outputs from declared shapes and never compares.
+# Control-flow owners are handled structurally (the checker descends
+# into the sub-block instead of evaluating the op), the rest have
+# host-side / value-dependent semantics no abstract eval can see.
+OPAQUE_OPS = {
+    "while", "conditional_block", "run_program", "py_func", "print",
+    "assert", "save", "load", "feed", "fetch",
+}
+
+
+def _grad_fallback(op, lookup) -> Dict[str, AbstractVal]:
+    """Generic grad-op rule: a cotangent has exactly the shape/dtype of
+    the forward value it differentiates — `X@GRAD` (and the
+    `X@GRAD@RENAME@i` accumulation temps) mirror `X`.  Exact for every
+    vjp-derived grad op, which is all of them (ops/registry.py)."""
+    out: Dict[str, AbstractVal] = {}
+    for name in op.output_arg_names():
+        if name == _EMPTY or _GRAD_SUFFIX not in name:
+            continue
+        base = name.split(_GRAD_SUFFIX, 1)[0]
+        val = lookup(base)
+        if val is not None:
+            out[name] = val
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The shared inference engine (Block._infer_shapes rides this too)
+# ---------------------------------------------------------------------------
+
+def _declared_lookup(block) -> Callable[[str], Optional[AbstractVal]]:
+    def lookup(name: str) -> Optional[AbstractVal]:
+        blk = block
+        while blk is not None:
+            v = blk.vars.get(name)
+            if v is not None:
+                if v.shape is None:
+                    return None
+                return tuple(v.shape), canon_dtype(v.dtype)
+            blk = blk.parent_block
+        return None
+
+    return lookup
+
+
+def _gather_abstract_ins(op, lookup) -> Dict[str, list]:
+    ins: Dict[str, list] = {}
+    for slot, names in op.inputs.items():
+        ins[slot] = [lookup(n) if n != _EMPTY else None for n in names]
+    return ins
+
+
+def _bind_rule_outs(op, outs) -> Dict[str, AbstractVal]:
+    bound: Dict[str, AbstractVal] = {}
+    for slot, names in op.outputs.items():
+        vals = outs.get(slot, [])
+        for i, name in enumerate(names):
+            if name == _EMPTY or i >= len(vals) or vals[i] is None:
+                continue
+            shape, dt = vals[i]
+            bound[name] = (tuple(shape), canon_dtype(dt))
+    return bound
+
+
+def _two_probe_eval(op, block, lookup) -> Dict[str, AbstractVal]:
+    """registry.eval_op_shape under two batch probes; dims that track
+    the probe are marked symbolic (-1).  Static inputs (no -1 dims)
+    need only one probe — nothing can vary."""
+    try:
+        from ..ops import registry
+    except Exception as e:  # noqa: BLE001 - jax-free standalone load
+        raise ShapeInferBail(op.type, f"jax unavailable ({e})")
+    if not registry.has_op(op.type):
+        raise ShapeInferSkip(op.type, "no lowering rule registered")
+
+    dynamic = any(
+        -1 in val[0]
+        for slot, names in op.inputs.items()
+        for n in names if n != _EMPTY
+        for val in (lookup(n),) if val is not None)
+    probes = (3, 5) if dynamic else (3,)
+    results = []
+    for probe in probes:
+        try:
+            results.append(
+                registry.eval_op_shape(op, block, probe, lookup=lookup))
+        except Exception as e:  # noqa: BLE001 - value-dependent lowering
+            raise ShapeInferBail(op.type, f"{type(e).__name__}: {e}")
+    first = results[0]
+    second = results[-1]
+    out: Dict[str, AbstractVal] = {}
+    for slot, names in op.outputs.items():
+        shapes1 = first.get(slot, [])
+        shapes2 = second.get(slot, [])
+        for i, name in enumerate(names):
+            if name == _EMPTY or i >= len(shapes1):
+                continue
+            s1 = shapes1[i]
+            if not hasattr(s1, "shape"):
+                continue  # composite values (TensorArrayVal): no one shape
+            s2 = shapes2[i] if i < len(shapes2) else s1
+            shape = tuple(
+                -1 if a != b else a for a, b in zip(s1.shape, s2.shape))
+            out[name] = (shape, canon_dtype(s1.dtype))
+    return out
+
+
+def infer_op_outputs(op, block, lookup=None) -> Dict[str, AbstractVal]:
+    """Infer `{output var name: (shape, dtype)}` for one op.
+
+    `lookup(name) -> (shape, dtype) | None` resolves input vars; it
+    defaults to the declared shapes walked through the block chain
+    (build-time inference), and the shape-consistency pass passes its
+    abstract env instead (replay).  Raises ShapeInferBail when the op
+    cannot be evaluated (ShapeInferSkip for unregistered types)."""
+    if lookup is None:
+        lookup = _declared_lookup(block)
+    if op.attr("fwd_op_id", None) is not None:
+        return _grad_fallback(op, lookup)
+    rule = FALLBACK_SHAPE_RULES.get(op.type)
+    if rule is not None:
+        outs = rule(op, _gather_abstract_ins(op, lookup))
+        return _bind_rule_outs(op, outs)
+    return _two_probe_eval(op, block, lookup)
+
+
+_LOGGED_BAIL_TYPES: set = set()
+
+
+def log_bailout_once(op_type: str, reason: str) -> None:
+    """Satellite: un-inferable ops are visible — one log line per op
+    type per process instead of a silent `return`."""
+    if op_type in _LOGGED_BAIL_TYPES:
+        return
+    _LOGGED_BAIL_TYPES.add(op_type)
+    logger.info("shape inference bailed out for op type %r (%s); "
+                "declared shapes stay authoritative", op_type, reason)
+
+
+# ---------------------------------------------------------------------------
+# The abstract interpreter (the shape-consistency pass body)
+# ---------------------------------------------------------------------------
+
+_MAX_FINDINGS = 25  # per program: a bad rewrite cascades; cap the noise
+
+_LOOP_OWNERS = {"while"}
+_COND_OWNERS = {"conditional_block"}
+
+
+class _Env:
+    """One block's abstract env; chains to the parent block's env the
+    way `Block._var_recursive` chains declarations."""
+
+    __slots__ = ("block", "vals", "parent")
+
+    def __init__(self, block, parent: Optional["_Env"] = None):
+        self.block = block
+        self.vals: Dict[str, AbstractVal] = {}
+        self.parent = parent
+
+    def lookup(self, name: str) -> Optional[AbstractVal]:
+        e = self
+        while e is not None:
+            v = e.vals.get(name)
+            if v is not None:
+                return v
+            e = e.parent
+        return None
+
+    def bind(self, name: str, val: AbstractVal) -> None:
+        # write lands on the env whose block DECLARES the var (loop
+        # bodies assign to parent-declared loop-carried vars)
+        e = self
+        while e is not None:
+            if name in getattr(e.block, "vars", {}):
+                e.vals[name] = val
+                return
+            e = e.parent
+        self.vals[name] = val
+
+    def forget(self, name: str) -> None:
+        e = self
+        while e is not None:
+            e.vals.pop(name, None)
+            e = e.parent
+
+    def chain(self) -> List["_Env"]:
+        out, e = [], self
+        while e is not None:
+            out.append(e)
+            e = e.parent
+        return out
+
+
+def _op_prov_tag(op) -> str:
+    """The transform-provenance suffix for finding messages: carries
+    the source-op identity plus any `[pass=...]` rewrite tags."""
+    prov = op.attrs.get("op_provenance")
+    return f" (provenance: {prov})" if prov else ""
+
+
+def _shapes_conflict(inferred: tuple, declared: tuple) -> bool:
+    if len(inferred) != len(declared):
+        return True
+    return any(a != -1 and b != -1 and a != b
+               for a, b in zip(inferred, declared))
+
+
+class _Checker:
+    def __init__(self, ctx: VerifyContext):
+        self.ctx = ctx
+        self.prog = ctx.program
+        self.findings: List[Finding] = []
+        self.external = ctx.external_names()
+        self.all_written = {
+            n for blk in self.prog.blocks for op in blk.ops
+            for n in op.output_arg_names() if n != _EMPTY}
+        self.reported_vars: set = set()
+        self.bailed = 0
+
+    # -- findings ----------------------------------------------------------
+    def _err(self, message, op=None, var=None) -> None:
+        if len(self.findings) >= _MAX_FINDINGS:
+            return
+        self.findings.append(self.ctx.finding(
+            ERROR, "shape-consistency", message, op=op, var=var))
+
+    # -- env seeding -------------------------------------------------------
+    def _declared(self, block, name) -> Optional[AbstractVal]:
+        blk = block
+        seen = set()
+        while blk is not None and id(blk) not in seen:
+            seen.add(id(blk))
+            v = blk.vars.get(name)
+            if v is not None:
+                if v.shape is None:
+                    return None
+                return tuple(v.shape), canon_dtype(v.dtype)
+            blk = getattr(blk, "parent_block", None)
+        return None
+
+    def _declared_var(self, block, name):
+        blk = block
+        seen = set()
+        while blk is not None and id(blk) not in seen:
+            seen.add(id(blk))
+            v = blk.vars.get(name)
+            if v is not None:
+                return v
+            blk = getattr(blk, "parent_block", None)
+        return None
+
+    def _seed_entry(self, env: _Env) -> None:
+        """Externally-materialized vars enter the env with their
+        declared shapes: feeds (`is_data`), scope state (persistable),
+        and anything the caller names in feed/scope sets."""
+        for v in env.block.vars.values():
+            if v.shape is None:
+                continue
+            if getattr(v, "is_data", False) or v.persistable \
+                    or v.name in self.external:
+                env.vals.setdefault(
+                    v.name, (tuple(v.shape), canon_dtype(v.dtype)))
+
+    # -- input resolution --------------------------------------------------
+    def _resolve_input(self, env: _Env, block, op, name: str,
+                       owner_type) -> Optional[AbstractVal]:
+        val = env.lookup(name)
+        if val is not None:
+            return val
+        var = self._declared_var(block, name)
+        if var is None:
+            if name in self.all_written:
+                return None  # produced in a block we did not walk: unknown
+            if name not in self.reported_vars:
+                self.reported_vars.add(name)
+                self._err(
+                    f"op reads {name!r}, which is neither declared in any "
+                    f"reachable block scope nor written by any op — a "
+                    f"rewrite renamed or removed it{_op_prov_tag(op)}",
+                    op=op, var=name)
+            return None
+        if var.shape is None:
+            return None
+        declared = (tuple(var.shape), canon_dtype(var.dtype))
+        if name in self.all_written or owner_type in _LOOP_OWNERS:
+            # written later (loop-carried / forward ref): trust declared
+            return declared
+        if getattr(var, "is_data", False) or var.persistable \
+                or name in self.external:
+            return declared
+        if self.ctx.feed_names is not None:
+            # feed set is known and the var is neither fed, in scope,
+            # data, persistable, nor produced by ANY op: nothing can
+            # materialize it — the DCE-removed-writer signature
+            if name not in self.reported_vars:
+                self.reported_vars.add(name)
+                self._err(
+                    f"op reads {name!r}, which no op produces and which "
+                    f"is not fed, persistable, or data — was its writer "
+                    f"removed by a rewrite?{_op_prov_tag(op)}",
+                    op=op, var=name)
+            return None
+        return declared  # feed unknown: the var may be fed — degrade
+
+    # -- per-op ------------------------------------------------------------
+    def _check_op(self, env: _Env, block, op, owner_type) -> None:
+        inputs_known = True
+        for name in op.input_arg_names():
+            if name == _EMPTY:
+                continue
+            if self._resolve_input(env, block, op, name, owner_type) is None:
+                inputs_known = False
+        if op.type in OPAQUE_OPS:
+            for name in op.output_arg_names():
+                if name == _EMPTY or env.lookup(name) is not None:
+                    continue
+                d = self._declared(block, name)
+                if d is not None:
+                    env.bind(name, d)
+            return
+        if not inputs_known:
+            for name in op.output_arg_names():
+                if name != _EMPTY:
+                    env.forget(name)
+            return
+
+        def lookup(name):
+            v = env.lookup(name)
+            if v is not None:
+                return v
+            return self._declared(block, name)
+
+        try:
+            inferred = infer_op_outputs(op, block, lookup=lookup)
+        except ShapeInferBail as bail:
+            if not isinstance(bail, ShapeInferSkip):
+                self.bailed += 1
+                log_bailout_once(bail.op_type, bail.reason)
+            for name in op.output_arg_names():
+                if name != _EMPTY:
+                    env.forget(name)
+            return
+        except Exception:  # noqa: BLE001 - a checker bug must not kill compile
+            for name in op.output_arg_names():
+                if name != _EMPTY:
+                    env.forget(name)
+            return
+
+        for name in op.output_arg_names():
+            if name == _EMPTY:
+                continue
+            val = inferred.get(name)
+            if val is None:
+                env.forget(name)
+                continue
+            var = self._declared_var(block, name)
+            # shape None = type inference was skipped at build time; the
+            # declared metadata is untrusted and not compared
+            if var is not None and var.shape is not None:
+                decl_shape = tuple(var.shape)
+                decl_dt = canon_dtype(var.dtype)
+                if _shapes_conflict(val[0], decl_shape):
+                    self._err(
+                        f"var {name!r}: inferred shape {list(val[0])} "
+                        f"conflicts with declared shape {list(decl_shape)}"
+                        f"{_op_prov_tag(op)}", op=op, var=name)
+                elif val[1] != decl_dt:
+                    self._err(
+                        f"var {name!r}: inferred dtype {val[1]} conflicts "
+                        f"with declared dtype {decl_dt}"
+                        f"{_op_prov_tag(op)}", op=op, var=name)
+            env.bind(name, val)
+
+    # -- block / sub-block walk -------------------------------------------
+    def _walk(self, block, env: _Env, owner_type, visited) -> None:
+        for op in block.ops:
+            sb = op.attr("sub_block")
+            if isinstance(sb, int) and 0 < sb < len(self.prog.blocks) \
+                    and sb not in visited:
+                self._descend(env, block, op, sb, visited)
+                # outputs the body did not bind fall back to declared
+                for name in op.output_arg_names():
+                    if name == _EMPTY or env.lookup(name) is not None:
+                        continue
+                    d = self._declared(block, name)
+                    if d is not None:
+                        env.bind(name, d)
+                continue
+            if len(self.findings) >= _MAX_FINDINGS:
+                return
+            self._check_op(env, block, op, owner_type)
+
+    def _descend(self, env: _Env, block, op, sb: int, visited) -> None:
+        sub = self.prog.blocks[sb]
+        if op.type in _LOOP_OWNERS:
+            # pass 1: run the body with findings suppressed, diff the
+            # loop-carried writes, widen changed dims to symbolic
+            saved = [(e, dict(e.vals)) for e in env.chain()]
+            kept, self.findings = self.findings, []
+            # per-var dedup must not "use up" findings in the muted
+            # pass, or pass 2 would silently skip them
+            kept_reported = set(self.reported_vars)
+            child = _Env(sub, parent=env)
+            self._seed_entry(child)
+            self._walk(sub, child, op.type, visited | {sb})
+            self.findings = kept
+            self.reported_vars = kept_reported
+            for e, before in saved:
+                for name, new in list(e.vals.items()):
+                    old = before.get(name)
+                    if old is None or old == new:
+                        continue
+                    if old[1] != new[1]:
+                        self._err(
+                            f"loop-carried var {name!r} changes dtype "
+                            f"across the `while` body ({old[1]} -> "
+                            f"{new[1]})" + _op_prov_tag(op), op=op, var=name)
+                        e.vals[name] = old
+                    elif len(old[0]) != len(new[0]):
+                        self._err(
+                            f"loop-carried var {name!r} changes rank "
+                            f"across the `while` body ({list(old[0])} -> "
+                            f"{list(new[0])})" + _op_prov_tag(op),
+                            op=op, var=name)
+                        e.vals[name] = old
+                    else:
+                        widened = tuple(
+                            a if a == b else -1
+                            for a, b in zip(old[0], new[0]))
+                        e.vals[name] = (widened, old[1])
+            # pass 2: re-run with widened carried vars, findings live
+            child = _Env(sub, parent=env)
+            self._seed_entry(child)
+            self._walk(sub, child, op.type, visited | {sb})
+        else:
+            saved = [(e, dict(e.vals)) for e in env.chain()]
+            child = _Env(sub, parent=env)
+            self._seed_entry(child)
+            self._walk(sub, child, op.type, visited | {sb})
+            # a conditional body may or may not run: widen its writes
+            for e, before in saved:
+                for name, new in list(e.vals.items()):
+                    old = before.get(name)
+                    if old is None or old == new:
+                        continue
+                    if old[1] != new[1] or len(old[0]) != len(new[0]):
+                        e.vals.pop(name, None)  # unknown across paths
+                    else:
+                        e.vals[name] = (tuple(
+                            a if a == b else -1
+                            for a, b in zip(old[0], new[0])), old[1])
+
+    def run(self) -> List[Finding]:
+        if not self.prog.blocks:
+            return []
+        root = _Env(self.prog.blocks[0])
+        self._seed_entry(root)
+        self._walk(self.prog.blocks[0], root, None, {0})
+        if self.bailed:
+            try:
+                from ..profiler import stat_add
+
+                stat_add("shape_check_bailouts", self.bailed)
+            except Exception:  # noqa: BLE001 - stdlib-only standalone load
+                pass
+        return self.findings
+
+
+def check_program(program, feed=None, fetch_list=None,
+                  scope_names=None) -> List[Finding]:
+    """Standalone entry: replay shape/dtype inference over `program`
+    and return the ERROR findings (empty = consistent).  Used by
+    tools/shapecheck.py and the transform bisection hook."""
+    feed_names = None
+    if feed is not None:
+        feed_names = set(feed.keys() if hasattr(feed, "keys") else feed)
+    fetch_names = None
+    if fetch_list is not None:
+        fetch_names = [v.name if hasattr(v, "name") else str(v)
+                       for v in fetch_list]
+    ctx = VerifyContext(program, feed_names=feed_names,
+                        fetch_names=fetch_names, scope_names=scope_names)
+    return _Checker(ctx).run()
+
+
+@register_pass("shape-consistency")
+def shape_consistency_pass(ctx: VerifyContext) -> List[Finding]:
+    """ERROR-tier verifier pass: whole-program shape/dtype replay over
+    the final (post-transform) graph."""
+    return _Checker(ctx).run()
+
+
+# ---------------------------------------------------------------------------
+# Program-dict view (tools/shapecheck.py, jax-free)
+# ---------------------------------------------------------------------------
+#
+# Program.to_dict() round-trips through JSON; these shims rebuild just
+# enough of the Block/Operator/Variable surface for _Checker to walk a
+# serialized program on a box without jax (fallback-table subset only:
+# everything else degrades to unknown).
+
+class _VarView:
+    __slots__ = ("name", "shape", "dtype", "persistable", "is_data")
+
+    def __init__(self, d):
+        self.name = d["name"]
+        self.shape = tuple(d["shape"]) if d.get("shape") is not None else None
+        self.dtype = d.get("dtype", "float32")
+        self.persistable = bool(d.get("persistable", False))
+        self.is_data = bool(d.get("is_data", False))
+
+
+class _OpView:
+    __slots__ = ("id", "type", "inputs", "outputs", "attrs", "block")
+
+    def __init__(self, d, block):
+        self.id = d.get("id", 0)
+        self.type = d["type"]
+        self.inputs = {s: list(ns) for s, ns in d.get("inputs", {}).items()}
+        self.outputs = {s: list(ns) for s, ns in d.get("outputs", {}).items()}
+        self.attrs = dict(d.get("attrs", {}))
+        self.block = block
+
+    def attr(self, name, default=None):
+        return self.attrs.get(name, default)
+
+    def input_arg_names(self):
+        return [n for ns in self.inputs.values() for n in ns]
+
+    def output_arg_names(self):
+        return [n for ns in self.outputs.values() for n in ns]
+
+
+class _BlockView:
+    __slots__ = ("idx", "parent_idx", "vars", "ops", "program")
+
+    def __init__(self, d, program):
+        self.idx = d.get("idx", 0)
+        self.parent_idx = d.get("parent_idx", -1)
+        self.program = program
+        self.vars = {v["name"]: _VarView(v) for v in d.get("vars", [])}
+        self.ops = [_OpView(o, self) for o in d.get("ops", [])]
+
+    @property
+    def parent_block(self):
+        if 0 <= self.parent_idx < len(self.program.blocks) \
+                and self.parent_idx != self.idx:
+            return self.program.blocks[self.parent_idx]
+        return None
+
+
+class ProgramView:
+    """Read-only duck type of fluid.framework.Program over to_dict()
+    output — what _Checker walks when loaded standalone."""
+
+    def __init__(self, d, prog_id=0):
+        self.prog_id = d.get("prog_id", prog_id)
+        self.version = d.get("version", 0)
+        self.blocks = [_BlockView(b, self) for b in d.get("blocks", [])]
+
+
+def check_program_dict(d, feed=None, fetch_list=None) -> List[Finding]:
+    """Check a serialized Program (Program.to_dict() / its JSON)."""
+    return check_program(ProgramView(d), feed=feed, fetch_list=fetch_list)

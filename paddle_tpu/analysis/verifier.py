@@ -591,6 +591,29 @@ def verify_program(program, feed=None, fetch_list=None, scope=None,
     return findings
 
 
+# warn-mode finding dedup (ISSUE 11 satellite): repeated
+# maybe_verify_program calls on the same program — or on clone-identical
+# programs (an eval clone re-verified under a new feed signature) —
+# previously re-warned the identical findings on every compile-cache
+# miss.  Keyed on (clone family, program version, finding identity), so
+# a finding re-surfaces only when the program actually changes.
+_REPORTED: Set[tuple] = set()
+_MAX_REPORTED = 4096  # bounded: clear-on-full beats unbounded growth
+
+
+def _finding_key(program, f: Finding) -> tuple:
+    root = getattr(program, "clone_root",
+                   getattr(program, "prog_id", id(program)))
+    return (root, getattr(program, "version", 0), f.pass_name,
+            f.severity, f.block_idx, f.op_id, f.op_type, f.var,
+            f.message)
+
+
+def reset_finding_dedup() -> None:
+    """Forget which findings were already warned about (tests)."""
+    _REPORTED.clear()
+
+
 def maybe_verify_program(program, feed_names=None, fetch_names=None,
                          scope=None, donated=None) -> None:
     """Compile-cache-miss hook for Executor._prepare /
@@ -619,10 +642,20 @@ def maybe_verify_program(program, feed_names=None, fetch_names=None,
     if not errors:
         return
     if mode in ("warn", "warning"):
+        if len(_REPORTED) > _MAX_REPORTED:
+            _REPORTED.clear()
+        fresh = []
+        for f in errors:
+            key = _finding_key(program, f)
+            if key not in _REPORTED:
+                _REPORTED.add(key)
+                fresh.append(f)
+        if not fresh:
+            return  # every finding already reported for this version
         warnings.warn(
             "program verifier found {} error(s) "
             "(FLAGS_verify_program=warn):\n{}".format(
-                len(errors), "\n".join(f"  {f}" for f in errors)),
+                len(fresh), "\n".join(f"  {f}" for f in fresh)),
             RuntimeWarning, stacklevel=3)
         return
     raise ProgramVerificationError(errors)

@@ -147,6 +147,12 @@ _define("obs_flight_min_interval_s", 60.0,
         "flight-recorder rate limit: at most one bundle per N seconds "
         "(further firings only update /healthz)",
         env_var="PADDLE_OBS_FLIGHT_MIN_INTERVAL_S")
+_define("transform_debug", False,
+        "per-pass transform bisection (docs/graph_transforms.md): run "
+        "the shape-consistency check after EVERY transform pass inside "
+        "apply_transforms and raise naming the first pass whose rewrite "
+        "broke the graph — instead of one post-pipeline failure that "
+        "does not say which pass did it")
 _define("op_callstack", False,
         "record the Python construction stack on every appended op "
         "(attrs['op_callstack']); verifier findings then point at the "

@@ -37,10 +37,6 @@ from .flags import flag
 GRAD_SUFFIX = "@GRAD"
 EMPTY_VAR_NAME = "@EMPTY@"
 
-# Placeholder batch sizes used to probe which output dims depend on dynamic
-# (-1) input dims during build-time shape inference.
-_BATCH_PROBES = (3, 5)
-
 # package root, for filtering framework frames out of recorded op
 # construction stacks (FLAGS_op_callstack)
 _PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -317,41 +313,36 @@ class Block:
         return self.insert_op(0, type, inputs, outputs, attrs, infer_shape)
 
     def _infer_shapes(self, op: Operator) -> None:
-        """Derive output var shapes/dtypes by jax.eval_shape over the op's
-        lowering rule.  Dims that change when the -1 placeholder changes are
-        marked dynamic (-1)."""
-        from ..ops import registry
+        """Derive output var shapes/dtypes through the shared abstract
+        inference engine (analysis/shape_check.py): two-probe
+        jax.eval_shape over the op's lowering rule — dims that track the
+        -1 placeholder stay dynamic — with the declarative fallback
+        table covering ops whose lowering cannot be abstractly
+        evaluated.  The shape-consistency verifier pass replays the SAME
+        engine over the post-transform graph, so build-time inference
+        and verification cannot drift.  A bailout is no longer silent:
+        it books the `shape_infer_bailouts` profiler stat and logs the
+        op type once per type."""
+        from ..analysis import shape_check
 
-        if not registry.has_op(op.type):
-            return  # shapes must be set by the caller
-        results = []
-        for probe in _BATCH_PROBES:
-            try:
-                results.append(registry.eval_op_shape(op, self, probe))
-            except Exception:
-                # Lowering could not be abstractly evaluated (e.g. depends on
-                # concrete values).  Leave declared shapes untouched.
-                return
-        first, second = results
-        for slot, names in op.outputs.items():
-            shapes1 = first.get(slot, [])
-            shapes2 = second.get(slot, [])
-            for i, name in enumerate(names):
-                if name == EMPTY_VAR_NAME or i >= len(shapes1):
-                    continue
-                s1, s2 = shapes1[i], shapes2[i]
-                if not hasattr(s1, "shape"):
-                    # composite values (TensorArrayVal) have no single
-                    # shape; leave the declared one
-                    continue
-                shape = tuple(
-                    -1 if a != b else a for a, b in zip(s1.shape, s2.shape)
-                )
-                v = self.vars.get(name)
-                if v is None:
-                    v = self._var_recursive(name)
-                v.shape = shape
-                v.dtype = core.convert_dtype(s1.dtype)
+        try:
+            inferred = shape_check.infer_op_outputs(op, self)
+        except shape_check.ShapeInferSkip:
+            return  # no lowering rule: shapes must be set by the caller
+        except shape_check.ShapeInferBail as bail:
+            # Lowering could not be abstractly evaluated (e.g. depends
+            # on concrete values).  Declared shapes stay authoritative.
+            from ..profiler import stat_add
+
+            stat_add("shape_infer_bailouts")
+            shape_check.log_bailout_once(bail.op_type, bail.reason)
+            return
+        for name, (shape, dtype) in inferred.items():
+            v = self.vars.get(name)
+            if v is None:
+                v = self._var_recursive(name)
+            v.shape = shape
+            v.dtype = core.convert_dtype(dtype)
 
     def to_dict(self):
         return {
@@ -382,6 +373,10 @@ class Program:
         self._seed_counter = 0
         self._is_test = False
         self.prog_id = next(Program._prog_id_counter)
+        # clone lineage: clones share the root program's id so analyses
+        # (cross-program collective-order, finding dedup) can group a
+        # train step with its eval clone
+        self.clone_root = self.prog_id
 
     # -- identity / caching ------------------------------------------------
     @property
@@ -431,6 +426,7 @@ class Program:
         (batch_norm/dropout eval behavior) and prunes backward/optimize ops,
         mirroring Program.clone(for_test=True) (framework.py:4312)."""
         p = Program()
+        p.clone_root = self.clone_root
         p.random_seed = self.random_seed
         p._op_id_counter = self._op_id_counter
         p.blocks = []

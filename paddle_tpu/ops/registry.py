@@ -410,9 +410,19 @@ def _lower_grad_op(ctx: LowerCtx, op: Operator, env) -> None:
 # Build-time shape inference via eval_shape (framework.Block._infer_shapes)
 # ---------------------------------------------------------------------------
 
-def eval_op_shape(op: Operator, block, batch_probe: int) -> Dict[str, list]:
+def eval_op_shape(op: Operator, block, batch_probe: int,
+                  lookup=None) -> Dict[str, list]:
     """Abstractly evaluate one op's lowering with -1 dims replaced by
-    `batch_probe`; returns {slot: [ShapeDtypeStruct,...]}."""
+    `batch_probe`; returns {slot: [ShapeDtypeStruct,...]}.
+
+    `lookup(name) -> (shape, dtype) | None` overrides where input
+    shapes come from — the shape-consistency pass passes its abstract
+    env so inference REPLAYS through the graph instead of re-reading
+    declared shapes (analysis/shape_check.py).  Default: the declared
+    shapes via `block._var_recursive`.  The op's layout-adapter attrs
+    (`nhwc_in`/`nchw_in`/`nhwc_out`) are applied around the rule, same
+    as at lowering time, so transformed NHWC graphs evaluate with their
+    real boundary transposes."""
     specs: InsOuts = {}
     for slot, names in op.inputs.items():
         vals = []
@@ -420,15 +430,23 @@ def eval_op_shape(op: Operator, block, batch_probe: int) -> Dict[str, list]:
             if n == EMPTY_VAR_NAME:
                 vals.append(None)
                 continue
-            v = block._var_recursive(n)
-            if v.shape is None:
-                raise ValueError(f"input {n} has unknown shape")
-            shape = tuple(batch_probe if d == -1 else d for d in v.shape)
-            vals.append(jax.ShapeDtypeStruct(shape, jdt(v.dtype)))
+            shape = dtype = None
+            if lookup is not None:
+                got = lookup(n)
+                if got is not None:
+                    shape, dtype = got
+            if shape is None:
+                v = block._var_recursive(n)
+                if v.shape is None:
+                    raise ValueError(f"input {n} has unknown shape")
+                shape, dtype = v.shape, v.dtype
+            shape = tuple(batch_probe if d == -1 else d for d in shape)
+            vals.append(jax.ShapeDtypeStruct(shape, jdt(dtype)))
         specs[slot] = vals
     fn = _FORWARD.get(op.type)
     if fn is None:
         raise NotImplementedError(op.type)
+    fn = _layout_adapted(fn, op)
 
     ctx = LowerCtx(jax.random.PRNGKey(0), block=block, abstract=True)
 

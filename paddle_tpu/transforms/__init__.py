@@ -232,26 +232,64 @@ def enabled_signature() -> tuple:
     return tuple(n for n, on in _resolve_spec(_current_spec()) if on)
 
 
+class TransformDebugError(RuntimeError):
+    """Raised under FLAGS_transform_debug when the per-pass bisection
+    pinpoints the transform pass whose rewrite broke shape/dtype
+    consistency."""
+
+    def __init__(self, pass_name: str, findings):
+        self.pass_name = pass_name
+        self.findings = list(findings)
+        lines = "\n".join(f"  {f}" for f in self.findings)
+        super().__init__(
+            f"transform pass {pass_name!r} broke shape/dtype "
+            f"consistency ({len(self.findings)} finding(s), "
+            f"FLAGS_transform_debug bisection):\n{lines}")
+
+
+def _debug_check(program, feed_names, fetch_names):
+    from ..analysis import shape_check
+
+    return shape_check.check_program(
+        program, feed=feed_names, fetch_list=fetch_names)
+
+
 def apply_transforms(program, feed_names=None, fetch_names=None,
                      scope=None, passes: Optional[Iterable[str]] = None):
     """Run the transform pipeline over a CLONE of `program`.
 
     Returns `(transformed_program, {pass_name: ops_rewritten})`.  The
     input program is never mutated; op ids are preserved by the clone so
-    grad-op `fwd_op_id` links stay valid."""
+    grad-op `fwd_op_id` links stay valid.
+
+    Under FLAGS_transform_debug, the shape-consistency check runs after
+    EVERY pass (bisection mode): the first pass whose rewrite breaks
+    the graph raises TransformDebugError naming it — instead of the
+    post-pipeline verifier reporting a failure nothing attributes."""
     wanted = list(passes) if passes is not None else [
         n for n, on in enabled_passes().items() if on]
+    from ..fluid.flags import flag
+
+    debug = bool(flag("transform_debug", False))
     clone = program.clone()
     # provenance must name the SOURCE program (the clone's prog_id is
     # fresh), and must be stamped BEFORE passes rewrite anything
     stamp_provenance(clone, program.prog_id)
     ctx = TransformContext(clone, feed_names=feed_names,
                            fetch_names=fetch_names, scope=scope)
+    # a program that is already inconsistent BEFORE any pass must not
+    # get the first pass blamed for it
+    baseline_clean = debug and not _debug_check(clone, feed_names,
+                                                fetch_names)
     stats: Dict[str, int] = {}
     for name in _PASSES:
         if name not in wanted:
             continue
         stats[name] = int(_PASSES[name]["fn"](ctx))
+        if baseline_clean:
+            findings = _debug_check(clone, feed_names, fetch_names)
+            if findings:
+                raise TransformDebugError(name, findings)
     return clone, stats
 
 

@@ -138,10 +138,19 @@ def _follower_eligible(block, op) -> bool:
 
 
 def _permute_declared_shape(block, name):
-    v = _find_var(block, name)
-    if v is not None and v.shape is not None and len(v.shape) == 4:
-        s = v.shape
-        v.shape = (s[0], s[2], s[3], s[1])
+    """NCHW -> NHWC on the declared shape of a kept-NHWC interior var —
+    and on its `@GRAD` twins: the cotangent of an NHWC value is NHWC
+    (grad ops replay jax.vjp of the rewritten forward), so the grad
+    vars' declared metadata must follow or the shape-consistency
+    verifier correctly flags the drift."""
+    targets = [name] + [
+        n for n in block.vars
+        if n == name + "@GRAD" or n.startswith(name + "@GRAD@RENAME@")]
+    for n in targets:
+        v = _find_var(block, n)
+        if v is not None and v.shape is not None and len(v.shape) == 4:
+            s = v.shape
+            v.shape = (s[0], s[2], s[3], s[1])
 
 
 @register_transform(

@@ -1,0 +1,133 @@
+"""Deliberately buggy transform-pass variants (ISSUE 11 fault-injection
+harness).
+
+Each pass below re-creates a real rewrite-bug class the shape-consistency
+verifier pass (analysis/shape_check.py) exists to catch:
+
+* `broken_layout_wrong_perm` — NHWC anchor rewrite that permutes the
+  declared output shape with the WRONG permutation (swapped H/W), the
+  classic layout-pass transposition bug;
+* `broken_fold_bn_dtype` — a fold_bn whose synthesized chain drops the
+  dtype (declares the folded bias float16 while the chain computes in
+  float32);
+* `broken_dce_overeager` — dead-op elimination that removes a writer
+  whose output a later op still reads;
+* `broken_subblock_rename` — a sub-block rewrite that renames an op's
+  input to a name no scope declares and no op writes.
+
+All register with `default=False`, so `enabled_passes()` never selects
+them — tests opt in explicitly via
+`apply_transforms(program, passes=["broken_..."])`.  Every touched op
+is tagged via `tag_provenance`, so the resulting findings carry the
+`[pass=...]` attribution the acceptance criteria require.
+"""
+
+from paddle_tpu.transforms import register_transform, tag_provenance
+
+BROKEN_PASSES = (
+    "broken_layout_wrong_perm",
+    "broken_fold_bn_dtype",
+    "broken_dce_overeager",
+    "broken_subblock_rename",
+)
+
+_WRONG_PERM = (0, 3, 2, 1)  # correct NHWC perm is (0, 2, 3, 1)
+
+
+@register_transform(
+    "broken_layout_wrong_perm", default=False,
+    help_str="FAULT INJECTION: NHWC anchor rewrite with a swapped-H/W "
+             "declared-shape permutation")
+def broken_layout_wrong_perm(ctx) -> int:
+    block = ctx.program.global_block()
+    for op in block.ops:
+        if op.type != "conv2d":
+            continue
+        op.attrs["data_format"] = "NHWC"
+        op.attrs["nhwc_in"] = ["Input"]
+        # keep the output NHWC (no nhwc_out) but record the WRONG
+        # permutation in the declared metadata
+        out = op.output("Output")[0]
+        v = block.vars.get(out)
+        if v is not None and v.shape is not None and len(v.shape) == 4:
+            s = v.shape
+            v.shape = tuple(s[i] for i in _WRONG_PERM)
+        tag_provenance(op, "broken_layout_wrong_perm")
+        return 1
+    return 0
+
+
+@register_transform(
+    "broken_fold_bn_dtype", default=False,
+    help_str="FAULT INJECTION: fold_bn whose synthesized bias var "
+             "drops to float16")
+def broken_fold_bn_dtype(ctx) -> int:
+    from paddle_tpu.transforms import fold_bn
+
+    n = fold_bn.run(ctx)
+    if not n:
+        return 0
+    block = ctx.program.global_block()
+    broken = 0
+    for name, v in block.vars.items():
+        if "@fold_bn." in name and name.endswith(".bias"):
+            v.dtype = "float16"  # the chain still computes float32
+            for op in block.ops:
+                if name in op.output_arg_names():
+                    tag_provenance(op, "broken_fold_bn_dtype")
+            broken += 1
+    return broken
+
+
+@register_transform(
+    "broken_dce_overeager", default=False,
+    help_str="FAULT INJECTION: DCE that removes a writer whose output "
+             "is still read")
+def broken_dce_overeager(ctx) -> int:
+    block = ctx.program.global_block()
+    read_anywhere = {
+        n for b in ctx.program.blocks for o in b.ops
+        for n in o.input_arg_names()}
+    for op in block.ops:
+        outs = [n for n in op.output_arg_names() if n != "@EMPTY@"]
+        if not outs or not all(n in read_anywhere for n in outs):
+            continue
+        ok = True
+        for n in outs:
+            v = block.vars.get(n)
+            if v is None or v.persistable or getattr(v, "is_data", False):
+                ok = False
+                break
+        if not ok:
+            continue
+        block.ops.remove(op)
+        # "normalize" the surviving consumers, the way a rewrite pass
+        # stamps everything it touched — this is what attributes the
+        # findings to this pass
+        for o in block.ops:
+            if any(n in o.input_arg_names() for n in outs):
+                tag_provenance(o, "broken_dce_overeager")
+        return 1
+    return 0
+
+
+@register_transform(
+    "broken_subblock_rename", default=False,
+    help_str="FAULT INJECTION: sub-block rewrite renaming an op input "
+             "to an undeclared name")
+def broken_subblock_rename(ctx) -> int:
+    prog = ctx.program
+    for blk in prog.blocks[1:]:
+        declared_outside = set()
+        b = blk.parent_block
+        while b is not None:
+            declared_outside.update(b.vars)
+            b = b.parent_block
+        for op in blk.ops:
+            for slot, names in op.inputs.items():
+                for i, n in enumerate(names):
+                    if n in declared_outside:
+                        op.inputs[slot][i] = n + "@renamed"
+                        tag_provenance(op, "broken_subblock_rename")
+                        return 1
+    return 0

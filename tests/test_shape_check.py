@@ -1,0 +1,474 @@
+"""Whole-program shape/dtype verification tests (ISSUE 11 tentpole).
+
+The `shape-consistency` verifier pass (paddle_tpu/analysis/shape_check.py)
+replays shape/dtype inference op-by-op over the FINAL (post-transform)
+Program and must catch exactly the rewrite-bug classes the fault-
+injection passes in tests/fixtures/broken_passes.py re-create — with
+`program#<id> block<idx> op<id>` provenance and `[pass=...]` tags —
+while the SHIPPED transforms stay clean over the fixture zoo and the
+book-model zoo.  The `cross-program-collective-order` pass diffs
+collective issue-order signatures across programs in one clone family
+(train step vs eval clone) and errors on interleave mismatches.  Both
+run once per compile-cache miss only (profiler-asserted), and the
+engine doubles as `Block._infer_shapes` (bailouts become a counted
+stat, never a crash).
+"""
+
+import os
+import re
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+import paddle_tpu.fluid as fluid
+from paddle_tpu import profiler
+from paddle_tpu.analysis import (ERROR, collective_signature,
+                                 registered_passes, reset_finding_dedup,
+                                 reset_ring_registry,
+                                 ring_registry_snapshot, shape_check,
+                                 verify_program)
+from paddle_tpu.analysis.verifier import maybe_verify_program
+from paddle_tpu.fluid import framework, unique_name
+from paddle_tpu.fluid.executor import Scope, scope_guard
+from paddle_tpu.transforms import TransformDebugError, apply_transforms
+
+_TESTS = os.path.dirname(os.path.abspath(__file__))
+if _TESTS not in sys.path:
+    sys.path.insert(0, _TESTS)
+
+from fixtures import broken_passes  # noqa: E402  (registration side effect)
+from fixtures import programs as fixture_programs  # noqa: E402
+import test_book_models as book  # noqa: E402
+
+_PROV_RE = re.compile(r"program#\d+ block\d+ op\d+")
+_SHIPPED = ["fold_bn", "layout_optimize", "dead_op_elim"]
+
+
+def _errors(findings):
+    return [f for f in findings if f.severity == ERROR]
+
+
+def _shape_errors(findings):
+    return [f for f in _errors(findings)
+            if f.pass_name == "shape-consistency"]
+
+
+def _names(fetch):
+    return [v.name if hasattr(v, "name") else str(v) for v in fetch or ()]
+
+
+def _build(body):
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup), unique_name.guard():
+        fetch = body()
+    return main, startup, fetch
+
+
+def _conv_bn_eval():
+    """conv -> batch_norm(is_test) with H != W so a transposed layout
+    permutation is observable in the declared shapes."""
+
+    def body():
+        x = fluid.data("x", [4, 3, 16, 8], "float32")
+        y = fluid.layers.conv2d(x, 8, 3, padding=1, bias_attr=False)
+        y = fluid.layers.batch_norm(y, is_test=True)
+        return [fluid.layers.reduce_mean(y)]
+
+    return _build(body)
+
+
+def _fc_chain():
+    def body():
+        x = fluid.data("x", [-1, 4], "float32")
+        h = fluid.layers.fc(x, 8, act="relu")
+        return [fluid.layers.fc(h, 2)]
+
+    return _build(body)
+
+
+def test_new_passes_registered():
+    names = set(registered_passes(tier=ERROR))
+    assert {"shape-consistency", "cross-program-collective-order"} <= names
+
+
+# ---------------------------------------------------------------------------
+# Fault injection: every broken pass trips the shape pass, with
+# provenance + [pass=...] attribution
+# ---------------------------------------------------------------------------
+
+def _assert_fires(findings, pass_name):
+    errs = _shape_errors(findings)
+    assert errs, f"{pass_name}: no shape-consistency ERROR findings"
+    tagged = [f for f in errs if f"[pass={pass_name}]" in f.message
+              or f",{pass_name}]" in f.message]
+    assert tagged, (pass_name, [str(f) for f in errs])
+    for f in tagged:
+        assert _PROV_RE.search(str(f)), str(f)
+    return tagged
+
+
+def test_broken_layout_wrong_perm_fires():
+    main, _startup, fetch = _conv_bn_eval()
+    tprog, stats = apply_transforms(
+        main, feed_names=["x"], fetch_names=_names(fetch),
+        passes=["broken_layout_wrong_perm"])
+    assert stats["broken_layout_wrong_perm"] == 1
+    findings = shape_check.check_program(
+        tprog, feed=["x"], fetch_list=fetch)
+    tagged = _assert_fires(findings, "broken_layout_wrong_perm")
+    assert any("conflicts with declared shape" in f.message
+               for f in tagged), [str(f) for f in tagged]
+    # the untransformed source program is untouched and still clean
+    assert not _shape_errors(
+        shape_check.check_program(main, feed=["x"], fetch_list=fetch))
+
+
+def test_broken_fold_bn_dtype_fires():
+    main, _startup, fetch = _conv_bn_eval()
+    tprog, stats = apply_transforms(
+        main, feed_names=["x"], fetch_names=_names(fetch),
+        passes=["broken_fold_bn_dtype"])
+    assert stats["broken_fold_bn_dtype"] >= 1
+    findings = shape_check.check_program(
+        tprog, feed=["x"], fetch_list=fetch)
+    tagged = _assert_fires(findings, "broken_fold_bn_dtype")
+    assert any("dtype" in f.message for f in tagged), \
+        [str(f) for f in tagged]
+
+
+def test_broken_dce_overeager_fires():
+    main, _startup, fetch = _fc_chain()
+    tprog, stats = apply_transforms(
+        main, feed_names=["x"], fetch_names=_names(fetch),
+        passes=["broken_dce_overeager"])
+    assert stats["broken_dce_overeager"] == 1
+    findings = shape_check.check_program(
+        tprog, feed=["x"], fetch_list=fetch)
+    tagged = _assert_fires(findings, "broken_dce_overeager")
+    assert any("no op produces" in f.message for f in tagged), \
+        [str(f) for f in tagged]
+
+
+def test_broken_subblock_rename_fires():
+    main, _startup, fetch = fixture_programs.while_counter()
+    tprog, stats = apply_transforms(
+        main, fetch_names=_names(fetch),
+        passes=["broken_subblock_rename"])
+    assert stats["broken_subblock_rename"] == 1
+    findings = shape_check.check_program(tprog, fetch_list=fetch)
+    tagged = _assert_fires(findings, "broken_subblock_rename")
+    assert any(f.block_idx >= 1 for f in tagged), \
+        [str(f) for f in tagged]
+    assert any("renamed or removed" in f.message for f in tagged)
+
+
+def test_broken_passes_are_off_by_default():
+    from paddle_tpu.transforms import enabled_passes
+
+    on = {n for n, enabled in enabled_passes().items() if enabled}
+    assert not (on & set(broken_passes.BROKEN_PASSES))
+
+
+def test_verifier_reports_broken_pass_through_full_pipeline():
+    """End to end: the ERROR-tier verifier (not just the standalone
+    checker) flags the transformed program."""
+    main, _startup, fetch = _conv_bn_eval()
+    tprog, _ = apply_transforms(
+        main, feed_names=["x"], fetch_names=_names(fetch),
+        passes=["broken_layout_wrong_perm"])
+    errs = _shape_errors(verify_program(tprog, feed=["x"],
+                                        fetch_list=fetch))
+    assert errs and any("broken_layout_wrong_perm" in f.message
+                        for f in errs)
+
+
+# ---------------------------------------------------------------------------
+# Shipped transforms stay clean: fixture zoo + book-model zoo
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", sorted(fixture_programs.FIXTURES))
+def test_fixture_zoo_clean_after_shipped_transforms(name):
+    main, startup, fetch = fixture_programs.FIXTURES[name]()
+    for prog, fl in ((main, fetch), (startup, None)):
+        tprog, _ = apply_transforms(prog, fetch_names=_names(fl),
+                                    passes=_SHIPPED)
+        errs = _shape_errors(
+            shape_check.check_program(tprog, fetch_list=fl))
+        assert not errs, (name, [str(f) for f in errs])
+
+
+@pytest.mark.parametrize("name", sorted(book.BOOK_BUILDERS))
+def test_book_zoo_clean_after_shipped_transforms(name):
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup), unique_name.guard():
+        fetch = book.BOOK_BUILDERS[name]()
+    tprog, _ = apply_transforms(main, fetch_names=_names(fetch),
+                                passes=_SHIPPED)
+    errs = _shape_errors(shape_check.check_program(tprog,
+                                                   fetch_list=fetch))
+    assert not errs, (name, [str(f) for f in errs])
+
+
+# ---------------------------------------------------------------------------
+# Engine behavior: declared-metadata conflicts, bailouts, dict view
+# ---------------------------------------------------------------------------
+
+def test_declared_shape_conflict_fires_without_transforms():
+    main, _startup, fetch = _fc_chain()
+    out = fetch[0]
+    main.global_block().vars[out.name].shape = (-1, 3)  # real is (-1, 2)
+    errs = _shape_errors(shape_check.check_program(
+        main, feed=["x"], fetch_list=fetch))
+    assert any(f.var == out.name
+               and "conflicts with declared shape" in f.message
+               for f in errs), [str(f) for f in errs]
+
+
+def test_symbolic_batch_dim_survives():
+    """-1 batch feeds stay -1: no spurious findings from probing."""
+    main, _startup, fetch = _fc_chain()
+    assert not _shape_errors(shape_check.check_program(
+        main, feed=["x"], fetch_list=fetch))
+
+
+def test_infer_shapes_bailout_is_counted_not_raised():
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup), unique_name.guard():
+        block = main.global_block()
+        a = block.create_var(name="a", shape=(2, 3), dtype="float32")
+        b = block.create_var(name="b", shape=(4, 5), dtype="float32")
+        out = block.create_var(name="bad_out", shape=None,
+                               dtype="float32")
+        before = profiler.get_int_stats().get("shape_infer_bailouts", 0)
+        # un-broadcastable operands: abstract eval fails -> counted
+        # bailout, declared shape stays unknown, and NO exception
+        block.append_op("elementwise_add",
+                        inputs={"X": [a.name], "Y": [b.name]},
+                        outputs={"Out": [out.name]})
+        after = profiler.get_int_stats().get("shape_infer_bailouts", 0)
+    assert after == before + 1
+    assert out.shape is None
+
+
+def test_check_program_dict_round_trip():
+    """The jax-free dict view walks a serialized program and still
+    catches a planted conflict (tools/shapecheck.py path)."""
+    main, _startup, fetch = _fc_chain()
+    d = main.to_dict()
+    assert not _shape_errors(shape_check.check_program_dict(
+        d, feed=["x"], fetch_list=_names(fetch)))
+    # corrupt the serialized declared dtype of the fetch target
+    broken = main.clone()
+    broken.global_block().vars[fetch[0].name].dtype = "int32"
+    errs = _shape_errors(shape_check.check_program_dict(
+        broken.to_dict(), feed=["x"], fetch_list=_names(fetch)))
+    assert any("dtype" in f.message for f in errs), \
+        [str(f) for f in errs]
+
+
+def test_while_loop_carried_dtype_drift_fires():
+    main, _startup, fetch = fixture_programs.while_counter()
+    # clean as built
+    assert not _shape_errors(shape_check.check_program(
+        main, fetch_list=fetch))
+    # flip a loop-carried var's declared dtype: the body rebinds it
+    # float32 every iteration, so the widening pass must object
+    acc = fetch[0]
+    main.global_block().vars[acc.name].dtype = "int64"
+    errs = _shape_errors(shape_check.check_program(main,
+                                                   fetch_list=fetch))
+    assert any(f.var == acc.name and "dtype" in f.message
+               for f in errs), [str(f) for f in errs]
+
+
+# ---------------------------------------------------------------------------
+# FLAGS_transform_debug: per-pass bisection names the guilty pass
+# ---------------------------------------------------------------------------
+
+def test_transform_debug_bisection_names_breaking_pass():
+    main, _startup, fetch = _conv_bn_eval()
+    paddle_tpu.set_flags({"FLAGS_transform_debug": True})
+    try:
+        with pytest.raises(TransformDebugError) as ei:
+            apply_transforms(
+                main, feed_names=["x"], fetch_names=_names(fetch),
+                passes=["fold_bn", "broken_layout_wrong_perm",
+                        "dead_op_elim"])
+        assert ei.value.pass_name == "broken_layout_wrong_perm"
+        assert ei.value.findings
+        assert "broke shape/dtype consistency" in str(ei.value)
+    finally:
+        paddle_tpu.set_flags({"FLAGS_transform_debug": False})
+    # without the flag the same pipeline completes (the verifier
+    # catches it later at the compile seam instead)
+    tprog, _ = apply_transforms(
+        main, feed_names=["x"], fetch_names=_names(fetch),
+        passes=["fold_bn", "broken_layout_wrong_perm", "dead_op_elim"])
+    assert _shape_errors(shape_check.check_program(
+        tprog, feed=["x"], fetch_list=fetch))
+
+
+def test_transform_debug_clean_pipeline_passes():
+    main, _startup, fetch = _conv_bn_eval()
+    paddle_tpu.set_flags({"FLAGS_transform_debug": True})
+    try:
+        tprog, stats = apply_transforms(
+            main, feed_names=["x"], fetch_names=_names(fetch),
+            passes=_SHIPPED)
+        assert stats.get("fold_bn", 0) >= 1
+    finally:
+        paddle_tpu.set_flags({"FLAGS_transform_debug": False})
+
+
+# ---------------------------------------------------------------------------
+# Cross-program collective order (pass 2)
+# ---------------------------------------------------------------------------
+
+def _collective_program():
+    """fc trunk + two ring-0 collectives in a fixed issue order."""
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup), unique_name.guard():
+        x = fluid.data("x", [8, 4], "float32")
+        y = fluid.layers.fc(x, 4)
+        blk = main.global_block()
+        blk.append_op("c_allreduce_sum", inputs={"X": [y.name]},
+                      outputs={"Out": [y.name]},
+                      attrs={"ring_id": 0}, infer_shape=False)
+        blk.append_op("c_allreduce_max", inputs={"X": [y.name]},
+                      outputs={"Out": [y.name]},
+                      attrs={"ring_id": 0}, infer_shape=False)
+    return main, [y]
+
+
+def _collective_errs(prog, fetch):
+    return [f for f in _errors(verify_program(
+        prog, fetch_list=fetch,
+        passes=["cross-program-collective-order"]))
+        if f.pass_name == "cross-program-collective-order"]
+
+
+def test_cross_program_matched_order_is_clean():
+    reset_ring_registry()
+    main, fetch = _collective_program()
+    clone = main.clone(for_test=True)
+    assert clone.clone_root == main.clone_root
+    assert not _collective_errs(main, fetch)
+    assert not _collective_errs(clone, fetch)
+    fam = ring_registry_snapshot()[main.clone_root]
+    assert {main.prog_id, clone.prog_id} <= set(fam)
+    reset_ring_registry()
+
+
+def test_cross_program_interleave_mismatch_fires():
+    reset_ring_registry()
+    main, fetch = _collective_program()
+    clone = main.clone(for_test=True)
+    blk = clone.global_block()
+    idx = {op.type: i for i, op in enumerate(blk.ops)
+           if op.type.startswith("c_allreduce")}
+    i, j = idx["c_allreduce_sum"], idx["c_allreduce_max"]
+    blk.ops[i], blk.ops[j] = blk.ops[j], blk.ops[i]  # reorder the ring
+
+    assert not _collective_errs(main, fetch)  # recorded clean
+    errs = _collective_errs(clone, fetch)
+    assert errs, "reordered clone must fire"
+    f = errs[0]
+    assert f"program#{main.prog_id}" in f.message
+    assert "deadlock" in f.message
+    assert _PROV_RE.search(str(f)), str(f)
+    # the dirty program is NOT recorded (no poisoning later diffs)
+    fam = ring_registry_snapshot()[main.clone_root]
+    assert clone.prog_id not in fam
+    reset_ring_registry()
+
+
+def test_cross_program_pruned_subsequence_is_clean():
+    """An eval clone that dropped its backward collectives is an
+    ordered subsequence — compatible by design."""
+    reset_ring_registry()
+    main, fetch = _collective_program()
+    clone = main.clone(for_test=True)
+    blk = clone.global_block()
+    blk.ops.remove(next(op for op in blk.ops
+                        if op.type == "c_allreduce_max"))
+    assert not _collective_errs(main, fetch)
+    assert not _collective_errs(clone, fetch)
+    reset_ring_registry()
+
+
+def test_cross_program_unrelated_families_not_compared():
+    """Two independently-built programs default to ring 0 but are NOT
+    clones of each other: they must not be diffed."""
+    reset_ring_registry()
+    a, fa = _collective_program()
+    b, fb = _collective_program()  # fresh build -> different clone_root
+    assert a.clone_root != b.clone_root
+    blk = b.global_block()
+    ops = [op for op in blk.ops if op.type.startswith("c_allreduce")]
+    i, j = blk.ops.index(ops[0]), blk.ops.index(ops[1])
+    blk.ops[i], blk.ops[j] = blk.ops[j], blk.ops[i]
+    assert not _collective_errs(a, fa)
+    assert not _collective_errs(b, fb)
+    reset_ring_registry()
+
+
+def test_collective_signature_inlines_sub_blocks():
+    main, _fetch = _collective_program()
+    sig = collective_signature(main)
+    assert [(r, t) for r, t, _b, _o in sig] == \
+        [(0, "c_allreduce_sum"), (0, "c_allreduce_max")]
+
+
+# ---------------------------------------------------------------------------
+# Hot-path + warn-mode contracts
+# ---------------------------------------------------------------------------
+
+def test_both_passes_run_only_on_cache_miss():
+    """With the new passes registered, cache-hit steps still pay zero
+    verifier time (the ISSUE 11 acceptance bar)."""
+    reset_ring_registry()
+    main, startup = framework.Program(), framework.Program()
+    scope = Scope()
+    with framework.program_guard(main, startup), unique_name.guard(), \
+            scope_guard(scope):
+        x = fluid.data("x", [-1, 4], "float32")
+        y = fluid.layers.fc(x, 2)
+        exe = fluid.Executor()
+        exe.run(startup)
+        feed = {"x": np.ones((3, 4), "float32")}
+        exe.run(main, feed=feed, fetch_list=[y])  # miss: verified
+
+        runs0 = profiler.get_int_stats().get("verifier_runs", 0)
+        ms0 = profiler.get_time_stats().get("verify_ms", 0.0)
+        assert runs0 >= 1
+        for _ in range(4):  # hits: flat
+            exe.run(main, feed=feed, fetch_list=[y])
+        assert profiler.get_int_stats().get("verifier_runs", 0) == runs0
+        assert profiler.get_time_stats().get("verify_ms", 0.0) == ms0
+    reset_ring_registry()
+
+
+def test_warn_mode_dedups_repeat_findings():
+    reset_finding_dedup()
+    main, _startup, fetch = _conv_bn_eval()
+    tprog, _ = apply_transforms(
+        main, feed_names=["x"], fetch_names=_names(fetch),
+        passes=["broken_layout_wrong_perm"])
+    paddle_tpu.set_flags({"FLAGS_verify_program": "warn"})
+    try:
+        with warnings.catch_warnings(record=True) as first:
+            warnings.simplefilter("always")
+            maybe_verify_program(tprog, feed_names=["x"],
+                                 fetch_names=_names(fetch))
+        assert any("shape-consistency" in str(w.message) for w in first)
+        with warnings.catch_warnings(record=True) as second:
+            warnings.simplefilter("always")
+            maybe_verify_program(tprog, feed_names=["x"],
+                                 fetch_names=_names(fetch))
+        assert not second, [str(w.message) for w in second]
+    finally:
+        paddle_tpu.set_flags({"FLAGS_verify_program": "on"})
+        reset_finding_dedup()

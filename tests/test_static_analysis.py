@@ -483,6 +483,99 @@ def test_lock_order_compile_lock_exempt():
     assert not lint.lock_order.check_sources({"c.py": src})
 
 
+_LOCAL_RECEIVER_SRC = """
+import threading
+
+class Batcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.w = Worker()
+    def push(self):
+        with self._lock:
+            w = self.w
+            w.drain()
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.b = Batcher()
+    def drain(self):
+        with self._lock:
+            pass
+    def kick(self):
+        with self._lock:
+            b = self.b
+            b.push()
+"""
+
+
+def test_lock_order_resolves_plain_local_receivers():
+    """`w = self.w; w.drain()` must resolve like `self.w.drain()` — the
+    call-graph edge (and the cycle) survives the local alias."""
+    findings = lint.lock_order.check_sources({"a.py": _LOCAL_RECEIVER_SRC})
+    assert any("lock-order cycle" in f.message
+               and "Batcher._lock" in f.message
+               and "Worker._lock" in f.message
+               for f in findings), [f.message for f in findings]
+
+
+_MODULE_SINGLETON_SRC = """
+import threading
+
+class Engine:
+    def __init__(self):
+        self.mu = threading.Lock()
+    def run(self):
+        with self.mu:
+            _PUMP.go()
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+    def go(self):
+        lk = self._lock
+        with lk:
+            _ENGINE.run()
+
+_ENGINE = Engine()
+_PUMP = Pump()
+"""
+
+
+def test_lock_order_resolves_module_singletons_and_lock_aliases():
+    """Module-level `_ENGINE = Engine()` receivers and `lk = self._lock`
+    acquisitions both resolve; the cross-singleton cycle is reported."""
+    findings = lint.lock_order.check_sources(
+        {"b.py": _MODULE_SINGLETON_SRC})
+    assert any("lock-order cycle" in f.message
+               and "Engine.mu" in f.message and "Pump._lock" in f.message
+               for f in findings), [f.message for f in findings]
+
+
+def test_lock_order_untyped_locals_stay_unresolved():
+    """A local bound from an arbitrary call has no known type: no edge
+    may be invented, even when a wrong guess would close a cycle."""
+    src = ("import threading\n"
+           "class G:\n"
+           "    def __init__(self):\n"
+           "        self.mu = threading.Lock()\n"
+           "    def a(self, x):\n"
+           "        with self.mu:\n"
+           "            h = x.get()\n"
+           "            h.b()\n"
+           "class H:\n"
+           "    def __init__(self):\n"
+           "        self.mu = threading.Lock()\n"
+           "    def b(self):\n"
+           "        with self.mu:\n"
+           "            pass\n"
+           "    def c(self, y):\n"
+           "        with self.mu:\n"
+           "            g = y.get()\n"
+           "            g.a(None)\n")
+    assert not lint.lock_order.check_sources({"c.py": src})
+
+
 _SIDE_EFFECT_SRC = """
 import jax
 
@@ -552,6 +645,48 @@ def test_run_lints_aggregator_fails_on_regression(tmp_path):
         capture_output=True, text=True, timeout=120)
     assert proc.returncode == 1, proc.stdout + proc.stderr
     assert "finding" in proc.stderr
+
+
+def test_shapecheck_cli_selftest():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "shapecheck.py"),
+         "--selftest"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "selftest ok" in proc.stdout
+
+
+def test_shapecheck_cli_dump_roundtrip(tmp_path):
+    """Executor-grade verification of a Program.to_dict() dump, then
+    the same dump with a planted dtype drift (exit 1 + finding)."""
+    import json
+
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup), unique_name.guard():
+        x = fluid.data("x", [8, 4], "float32")
+        y = fluid.layers.fc(x, 4)
+    d = main.to_dict()
+    clean = tmp_path / "prog.json"
+    clean.write_text(json.dumps(d))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "shapecheck.py"),
+         str(clean), "--feed", "x", "--fetch", y.name],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    # plant the renamed/removed-var signature (catchable without jax:
+    # dataflow corruption, not numeric rule evaluation)
+    op0 = d["blocks"][0]["ops"][0]
+    slot = next(iter(op0["inputs"]))
+    op0["inputs"][slot] = ["ghost" for _ in op0["inputs"][slot]]
+    dirty = tmp_path / "dirty.json"
+    dirty.write_text(json.dumps(d))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "shapecheck.py"),
+         str(dirty), "--feed", "x", "--fetch", y.name],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "renamed or removed" in proc.stderr
 
 
 def test_tpulint_cli():

@@ -17,8 +17,11 @@ python -m pytest tests/test_checkpoint.py -q -k smoke
 echo "== unit tests (8-dev virtual CPU mesh) =="
 python -m pytest tests/ -x -q
 
-echo "== static analysis: tpulint rules + op-test coverage floor =="
-python tools/run_lints.py
+echo "== static analysis: tpulint rules + op-test coverage floor + shape-consistency sweep =="
+python tools/run_lints.py --shape-check
+
+echo "== static analysis: shapecheck selftest (jax-free dump checker) =="
+python tools/shapecheck.py --selftest
 
 echo "== observability: tracetool selftest (spans + op-profile walk + telemetry metrics replay) =="
 python tools/tracetool.py selftest

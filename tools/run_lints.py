@@ -11,6 +11,9 @@ op_coverage counts the ops its passes insert.
   python tools/run_lints.py                  # everything
   python tools/run_lints.py --skip-op-coverage   # AST lints only
                                                  # (no jax needed)
+  python tools/run_lints.py --shape-check    # + shape-consistency
+                                             # sweep over the fixture
+                                             # zoo (raw + transformed)
 
 Exit status: 0 all gates clean, 1 otherwise.
 """
@@ -33,11 +36,57 @@ from tpulint import load_lint  # noqa: E402
 OP_COVERAGE_FAIL_UNDER = 90.0
 
 
+def _shape_check_sweep() -> int:
+    """Build the fixture-program zoo and run the shape-consistency
+    checker over every program, raw AND after the shipped transform
+    pipeline — the CI twin of
+    tests/test_shape_check.py::test_fixture_zoo_clean_after_shipped_transforms.
+    Needs jax (programs are built through the layers API)."""
+    repo = os.path.dirname(_TOOLS)
+    for p in (repo, os.path.join(repo, "tests")):
+        if p not in sys.path:
+            sys.path.insert(0, p)
+    from fixtures import programs as fixture_programs
+    from paddle_tpu.analysis import shape_check
+    from paddle_tpu.transforms import apply_transforms
+
+    shipped = ["fold_bn", "layout_optimize", "dead_op_elim"]
+    checked = bad = 0
+    for name, main_p, startup, fetch in fixture_programs.build_all():
+        fetch_names = [v.name if hasattr(v, "name") else str(v)
+                       for v in fetch or ()]
+        for label, prog, fl in (("main", main_p, fetch_names),
+                                ("startup", startup, None)):
+            variants = [("raw", prog)]
+            tprog, _ = apply_transforms(prog, fetch_names=fl,
+                                        passes=shipped)
+            variants.append(("transformed", tprog))
+            for kind, p in variants:
+                findings = shape_check.check_program(p, fetch_list=fl)
+                checked += 1
+                if findings:
+                    bad += 1
+                    print(f"run_lints: shape-check {name}/{label} "
+                          f"({kind}) reported {len(findings)} "
+                          f"finding(s):", file=sys.stderr)
+                    for f in findings:
+                        print(f"  {f}", file=sys.stderr)
+    if bad:
+        return 1
+    print(f"run_lints: shape-check clean "
+          f"({checked} program variants swept)")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--skip-op-coverage", action="store_true",
                     help="skip the op-coverage gate (it imports "
                          "paddle_tpu.ops.registry, which needs jax)")
+    ap.add_argument("--shape-check", action="store_true",
+                    help="also sweep the fixture-program zoo (raw + "
+                         "transformed) through the shape-consistency "
+                         "checker (needs jax)")
     ap.add_argument("--root", default=None,
                     help="repo root to lint (default: this repo)")
     args = ap.parse_args(argv)
@@ -62,6 +111,11 @@ def main(argv=None) -> int:
             ["--fail-under", str(OP_COVERAGE_FAIL_UNDER)])
         if cov_rc:
             print("run_lints: op_coverage gate failed", file=sys.stderr)
+            rc = 1
+
+    if args.shape_check:
+        if _shape_check_sweep():
+            print("run_lints: shape-check gate failed", file=sys.stderr)
             rc = 1
     return rc
 

@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""shapecheck CLI: whole-program shape/dtype verification over a
+serialized Program (ISSUE 11 tooling satellite).
+
+Runs the `shape-consistency` abstract interpreter
+(paddle_tpu/analysis/shape_check.py) over `Program.to_dict()` JSON
+dumps, plus the cross-program collective-order diff when several dumps
+are given — the same ERROR-tier checks the Executor runs at every
+compile-cache miss, usable from CI boxes and dump post-mortems.
+
+The analysis package is stdlib-only at module scope and is loaded by
+FILE PATH (tpulint idiom), so this tool runs in environments without
+jax: ops with no declarative fallback rule degrade to "unknown" instead
+of aborting, which keeps every reported finding trustworthy.
+
+Usage:
+  python tools/shapecheck.py prog.json [more.json ...]
+  python tools/shapecheck.py prog.json --feed x,y --fetch loss
+  python tools/shapecheck.py --selftest
+
+Exit status: 0 clean, 1 findings, 2 usage/load error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PKG = os.path.join(REPO_ROOT, "paddle_tpu", "analysis")
+_MOD = "paddle_tpu_analysis"
+
+
+def load_analysis():
+    """The analysis package, loaded by path so that importing it never
+    drags in paddle_tpu (and therefore jax)."""
+    existing = sys.modules.get(_MOD)
+    if existing is not None:
+        return existing
+    spec = importlib.util.spec_from_file_location(
+        _MOD, os.path.join(_PKG, "__init__.py"),
+        submodule_search_locations=[_PKG])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[_MOD] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _split(arg):
+    return [s for s in (arg or "").split(",") if s] or None
+
+
+def _selftest(analysis) -> int:
+    """Prove the jax-free path catches what it must: a clean program
+    stays clean, a dtype drift on a fallback-rule op fires, and an
+    undeclared read (the renamed/removed-var signature) fires."""
+    sc = analysis.shape_check
+
+    def prog(out_dtype="float32", read="x"):
+        return {
+            "blocks": [{
+                "idx": 0, "parent_idx": -1,
+                "vars": [
+                    {"name": "x", "shape": [-1, 4], "dtype": "float32",
+                     "is_data": True},
+                    {"name": "out", "shape": [-1, 4],
+                     "dtype": out_dtype},
+                ],
+                "ops": [{
+                    "id": 1, "type": "c_allreduce_sum",
+                    "inputs": {"X": [read]}, "outputs": {"Out": ["out"]},
+                    "attrs": {"ring_id": 0},
+                }],
+            }],
+        }
+
+    clean = sc.check_program_dict(prog(), feed=["x"], fetch_list=["out"])
+    if clean:
+        print("selftest: clean program reported findings:", file=sys.stderr)
+        for f in clean:
+            print(f"  {f}", file=sys.stderr)
+        return 1
+    drift = sc.check_program_dict(prog(out_dtype="int32"),
+                                  feed=["x"], fetch_list=["out"])
+    if not any("dtype" in f.message for f in drift):
+        print("selftest: dtype drift not caught", file=sys.stderr)
+        return 1
+    ghost = sc.check_program_dict(prog(read="ghost"),
+                                  feed=["x"], fetch_list=["out"])
+    if not any("renamed or removed" in f.message for f in ghost):
+        print("selftest: undeclared read not caught", file=sys.stderr)
+        return 1
+    print("shapecheck: selftest ok (clean/dtype-drift/undeclared-read)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="shapecheck", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("dumps", nargs="*",
+                    help="Program.to_dict() JSON file(s)")
+    ap.add_argument("--feed", default=None,
+                    help="comma-separated feed var names")
+    ap.add_argument("--fetch", default=None,
+                    help="comma-separated fetch var names")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the built-in jax-free self test and exit")
+    args = ap.parse_args(argv)
+
+    analysis = load_analysis()
+    if args.selftest:
+        return _selftest(analysis)
+    if not args.dumps:
+        ap.print_usage(sys.stderr)
+        return 2
+
+    sc = analysis.shape_check
+    feed, fetch = _split(args.feed), _split(args.fetch)
+    rc = 0
+    views = []
+    for path in args.dumps:
+        try:
+            with open(path) as fh:
+                d = json.load(fh)
+        except (OSError, ValueError) as e:
+            print(f"shapecheck: {path}: {e}", file=sys.stderr)
+            return 2
+        view = sc.ProgramView(d)
+        views.append((path, view))
+        findings = sc.check_program(view, feed=feed, fetch_list=fetch)
+        for f in findings:
+            print(f"  {f}", file=sys.stderr)
+        if findings:
+            print(f"shapecheck: {path}: {len(findings)} finding(s)",
+                  file=sys.stderr)
+            rc = 1
+        else:
+            print(f"shapecheck: {path}: clean")
+
+    if len(views) > 1:
+        # dumps given together are declared to share a mesh: diff their
+        # collective issue orders pairwise
+        co = analysis.collective_order
+        sigs = [(p, co.collective_signature(v)) for p, v in views]
+        for i, (pa, sa) in enumerate(sigs):
+            for pb, sb in sigs[i + 1:]:
+                diff = co._diff_signatures(sa, sb)
+                if diff is not None:
+                    entry, pc, po = diff
+                    print(f"shapecheck: collective order of {pa} "
+                          f"diverges from {pb} near "
+                          f"{entry[1]}@ring{entry[0]}: "
+                          f"[{co._fmt(pc)}] vs [{co._fmt(po)}]",
+                          file=sys.stderr)
+                    rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
