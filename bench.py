@@ -44,6 +44,25 @@ PROBE_CACHE_TTL_S = float(os.environ.get("PADDLE_TPU_PROBE_TTL_S", "1800"))
 PROBE_CACHE_NEG_TTL_S = float(os.environ.get("PADDLE_TPU_PROBE_NEG_TTL_S",
                                              "120"))
 
+# last probe verdict record for detail stamping ({ok, reason, cache,
+# verdict_age_s}); None until the probe path runs (e.g. env-pinned CPU)
+_PROBE_RECORD = None
+
+
+def _tpu_probe_detail():
+    """The probe record every BENCH `detail` carries: why this run is
+    on-chip or cpu-fallback, whether the verdict came from the session
+    cache and how stale it was.  A cpu-fallback BENCH line is then
+    diagnosable (wedged tunnel vs missing plugin vs operator pin)
+    without hunting for the stderr of the run that probed."""
+    if os.environ.get("JAX_PLATFORMS") == "cpu" and _PROBE_RECORD is None:
+        return {"ok": False, "reason": "JAX_PLATFORMS=cpu (pinned)",
+                "cache": "none", "verdict_age_s": 0.0}
+    if _PROBE_RECORD is None:
+        return {"ok": None, "reason": "probe never ran",
+                "cache": "none", "verdict_age_s": 0.0}
+    return dict(_PROBE_RECORD)
+
 
 def _tpu_probe_subprocess(timeout_s=75.0, attempts=3, backoff_s=20.0):
     """Probe the TPU backend in a THROWAWAY subprocess.
@@ -51,7 +70,11 @@ def _tpu_probe_subprocess(timeout_s=75.0, attempts=3, backoff_s=20.0):
     The axon tunnel wedges for hours: backend init then blocks every
     process that touches it, and jax memoizes the failure, so the probe
     must not run in the bench process (VERDICT r3 weak #1 / next #1a).
-    Several short attempts with backoff instead of one 240s block."""
+    Several short attempts with backoff instead of one 240s block.
+
+    Returns (ok, reason) — the reason says WHY a negative verdict was
+    reached (exit code vs wedged-tunnel timeout), so a cpu-fallback
+    BENCH line is diagnosable from its JSON alone."""
     code = ("import jax\n"
             "assert jax.default_backend() == 'tpu'\n"
             "import jax.numpy as jnp\n"
@@ -61,19 +84,20 @@ def _tpu_probe_subprocess(timeout_s=75.0, attempts=3, backoff_s=20.0):
             r = subprocess.run([sys.executable, "-c", code],
                                capture_output=True, timeout=timeout_s)
             if r.returncode == 0 and b"4.0" in r.stdout:
-                return True
+                return True, "probe ok"
             # fast non-zero exit = no TPU plugin at all; retrying and
             # backing off cannot help — bail straight to CPU
             print("bench: no TPU backend (probe exited "
                   f"{r.returncode})", file=sys.stderr)
-            return False
+            return False, f"no TPU backend (probe exited {r.returncode})"
         except subprocess.TimeoutExpired:
             # a TIMEOUT is the wedged-tunnel signature: worth retrying
             print(f"bench: TPU probe attempt {i + 1}/{attempts} "
                   "timed out", file=sys.stderr)
             if i + 1 < attempts:
                 time.sleep(backoff_s)
-    return False
+    return False, (f"all {attempts} probe attempts timed out at "
+                   f"{timeout_s:.0f}s (wedged-tunnel signature)")
 
 
 def _tpu_probe_cached():
@@ -91,7 +115,12 @@ def _tpu_probe_cached():
     ok=false only for PADDLE_TPU_PROBE_NEG_TTL_S (default 120s) — a
     single flaky probe result must not poison the whole session into
     cpu-fallback; once the short TTL lapses the chip is re-probed
-    before falling back."""
+    before falling back.
+
+    The returned record {ok, reason, cache, verdict_age_s} also lands
+    in `_PROBE_RECORD` so every BENCH detail can stamp WHY this run is
+    (or is not) on chip and how old the verdict was."""
+    global _PROBE_RECORD
     try:
         with open(PROBE_CACHE) as f:
             rec = json.load(f)
@@ -102,22 +131,30 @@ def _tpu_probe_cached():
             print(f"bench: cached TPU probe verdict ok={rec['ok']} "
                   f"({age:.0f}s old, ttl {ttl:.0f}s, {PROBE_CACHE})",
                   file=sys.stderr)
-            return bool(rec["ok"])
+            _PROBE_RECORD = {"ok": bool(rec["ok"]),
+                             "reason": str(rec.get("reason",
+                                                   "cached verdict")),
+                             "cache": "hit",
+                             "verdict_age_s": round(age, 1)}
+            return _PROBE_RECORD
         if not rec["ok"]:
             print(f"bench: negative probe verdict expired ({age:.0f}s "
                   f"> {ttl:.0f}s); re-probing before falling back",
                   file=sys.stderr)
     except (OSError, ValueError, KeyError, TypeError):
         pass
-    ok = _tpu_probe_subprocess()
+    ok, reason = _tpu_probe_subprocess()
+    _PROBE_RECORD = {"ok": bool(ok), "reason": reason, "cache": "miss",
+                     "verdict_age_s": 0.0}
     try:
         os.makedirs(os.path.dirname(PROBE_CACHE), exist_ok=True)
         with open(PROBE_CACHE, "w") as f:
-            json.dump({"ok": bool(ok), "at": time.time()}, f)
+            json.dump({"ok": bool(ok), "reason": reason,
+                       "at": time.time()}, f)
     except OSError as e:
         print(f"bench: could not cache probe verdict: {e}",
               file=sys.stderr)
-    return ok
+    return _PROBE_RECORD
 
 
 def bench_feed_pipeline(jax, jnp):
@@ -686,6 +723,77 @@ def _resnet_op_profile_detail():
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def _device_profile_detail():
+    """`detail.device_profile` (ISSUE 12 tentpole): MEASURED device
+    time for the transformed toy ResNet-50 — compile through the
+    Executor outside the capture window, then profile two dispatches
+    under `obs.profile_window` and report the measured/attributed split
+    plus the top ops by measured time with their roofline verdicts.
+    This is the measured counterpart of `detail.op_profile` (analytic
+    FLOPs): the two tables disagreeing is the signal the roofline
+    exists to surface.  Outside the timed region; failures degrade to
+    an error string."""
+    try:
+        import paddle_tpu
+        import paddle_tpu.fluid as pfluid
+        from paddle_tpu import obs
+        from paddle_tpu.fluid import framework, unique_name
+        from paddle_tpu.models import resnet as presnet
+
+        with framework.program_guard(pfluid.Program(), pfluid.Program()), \
+                unique_name.guard():
+            main, startup, _feeds, fetches = presnet.build_train_program(
+                depth=50, class_num=10, image_shape=(3, 32, 32),
+                batch_size=2, width=4)
+        infer = main.clone(for_test=True)
+        feed = {"image": np.zeros((2, 3, 32, 32), np.float32),
+                "label": np.zeros((2, 1), np.int64)}
+        old = paddle_tpu.get_flags(["FLAGS_graph_transforms"])[
+            "FLAGS_graph_transforms"]
+        paddle_tpu.set_flags({"FLAGS_graph_transforms": "on,fold_bn=on"})
+        try:
+            scope = pfluid.executor.Scope()
+            with pfluid.executor.scope_guard(scope):
+                exe = pfluid.Executor()
+                exe.run(startup)
+                # compile (cache miss) OUTSIDE the window so the capture
+                # holds steady-state dispatches only
+                exe.run(infer, feed=feed, fetch_list=[fetches[0].name])
+                with obs.profile_window(label="bench.device_profile"):
+                    for _ in range(2):
+                        exe.run(infer, feed=feed,
+                                fetch_list=[fetches[0].name])
+        finally:
+            paddle_tpu.set_flags({"FLAGS_graph_transforms": old})
+        from paddle_tpu.obs import devprof
+
+        res = devprof.last_result()
+        if res is None:
+            return {"error": "no devprof window captured"}
+        if res.get("error"):
+            return {"error": res["error"]}
+        roof = res.get("roofline") or {}
+        rows = [r for r in roof.get("ops", [])
+                if r["op"] != devprof.UNATTRIBUTED][:8]
+        unattr = next((r for r in roof.get("ops", [])
+                       if r["op"] == devprof.UNATTRIBUTED), None)
+        return {
+            "capture_ms": round(res["capture_ms"], 2),
+            "device_class": res["device_class"],
+            "runs": res["runs"],
+            "events": res["events"],
+            "measured_ms": round(res["measured_ms"], 3),
+            "attributed_pct": round(res["attributed_pct"], 2),
+            "unattributed_ms": round(unattr["time_ms"], 3) if unattr
+            else 0.0,
+            "top_time": [{"op": r["op"],
+                          "share_pct": round(r["share_pct"], 2),
+                          "bound": r["bound"]} for r in rows],
+        }
+    except Exception as e:  # noqa: BLE001 - detail must not kill the bench
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def bench_resnet50(jax, jnp, on_tpu, batch=None):
     """ResNet-50 train-step throughput, images/sec/chip (BASELINE.md
     row 1; reference anchor: the book image-classification fixture
@@ -816,6 +924,10 @@ def bench_resnet50(jax, jnp, on_tpu, batch=None):
                    **pipe,
                    "layout": _resnet_layout_detail(),
                    "op_profile": _resnet_op_profile_detail(),
+                   "device_profile": _run_with_watchdog(
+                       _device_profile_detail, timeout_s=120,
+                       what="device profile capture"),
+                   "tpu_probe": _tpu_probe_detail(),
                    "loss": final_loss},
     }
 
@@ -900,6 +1012,7 @@ def bench_serving(jax, jnp, on_tpu):
             "trace_count": eng.model.runner.trace_count,
             "buckets": list(cfg.buckets),
             "feature_dim": d_in,
+            "tpu_probe": _tpu_probe_detail(),
         }
         return {
             "metric": "serving_p99_latency_ms",
@@ -929,7 +1042,7 @@ def main():
     # decide the backend BEFORE jax loads: a wedged tunnel would block
     # this process's backend init for good
     if os.environ.get("JAX_PLATFORMS") != "cpu" \
-            and not _tpu_probe_cached():
+            and not _tpu_probe_cached()["ok"]:
         print("bench: TPU unreachable; pinning to CPU", file=sys.stderr)
         os.environ["JAX_PLATFORMS"] = "cpu"
     jax, backend = _init_backend()
@@ -1103,6 +1216,12 @@ def main():
     # region over the real in-process sources, gated by bench_diff
     detail["telemetry"] = _run_with_watchdog(
         bench_telemetry, timeout_s=120, what="telemetry bench")
+    # measured device time + roofline (ISSUE 12): AFTER the timed
+    # region — jax.profiler.trace around the toy ResNet dispatches
+    detail["device_profile"] = _run_with_watchdog(
+        _device_profile_detail, timeout_s=120,
+        what="device profile capture")
+    detail["tpu_probe"] = _tpu_probe_detail()
     result = {
         "metric": ("bert_base_pretrain_mfu" if on_tpu
                    else "bert_tiny_pretrain_mfu_cpu"),

@@ -95,6 +95,16 @@ WATCHLIST: List[Tuple[str, str]] = [
     ("paddle_tpu/obs/telemetry.py", "Watchdog.evaluate"),
     ("paddle_tpu/obs/telemetry.py", "Watchdog.observe"),
     ("paddle_tpu/obs/telemetry.py", "_Handler.do_GET"),
+    # measured device-time profiling (ISSUE 12): note_dispatch and the
+    # autostop check run INSIDE the dispatch/step loop; window
+    # start/finish and the xplane parse run at window boundaries but on
+    # the training thread — capture must never smuggle a sync into the
+    # hot path it is measuring
+    ("paddle_tpu/obs/devprof.py", "note_dispatch"),
+    ("paddle_tpu/obs/devprof.py", "maybe_autostop"),
+    ("paddle_tpu/obs/devprof.py", "DevprofWindow.start"),
+    ("paddle_tpu/obs/devprof.py", "DevprofWindow.finish"),
+    ("paddle_tpu/obs/devprof.py", "parse_xplane_bytes"),
 ]
 
 # blocking / transferring constructs that must not appear unsanctioned

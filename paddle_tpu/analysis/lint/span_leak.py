@@ -41,6 +41,8 @@ WATCHED = [
     "paddle_tpu/obs",
     "paddle_tpu/obs/telemetry.py",  # explicit: the live-telemetry layer
     # stays covered even if the obs dir entry is ever narrowed
+    "paddle_tpu/obs/devprof.py",  # explicit: same reasoning for the
+    # measured device-time profiler (ISSUE 12)
     "paddle_tpu/ckpt",
     "paddle_tpu/profiler",
     "paddle_tpu/fluid/executor.py",

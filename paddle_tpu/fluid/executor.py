@@ -732,6 +732,17 @@ class Executor:
             telemetry = obs.maybe_start_telemetry()
         except Exception:  # noqa: BLE001 - observability, not control
             pass
+        # PADDLE_OBS_DEVPROF auto-attach: arm a bounded measured
+        # device-time window over the first N steps of this pass
+        # (None when the env knob is unset)
+        devprof_window = None
+        try:
+            from ..obs import devprof as _devprof
+
+            devprof_window = _devprof.maybe_start_env_window(
+                label="train_from_dataset")
+        except Exception:  # noqa: BLE001 - observability, not control
+            pass
         if ckpt is not None and ckpt.skip_pass:
             # the restored checkpoint is from a LATER epoch than this
             # pass: the work this call represents already happened —
@@ -769,6 +780,13 @@ class Executor:
                         h.block_until_ready()  # sync-ok: dispatch-ahead throttle
                 if ckpt is not None:
                     ckpt.on_step()
+                if devprof_window is not None:
+                    # step boundary, off the dispatch call itself:
+                    # finish the window once its budget is spent
+                    from ..obs import devprof as _devprof
+
+                    if _devprof.maybe_autostop() is not None:
+                        devprof_window = None
                 if step_callback is not None:
                     step_callback(self._step,
                                   step if ckpt is None
@@ -783,6 +801,10 @@ class Executor:
             stat_set("in_flight_steps", 0)
             if monitor is not None:
                 monitor.stop()
+            if devprof_window is not None:
+                # short pass: the window outlived the loop; finish it
+                # so the capture is never left armed
+                devprof_window.finish()
             if telemetry is not None:
                 telemetry.close()
         if ckpt is not None:
@@ -1074,7 +1096,10 @@ class Executor:
             entry.fn_compiled, entry.cost = compile_with_cost(
                 entry.fn, (mutable_state, const_state, feed_arrays, seed),
                 entry.label)
-        with obs.span("executor.dispatch"):
+        with obs.span("executor.dispatch") as sp:
+            # devprof window bookkeeping: a single attribute check when
+            # no capture window is armed; never syncs, never transfers
+            obs.devprof.note_dispatch(sp, entry.label)
             if entry.fn_compiled is not None:
                 try:
                     result = entry.fn_compiled(mutable_state, const_state,

@@ -50,21 +50,26 @@ import os as _os
 import threading as _threading
 
 from . import cost
+from . import devprof
 from . import opprof
 from . import telemetry
 from .tracing import NULL_SPAN, TRACER, Tracer  # noqa: F401
 
 __all__ = ["span", "add_span", "new_flow", "attach_flow", "current_span",
            "enable", "disable", "enabled", "reset", "snapshot",
-           "export_trace", "op_profile", "cost", "opprof", "telemetry",
+           "export_trace", "op_profile", "profile_window", "roofline",
+           "cost", "devprof", "opprof", "telemetry",
            "start_telemetry", "stop_telemetry", "maybe_start_telemetry",
            "telemetry_epoch_refresh", "telemetry_handle", "TRACER",
            "NULL_SPAN", "Tracer"]
 
 
 def enable(reset: bool = False) -> None:
-    """Turn span recording on (optionally clearing the buffer)."""
+    """Turn span recording on (optionally clearing the buffer along
+    with any completed devprof captures — see reset())."""
     TRACER.enable(reset=reset)
+    if reset:
+        devprof.reset()
 
 
 def disable() -> None:
@@ -76,8 +81,12 @@ def enabled() -> bool:
 
 
 def reset() -> None:
-    """Clear the span buffer and drop counter (enabled state kept)."""
+    """Clear the span buffer and drop counter (enabled state kept).
+    Completed devprof captures are cleared too — a fresh trace must
+    not merge device tracks from a window profiled before the
+    reset."""
     TRACER.reset()
+    devprof.reset()
 
 
 def span(name: str, flow=None, attrs: Optional[dict] = None):
@@ -117,6 +126,30 @@ def op_profile(program=None, label: Optional[str] = None) \
     prog_id = getattr(program, "prog_id", None) \
         if program is not None else None
     return opprof.profile_for(prog_id=prog_id, label=label)
+
+
+def profile_window(steps: Optional[int] = None,
+                   label: Optional[str] = None):
+    """Arm a bounded *measured* device-time capture window
+    (obs/devprof.py): `jax.profiler` trace around the next dispatches,
+    xplane parse, and the join back onto source Program ops.  Use as a
+    context manager, or pass `steps=N` and let the Executor training
+    loop auto-stop it.  `PADDLE_OBS_DEVPROF=1` arms the same window
+    from the environment."""
+    return devprof.profile_window(steps=steps, label=label)
+
+
+def roofline(program=None, label: Optional[str] = None) \
+        -> Optional[Dict[str, Any]]:
+    """The measured roofline for `program` (matched by the SOURCE
+    prog_id the window's join attributed time to), for an exact window
+    `label`, or the most recent window when neither is given: per-op
+    measured time vs opprof FLOPs/bytes -> achieved-FLOPs/achieved-BW
+    and a compute-/memory-/relayout-bound verdict.  None until a
+    profile_window has finished."""
+    prog_id = getattr(program, "prog_id", None) \
+        if program is not None else None
+    return devprof.roofline_for(prog_id=prog_id, label=label)
 
 
 def _process_index() -> int:
@@ -185,6 +218,7 @@ def snapshot(all_hosts: bool = False) -> Dict[str, Any]:
         "spans": TRACER.summary(),
         "cost": cost.snapshot(),
         "op_profile": opprof.snapshot(),
+        "devprof": devprof.snapshot(),
         **local,
     }
     if all_hosts:
@@ -355,10 +389,20 @@ def telemetry_epoch_refresh() -> None:
 def export_trace(path: str, include_snapshot: bool = True) -> int:
     """Write the recorded spans as Chrome-trace/Perfetto JSON.  The
     snapshot rides in otherData so tracetool can summarize MFU and
-    stall attribution from the one file.  Returns the span count."""
+    stall attribution from the one file; when a devprof window has
+    captured measured device time, its device op events merge in as
+    their own tracks, flow-linked from the `executor.dispatch` spans
+    that launched them.  Returns the span ("X") event count."""
     other = None
     if include_snapshot:
         snap = snapshot()
         snap.pop("spans", None)  # the events ARE the span detail
         other = {"snapshot": snap}
-    return TRACER.export(path, other_data=other)
+    doc = TRACER.chrome_trace(other_data=other)
+    try:
+        devprof.merge_chrome_trace(doc)
+    except Exception:  # noqa: BLE001 - the host trace must still export
+        pass
+    with open(path, "w") as f:
+        _json.dump(doc, f)
+    return sum(1 for e in doc["traceEvents"] if e.get("ph") == "X")

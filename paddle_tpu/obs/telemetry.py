@@ -75,6 +75,7 @@ GAUGE_STATS = frozenset({
     "serving_batch_occupancy_max", "serving_kv_pages_in_use",
     "ring_occupancy", "ring_occupancy_max",
     "in_flight_steps", "in_flight_steps_max",
+    "devprof_attributed_pct",
 })
 # timer-table entries written with time_set (per-epoch gauges), not
 # time_add accumulators
@@ -200,6 +201,7 @@ DEFAULT_THRESHOLDS: Dict[str, float] = {
     "window_ms": 1000.0,        # sample window (set from sample_s)
     "collective_jump_frac": 0.5,  # bytes-on-wire growth within window
     "collective_min_bytes": 1024.0,
+    "host_lost_stale_s": 300.0,   # pod-merged snapshot staleness limit
 }
 
 
@@ -317,6 +319,25 @@ def rule_collective_bytes_jump(v, cfg) -> Optional[str]:
     return None
 
 
+def rule_host_lost(v, cfg) -> Optional[str]:
+    """A host dropped out of the pod-merged snapshot, or the merged
+    view itself went stale.  `hosts_reporting` is recorded at every
+    refresh_merged; on a single-host run the peak never exceeds 1 and
+    the rule stays silent."""
+    xs = v.vals("hosts_reporting")
+    peak = max(xs) if xs else 0.0
+    if peak > 1 and xs[-1] < peak:
+        return (f"{int(peak - xs[-1])} host(s) missing from the "
+                f"pod-merged snapshot ({int(xs[-1])}/{int(peak)} "
+                f"reporting)")
+    age = v.last("merged_age_s")
+    if peak > 1 and age is not None and age > cfg["host_lost_stale_s"]:
+        return (f"pod-merged snapshot is {age:.0f} s stale (limit "
+                f"{cfg['host_lost_stale_s']:.0f} s) — the gather "
+                f"stopped reaching this host")
+    return None
+
+
 RULES: List[Tuple[str, Callable]] = [
     ("step_time_spike", rule_step_time_spike),
     ("mfu_drop", rule_mfu_drop),
@@ -326,6 +347,7 @@ RULES: List[Tuple[str, Callable]] = [
     ("ckpt_stall", rule_ckpt_stall),
     ("feed_starvation", rule_feed_starvation),
     ("collective_bytes_jump", rule_collective_bytes_jump),
+    ("host_lost", rule_host_lost),
 ]
 
 
@@ -532,6 +554,9 @@ def default_sources() -> Callable[[], Dict[str, Any]]:
                 gauges["serving_p99_ms"] = float(ls["p99_ms"])
         except Exception:  # noqa: BLE001 - no serving traffic
             pass
+        # devprof's capture stats need no extra source: _publish writes
+        # devprof_capture_ms / devprof_attributed_pct into the profiler
+        # tables folded above (attributed_pct is a level via GAUGE_STATS)
         return {"counters": profiler.get_int_stats(),
                 "timers_ms": profiler.get_time_stats(),
                 "gauges": gauges}
@@ -611,6 +636,9 @@ class Collector:
                                               name, raw), cum=raw)
         for name, val in (data.get("gauges") or {}).items():
             self.store.record(now, name, GAUGE, val)
+        if self._merged_t is not None:
+            self.store.record(now, "merged_age_s", GAUGE,
+                              max(0.0, now - self._merged_t))
         fired = []
         if self.watchdog is not None:
             fired = self.watchdog.observe(self, now)
@@ -660,7 +688,13 @@ class Collector:
             self._merged = gather_fn()
             self._merged_t = self.clock()
         except Exception:  # noqa: BLE001 - observability, not control
-            pass
+            return
+        hosts = (self._merged or {}).get("hosts")
+        if isinstance(hosts, (list, dict)):
+            # level feed for the host_lost watchdog rule: a host that
+            # stops contributing shows up as a drop below the peak
+            self.store.record(self._merged_t, "hosts_reporting",
+                              GAUGE, float(len(hosts)))
 
     def merged(self) -> Optional[dict]:
         if self._merged is None:

@@ -313,11 +313,13 @@ class TestProbeCacheNegativeTTL:
         cache = tmp_path / "probe.json"
         cache.write_text(json.dumps({"ok": False, "at": time.time()}))
         monkeypatch.setattr(bench, "PROBE_CACHE", str(cache))
+        monkeypatch.setattr(bench, "_PROBE_RECORD", None)
         monkeypatch.setattr(bench, "_tpu_probe_subprocess",
                             lambda *a, **k: pytest.fail(
                                 "fresh negative verdict must not "
                                 "re-probe"))
-        assert bench._tpu_probe_cached() is False
+        rec = bench._tpu_probe_cached()
+        assert rec["ok"] is False and rec["cache"] == "hit"
 
     def test_expired_negative_verdict_reprobes(self, tmp_path,
                                                monkeypatch):
@@ -328,13 +330,18 @@ class TestProbeCacheNegativeTTL:
         cache.write_text(json.dumps({"ok": False,
                                      "at": time.time() - 600}))
         monkeypatch.setattr(bench, "PROBE_CACHE", str(cache))
+        monkeypatch.setattr(bench, "_PROBE_RECORD", None)
         calls = []
-        monkeypatch.setattr(bench, "_tpu_probe_subprocess",
-                            lambda *a, **k: calls.append(1) or True)
-        assert bench._tpu_probe_cached() is True
+        monkeypatch.setattr(
+            bench, "_tpu_probe_subprocess",
+            lambda *a, **k: calls.append(1) or (True, "probe ok"))
+        rec = bench._tpu_probe_cached()
+        assert rec["ok"] is True and rec["cache"] == "miss"
         assert calls, "expired ok=false must re-probe"
-        # and the recovered verdict is re-cached as positive
-        assert json.loads(cache.read_text())["ok"] is True
+        # and the recovered verdict is re-cached as positive, with
+        # its reason alongside for the next run's detail stamp
+        saved = json.loads(cache.read_text())
+        assert saved["ok"] is True and saved["reason"] == "probe ok"
 
     def test_positive_verdict_keeps_long_ttl(self, tmp_path,
                                              monkeypatch):
@@ -343,11 +350,14 @@ class TestProbeCacheNegativeTTL:
         cache.write_text(json.dumps({"ok": True,
                                      "at": time.time() - 600}))
         monkeypatch.setattr(bench, "PROBE_CACHE", str(cache))
+        monkeypatch.setattr(bench, "_PROBE_RECORD", None)
         monkeypatch.setattr(bench, "_tpu_probe_subprocess",
                             lambda *a, **k: pytest.fail(
                                 "positive verdict inside TTL must not "
                                 "re-probe"))
-        assert bench._tpu_probe_cached() is True
+        rec = bench._tpu_probe_cached()
+        assert rec["ok"] is True and rec["cache"] == "hit"
+        assert 500 <= rec["verdict_age_s"] <= 700
 
 
 # ---------------------------------------------------------------------------

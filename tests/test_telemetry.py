@@ -285,10 +285,55 @@ class TestWatchdogRules:
                                                      4096]),
             self.CFG) is None
 
+    def test_host_lost_pos_neg(self):
+        def st(hosts, ages=()):
+            s = MetricStore()
+            for i, v in enumerate(hosts):
+                s.record(float(i), "hosts_reporting",
+                         telemetry.GAUGE, float(v))
+            for i, v in enumerate(ages):
+                s.record(float(i), "merged_age_s",
+                         telemetry.GAUGE, float(v))
+            return s
+
+        # a host drops out of the pod-merged snapshot
+        pos = telemetry.rule_host_lost(st([2, 2, 1]), self.CFG)
+        assert pos and "1 host(s) missing" in pos
+        # full pod reporting: silent
+        assert telemetry.rule_host_lost(st([2, 2, 2]), self.CFG) is None
+        # single-host run: nothing to lose, never fires
+        assert telemetry.rule_host_lost(st([1, 1]), self.CFG) is None
+        assert telemetry.rule_host_lost(
+            st([1, 1], ages=[9999]), self.CFG) is None
+        # pod intact but the merged snapshot went stale: the gather
+        # stopped reaching this host
+        stale = telemetry.rule_host_lost(
+            st([2, 2, 2], ages=[1, 2, 400]), self.CFG)
+        assert stale and "stale" in stale
+        assert telemetry.rule_host_lost(
+            st([2, 2, 2], ages=[1, 2, 30]), self.CFG) is None
+
     def test_broken_rule_is_contained(self):
         wd = Watchdog(rules=[("boom", lambda v, c: 1 / 0),
                              ("ok", lambda v, c: "fired")])
         assert wd.evaluate(_store()) == [("ok", "fired")]
+
+
+class TestHostLostFeed:
+    """refresh_merged / sample_once feed the series rule_host_lost
+    reads, so losing a pod host actually pages."""
+
+    def test_collector_records_hosts_and_merge_age(self, tmp_path):
+        col, wd, counters, timers, gauges, tick = _collector(tmp_path)
+        col.refresh_merged(lambda: {"hosts": {"0": {}, "1": {}}})
+        assert col.store.last("hosts_reporting") == 2.0
+        tick(3)
+        age = col.store.last("merged_age_s")
+        assert age is not None and age >= 3.0
+        # a failing gather leaves the last good snapshot (and its
+        # growing age) in place instead of recording a phantom count
+        col.refresh_merged(lambda: 1 / 0)
+        assert col.store.last("hosts_reporting") == 2.0
 
 
 # ---------------------------------------------------------------------------

@@ -21,6 +21,11 @@ Metrics compared (each only when present in BOTH files):
   telemetry_overhead_ms  detail.telemetry.sampler_overhead_ms
                          (rise > 50% rel AND > 2 ms abs — the live
                          sampler must stay invisible next to a step)
+  devprof_attributed_pct  detail...device_profile.attributed_pct
+                          (drop > 5 abs — the measured-time join must
+                          keep resolving thunks to Program ops; under
+                          cpu-fallback the usual warn-only regime
+                          applies)
 
 Exit status: 1 when any regression fires AND the current run is
 on-chip; under `device_class: cpu-fallback` (or a stale re-emitted
@@ -54,6 +59,7 @@ DEFAULT_THRESHOLDS = {
     "interior_transposes": ("down", 0.0, 0.0),
     "op_attribution_pct": ("up", 0.0, 5.0),
     "telemetry_overhead_ms": ("down", 0.5, 2.0),
+    "devprof_attributed_pct": ("up", 0.0, 5.0),
 }
 
 
@@ -101,6 +107,12 @@ def extract_metrics(doc: dict) -> Dict[str, float]:
     tel = _get(detail, "telemetry", "sampler_overhead_ms")
     if isinstance(tel, (int, float)):
         out["telemetry_overhead_ms"] = float(tel)
+    for dp in (_get(detail, "device_profile"),
+               _get(rd, "device_profile")):
+        dap = _get(dp or {}, "attributed_pct")
+        if isinstance(dap, (int, float)):
+            out["devprof_attributed_pct"] = float(dap)
+            break
     return out
 
 
@@ -189,7 +201,8 @@ def run_gate(baseline_path: str, current_path: str, strict: bool,
 
 def _synthetic(mfu: float, step_ms: float, transposes: int = 0,
                coll_bytes: int = 4096, device_class: str = "tpu",
-               telemetry_ms: float = 0.5) -> dict:
+               telemetry_ms: float = 0.5,
+               devprof_pct: float = 95.0) -> dict:
     return {
         "metric": "bert_base_pretrain_mfu",
         "value": mfu, "unit": "%", "vs_baseline": mfu / 45.0,
@@ -199,6 +212,8 @@ def _synthetic(mfu: float, step_ms: float, transposes: int = 0,
             "telemetry": {"sampler_overhead_ms": telemetry_ms,
                           "samples": 50, "drops": 0,
                           "rules_fired": 0},
+            "device_profile": {"attributed_pct": devprof_pct,
+                               "capture_ms": 40.0, "runs": 2},
             "obs": {"cost": {"collective_bytes":
                              {"c_allreduce_sum": coll_bytes}}},
             "resnet50": {"metric": "resnet50_images_per_sec_per_chip",
@@ -264,7 +279,20 @@ def selftest(verbose: bool = True) -> int:
     checks.append(("sub-floor telemetry wiggle passes",
                    not any(r["metric"] == "telemetry_overhead_ms"
                            and r["regressed"] for r in rows)))
-    # 9. stale re-emitted on-chip record is warn-only
+    # 9. a >5-point drop in MEASURED attribution fires (the devprof
+    # join decayed — a renamed pass or runtime renumbering change);
+    # a 3-point wiggle stays under the absolute floor
+    cur_dev = _synthetic(mfu=42.0, step_ms=100.0, devprof_pct=80.0)
+    rows = diff(base, cur_dev)
+    checks.append(("devprof attribution drop fires",
+                   any(r["metric"] == "devprof_attributed_pct"
+                       and r["regressed"] for r in rows)))
+    cur_dev_ok = _synthetic(mfu=42.0, step_ms=100.0, devprof_pct=92.0)
+    rows = diff(base, cur_dev_ok)
+    checks.append(("devprof attribution wiggle passes",
+                   not any(r["metric"] == "devprof_attributed_pct"
+                           and r["regressed"] for r in rows)))
+    # 10. stale re-emitted on-chip record is warn-only
     stale = dict(base)
     stale["detail"] = dict(base["detail"], stale_s=1234)
     checks.append(("stale on-chip record is warn-only",

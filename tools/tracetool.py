@@ -24,12 +24,20 @@ viewer:
              bundle's series.json or the /metrics?format=json body
              saved to a file) plus which watchdog rules WOULD have
              fired replayed over the series
+  roofline   measured device time per op (ISSUE 12): the devprof
+             join + roofline table — per-op measured ms, share,
+             achieved MFU/BW and the compute-/memory-/relayout-bound
+             verdict — from a devprof result, obs.snapshot(), a trace
+             with an embedded snapshot, or a BENCH JSON
+             (detail.device_profile)
   selftest   build a synthetic multi-thread trace through the span
              layer, export it, summarize it, verify the invariants
              end to end, run the op-profile HLO walk + top-ops
-             rendering over a synthetic HLO dump, and drive the
-             telemetry collector/watchdog/flight-recorder over
-             scripted sources (wired into tools/ci.sh)
+             rendering over a synthetic HLO dump, round-trip
+             synthetic xplane bytes through the devprof wire
+             reader/join/roofline, and drive the telemetry
+             collector/watchdog/flight-recorder over scripted
+             sources (wired into tools/ci.sh)
 
 stdlib-only; paddle_tpu.obs.tracing, obs.opprof and obs.telemetry are
 loaded by FILE PATH (the tpulint idiom), so this tool runs in
@@ -52,6 +60,7 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _TRACING = os.path.join(REPO_ROOT, "paddle_tpu", "obs", "tracing.py")
 _OPPROF = os.path.join(REPO_ROOT, "paddle_tpu", "obs", "opprof.py")
 _TELEMETRY = os.path.join(REPO_ROOT, "paddle_tpu", "obs", "telemetry.py")
+_DEVPROF = os.path.join(REPO_ROOT, "paddle_tpu", "obs", "devprof.py")
 
 
 def _load_by_path(name: str, path: str):
@@ -77,6 +86,10 @@ def load_opprof():
 
 def load_telemetry():
     return _load_by_path("paddle_tpu_obs_telemetry", _TELEMETRY)
+
+
+def load_devprof():
+    return _load_by_path("paddle_tpu_obs_devprof", _DEVPROF)
 
 
 def load_trace(path: str) -> dict:
@@ -313,6 +326,88 @@ def top_ops_cmd(path: str, top: int, key: str, as_json: bool) -> int:
 
 
 # ---------------------------------------------------------------------------
+# roofline (measured device time per op, ISSUE 12)
+# ---------------------------------------------------------------------------
+
+def find_rooflines(path: str) -> Dict[str, dict]:
+    """Roofline tables from any artifact that carries them:
+
+    * a saved devprof window result or obs.snapshot() (the `roofline`
+      key under each window)
+    * a trace JSON (otherData.snapshot.devprof.windows...)
+    * a BENCH JSON — detail.device_profile is the trimmed form
+      (top_time rows with share/bound only)
+    * a bare roofline JSON (`roofline_for()` output saved to a file)
+    """
+    with open(path) as f:
+        doc = json.load(f)
+    out: Dict[str, dict] = {}
+    if isinstance(doc, dict) and isinstance(doc.get("ops"), list) \
+            and "attributed_pct" in doc and "rows" not in doc:
+        return {os.path.basename(path): doc}
+
+    def walk(node, label):
+        if not isinstance(node, dict):
+            return
+        rl = node.get("roofline")
+        if isinstance(rl, dict) and isinstance(rl.get("ops"), list):
+            out[node.get("label") or label or "roofline"] = rl
+        dp = node.get("device_profile")
+        if isinstance(dp, dict) and isinstance(dp.get("top_time"), list):
+            out.setdefault("device_profile", {
+                "device_class": dp.get("device_class"),
+                "runs": dp.get("runs"),
+                "measured_ms": dp.get("measured_ms"),
+                "attributed_pct": dp.get("attributed_pct"),
+                "ops": [dict(r) for r in dp["top_time"]],
+            })
+        for k, v in node.items():
+            if isinstance(v, dict):
+                walk(v, k)
+            elif isinstance(v, list):
+                for item in v:
+                    walk(item, k)
+
+    walk(doc, None)
+    return out
+
+
+def print_roofline(label: str, roof: dict, top: int) -> None:
+    print(f"== {label}  (device_class={roof.get('device_class')}, "
+          f"runs={roof.get('runs', '?')}, "
+          f"measured {roof.get('measured_ms', '?')} ms, "
+          f"attributed {roof.get('attributed_pct', '?')}%)")
+    print(f"{'op':<56}{'per_run_ms':>12}{'share%':>8}{'mfu%':>9}"
+          f"{'hbm%':>9}  {'bound':<16}{'passes'}")
+    for r in roof.get("ops", [])[:top]:
+        passes = ",".join(r.get("passes", []))
+        print(f"{r.get('op', '?'):<56}"
+              f"{r.get('per_run_ms', 0.0):>12.6f}"
+              f"{r.get('share_pct', 0.0):>8.2f}"
+              f"{r.get('mfu_pct', 0.0):>9.3f}"
+              f"{r.get('hbm_bw_pct', 0.0):>9.3f}  "
+              f"{r.get('bound', '?'):<16}{passes}")
+
+
+def roofline_cmd(path: str, top: int, as_json: bool) -> int:
+    roofs = find_rooflines(path)
+    if not roofs:
+        print(f"tracetool roofline: no roofline table found in {path} "
+              "(need a devprof result/snapshot JSON, a trace with an "
+              "embedded snapshot, or a BENCH JSON with "
+              "detail.device_profile)", file=sys.stderr)
+        return 1
+    if as_json:
+        print(json.dumps({label: {**roof,
+                                  "ops": roof.get("ops", [])[:top]}
+                          for label, roof in roofs.items()}))
+        return 0
+    for label, roof in roofs.items():
+        print_roofline(label, roof, top)
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # metrics (live-telemetry dump post-mortem)
 # ---------------------------------------------------------------------------
 
@@ -428,6 +523,136 @@ def _opprof_selftest_checks() -> List[tuple]:
         ("top-ops: dot ranks first by flops",
          bool(top) and top[0]["op"] == "program#7/block0/op1:mul"),
     ]
+
+def _devprof_selftest_checks() -> List[tuple]:
+    """The measured-device-time half of the selftest: synthetic xplane
+    bytes through the wire encoder/parser, the tiered join against the
+    _SELFTEST_HLO profile, the roofline verdicts and the Chrome-trace
+    merge — all by file path, no jax."""
+    devprof = load_devprof()
+    opprof = load_opprof()
+    checks: List[tuple] = []
+
+    prof = opprof.profile_hlo_text(_SELFTEST_HLO, label="selftest",
+                                   cost={"flops": 2.0 * 64 * 64 * 128,
+                                         "bytes_accessed": 64 * 64 * 8.0})
+    profiles = {"selftest": prof}
+
+    # one host line carrying the (nested, duplicated) run markers and
+    # one device thunk line whose leaf names the runtime renumbered
+    planes = [{"name": "/host:CPU", "lines": [
+        {"name": "python", "timestamp_ns": 1000, "events": [
+            {"name": devprof.RUN_MARKER, "offset_ps": 0,
+             "duration_ps": 5_000_000, "stats": {}},
+            {"name": devprof.RUN_MARKER, "offset_ps": 100_000,
+             "duration_ps": 4_000_000, "stats": {}},      # nested dup
+            {"name": devprof.RUN_MARKER, "offset_ps": 10_000_000,
+             "duration_ps": 5_000_000, "stats": {}},      # second run
+        ]},
+        {"name": "tf_XLATfrtCpuClient/7", "timestamp_ns": 1000,
+         "events": [
+             {"name": "ThunkExecutor::Execute (wait for completion)",
+              "offset_ps": 0, "duration_ps": 9_000_000, "stats": {}},
+             {"name": "dot.10", "offset_ps": 200_000,
+              "duration_ps": 4_000_000,
+              "stats": {"program_id": 7, "occ": 0.5, "kind": "dot"}},
+             {"name": "relu_fusion", "offset_ps": 4_400_000,
+              "duration_ps": 3_000_000, "stats": {"program_id": 7}},
+             {"name": "all-reduce.3", "offset_ps": 7_600_000,
+              "duration_ps": 2_000_000, "stats": {"program_id": 7}},
+             {"name": "custom-call.9", "offset_ps": 9_800_000,
+              "duration_ps": 1_000_000, "stats": {"program_id": 7}},
+         ]},
+    ]}]
+
+    data = devprof.encode_xspace(planes)
+    space = devprof.parse_xplane_bytes(data)
+    rt_line = space["planes"][0]["lines"][1]
+    dot_ev = rt_line["events"][1]
+    checks.append(("devprof: wire roundtrip preserves events + units",
+                   len(space["planes"]) == 1
+                   and rt_line["timestamp_ns"] == 1000
+                   and dot_ev["name"] == "dot.10"
+                   and dot_ev["offset_ps"] == 200_000
+                   and dot_ev["duration_ps"] == 4_000_000))
+    checks.append(("devprof: wire roundtrip preserves stat types",
+                   dot_ev["stats"].get("program_id") == 7
+                   and dot_ev["stats"].get("occ") == 0.5
+                   and dot_ev["stats"].get("kind") == "dot"))
+
+    dispatches = [(1, "selftest", 10.0), (2, "selftest", 10.001)]
+    join = devprof.join_events(space, profiles, dispatches)
+    checks.append(("devprof: containers excluded from measured time",
+                   join["measured_ns"] == 10_000.0
+                   and join["events"] == 4))
+    checks.append(("devprof: nested run markers dedup, pair by order",
+                   join["runs"] == 2 and join["run_seqs"] == [1, 2]))
+    by_op = join["ops"]
+    checks.append(("devprof: exact + order tiers resolve renumbered "
+                   "thunks",
+                   by_op.get("program#7/block0/op1:mul",
+                             {}).get("time_ns") == 4_000.0
+                   and by_op.get(
+                       "program#7/block0/op2:relu[pass=layout_optimize]",
+                       {}).get("match") == "exact"
+                   and by_op.get("program#7/block0/op3:c_allreduce_sum",
+                                 {}).get("time_ns") == 2_000.0))
+    checks.append(("devprof: unknown thunk lands in an explicit "
+                   "unattributed bin",
+                   by_op.get(devprof.UNATTRIBUTED,
+                             {}).get("time_ns") == 1_000.0
+                   and abs(join["attributed_pct"] - 90.0) < 1e-9))
+
+    roof = devprof.compute_roofline(join, profiles, "cpu-fallback",
+                                    pf=2e11, pb=5e10)
+    rops = {r["op"]: r for r in roof["ops"]}
+    dot_r = rops.get("program#7/block0/op1:mul", {})
+    checks.append(("devprof: roofline verdicts + pass tags",
+                   dot_r.get("bound") == "compute-bound"
+                   and dot_r.get("mfu_pct", 0.0) > 0.0
+                   and rops.get(devprof.UNATTRIBUTED,
+                                {}).get("bound") == devprof.UNATTRIBUTED
+                   and "layout_optimize" in rops.get(
+                       "program#7/block0/op2:relu[pass=layout_optimize]",
+                       {}).get("passes", [])))
+
+    # the unified timeline: device tracks + a flow arrow from the host
+    # dispatch span note_dispatch stamped with devprof_seq
+    host_doc = {"traceEvents": [
+        {"ph": "M", "name": "thread_name", "pid": 0, "tid": 0,
+         "args": {"name": "main"}},
+        {"ph": "X", "name": "executor.dispatch", "pid": 0, "tid": 0,
+         "ts": 10.0 * 1e6, "dur": 500.0, "cat": "span",
+         "args": {"devprof_seq": 1}},
+    ], "otherData": {}}
+    result = {"label": "selftest", "trace_events": join["trace_events"],
+              "attributed_pct": join["attributed_pct"]}
+    devprof.merge_chrome_trace(host_doc, result)
+    evs = host_doc["traceEvents"]
+    dev_tracks = [e for e in evs if e.get("ph") == "M"
+                  and str(e.get("args", {}).get("name",
+                                                "")).startswith("device:")]
+    s_evs = [e for e in evs if e.get("ph") == "s"
+             and e.get("id") == "devprof:1"]
+    f_evs = [e for e in evs if e.get("ph") == "f"
+             and e.get("id") == "devprof:1"]
+    dp = host_doc["otherData"].get("devprof", {})
+    checks.append(("devprof: merge adds device tracks + host->device "
+                   "flow",
+                   len(dev_tracks) >= 2 and len(s_evs) == 1
+                   and len(f_evs) == 1 and f_evs[0].get("bp") == "e"
+                   and s_evs[0]["tid"] == 0
+                   and dp.get("flows_linked") == 1))
+    # the rebase anchored run 1 at its dispatch time (10.0 s)
+    marker = next((e for e in evs if e.get("ph") == "X"
+                   and e["name"] == devprof.RUN_MARKER
+                   and e.get("args", {}).get("devprof_seq") == 1), None)
+    checks.append(("devprof: device clock rebased onto the host "
+                   "timeline",
+                   marker is not None
+                   and abs(marker["ts"] - 10.0 * 1e6) < 1.0))
+    return checks
+
 
 def _telemetry_selftest_checks() -> List[tuple]:
     """The live-telemetry half of the selftest: drive the collector,
@@ -599,6 +824,7 @@ def selftest(verbose: bool = True) -> int:
              s["stall_attribution"] == "compute-bound"),
         ]
         checks += _opprof_selftest_checks()
+        checks += _devprof_selftest_checks()
         checks += _telemetry_selftest_checks()
         failed = [name for name, ok in checks if not ok]
         if verbose:
@@ -646,8 +872,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         "a telemetry JSON dump (or a flight-bundle dir)")
     p_met.add_argument("dump")
     p_met.add_argument("--json", action="store_true")
+    p_roof = sub.add_parser(
+        "roofline", help="measured device time per op with roofline "
+        "bound verdicts from a devprof/snapshot/trace/BENCH JSON")
+    p_roof.add_argument("artifact")
+    p_roof.add_argument("--top", type=int, default=10)
+    p_roof.add_argument("--json", action="store_true")
     sub.add_parser("selftest", help="exercise the span layer, the "
-                                    "op-profile HLO walk and the "
+                                    "op-profile HLO walk, the devprof "
+                                    "xplane parse/join/roofline and the "
                                     "telemetry collector/watchdog end "
                                     "to end")
     args = ap.parse_args(argv)
@@ -672,6 +905,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                            args.json)
     if args.cmd == "metrics":
         return metrics_cmd(args.dump, args.json)
+    if args.cmd == "roofline":
+        return roofline_cmd(args.artifact, args.top, args.json)
     if args.cmd == "selftest":
         return selftest()
     ap.print_help()
