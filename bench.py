@@ -568,6 +568,89 @@ def bench_checkpoint(jax, jnp):
     }
 
 
+def bench_sharding(jax, jnp):
+    """`detail.sharding` (ISSUE 13 satellite): SPMD named-axis layout
+    numbers on a small fluid train loop — the mesh axes used, params /
+    optimizer-state bytes resident per device (via
+    `.addressable_shards`), how many registry specs applied, and the
+    SPMD-inserted collective traffic.  tools/bench_diff.py gates
+    `optimizer_bytes_per_device` on these (any rise fails on-chip)."""
+    import numpy as np
+
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import profiler
+    from paddle_tpu.fluid import framework, unique_name
+    from paddle_tpu.fluid.executor import Scope, scope_guard
+    from paddle_tpu.parallel import mesh as mesh_lib
+    from paddle_tpu.parallel.compiler import BuildStrategy
+
+    n_dev = len(jax.devices())
+    if n_dev % 4 == 0:
+        axes = {"data": n_dev // 4, "fsdp": 2, "tp": 2}
+    elif n_dev % 2 == 0:
+        axes = {"data": n_dev // 2, "fsdp": 2}
+    else:
+        axes = {"data": n_dev}
+    profiler.stat_reset("spmd_specs_applied")
+    main, startup, scope = framework.Program(), framework.Program(), Scope()
+    try:
+        with framework.program_guard(main, startup), \
+                unique_name.guard(), scope_guard(scope):
+            x = fluid.data("x", [-1, 64], "float32")
+            label = fluid.data("label", [-1, 1], "int64")
+            h = fluid.layers.fc(x, 128, act="relu")
+            h2 = fluid.layers.fc(h, 128, act="relu")
+            pred = fluid.layers.fc(h2, 8)
+            loss = fluid.layers.reduce_mean(
+                fluid.layers.loss.softmax_with_cross_entropy(pred, label))
+            fluid.optimizer.Adam(1e-3).minimize(loss)
+            exe = fluid.Executor()
+            exe.run(startup)
+            bs = BuildStrategy()
+            bs.mesh_axes = axes
+            compiled = fluid.CompiledProgram(main).with_data_parallel(
+                loss_name=loss.name, build_strategy=bs)
+            rng = np.random.RandomState(0)
+            X = rng.rand(32, 64).astype("float32")
+            L = rng.randint(0, 8, (32, 1)).astype("int64")
+            for _ in range(3):
+                out = exe.run(compiled, feed={"x": X, "label": L},
+                              fetch_list=[loss])
+            param_bytes = opt_bytes = 0
+            for v in main.list_vars():
+                if not v.persistable:
+                    continue
+                arr = scope.get(v.name)
+                shards = getattr(arr, "addressable_shards", None)
+                if not shards:
+                    continue
+                per_dev = {}
+                for s in shards:
+                    per_dev[s.device] = (per_dev.get(s.device, 0)
+                                         + s.data.nbytes)
+                nbytes = max(per_dev.values())
+                if getattr(v, "_optimizer_state_of", None):
+                    opt_bytes += nbytes
+                else:
+                    param_bytes += nbytes
+            stats = profiler.get_int_stats()
+            spmd_coll = sum(v for k, v in stats.items()
+                            if k.startswith("collective_bytes_spmd_"))
+            return {
+                "mesh_axes": axes,
+                "devices": n_dev,
+                "params_bytes_per_device": int(param_bytes),
+                "optimizer_bytes_per_device": int(opt_bytes),
+                "specs_applied": stats.get("spmd_specs_applied", 0),
+                "spmd_collective_bytes": int(spmd_coll),
+                "loss": float(np.asarray(out[0]).reshape(-1)[0]),
+            }
+    finally:
+        # the bench process keeps running other sections — don't leak
+        # the mesh context into them
+        mesh_lib.set_current_mesh(None)
+
+
 def _run_with_watchdog(fn, timeout_s, what):
     """Run fn() in a daemon thread: if the tunnel wedges mid-call (the
     axon failure mode — blocks, not raises), the caller still gets
@@ -1221,6 +1304,11 @@ def main():
     detail["device_profile"] = _run_with_watchdog(
         _device_profile_detail, timeout_s=120,
         what="device profile capture")
+    # SPMD sharding layout numbers (ISSUE 13): AFTER the timed region;
+    # bench_diff gates optimizer_bytes_per_device on these
+    detail["sharding"] = _run_with_watchdog(
+        lambda: bench_sharding(jax, jnp), timeout_s=120,
+        what="sharding bench")
     detail["tpu_probe"] = _tpu_probe_detail()
     result = {
         "metric": ("bert_base_pretrain_mfu" if on_tpu

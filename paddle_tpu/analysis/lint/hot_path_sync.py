@@ -35,6 +35,10 @@ RULE = "hot-path-sync"
 WATCHLIST: List[Tuple[str, str]] = [
     ("paddle_tpu/fluid/executor.py", "Executor.run"),
     ("paddle_tpu/fluid/executor.py", "Executor._dispatch"),
+    # SPMD state seat (ISSUE 13): runs at the top of EVERY dispatch —
+    # re-seating host arrays under their NamedSharding must stay an
+    # async device_put, never a transfer
+    ("paddle_tpu/fluid/executor.py", "Executor._seat_state"),
     ("paddle_tpu/fluid/executor.py", "Executor._finish"),
     ("paddle_tpu/fluid/executor.py", "Executor._const_state"),
     ("paddle_tpu/fluid/executor.py", "Executor._normalize_feed_inner"),
@@ -48,6 +52,10 @@ WATCHLIST: List[Tuple[str, str]] = [
     # at sanctioned boundaries
     ("paddle_tpu/dataset/feed_pipeline.py", "FeedPipeline.__iter__"),
     ("paddle_tpu/dataset/feed_pipeline.py", "FeedPipeline._produce"),
+    # SPMD batch placement (ISSUE 13): runs inside _produce for every
+    # staged batch — placement under NamedSharding(P("data",…)) is an
+    # async device op, not a transfer
+    ("paddle_tpu/dataset/feed_pipeline.py", "FeedPipeline._place_sharded"),
     ("paddle_tpu/dataset/feed_pipeline.py", "DeviceRing.put"),
     ("paddle_tpu/dataset/feed_pipeline.py", "DeviceRing.get"),
     ("paddle_tpu/parallel/compiler.py", "CompiledProgram._run"),

@@ -534,6 +534,72 @@ def check_write_never_read(ctx: VerifyContext) -> List[Finding]:
     return out
 
 
+@register_pass("partition-spec", tier=WARNING)
+def check_partition_specs(ctx: VerifyContext) -> List[Finding]:
+    """SPMD layout sanity (docs/spmd.md): a registered PartitionSpec
+    override or a ZeRO `_sharding_axes` annotation that names an axis
+    absent from the active mesh, or whose sharded dim does not divide
+    the var's dim, silently degrades to replicated at compile — flag it
+    here instead.  Needs an active mesh (`parallel.mesh.current_mesh`)
+    — skipped outside any mesh context."""
+    try:
+        from ..parallel import mesh as mesh_lib
+        from ..parallel import spec_layout
+    except Exception:  # noqa: BLE001 - jax-less tooling environments
+        return []
+    mesh = mesh_lib.current_mesh()
+    if mesh is None:
+        return []
+    prog = ctx.program
+    overrides = spec_layout.registered_specs()
+    out = []
+    seen: Set[str] = set()
+    for blk in prog.blocks:
+        for name, v in blk.vars.items():
+            if name in seen:
+                continue
+            seen.add(name)
+            shape = tuple(int(s) for s in (v.shape or ()))
+            problems: List[str] = []
+            if name in overrides:
+                problems = spec_layout.validate_spec(
+                    overrides[name], shape, mesh)
+            else:
+                axes = getattr(v, "_sharding_axes", None)
+                if axes and shape and shape[0] > 1:
+                    fits = [ax for ax in axes if ax in mesh.axis_names
+                            and shape[0] % mesh.shape[ax] == 0]
+                    if not fits:
+                        missing = [ax for ax in axes
+                                   if ax not in mesh.axis_names]
+                        if missing:
+                            problems.append(
+                                f"sharding axes {tuple(axes)} name "
+                                f"{missing} absent from mesh axes "
+                                f"{tuple(mesh.axis_names)}")
+                        else:
+                            problems.append(
+                                f"dim 0 of size {shape[0]} not divisible "
+                                f"by any of its sharding axes "
+                                f"{tuple(axes)} on mesh "
+                                f"{dict(mesh.shape)}")
+            if not problems:
+                continue
+            # provenance: the first op that touches the var
+            op = None
+            for o in blk.ops:
+                if name in o.output_arg_names() \
+                        or name in o.input_arg_names():
+                    op = o
+                    break
+            for p in problems:
+                out.append(ctx.finding(
+                    WARNING, "partition-spec",
+                    f"partition spec for {name!r} degrades to "
+                    f"replicated: {p}", block=blk, op=op, var=name))
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Driver
 # ---------------------------------------------------------------------------

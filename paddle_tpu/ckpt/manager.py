@@ -21,11 +21,15 @@ training instead of stalling it:
   than `keep` and any half-written tmp dirs a killed run left behind.
 
 Restore (`restore`) refuses partial and topology-mismatched
-checkpoints with a clear error, loads every shard (training state is
-replicated across hosts today — the shard map is the ZeRO on-ramp, not
-yet a partition of live memory), and returns `(state, manifest)` so
-callers can re-seat the executor step / feed epoch for deterministic
-mid-epoch resume.
+checkpoints with a clear error — host-count AND mesh-axes mismatches
+both name the expected vs found topology — and returns `(state,
+manifest)` so callers can re-seat the executor step / feed epoch for
+deterministic mid-epoch resume.  Checkpoints written under a named
+SPMD mesh (docs/spmd.md) record the mesh axes and the per-var
+PartitionSpec in the manifest; restoring such a checkpoint loads ONLY
+the shards this host owns per that layout.  Legacy manifests (no
+recorded mesh) keep the merge-all-shards behavior so old checkpoints
+and the weights-only serving reload keep working.
 """
 
 from __future__ import annotations
@@ -45,6 +49,21 @@ def _host_topology(process_index, process_count) -> Tuple[int, int]:
     from ..dataset.feed_pipeline import host_topology
 
     return host_topology(process_index, process_count)
+
+
+def _current_mesh_axes() -> Optional[Dict[str, int]]:
+    """Axes dict of the active SPMD mesh, or None outside any mesh
+    context.  Recorded in the manifest so restore can verify the
+    partition layout still fits."""
+    try:
+        from ..parallel import mesh as mesh_lib
+
+        m = mesh_lib.current_mesh()
+    except Exception:  # noqa: BLE001 - jax-less tooling environments
+        return None
+    if m is None:
+        return None
+    return {str(k): int(v) for k, v in dict(m.shape).items()}
 
 
 def _barrier(count: int, tag: str) -> None:
@@ -98,8 +117,12 @@ class CheckpointManager:
             snap, var_meta = self._snapshot(state)
         job_meta = dict(meta or {})
         step = int(step)
+        # capture the mesh layout ON the training thread (a global
+        # read), so the writer thread records a consistent topology
+        mesh_axes = _current_mesh_axes()
         self._pool.submit(
-            lambda: self._write_job(snap, var_meta, step, job_meta),
+            lambda: self._write_job(snap, var_meta, step, job_meta,
+                                    mesh_axes),
             flow=flow)
         profiler.stat_add("ckpt_snapshots_total")
 
@@ -127,15 +150,27 @@ class CheckpointManager:
             val = state[name]
             if val is None:
                 continue
+            spec_doc = None
             if isinstance(val, jax.Array):
                 shape = tuple(val.shape)
                 dtype = str(np.dtype(val.dtype))
+                # record the live partition layout (docs/spmd.md): the
+                # manifest is the authoritative description of how this
+                # var was laid out over the mesh at save time
+                sh = getattr(val, "sharding", None)
+                spec = getattr(sh, "spec", None)
+                if spec is not None and tuple(spec):
+                    from ..parallel.spec_layout import spec_to_json
+
+                    spec_doc = spec_to_json(spec)
             else:
                 val = np.asarray(val)  # sync-ok: host python value
                 shape = tuple(val.shape)
                 dtype = str(val.dtype)
             var_meta[name] = {"shape": list(shape), "dtype": dtype,
                               "shard": assignment[name]}
+            if spec_doc:
+                var_meta[name]["spec"] = spec_doc
             if assignment[name] == self._index:
                 snap[name] = val.copy() if isinstance(val, jax.Array) \
                     else val
@@ -143,7 +178,8 @@ class CheckpointManager:
 
     # -- write (writer thread) ---------------------------------------------
     def _write_job(self, snap, var_meta, step: int,
-                   meta: Dict[str, Any]) -> None:
+                   meta: Dict[str, Any],
+                   mesh_axes: Optional[Dict[str, int]] = None) -> None:
         import numpy as np
 
         from .. import profiler
@@ -169,6 +205,12 @@ class CheckpointManager:
             "flag_signature": mf.flag_signature(),
             "meta": meta,
         }
+        # record the partition layout only when the state IS partitioned
+        # (some var carries a spec): a fully-replicated DP checkpoint
+        # stays in the legacy merge-all format regardless of what mesh
+        # happens to be globally active
+        if mesh_axes and any("spec" in m for m in var_meta.values()):
+            manifest["mesh_axes"] = mesh_axes
         mf.write_manifest(tmp, manifest)
         final = os.path.join(self.root, mf.checkpoint_dir_name(step))
         if os.path.exists(final):
@@ -245,7 +287,24 @@ class CheckpointManager:
                 f"per-host shards do not re-deal across host counts "
                 f"(restore with strict_topology=False to load weights "
                 f"only, e.g. for serving reload)")
-        state = _load_shards(path, manifest)
+        saved_axes = manifest.get("mesh_axes")
+        live_axes = _current_mesh_axes()
+        if strict_topology and saved_axes and live_axes \
+                and dict(saved_axes) != dict(live_axes):
+            raise CheckpointError(
+                f"{path}: topology mismatch — checkpoint expects mesh "
+                f"axes {dict(saved_axes)}, found {dict(live_axes)}; the "
+                f"recorded partition layout does not re-seat across mesh "
+                f"shapes (restore with strict_topology=False to load "
+                f"weights only and let the compiler re-shard)")
+        # sharded-live-state restore (docs/spmd.md): a checkpoint that
+        # records its mesh layout is loaded owned-shards-only — each
+        # host reads just its own file; legacy manifests merge all
+        # shards (weights-only / serving reload path)
+        owned_only = bool(saved_axes) and strict_topology \
+            and saved_count == self._count and self._count > 1
+        state = _load_shards(path, manifest,
+                             index=self._index if owned_only else None)
         sig = mf.flag_signature()
         saved_sig = manifest.get("flag_signature", "")
         if saved_sig and sig and saved_sig != sig:
@@ -257,12 +316,25 @@ class CheckpointManager:
         return state, manifest
 
 
-def _load_shards(path: str, manifest: Dict[str, Any]) -> Dict[str, Any]:
+def _load_shards(path: str, manifest: Dict[str, Any],
+                 index: Optional[int] = None) -> Dict[str, Any]:
+    """Merge shard files back into a state dict.  `index` selects
+    owned-shards-only mode: read just `shard_<index>.npz` and validate
+    only the vars the manifest assigns to that host — the sharded-
+    live-state restore path.  None (legacy / weights-only) reads every
+    shard."""
     import numpy as np
 
     var_meta = manifest.get("vars", {})
+    shards = manifest.get("shards", [])
+    if index is not None:
+        shards = [s for s in shards if s == mf.shard_file(index)]
+        expected = [n for n, m in var_meta.items()
+                    if int(m.get("shard", 0)) == index]
+    else:
+        expected = list(var_meta)
     state: Dict[str, Any] = {}
-    for shard in manifest.get("shards", []):
+    for shard in shards:
         with np.load(os.path.join(path, shard)) as data:
             for key in data.files:
                 name = mf.decode_name(key)
@@ -271,7 +343,7 @@ def _load_shards(path: str, manifest: Dict[str, Any]) -> Dict[str, Any]:
                 if meta is not None:
                     arr = mf.restore_dtype(arr, meta["dtype"])
                 state[name] = arr
-    missing = [n for n in var_meta if n not in state]
+    missing = [n for n in expected if n not in state]
     if missing:
         raise CheckpointError(
             f"{path}: partial checkpoint — manifest describes vars "

@@ -275,10 +275,17 @@ class FeedPipeline:
                  process_index: Optional[int] = None,
                  process_count: Optional[int] = None,
                  epoch: Optional[int] = None,
-                 skip_batches: int = 0):
+                 skip_batches: int = 0,
+                 mesh=None):
         from .. import profiler
 
         self._stage = stage_fn
+        # SPMD mesh (docs/spmd.md): when the program compiles under a
+        # named-axis mesh, staged batches are placed under
+        # NamedSharding(P("data"[, "fsdp"])) on the producer thread so
+        # dispatch never reshards.  None (plain Executor path) keeps
+        # staging byte-identical to before.
+        self._mesh = mesh
         self._depth = DEFAULT_PREFETCH_DEPTH if depth is None \
             else max(1, int(depth))
         # deterministic mid-epoch resume (paddle_tpu.ckpt,
@@ -324,6 +331,29 @@ class FeedPipeline:
         source._feed_epoch = epoch
         return batch_iter(shard=(self._index, self._count), epoch=epoch)
 
+    def _place_sharded(self, staged):
+        """Seat a staged feed dict under the mesh's batch sharding
+        (mesh_lib.batch_spec: P("data") composed with "fsdp" when
+        present).  device_put under a NamedSharding is an async device
+        placement — no host transfer, hot-path safe.  No-op without a
+        mesh."""
+        mesh = self._mesh
+        if mesh is None or not isinstance(staged, dict):
+            return staged
+        import jax
+        from jax.sharding import NamedSharding
+
+        from ..parallel import mesh as mesh_lib
+
+        out = {}
+        for n, a in staged.items():
+            if getattr(a, "ndim", 0) >= 1:
+                spec = mesh_lib.batch_spec(mesh, a.shape[0])
+                out[n] = jax.device_put(a, NamedSharding(mesh, spec))
+            else:
+                out[n] = a
+        return out
+
     # -- producer (background thread; hot path — lint-watched) -------------
     def _produce(self):
         from .. import obs, profiler
@@ -354,7 +384,7 @@ class FeedPipeline:
                 # to the consumer's ring_get span across threads
                 flow = tracer.new_flow() if tracer.enabled else 0
                 with obs.span("feed.stage", flow=flow):
-                    staged = self._stage(feed)
+                    staged = self._place_sharded(self._stage(feed))
                 if not ring.put((staged, flow)):
                     return  # consumer abandoned the epoch
             self.epoch_feed_ms = (time.perf_counter() - t_start) * 1e3

@@ -17,7 +17,11 @@ from __future__ import annotations
 from .meta_optimizer_base import MetaOptimizerBase
 
 
-def _annotate(var, axes=("data",)):
+def _annotate(var, axes=("fsdp", "data")):
+    # preference order, not a product: the compiler's spec registry
+    # (parallel/spec_layout.py) picks the FIRST axis present in the
+    # active mesh that divides dim 0 — "fsdp" on a data×fsdp×tp mesh,
+    # falling back to "data" on today's single-axis meshes
     var._sharding_axes = tuple(axes)
 
 

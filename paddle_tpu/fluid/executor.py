@@ -249,7 +249,8 @@ class _CompiledEntry:
                  "mutable_out_names", "feed_names", "fetch_names", "program",
                  "scope", "check_nan", "check_names", "const_src",
                  "const_dev", "feed_shardings", "const_shardings",
-                 "dispatched", "fn_compiled", "cost", "label")
+                 "state_shardings", "dispatched", "fn_compiled", "cost",
+                 "label")
 
 
 class _NanMonitor:
@@ -465,7 +466,7 @@ class _AutoCheckpoint:
         if feed_epoch < ds_next:
             return  # live in-process state is ahead of the checkpoint
         state, _ = self.manager.restore(path)
-        self._apply_state(state)
+        self._apply_state(state, manifest)
         self._exe._step = int(meta.get("executor_step", 0))
         saved_seed = meta.get("feed_seed")
         live_seed = int(getattr(self._dataset, "_seed", 0))
@@ -487,9 +488,35 @@ class _AutoCheckpoint:
 
         stat_add("ckpt_resume_count")
 
-    def _apply_state(self, state) -> None:
+    def _apply_state(self, state, manifest=None) -> None:
         from . import core
 
+        # sharded re-seat (docs/spmd.md): a checkpoint written under a
+        # named mesh records each var's PartitionSpec — restore places
+        # the host array straight back under that layout (async
+        # device_put per var) instead of leaving it host-resident for
+        # the first dispatch to reshard
+        shardings = {}
+        mesh_axes = (manifest or {}).get("mesh_axes")
+        if mesh_axes:
+            try:
+                from jax.sharding import NamedSharding
+
+                from ..parallel import mesh as mesh_lib
+                from ..parallel.spec_layout import spec_from_json
+
+                mesh = mesh_lib.current_mesh()
+                if mesh is not None and \
+                        {str(k): int(v)
+                         for k, v in dict(mesh.shape).items()} == \
+                        {str(k): int(v) for k, v in mesh_axes.items()}:
+                    for name, m in manifest.get("vars", {}).items():
+                        doc = m.get("spec")
+                        if doc:
+                            shardings[name] = NamedSharding(
+                                mesh, spec_from_json(doc))
+            except Exception:  # noqa: BLE001 - re-seat is best-effort
+                shardings = {}
         persist = {v.name: v for v in self._program.list_vars()
                    if v.persistable}
         for name, val in state.items():
@@ -499,6 +526,11 @@ class _AutoCheckpoint:
             want = core.np_dtype(var.dtype)
             if val.dtype != want:
                 val = val.astype(want)
+            sh = shardings.get(name)
+            if sh is not None:
+                import jax
+
+                val = jax.device_put(val, sh)
             self._scope.set(name, val)
 
     def bind_epoch(self, dataset) -> None:
@@ -759,7 +791,8 @@ class Executor:
             lambda feed: self._normalize_feed(program, feed),
             dataset, depth=depth,
             epoch=None if ckpt is None else ckpt.epoch,
-            skip_batches=0 if ckpt is None else ckpt.step_in_epoch)
+            skip_batches=0 if ckpt is None else ckpt.step_in_epoch,
+            mesh=getattr(program, "_mesh", None))
         if ckpt is not None:
             ckpt.bind_epoch(dataset)
         try:
@@ -1038,6 +1071,7 @@ class Executor:
         entry.const_dev = {}
         entry.feed_shardings = None
         entry.const_shardings = None
+        entry.state_shardings = None
         entry.dispatched = False
         entry.fn_compiled = None
         entry.cost = None
@@ -1070,6 +1104,24 @@ class Executor:
                             else jax.device_put(np.asarray(v))  # sync-ok: host value upload
         return dev
 
+    def _seat_state(self, entry: _CompiledEntry, scope: Scope):
+        """Gather the mutable device state for one dispatch, seating any
+        host-resident value (fresh startup init, checkpoint restore)
+        under its registry sharding (entry.state_shardings, built by
+        CompiledProgram._compile_spmd from parallel/spec_layout.py).
+        device_put under a NamedSharding is async — this never blocks;
+        steady-state steps pass device arrays through untouched."""
+        shardings = entry.state_shardings or {}
+        out = {}
+        for n in entry.mutable_in_names:
+            v = scope.get(n)
+            if not _is_device_array(v):
+                sh = shardings.get(n)
+                if sh is not None:
+                    v = jax.device_put(v, sh)
+            out[n] = v
+        return out
+
     def _dispatch(self, entry: _CompiledEntry, scope: Scope, feed_arrays):
         """The one dispatch point of the hot path (shared with
         CompiledProgram._run): gather device-resident state, call the
@@ -1086,7 +1138,7 @@ class Executor:
         from ..profiler import time_add
 
         t0 = time.perf_counter()
-        mutable_state = {n: scope.get(n) for n in entry.mutable_in_names}
+        mutable_state = self._seat_state(entry, scope)
         const_state = self._const_state(entry, scope)
         seed = self._next_seed(entry.program)
         first_call = not entry.dispatched

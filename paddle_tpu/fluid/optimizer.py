@@ -92,6 +92,12 @@ class Optimizer:
             name=var_name, shape=shape, dtype=dtype, persistable=True,
             stop_gradient=True)
         ConstantInitializer(fill_value)(sv, startup.global_block())
+        # optimizer-state marker for the SPMD spec registry
+        # (parallel/spec_layout.py) and the sharding bench probe: ties
+        # the accumulator back to its parameter so ZeRO layouts follow
+        # the param's partition
+        v._optimizer_state_of = param.name
+        sv._optimizer_state_of = param.name
         self._accumulators.setdefault(name, {})[param.name] = v
         return v
 

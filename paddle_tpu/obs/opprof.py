@@ -351,6 +351,10 @@ def profile_hlo_text(text: str, label: str = "",
     fusion_sets: Dict[str, set] = collections.defaultdict(set)
     raw_flops_total = 0.0
     raw_bytes_total = 0.0
+    # per-opcode collective traffic: who is moving bytes — the
+    # attribution seam the SPMD partitioner's inserted all-gathers /
+    # reduce-scatters surface through (docs/spmd.md)
+    coll_by_op: Dict[str, float] = {}
 
     for ins in instrs:
         in_fused = ins.comp in fused_comps
@@ -396,6 +400,8 @@ def profile_hlo_text(text: str, label: str = "",
             row["transpose_bytes"] += ins.shape.nbytes
         if ins.opcode in _COLLECTIVES:
             row["collective_bytes"] += ins.shape.nbytes
+            coll_by_op[ins.opcode] = (coll_by_op.get(ins.opcode, 0)
+                                      + ins.shape.nbytes)
 
     for key, comps in fusion_sets.items():
         rows[key]["fusions"] = max(rows[key]["fusions"], len(comps))
@@ -467,6 +473,7 @@ def profile_hlo_text(text: str, label: str = "",
             if raw_flops_total > 0.0 else 0.0),
         "transposes": sum(r["transposes"] for r in table),
         "collective_bytes": sum(r["collective_bytes"] for r in table),
+        "collective_bytes_by_op": dict(coll_by_op),
         "instr_prov": instr_prov,
     }
 
@@ -575,6 +582,18 @@ def profile_compiled(compiled, label: str,
         return None
     if register:
         register_profile(label, prof)
+    # attribute SPMD-inserted collectives to the counter table
+    # (cost.record_collective): the explicit shard_map path records
+    # per-op at lower time; the jit-SPMD path only learns what the
+    # partitioner inserted here, from the optimized HLO.  Prefixed
+    # spmd_* so the two attribution sources stay distinguishable.
+    for opcode, nbytes in (prof.get("collective_bytes_by_op")
+                           or {}).items():
+        if nbytes > 0:
+            from .cost import record_collective
+
+            record_collective("spmd_" + opcode.replace("-", "_"),
+                              int(nbytes))
     return prof
 
 

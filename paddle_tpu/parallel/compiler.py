@@ -25,6 +25,7 @@ import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from . import mesh as mesh_lib
+from . import spec_layout
 from ..fluid.compile_cache import CompileCache
 
 
@@ -99,6 +100,12 @@ class CompiledProgram:
         self._exec_strategy = exec_strategy
         axes = self._build_strategy.mesh_axes
         self._mesh = mesh_lib.make_mesh(axes, devices=places)
+        # the active mesh is global context: the checkpoint manifest
+        # records its axes, the verifier's partition-spec pass checks
+        # registered specs against it, and train_from_dataset threads
+        # it into the feed pipeline for sharded batch placement
+        mesh_lib.set_current_mesh(self._mesh)
+        self._program._mesh = self._mesh
         return self
 
     # -- execution (called from Executor.run) ------------------------------
@@ -170,7 +177,7 @@ class CompiledProgram:
     def _make_entry(self, program, scope, fn, state_in, mutable_in,
                     const_in, mutable_out, feed_arrays, fetch_names,
                     check_nan, check_names_box, feed_shardings,
-                    const_shardings):
+                    const_shardings, state_shardings=None):
         from ..fluid.executor import _CompiledEntry
 
         entry = _CompiledEntry()
@@ -189,6 +196,7 @@ class CompiledProgram:
         entry.const_dev = {}
         entry.feed_shardings = feed_shardings
         entry.const_shardings = const_shardings
+        entry.state_shardings = state_shardings
         entry.dispatched = False
         entry.fn_compiled = None
         entry.cost = None
@@ -218,27 +226,31 @@ class CompiledProgram:
         mutable_out = sorted(pw)
 
         repl = NamedSharding(mesh, P())
-        batch = NamedSharding(mesh, P(mesh_lib.DATA_AXIS))
         feed_shardings = {}
         for n, a in feed_arrays.items():
-            if a.ndim >= 1 and a.shape[0] % mesh.shape[mesh_lib.DATA_AXIS] == 0:
-                feed_shardings[n] = batch
+            if a.ndim >= 1:
+                spec = mesh_lib.batch_spec(mesh, a.shape[0])
+                feed_shardings[n] = NamedSharding(mesh, spec)
             else:
                 feed_shardings[n] = repl
 
+        specs_applied = [0]
+
         def state_sharding(name):
-            """Honor ZeRO annotations (sharding_optimizer.py): vars marked
-            _sharding_axes get dim-0 sharded over that axis; XLA SPMD then
-            materializes the reduce-scatter/all-gather pattern."""
+            """Per-var layout from the PartitionSpec registry
+            (parallel/spec_layout.py): explicit overrides, then ZeRO
+            `_sharding_axes` annotations (sharding_optimizer.py), then
+            name-pattern rules on fsdp/tp meshes.  XLA SPMD
+            materializes the reduce-scatter/all-gather pattern from
+            these annotations."""
             try:
                 v = block._var_recursive(name)
             except ValueError:
                 return repl
-            axes = getattr(v, "_sharding_axes", None)
-            if axes and v.shape and len(v.shape) >= 1 and v.shape[0] != 1:
-                ax = axes[0]
-                if ax in mesh.axis_names and v.shape[0] % mesh.shape[ax] == 0:
-                    return NamedSharding(mesh, P(ax))
+            spec = spec_layout.spec_for(name, v.shape, mesh, var=v)
+            if tuple(spec):
+                specs_applied[0] += 1
+                return NamedSharding(mesh, spec)
             return repl
 
         check_names_box = []
@@ -258,14 +270,17 @@ class CompiledProgram:
                 return fetches, new_state, flags
             return fetches, new_state
 
-        out_shardings = (None, {n: state_sharding(n) for n in mutable_out})
+        state_shardings = {n: state_sharding(n)
+                           for n in set(mutable_in) | set(const_in)
+                           | set(mutable_out)}
+        out_shardings = (None, {n: state_shardings[n] for n in mutable_out})
         if check_nan:
             out_shardings = out_shardings + (None,)
-        const_shardings = {n: state_sharding(n) for n in const_in}
+        const_shardings = {n: state_shardings[n] for n in const_in}
         fn = jax.jit(
             step_fn,
             in_shardings=(
-                {n: state_sharding(n) for n in mutable_in},
+                {n: state_shardings[n] for n in mutable_in},
                 const_shardings,
                 {n: feed_shardings[n] for n in feed_arrays},
                 None,
@@ -273,10 +288,14 @@ class CompiledProgram:
             out_shardings=out_shardings,
             donate_argnums=(0,),
         )
+        if specs_applied[0]:
+            from ..profiler import stat_add
+            stat_add("spmd_specs_applied", specs_applied[0])
         return self._make_entry(program, scope, fn, state_in, mutable_in,
                                 const_in, mutable_out, feed_arrays,
                                 fetch_names, check_nan, check_names_box,
-                                feed_shardings, const_shardings)
+                                feed_shardings, const_shardings,
+                                state_shardings)
 
     def _compile_shard_map(self, executor, program, feed_arrays,
                            fetch_names, scope):

@@ -9,7 +9,12 @@ the ICI collectives (SURVEY.md §5.8).
 
 Axis-name conventions used across the framework:
   "data"  — data parallelism (batch sharding, gradient psum)
-  "model" — tensor/model parallelism (column/row-parallel matmuls)
+  "fsdp"  — fully-sharded data parallelism (ZeRO param/optimizer-state
+            sharding; also batch-sharded like "data")
+  "tp"    — tensor parallelism (column/row-parallel matmuls, the
+            modern spelling; see parallel/spec_layout.py)
+  "model" — tensor/model parallelism (legacy alias of "tp" kept for
+            the shard_map collective path)
   "pipe"  — pipeline stages
   "seq"   — sequence/context parallelism (ring attention)
 """
@@ -24,6 +29,8 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
+FSDP_AXIS = "fsdp"
+TP_AXIS = "tp"
 MODEL_AXIS = "model"
 PIPE_AXIS = "pipe"
 SEQ_AXIS = "seq"
@@ -64,6 +71,21 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 def batch_sharded(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
     return NamedSharding(mesh, P(axis))
+
+
+def batch_spec(mesh: Mesh, nrows: int) -> P:
+    """PartitionSpec for a batch (leading) dim: sharded over the
+    data-parallel axes the mesh carries — "data" composed with "fsdp"
+    when present (fsdp ranks consume distinct batch slices too; that is
+    what makes it *sharded data* parallelism) — degrading to whatever
+    subset divides `nrows`, else replicated."""
+    axes = [ax for ax in (DATA_AXIS, FSDP_AXIS) if ax in mesh.axis_names]
+    while axes:
+        size = int(np.prod([mesh.shape[ax] for ax in axes]))
+        if size > 1 and nrows % size == 0:
+            return P(tuple(axes) if len(axes) > 1 else axes[0])
+        axes.pop()
+    return P()
 
 
 def global_mesh(axes: Optional[Dict[str, int]] = None) -> Mesh:

@@ -1,0 +1,222 @@
+"""Per-parameter PartitionSpec registry for SPMD named-axis lowering.
+
+The partition layout is an explicit, inspectable artifact (TensorFlow's
+large-scale-training lesson, arXiv 1605.08695) rather than an emergent
+property of the lowering: `spec_for(name, shape, mesh)` answers "how is
+this variable laid out over the `data × fsdp × tp` mesh" for the
+compiler (`CompiledProgram._compile_spmd` in/out shardings), the
+executor state seat, the checkpoint manifest, and the verifier.
+
+Resolution order:
+  1. explicit per-var override (`register_spec`) — always wins;
+  2. a `_sharding_axes` annotation left by fleet's ShardingOptimizer
+     (ZeRO, arXiv 2004.13336): dim 0 goes over the first annotated axis
+     present in the mesh that divides it;
+  3. name-pattern rules (active only when the mesh actually has an
+     `fsdp` or `tp` axis): embedding tables over fsdp×tp, 2-D
+     weights row-split over fsdp (col-split over tp as fallback),
+     conv/bn/norm/bias/scalars replicated.
+
+On a pure `{data: N}` mesh with no annotations everything resolves to
+`P()` (replicated) — exactly today's behavior, so plain data-parallel
+programs compile byte-identically.
+
+Optimizer accumulators are named `<param>_<acc>_<n>` (e.g.
+`fc_0.w_0_moment1_0`), so the pattern rules automatically give Adam
+moments their parameter's layout — that IS the ZeRO optimizer-state
+sharding: per-device optimizer bytes scale down by the fsdp(×tp)
+extent with XLA SPMD materializing the reduce-scatter/all-gather.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from jax.sharding import Mesh, PartitionSpec as P
+
+DATA_AXIS = "data"
+FSDP_AXIS = "fsdp"
+TP_AXIS = "tp"
+
+
+@dataclass(frozen=True)
+class SpecLayout:
+    """Axis-name binding for the rule table (SNIPPETS [1] style).  A
+    custom layout renames the logical roles without touching the rules."""
+
+    data_axis: str = DATA_AXIS
+    fsdp_axis: str = FSDP_AXIS
+    tp_axis: str = TP_AXIS
+
+
+DEFAULT_LAYOUT = SpecLayout()
+
+# explicit per-var overrides: name -> PartitionSpec.  Always consulted
+# first; an override naming an axis the active mesh lacks is reported
+# by the verifier's partition-spec pass and fitted to P() at compile.
+_OVERRIDES: Dict[str, P] = {}
+
+# name fragments that mark replicated-by-design variables: norm/bn
+# stats and scales, biases, scalar bookkeeping (Adam pow accumulators,
+# learning rate).
+_REPLICATED_PAT = re.compile(
+    r"(batch_norm|layer_norm|\bnorm\b|_norm|\bln_|\.b_0|_bias|\bbias"
+    r"|scale|beta|gamma|_mean|_variance|pow_acc|learning_rate)")
+
+_EMBEDDING_PAT = re.compile(r"(embedding|emb_|word_emb|pos_emb|_emb\b)")
+
+
+def register_spec(var_name: str, spec) -> None:
+    """Explicit per-var override: `register_spec("w_qkv", P("fsdp",
+    "tp"))`.  Pass None to clear one name."""
+    if spec is None:
+        _OVERRIDES.pop(var_name, None)
+    else:
+        _OVERRIDES[var_name] = P(*spec) if not isinstance(spec, P) else spec
+
+
+def clear_specs() -> None:
+    _OVERRIDES.clear()
+
+
+def registered_specs() -> Dict[str, P]:
+    return dict(_OVERRIDES)
+
+
+def _axis_size(mesh: Mesh, axis) -> int:
+    """Product extent of one spec entry (str or tuple of axis names)."""
+    names = (axis,) if isinstance(axis, str) else tuple(axis)
+    size = 1
+    for n in names:
+        size *= mesh.shape[n]
+    return size
+
+
+def validate_spec(spec, shape: Sequence[int], mesh: Mesh) -> List[str]:
+    """Problem strings for a spec against a shape+mesh; empty == fits.
+    Shared with the verifier's partition-spec pass."""
+    problems = []
+    entries = tuple(spec)
+    if len(entries) > len(shape):
+        problems.append(
+            f"spec {spec} has {len(entries)} entries for rank-"
+            f"{len(shape)} shape {tuple(shape)}")
+    for dim, axis in enumerate(entries):
+        if axis is None:
+            continue
+        names = (axis,) if isinstance(axis, str) else tuple(axis)
+        for n in names:
+            if n not in mesh.axis_names:
+                problems.append(
+                    f"axis {n!r} not in mesh axes {tuple(mesh.axis_names)}")
+        if any(n not in mesh.axis_names for n in names):
+            continue
+        if dim < len(shape):
+            size = _axis_size(mesh, axis)
+            if shape[dim] % size != 0:
+                problems.append(
+                    f"dim {dim} of size {shape[dim]} not divisible by "
+                    f"{axis!r} extent {size}")
+    return problems
+
+
+def _fit(spec, shape: Sequence[int], mesh: Mesh) -> P:
+    """Clamp a spec to what the mesh+shape can actually carry: drop
+    entries naming absent axes or not dividing their dim."""
+    out = []
+    for dim, axis in enumerate(tuple(spec)):
+        if axis is None or dim >= len(shape):
+            out.append(None)
+            continue
+        names = (axis,) if isinstance(axis, str) else tuple(axis)
+        ok = all(n in mesh.axis_names for n in names)
+        if ok and shape[dim] % _axis_size(mesh, axis) == 0:
+            out.append(axis)
+        else:
+            out.append(None)
+    while out and out[-1] is None:
+        out.pop()
+    return P(*out)
+
+
+def _annotation_spec(axes: Sequence[str], shape: Sequence[int],
+                     mesh: Mesh) -> Optional[P]:
+    """ZeRO `_sharding_axes` annotation: dim 0 over the first annotated
+    axis present in the mesh that divides it."""
+    if not shape or len(shape) < 1 or shape[0] <= 1:
+        return None
+    for ax in axes:
+        if ax in mesh.axis_names and shape[0] % mesh.shape[ax] == 0:
+            return P(ax)
+    return None
+
+
+def _pattern_spec(name: str, shape: Sequence[int], mesh: Mesh,
+                  layout: SpecLayout) -> P:
+    """Name-pattern rule table (SNIPPETS [1]): active only on meshes
+    that carry an fsdp or tp axis."""
+    fsdp, tp = layout.fsdp_axis, layout.tp_axis
+    has_fsdp = fsdp in mesh.axis_names
+    has_tp = tp in mesh.axis_names
+    if not (has_fsdp or has_tp):
+        return P()
+    ndim = len(shape)
+    if ndim == 0 or (ndim >= 1 and shape[0] <= 1 and ndim == 1):
+        return P()
+    if _REPLICATED_PAT.search(name):
+        return P()
+    if ndim == 4:
+        # conv kernels: replicated (spatial dims don't shard usefully
+        # at these sizes; the batch dim carries the parallelism)
+        return P()
+    if ndim == 2:
+        if _EMBEDDING_PAT.search(name):
+            # vocab dim over fsdp×tp when both divide; degrade to fsdp
+            if has_fsdp and has_tp:
+                fitted = _fit(P((fsdp, tp)), shape, mesh)
+                if tuple(fitted):
+                    return fitted
+            return _fit(P(fsdp if has_fsdp else tp), shape, mesh)
+        # dense weights: row-split (dim 0) over fsdp, col-split (dim 1)
+        # over tp — the qkv/ffn layout; _fit drops whichever doesn't
+        # divide
+        return _fit(P(fsdp if has_fsdp else None,
+                      tp if has_tp else None), shape, mesh)
+    # rank-1 / rank-3+: dim-0 over fsdp when it divides
+    if has_fsdp:
+        return _fit(P(fsdp), shape, mesh)
+    return P()
+
+
+def spec_for(name: str, shape: Sequence[int], mesh: Mesh, var=None,
+             layout: SpecLayout = DEFAULT_LAYOUT) -> P:
+    """Resolve the PartitionSpec for one variable.  `var` (a framework
+    Variable) supplies the `_sharding_axes` ZeRO annotation when
+    present.  Always returns a spec that FITS the mesh (the verifier
+    reports misfits; the compiler never crashes on them)."""
+    shape = tuple(int(s) for s in (shape or ()))
+    if name in _OVERRIDES:
+        return _fit(_OVERRIDES[name], shape, mesh)
+    axes = getattr(var, "_sharding_axes", None) if var is not None else None
+    if axes:
+        spec = _annotation_spec(axes, shape, mesh)
+        if spec is not None:
+            return spec
+    return _pattern_spec(name, shape, mesh, layout)
+
+
+def spec_to_json(spec) -> Optional[list]:
+    """PartitionSpec -> JSON-able list (entries None | str | [str...]).
+    None means "no spec recorded" (fully replicated / unknown)."""
+    if spec is None:
+        return None
+    return [list(e) if isinstance(e, (tuple, list)) else e
+            for e in tuple(spec)]
+
+
+def spec_from_json(doc) -> P:
+    if not doc:
+        return P()
+    return P(*[tuple(e) if isinstance(e, list) else e for e in doc])

@@ -26,6 +26,9 @@ Metrics compared (each only when present in BOTH files):
                           keep resolving thunks to Program ops; under
                           cpu-fallback the usual warn-only regime
                           applies)
+  optimizer_bytes_per_device  detail.sharding.optimizer_bytes_per_device
+                              (ANY rise — the ZeRO layout regressed
+                              toward replication)
 
 Exit status: 1 when any regression fires AND the current run is
 on-chip; under `device_class: cpu-fallback` (or a stale re-emitted
@@ -60,6 +63,10 @@ DEFAULT_THRESHOLDS = {
     "op_attribution_pct": ("up", 0.0, 5.0),
     "telemetry_overhead_ms": ("down", 0.5, 2.0),
     "devprof_attributed_pct": ("up", 0.0, 5.0),
+    # ZeRO guard (ISSUE 13): optimizer state resident per device must
+    # never grow — ANY rise means the sharded layout regressed toward
+    # replication
+    "optimizer_bytes_per_device": ("down", 0.0, 0.0),
 }
 
 
@@ -113,6 +120,9 @@ def extract_metrics(doc: dict) -> Dict[str, float]:
         if isinstance(dap, (int, float)):
             out["devprof_attributed_pct"] = float(dap)
             break
+    ob = _get(detail, "sharding", "optimizer_bytes_per_device")
+    if isinstance(ob, (int, float)):
+        out["optimizer_bytes_per_device"] = float(ob)
     return out
 
 
@@ -202,13 +212,17 @@ def run_gate(baseline_path: str, current_path: str, strict: bool,
 def _synthetic(mfu: float, step_ms: float, transposes: int = 0,
                coll_bytes: int = 4096, device_class: str = "tpu",
                telemetry_ms: float = 0.5,
-               devprof_pct: float = 95.0) -> dict:
+               devprof_pct: float = 95.0,
+               opt_bytes: int = 65536) -> dict:
     return {
         "metric": "bert_base_pretrain_mfu",
         "value": mfu, "unit": "%", "vs_baseline": mfu / 45.0,
         "detail": {
             "device_class": device_class,
             "step_ms": step_ms,
+            "sharding": {"mesh_axes": {"data": 2, "fsdp": 2, "tp": 2},
+                         "optimizer_bytes_per_device": opt_bytes,
+                         "specs_applied": 6},
             "telemetry": {"sampler_overhead_ms": telemetry_ms,
                           "samples": 50, "drops": 0,
                           "rules_fired": 0},
@@ -292,7 +306,18 @@ def selftest(verbose: bool = True) -> int:
     checks.append(("devprof attribution wiggle passes",
                    not any(r["metric"] == "devprof_attributed_pct"
                            and r["regressed"] for r in rows)))
-    # 10. stale re-emitted on-chip record is warn-only
+    # 10. ANY optimizer-bytes-per-device rise fires (ZeRO layout
+    # regressed toward replication); equal bytes pass
+    cur_opt = _synthetic(mfu=42.0, step_ms=100.0, opt_bytes=65536 * 4)
+    rows = diff(base, cur_opt)
+    checks.append(("optimizer bytes-per-device rise fires",
+                   any(r["metric"] == "optimizer_bytes_per_device"
+                       and r["regressed"] for r in rows)))
+    rows = diff(base, _synthetic(mfu=42.0, step_ms=100.0))
+    checks.append(("equal optimizer bytes pass",
+                   not any(r["metric"] == "optimizer_bytes_per_device"
+                           and r["regressed"] for r in rows)))
+    # 11. stale re-emitted on-chip record is warn-only
     stale = dict(base)
     stale["detail"] = dict(base["detail"], stale_s=1234)
     checks.append(("stale on-chip record is warn-only",
