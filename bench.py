@@ -464,6 +464,31 @@ def _obs_detail():
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def _memory_detail():
+    """BENCH JSON `detail.memory` (ISSUE 14): the device-memory ledger
+    + the peak byte count tools/bench_diff.py gates as
+    `hbm_peak_bytes`.  On CPU (`memory_stats()` absent) peak_bytes
+    falls back to the framework-side ledger peak, so the field exists
+    under cpu-fallback too (warn-only regime).  Never kills the
+    metric."""
+    try:
+        from paddle_tpu.obs import memprof
+
+        led = memprof.memory_ledger()
+        return {
+            "hbm_peak_bytes": int(led.get("peak_bytes") or 0),
+            "bytes_in_use": led.get("bytes_in_use"),
+            "unattributed": led.get("unattributed"),
+            "static_temp_bytes": led.get("static_temp_bytes"),
+            "ledger_total_bytes": led.get("total"),
+            "ledger": led.get("entries", {}),
+            "profiles": {lab: memprof.trim_profile(p)
+                         for lab, p in memprof.profiles().items()},
+        }
+    except Exception as e:  # noqa: BLE001 - observability is optional
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def bench_telemetry():
     """`detail.telemetry` (ISSUE 10 satellite): the live-telemetry
     sampler's own cost.  Drives Collector.sample_once over the REAL
@@ -1010,6 +1035,7 @@ def bench_resnet50(jax, jnp, on_tpu, batch=None):
                    "device_profile": _run_with_watchdog(
                        _device_profile_detail, timeout_s=120,
                        what="device profile capture"),
+                   "memory": _memory_detail(),
                    "tpu_probe": _tpu_probe_detail(),
                    "loss": final_loss},
     }
@@ -1309,6 +1335,9 @@ def main():
     detail["sharding"] = _run_with_watchdog(
         lambda: bench_sharding(jax, jnp), timeout_s=120,
         what="sharding bench")
+    # HBM ledger + peak (ISSUE 14): read AFTER every sub-bench so the
+    # peak covers the whole session; bench_diff gates hbm_peak_bytes
+    detail["memory"] = _memory_detail()
     detail["tpu_probe"] = _tpu_probe_detail()
     result = {
         "metric": ("bert_base_pretrain_mfu" if on_tpu

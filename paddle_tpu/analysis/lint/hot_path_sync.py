@@ -113,6 +113,14 @@ WATCHLIST: List[Tuple[str, str]] = [
     ("paddle_tpu/obs/devprof.py", "DevprofWindow.start"),
     ("paddle_tpu/obs/devprof.py", "DevprofWindow.finish"),
     ("paddle_tpu/obs/devprof.py", "parse_xplane_bytes"),
+    # HBM memory observability (ISSUE 14): set/add run on the dispatch /
+    # ring / ckpt hot paths; ledger_gauges runs on the telemetry
+    # sampler thread and oom_report on the dispatch except-path — all
+    # must stay host-registry reads, never device materializations
+    ("paddle_tpu/obs/memprof.py", "set_entry"),
+    ("paddle_tpu/obs/memprof.py", "add_entry"),
+    ("paddle_tpu/obs/memprof.py", "ledger_gauges"),
+    ("paddle_tpu/obs/memprof.py", "oom_report"),
 ]
 
 # blocking / transferring constructs that must not appear unsanctioned

@@ -43,6 +43,8 @@ WATCHED = [
     # stays covered even if the obs dir entry is ever narrowed
     "paddle_tpu/obs/devprof.py",  # explicit: same reasoning for the
     # measured device-time profiler (ISSUE 12)
+    "paddle_tpu/obs/memprof.py",  # explicit: same reasoning for the
+    # HBM memory ledger (ISSUE 14)
     "paddle_tpu/ckpt",
     "paddle_tpu/profiler",
     "paddle_tpu/fluid/executor.py",

@@ -66,6 +66,20 @@ def _current_mesh_axes() -> Optional[Dict[str, int]]:
     return {str(k): int(v) for k, v in dict(m.shape).items()}
 
 
+def _snapshot_device_bytes(snap: Dict[str, Any]) -> int:
+    """Device bytes pinned by an in-flight snapshot (jax arrays only;
+    host values in the snapshot are references, not copies)."""
+    try:
+        import jax
+    except Exception:  # noqa: BLE001 - jax-less tooling environments
+        return 0
+    total = 0
+    for v in snap.values():
+        if isinstance(v, jax.Array):
+            total += int(getattr(v, "nbytes", 0) or 0)
+    return total
+
+
 def _barrier(count: int, tag: str) -> None:
     """Pod-wide rendezvous before host 0 commits: every shard must be
     on (shared) disk before the manifest names it.  Single process (and
@@ -120,10 +134,34 @@ class CheckpointManager:
         # capture the mesh layout ON the training thread (a global
         # read), so the writer thread records a consistent topology
         mesh_axes = _current_mesh_axes()
-        self._pool.submit(
-            lambda: self._write_job(snap, var_meta, step, job_meta,
-                                    mesh_axes),
-            flow=flow)
+        # the snapshot copies double the state's device footprint until
+        # the writer materializes them to host — account that window in
+        # the memory ledger (obs/memprof.py) so an OOM mid-checkpoint
+        # is attributable
+        snap_bytes = _snapshot_device_bytes(snap)
+        if snap_bytes:
+            from ..obs import memprof
+
+            memprof.add_entry("ckpt_snapshot_bytes", snap_bytes)
+
+        def _job():
+            try:
+                self._write_job(snap, var_meta, step, job_meta,
+                                mesh_axes)
+            finally:
+                if snap_bytes:
+                    from ..obs import memprof as _mp
+
+                    _mp.add_entry("ckpt_snapshot_bytes", -snap_bytes)
+
+        try:
+            self._pool.submit(_job, flow=flow)
+        except BaseException:
+            if snap_bytes:
+                from ..obs import memprof
+
+                memprof.add_entry("ckpt_snapshot_bytes", -snap_bytes)
+            raise
         profiler.stat_add("ckpt_snapshots_total")
 
     def save(self, state: Dict[str, Any], step: int,

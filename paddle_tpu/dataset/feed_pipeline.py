@@ -168,6 +168,33 @@ def attribute_stall(times: Optional[Dict[str, float]] = None) -> str:
 # the device-resident double-buffer ring
 # ---------------------------------------------------------------------------
 
+def _staged_nbytes(item) -> int:
+    """Device bytes one ring slot pins: the staged feed dict's arrays.
+    Sentinels and forwarded exceptions weigh nothing."""
+    if not isinstance(item, tuple) or len(item) != 2:
+        return 0
+    staged = item[0]
+    if not isinstance(staged, dict):
+        return 0
+    total = 0
+    for v in staged.values():
+        total += int(getattr(v, "nbytes", 0) or 0)
+    return total
+
+
+def _ring_account(delta: int) -> None:
+    """Maintain the `feed_ring_bytes` memory-ledger entry
+    (obs/memprof.py) incrementally at stage/consume/close."""
+    if not delta:
+        return
+    try:
+        from ..obs import memprof
+
+        memprof.add_entry("feed_ring_bytes", delta)
+    except Exception:  # noqa: BLE001 - observability, not control
+        pass
+
+
 class DeviceRing:
     """Depth-K ring of staged device batches.
 
@@ -209,6 +236,7 @@ class DeviceRing:
             if self._closed:
                 return False
             self._slots.append(staged)
+            _ring_account(_staged_nbytes(staged))
             occ = len(self._slots)
             self.total_put += staged is not self._END
             if occ > self.max_occupancy:
@@ -237,6 +265,7 @@ class DeviceRing:
             if not self._slots:
                 return self._END  # closed and drained
             item = self._slots.popleft()
+            _ring_account(-_staged_nbytes(item))
             profiler.stat_set("ring_occupancy", len(self._slots))
             self._cond.notify_all()
             return item
@@ -246,6 +275,8 @@ class DeviceRing:
         and drain.  Dropped slots release their device buffers to XLA."""
         with self._cond:
             self._closed = True
+            for item in self._slots:
+                _ring_account(-_staged_nbytes(item))
             self._slots.clear()
             self._cond.notify_all()
 

@@ -31,7 +31,8 @@ class CompileCache:
     behavior is observable wherever the tenant lives.
     """
 
-    def __init__(self, capacity: int, stat_prefix: Optional[str] = None):
+    def __init__(self, capacity: int, stat_prefix: Optional[str] = None,
+                 on_evict: Optional[Callable[[Any, Any], None]] = None):
         if capacity < 1:
             raise ValueError(f"CompileCache capacity must be >= 1, "
                              f"got {capacity}")
@@ -40,6 +41,12 @@ class CompileCache:
             collections.OrderedDict()
         self._lock = threading.RLock()
         self._stat_prefix = stat_prefix
+        # eviction must actually RELEASE what the entry holds (device
+        # const/feed arrays, the AOT executable) — an evicted-but-
+        # referenced entry is a silent HBM leak.  The callback runs
+        # outside the lock; exceptions are swallowed (accounting must
+        # never break a put).
+        self._on_evict = on_evict
 
     def _stat(self, name: str) -> None:
         if self._stat_prefix is not None:
@@ -57,12 +64,19 @@ class CompileCache:
             return entry
 
     def put(self, key, value) -> None:
+        evicted = []
         with self._lock:
             self._od[key] = value
             self._od.move_to_end(key)
             while len(self._od) > self.capacity:
-                self._od.popitem(last=False)
+                evicted.append(self._od.popitem(last=False))
                 self._stat("evictions")
+        if self._on_evict is not None:
+            for ekey, evalue in evicted:
+                try:
+                    self._on_evict(ekey, evalue)
+                except Exception:  # noqa: BLE001 - see __init__
+                    pass
 
     def get_or_build(self, key, builder: Callable[[], Any]) -> Any:
         """Entry for `key`, building (and caching) it on miss.

@@ -34,6 +34,7 @@ import hashlib
 import os
 import threading
 import time
+import weakref
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 import jax
@@ -223,6 +224,69 @@ class Scope:
 
 _global_scope = Scope()
 _scope_stack = [_global_scope]
+
+# live executors for the memory-ledger pull source below; weak so the
+# ledger never pins a discarded Executor (and its caches) alive
+_LIVE_EXECUTORS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _device_resident_bytes(v, seen: set) -> int:
+    """Per-device resident bytes of one value: 0 for host arrays and
+    for device arrays already counted (id-dedup — a const cached by a
+    compile entry AND committed to the scope is ONE buffer).  Sharded
+    arrays count the worst device's share via `.addressable_shards`
+    (metadata reads only — never a transfer)."""
+    if not _is_device_array(v) or id(v) in seen:
+        return 0
+    seen.add(id(v))
+    try:
+        per_dev: Dict[Any, int] = {}
+        for s in v.addressable_shards:
+            nb = int(getattr(s.data, "nbytes", 0) or 0)
+            per_dev[s.device] = per_dev.get(s.device, 0) + nb
+        if per_dev:
+            return max(per_dev.values())
+    except Exception:  # noqa: BLE001 - fully-replicated / older arrays
+        pass
+    return int(getattr(v, "nbytes", 0) or 0)
+
+
+def _memprof_source() -> Dict[str, int]:
+    """Pull-style ledger source (obs/memprof.py `register_source`):
+    scope state + compile-cache const caches + feed-cache buffers,
+    id-deduped across all three so shared device buffers count once.
+    Called at ledger/telemetry-poll time only — never on the dispatch
+    hot path."""
+    seen: set = set()
+    scope_bytes = 0
+    walked: set = set()
+    for sc in list(_scope_stack):
+        s: Optional[Scope] = sc
+        while s is not None and id(s) not in walked:
+            walked.add(id(s))
+            for v in list(s._vars.values()):
+                scope_bytes += _device_resident_bytes(v, seen)
+            s = s.parent
+    cache_bytes = 0
+    feed_bytes = 0
+    for exe in list(_LIVE_EXECUTORS):
+        for entry in exe._cache.values():
+            for v in list(entry.const_dev.values()):
+                cache_bytes += _device_resident_bytes(v, seen)
+        for v in exe._feed_cache.values():
+            feed_bytes += _device_resident_bytes(v, seen)
+    return {"scope_bytes": scope_bytes,
+            "compile_cache_bytes": cache_bytes,
+            "feed_cache_bytes": feed_bytes}
+
+
+def _register_memprof_source() -> None:
+    try:
+        from ..obs import memprof
+
+        memprof.register_source("executor", _memprof_source)
+    except Exception:  # noqa: BLE001 - observability, not control flow
+        pass
 
 
 def global_scope() -> Scope:
@@ -651,12 +715,42 @@ class Executor:
         self.place = place
         # shared bounded-LRU machinery (fluid/compile_cache.py), the
         # same class backing CompiledProgram and the serving engine's
-        # bucketed entry cache
-        self._cache: CompileCache = CompileCache(self.CACHE_CAPACITY)
+        # bucketed entry cache.  The on_evict hooks RELEASE the evicted
+        # entry's device residents (const/feed caches, the AOT
+        # executable) — before ISSUE 14 an evicted entry's arrays
+        # stayed alive through the entry reference, a silent HBM leak.
+        self._cache: CompileCache = CompileCache(
+            self.CACHE_CAPACITY, on_evict=self._on_entry_evict)
         self._feed_cache: CompileCache = CompileCache(
-            self.FEED_CACHE_CAPACITY)
+            self.FEED_CACHE_CAPACITY, on_evict=self._on_feed_evict)
         self._nan_monitor = _NanMonitor()
         self._step = 0
+        _LIVE_EXECUTORS.add(self)
+        _register_memprof_source()
+
+    # -- memory-ledger eviction accounting (obs/memprof.py) ----------------
+    def _on_entry_evict(self, key, entry: "_CompiledEntry") -> None:
+        n = 0
+        for v in list(entry.const_dev.values()):
+            n += int(getattr(v, "nbytes", 0) or 0)
+        entry.const_dev.clear()
+        entry.const_src.clear()
+        # drop the AOT executable and the jit wrapper (its own compiled
+        # cache) — the evicted entry must hold NO device references
+        entry.fn_compiled = None
+        entry.fn = None
+        entry.cost = None
+        if n:
+            from ..profiler import stat_add
+
+            stat_add("compile_cache_evicted_bytes", n)
+
+    def _on_feed_evict(self, key, dev) -> None:
+        n = int(getattr(dev, "nbytes", 0) or 0)
+        if n:
+            from ..profiler import stat_add
+
+            stat_add("compile_cache_evicted_bytes", n)
 
     # -- public API --------------------------------------------------------
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
@@ -1152,21 +1246,33 @@ class Executor:
             # devprof window bookkeeping: a single attribute check when
             # no capture window is armed; never syncs, never transfers
             obs.devprof.note_dispatch(sp, entry.label)
-            if entry.fn_compiled is not None:
-                try:
-                    result = entry.fn_compiled(mutable_state, const_state,
-                                               feed_arrays, seed)
-                except TypeError:
-                    # argument signature drifted from the compiled avals
-                    # (a scope var replaced with a new shape/dtype): fall
-                    # back to the jit wrapper permanently, which retraces
-                    # — the exact behavior this entry had pre-obs
-                    entry.fn_compiled = None
+            try:
+                if entry.fn_compiled is not None:
+                    try:
+                        result = entry.fn_compiled(mutable_state,
+                                                   const_state,
+                                                   feed_arrays, seed)
+                    except TypeError:
+                        # argument signature drifted from the compiled
+                        # avals (a scope var replaced with a new
+                        # shape/dtype): fall back to the jit wrapper
+                        # permanently, which retraces — the exact
+                        # behavior this entry had pre-obs
+                        entry.fn_compiled = None
+                        result = entry.fn(mutable_state, const_state,
+                                          feed_arrays, seed)
+                else:
                     result = entry.fn(mutable_state, const_state,
                                       feed_arrays, seed)
-            else:
-                result = entry.fn(mutable_state, const_state, feed_arrays,
-                                  seed)
+            except Exception as e:
+                # RESOURCE_EXHAUSTED forensics (obs/memprof.py): the
+                # allocator said no — publish the mem_oom flight bundle
+                # (ledger + the failing program's top static temp
+                # buffers) before re-raising.  Host-registry reads
+                # only; non-OOM errors re-raise untouched.
+                if obs.memprof.is_oom_error(e):
+                    obs.publish_mem_oom(entry.label, e)
+                raise
         if entry.cost is not None:
             entry.cost.observe_dispatch(t0)
         entry.dispatched = True

@@ -51,6 +51,7 @@ import threading as _threading
 
 from . import cost
 from . import devprof
+from . import memprof
 from . import opprof
 from . import telemetry
 from .tracing import NULL_SPAN, TRACER, Tracer  # noqa: F401
@@ -58,7 +59,8 @@ from .tracing import NULL_SPAN, TRACER, Tracer  # noqa: F401
 __all__ = ["span", "add_span", "new_flow", "attach_flow", "current_span",
            "enable", "disable", "enabled", "reset", "snapshot",
            "export_trace", "op_profile", "profile_window", "roofline",
-           "cost", "devprof", "opprof", "telemetry",
+           "mem_profile", "memory_ledger", "publish_mem_oom",
+           "cost", "devprof", "memprof", "opprof", "telemetry",
            "start_telemetry", "stop_telemetry", "maybe_start_telemetry",
            "telemetry_epoch_refresh", "telemetry_handle", "TRACER",
            "NULL_SPAN", "Tracer"]
@@ -152,6 +154,62 @@ def roofline(program=None, label: Optional[str] = None) \
     return devprof.roofline_for(prog_id=prog_id, label=label)
 
 
+def mem_profile(program=None, label: Optional[str] = None) \
+        -> Optional[Dict[str, Any]]:
+    """The static memory-attribution table for `program` (matched by
+    the SOURCE prog_id its rows attribute to), for an exact executable
+    `label`, or the most recently compiled executable when neither is
+    given.  None until a compile-cache miss has captured one.  Rows
+    attribute the executable's temp-buffer peak (`memory_analysis()`)
+    to `program#<id>/block<idx>/op<id>:<type>` provenance, with the
+    remainder in an explicit `unattributed` bin
+    (docs/observability.md)."""
+    prog_id = getattr(program, "prog_id", None) \
+        if program is not None else None
+    return memprof.profile_for(prog_id=prog_id, label=label)
+
+
+def memory_ledger() -> Dict[str, Any]:
+    """The live device-memory ledger: every byte the framework
+    intentionally holds on device (scope vars, compile-cache
+    const/feed caches, feed-ring staged batches, KV pages, in-flight
+    ckpt snapshots), reconciled against `device.memory_stats()` —
+    `bytes_in_use = ledger total + executable temp + unattributed`,
+    with the residual explicit.  Device fields are None on backends
+    without memory_stats (CPU)."""
+    return memprof.memory_ledger()
+
+
+def publish_mem_oom(label: str = "", error: Any = "") -> Dict[str, Any]:
+    """RESOURCE_EXHAUSTED forensics: assemble the mem_oom report
+    (ledger at failure time + the failing executable's top static temp
+    buffers) and publish it as a flight bundle.  With a live telemetry
+    session the watchdog writes a full bundle (series + memory.json);
+    otherwise a minimal bundle lands in the PADDLE_OBS_FLIGHT_DIR (if
+    set).  Always returns the report; never raises — this runs on the
+    dispatch except-path."""
+    doc = memprof.oom_report(label=label, error=error)
+    handle = _TELEMETRY
+    try:
+        if handle is not None and handle.watchdog is not None:
+            handle.watchdog.trigger(
+                "mem_oom",
+                f"RESOURCE_EXHAUSTED dispatching {label or '<program>'}"
+                f": {str(error)[:200]}")
+        else:
+            flight_dir = _obs_flag("obs_flight_dir",
+                                   "PADDLE_OBS_FLIGHT_DIR", "", str)
+            if flight_dir:
+                telemetry.write_standalone_bundle(
+                    flight_dir, "mem_oom",
+                    f"RESOURCE_EXHAUSTED dispatching "
+                    f"{label or '<program>'}",
+                    {"memory.json": doc})
+    except Exception:  # noqa: BLE001 - forensics must not mask the OOM
+        pass
+    return doc
+
+
 def _process_index() -> int:
     try:
         from ..distributed.parallel import _safe_process_index
@@ -219,6 +277,7 @@ def snapshot(all_hosts: bool = False) -> Dict[str, Any]:
         "cost": cost.snapshot(),
         "op_profile": opprof.snapshot(),
         "devprof": devprof.snapshot(),
+        "memory": memprof.snapshot(),
         **local,
     }
     if all_hosts:
@@ -316,7 +375,8 @@ def start_telemetry(port: Optional[int] = None,
             min_interval_s=flight_min_interval_s,
             trace_cb=export_trace,
             snapshot_cb=snapshot,
-            op_profile_cb=opprof.snapshot)
+            op_profile_cb=opprof.snapshot,
+            mem_cb=memprof.memory_doc)
         collector = telemetry.Collector(
             sources=telemetry.default_sources(),
             sample_s=sample_s, watchdog=watchdog)
@@ -401,6 +461,12 @@ def export_trace(path: str, include_snapshot: bool = True) -> int:
     doc = TRACER.chrome_trace(other_data=other)
     try:
         devprof.merge_chrome_trace(doc)
+    except Exception:  # noqa: BLE001 - the host trace must still export
+        pass
+    try:
+        # ledger samples as a Chrome "C" counter track, aligned with
+        # the span timeline (both perf_counter-clocked)
+        doc["traceEvents"].extend(memprof.chrome_counter_events())
     except Exception:  # noqa: BLE001 - the host trace must still export
         pass
     with open(path, "w") as f:

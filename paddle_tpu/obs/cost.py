@@ -214,9 +214,13 @@ def compile_with_cost(jitted, args: Tuple, label: str):
     # per-op attribution (obs/opprof.py): walk the executable's HLO
     # once, here on the compile-cache miss, and fold per-instruction
     # FLOPs/bytes back onto the Program ops named in the metadata
-    from . import opprof
+    from . import memprof, opprof
 
-    opprof.profile_compiled(compiled, label, cost=cost)
+    op_prof = opprof.profile_compiled(compiled, label, cost=cost)
+    # static memory attribution (obs/memprof.py): same compile-miss
+    # seam, reusing opprof's instruction->provenance join so FLOP and
+    # temp-byte attribution can never disagree about fusion ownership
+    memprof.capture_compiled(compiled, label, opprof_profile=op_prof)
     return compiled, register_program(label, cost)
 
 

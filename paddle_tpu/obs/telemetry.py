@@ -73,6 +73,7 @@ BUNDLE_PREFIX = "flight_"
 GAUGE_STATS = frozenset({
     "serving_queue_depth", "serving_in_flight",
     "serving_batch_occupancy_max", "serving_kv_pages_in_use",
+    "serving_kv_bytes",
     "ring_occupancy", "ring_occupancy_max",
     "in_flight_steps", "in_flight_steps_max",
     "devprof_attributed_pct",
@@ -202,6 +203,8 @@ DEFAULT_THRESHOLDS: Dict[str, float] = {
     "collective_jump_frac": 0.5,  # bytes-on-wire growth within window
     "collective_min_bytes": 1024.0,
     "host_lost_stale_s": 300.0,   # pod-merged snapshot staleness limit
+    "hbm_pressure_frac": 0.92,    # bytes_in_use / bytes_limit ceiling
+    "hbm_headroom_temp_frac": 1.0,  # headroom vs biggest static temp
 }
 
 
@@ -338,6 +341,31 @@ def rule_host_lost(v, cfg) -> Optional[str]:
     return None
 
 
+def rule_hbm_pressure(v, cfg) -> Optional[str]:
+    """Device HBM nearly full, or headroom below the biggest compiled
+    program's static temp requirement (the next dispatch of that
+    program cannot fit).  The `hbm_*` gauges only exist where
+    `device.memory_stats()` reports them (TPU); on single-host CPU the
+    series are absent and this rule is silent by construction."""
+    in_use = v.last("hbm_bytes_in_use")
+    limit = v.last("hbm_limit_bytes")
+    if in_use is None or limit is None or limit <= 0:
+        return None
+    frac = in_use / limit
+    if frac > cfg["hbm_pressure_frac"]:
+        return (f"hbm_bytes_in_use {in_use:.0f} is {frac:.0%} of the "
+                f"{limit:.0f}-byte device limit (threshold "
+                f"{cfg['hbm_pressure_frac']:.0%})")
+    temp = v.last("hbm_static_temp_bytes")
+    headroom = limit - in_use
+    if temp and temp > 0 \
+            and headroom < cfg["hbm_headroom_temp_frac"] * temp:
+        return (f"hbm headroom {headroom:.0f} bytes is below the "
+                f"largest compiled program's static temp requirement "
+                f"({temp:.0f} bytes)")
+    return None
+
+
 RULES: List[Tuple[str, Callable]] = [
     ("step_time_spike", rule_step_time_spike),
     ("mfu_drop", rule_mfu_drop),
@@ -348,6 +376,7 @@ RULES: List[Tuple[str, Callable]] = [
     ("feed_starvation", rule_feed_starvation),
     ("collective_bytes_jump", rule_collective_bytes_jump),
     ("host_lost", rule_host_lost),
+    ("hbm_pressure", rule_hbm_pressure),
 ]
 
 
@@ -368,6 +397,7 @@ class Watchdog:
                  trace_cb: Optional[Callable[[str], Any]] = None,
                  snapshot_cb: Optional[Callable[[], dict]] = None,
                  op_profile_cb: Optional[Callable[[], dict]] = None,
+                 mem_cb: Optional[Callable[[], dict]] = None,
                  clock: Callable[[], float] = time.time):
         self.rules = list(RULES if rules is None else rules)
         self.cfg = dict(DEFAULT_THRESHOLDS)
@@ -378,7 +408,11 @@ class Watchdog:
         self.trace_cb = trace_cb
         self.snapshot_cb = snapshot_cb
         self.op_profile_cb = op_profile_cb
+        self.mem_cb = mem_cb
         self.clock = clock
+        # back-reference for external trigger() firings (RESOURCE_
+        # EXHAUSTED forensics); filled in by Collector.__init__
+        self.collector: Optional["Collector"] = None
         self.healthy = True
         self.reason: Optional[str] = None
         self.fired: List[dict] = []
@@ -417,6 +451,20 @@ class Watchdog:
         self._maybe_dump(collector, fired, now)
         return events
 
+    def trigger(self, rule: str, reason: str) -> Optional[str]:
+        """External firing seam — the executor's RESOURCE_EXHAUSTED
+        catch publishes `mem_oom` here: latch health unhealthy and
+        write a flight bundle exactly as if a sampled rule had fired,
+        without waiting for the next tick."""
+        now = self.clock()
+        with self._lock:
+            self.healthy = False
+            self.reason = f"{rule}: {reason}"
+            self.fired.append({"rule": rule, "reason": reason,
+                               "t": round(now, 3)})
+            del self.fired[:-50]
+        return self._maybe_dump(self.collector, [(rule, reason)], now)
+
     def reset(self) -> None:
         """Operator acknowledgment: flip health back after the anomaly
         is understood (the firing history is kept)."""
@@ -432,7 +480,7 @@ class Watchdog:
                     "dumps_rate_limited": self.dumps_rate_limited}
 
     # -- flight recorder ---------------------------------------------------
-    def _maybe_dump(self, collector: "Collector",
+    def _maybe_dump(self, collector: Optional["Collector"],
                     fired: List[Tuple[str, str]],
                     now: float) -> Optional[str]:
         if not self.artifacts_dir:
@@ -449,7 +497,7 @@ class Watchdog:
             # take down the sampler thread it runs on
             return None
 
-    def _dump(self, collector: "Collector",
+    def _dump(self, collector: Optional["Collector"],
               fired: List[Tuple[str, str]], now: float) -> str:
         name = f"{BUNDLE_PREFIX}{int(now * 1000)}_{fired[0][0]}"
         os.makedirs(self.artifacts_dir, exist_ok=True)
@@ -467,9 +515,11 @@ class Watchdog:
                 # beats no bundle; the gap is recorded in reason.json
                 errors[fname] = f"{type(e).__name__}: {e}"
 
-        _write_json("series.json", collector.to_json)
+        _write_json("series.json",
+                    collector.to_json if collector is not None else None)
         _write_json("snapshot.json", self.snapshot_cb)
         _write_json("op_profile.json", self.op_profile_cb)
+        _write_json("memory.json", self.mem_cb)
         if self.trace_cb is not None:
             try:
                 self.trace_cb(os.path.join(tmp, "trace.json"))
@@ -507,6 +557,41 @@ class Watchdog:
             if n.startswith(TMP_PREFIX):
                 shutil.rmtree(os.path.join(self.artifacts_dir, n),
                               ignore_errors=True)
+
+
+def write_standalone_bundle(artifacts_dir: str, rule: str, reason: str,
+                            files: Optional[Dict[str, Any]] = None,
+                            now: Optional[float] = None
+                            ) -> Optional[str]:
+    """Minimal flight bundle with no live collector (the executor's
+    OOM catch when telemetry is not running): the given JSON payloads
+    plus reason.json, published with the same atomic tmp-dir +
+    os.replace protocol so tracetool reads it like any other bundle.
+    Returns the bundle path, or None on any failure — forensics never
+    raise."""
+    if not artifacts_dir:
+        return None
+    if now is None:
+        now = time.time()
+    name = f"{BUNDLE_PREFIX}{int(now * 1000)}_{rule}"
+    try:
+        os.makedirs(artifacts_dir, exist_ok=True)
+        tmp = os.path.join(artifacts_dir, TMP_PREFIX + name)
+        os.makedirs(tmp, exist_ok=True)
+        for fname, payload in (files or {}).items():
+            with open(os.path.join(tmp, fname), "w") as f:
+                json.dump(payload, f)
+        with open(os.path.join(tmp, "reason.json"), "w") as f:
+            json.dump({"t": round(now, 3),
+                       "fired": [{"rule": rule, "reason": reason}],
+                       "errors": {}}, f)
+        final = os.path.join(artifacts_dir, name)
+        if os.path.exists(final):
+            shutil.rmtree(final)
+        os.replace(tmp, final)  # atomic publish (ckpt idiom)
+        return final
+    except Exception:  # noqa: BLE001 - see docstring
+        return None
 
 
 # ---------------------------------------------------------------------------
@@ -554,6 +639,14 @@ def default_sources() -> Callable[[], Dict[str, Any]]:
                 gauges["serving_p99_ms"] = float(ls["p99_ms"])
         except Exception:  # noqa: BLE001 - no serving traffic
             pass
+        try:
+            # the memory ledger computes on demand right here — the
+            # hbm_*/ledger_* gauges ride THIS sampler, no extra thread
+            from . import memprof
+
+            gauges.update(memprof.ledger_gauges())
+        except Exception:  # noqa: BLE001 - memory gauges are optional
+            pass
         # devprof's capture stats need no extra source: _publish writes
         # devprof_capture_ms / devprof_attributed_pct into the profiler
         # tables folded above (attributed_pct is a level via GAUGE_STATS)
@@ -594,6 +687,7 @@ class Collector:
             watchdog.cfg.setdefault("window_ms", 1000.0)
             watchdog.cfg["window_ms"] = max(1.0,
                                             self.sample_s * 1000.0)
+            watchdog.collector = self
         self.clock = clock
         self.samples = 0
         self.source_errors = 0

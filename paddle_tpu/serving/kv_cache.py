@@ -23,12 +23,28 @@ scatter shape static without corrupting live pages.
 from __future__ import annotations
 
 import threading
+import weakref
 from collections import deque
 from typing import Dict, List, Optional
 
 import numpy as np
 
 from .admission import EngineOverloaded
+
+# live pools for the memory-ledger pull source (obs/memprof.py); weak
+# so the ledger never pins a retired pool's device arrays alive
+_LIVE_POOLS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _memprof_source() -> int:
+    """Device bytes held by every live PagedKVCache pool — the pages
+    are allocated whole at construction, so the POOL is what HBM
+    actually holds regardless of how many pages are handed out."""
+    total = 0
+    for c in list(_LIVE_POOLS):
+        total += int(getattr(c.k, "nbytes", 0) or 0)
+        total += int(getattr(c.v, "nbytes", 0) or 0)
+    return total
 
 
 def cdiv(a: int, b: int) -> int:
@@ -50,6 +66,16 @@ class PageTable:
         self._free: deque = deque(range(1, self.num_pages))
         self._owned: Dict[object, List[int]] = {}
         self._lock = threading.Lock()
+        # device bytes per page, reported by the PagedKVCache backing
+        # this table (0 for a table with no device pool, e.g. tests)
+        self.bytes_per_page = 0
+
+    def note_pool_bytes(self, pool_nbytes: int) -> None:
+        """Record the device pool size backing this table so _publish
+        can export `serving_kv_bytes` (bytes of in-use pages)."""
+        self.bytes_per_page = int(pool_nbytes) // max(1, self.num_pages)
+        with self._lock:
+            self._publish()
 
     def pages_needed(self, n_tokens: int) -> int:
         return cdiv(max(1, int(n_tokens)), self.page_size)
@@ -70,8 +96,13 @@ class PageTable:
     def _publish(self) -> None:
         from ..profiler import stat_set
 
-        stat_set("serving_kv_pages_in_use",
-                 self.capacity - len(self._free))
+        used = self.capacity - len(self._free)
+        stat_set("serving_kv_pages_in_use", used)
+        if self.bytes_per_page:
+            # bytes backing the pages currently handed out — the
+            # admission-pressure view; the ledger's kv_cache_bytes
+            # entry carries the full pool (what HBM actually holds)
+            stat_set("serving_kv_bytes", used * self.bytes_per_page)
 
     def allocate(self, seq_id, n_tokens: int) -> List[int]:
         """Pages covering `n_tokens`; all-or-nothing."""
@@ -148,6 +179,15 @@ class PagedKVCache:
         shape = (num_pages, page_size, num_heads, head_dim)
         self.k = jnp.zeros(shape, dtype)
         self.v = jnp.zeros(shape, dtype)
+        self.table.note_pool_bytes(int(self.k.nbytes)
+                                   + int(self.v.nbytes))
+        _LIVE_POOLS.add(self)
+        try:
+            from ..obs import memprof
+
+            memprof.register_source("kv_cache_bytes", _memprof_source)
+        except Exception:  # noqa: BLE001 - observability, not control
+            pass
 
     @property
     def page_size(self) -> int:

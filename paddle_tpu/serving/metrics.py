@@ -23,6 +23,7 @@ Int stats (get_int_stats):
 | serving_trace_count           | bucketed-cache compiles (engine + Predictor) |
 | serving_pad_rows_total        | padding rows added by bucketing         |
 | serving_kv_pages_in_use       | gauge: PageTable pages allocated        |
+| serving_kv_bytes              | gauge: device bytes backing in-use KV pages |
 | serving_prefill_count         | prefill dispatches (autoregressive)     |
 | serving_decode_steps          | decode-step dispatches (autoregressive) |
 

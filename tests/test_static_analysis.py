@@ -413,6 +413,21 @@ def test_devprof_on_hot_path_watchlist():
     assert "paddle_tpu/obs/devprof.py" in lint.span_leak.WATCHED
 
 
+def test_memprof_on_hot_path_watchlist():
+    """ISSUE 14: the memory-ledger entry points are lint-watched —
+    set/add run on the dispatch/ring/ckpt hot paths, ledger_gauges on
+    the telemetry sampler thread and oom_report on the dispatch
+    except-path, so all of them must stay host-registry reads;
+    obs/memprof.py is also in the span-leak watched set, and
+    test_shipped_tree_is_lint_clean above proves the shipped tree
+    honors both."""
+    watched = set(lint.hot_path_sync.WATCHLIST)
+    for qual in ("set_entry", "add_entry", "ledger_gauges",
+                 "oom_report"):
+        assert ("paddle_tpu/obs/memprof.py", qual) in watched
+    assert "paddle_tpu/obs/memprof.py" in lint.span_leak.WATCHED
+
+
 def test_hot_path_rule_fires_on_unsanctioned_sync(tmp_path):
     bad = tmp_path / "paddle_tpu" / "fluid"
     bad.mkdir(parents=True)

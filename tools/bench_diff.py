@@ -29,6 +29,10 @@ Metrics compared (each only when present in BOTH files):
   optimizer_bytes_per_device  detail.sharding.optimizer_bytes_per_device
                               (ANY rise — the ZeRO layout regressed
                               toward replication)
+  hbm_peak_bytes   detail.memory.hbm_peak_bytes        (rise  > 5% rel
+                   — the device-memory high-water mark grew; on CPU
+                   the field is the framework-side ledger peak and the
+                   usual warn-only fallback regime applies)
 
 Exit status: 1 when any regression fires AND the current run is
 on-chip; under `device_class: cpu-fallback` (or a stale re-emitted
@@ -67,6 +71,9 @@ DEFAULT_THRESHOLDS = {
     # never grow — ANY rise means the sharded layout regressed toward
     # replication
     "optimizer_bytes_per_device": ("down", 0.0, 0.0),
+    # HBM high-water mark (ISSUE 14): a >5% rise in peak device bytes
+    # means some subsystem started holding more than it used to
+    "hbm_peak_bytes": ("down", 0.05, 0.0),
 }
 
 
@@ -123,6 +130,11 @@ def extract_metrics(doc: dict) -> Dict[str, float]:
     ob = _get(detail, "sharding", "optimizer_bytes_per_device")
     if isinstance(ob, (int, float)):
         out["optimizer_bytes_per_device"] = float(ob)
+    for mem in (_get(detail, "memory"), _get(rd, "memory")):
+        hp = _get(mem or {}, "hbm_peak_bytes")
+        if isinstance(hp, (int, float)) and hp > 0:
+            out["hbm_peak_bytes"] = float(hp)
+            break
     return out
 
 
@@ -213,7 +225,8 @@ def _synthetic(mfu: float, step_ms: float, transposes: int = 0,
                coll_bytes: int = 4096, device_class: str = "tpu",
                telemetry_ms: float = 0.5,
                devprof_pct: float = 95.0,
-               opt_bytes: int = 65536) -> dict:
+               opt_bytes: int = 65536,
+               hbm_peak: int = 1 << 30) -> dict:
     return {
         "metric": "bert_base_pretrain_mfu",
         "value": mfu, "unit": "%", "vs_baseline": mfu / 45.0,
@@ -228,6 +241,9 @@ def _synthetic(mfu: float, step_ms: float, transposes: int = 0,
                           "rules_fired": 0},
             "device_profile": {"attributed_pct": devprof_pct,
                                "capture_ms": 40.0, "runs": 2},
+            "memory": {"hbm_peak_bytes": hbm_peak,
+                       "ledger_total_bytes": hbm_peak // 2,
+                       "static_temp_bytes": hbm_peak // 8},
             "obs": {"cost": {"collective_bytes":
                              {"c_allreduce_sum": coll_bytes}}},
             "resnet50": {"metric": "resnet50_images_per_sec_per_chip",
@@ -317,7 +333,21 @@ def selftest(verbose: bool = True) -> int:
     checks.append(("equal optimizer bytes pass",
                    not any(r["metric"] == "optimizer_bytes_per_device"
                            and r["regressed"] for r in rows)))
-    # 11. stale re-emitted on-chip record is warn-only
+    # 11. a >5% HBM-peak rise fires (some subsystem holds more than it
+    # used to); an equal peak and a 3% wiggle pass
+    cur_hbm = _synthetic(mfu=42.0, step_ms=100.0,
+                         hbm_peak=int((1 << 30) * 1.10))
+    rows = diff(base, cur_hbm)
+    checks.append(("10% hbm peak rise fires",
+                   any(r["metric"] == "hbm_peak_bytes"
+                       and r["regressed"] for r in rows)))
+    cur_hbm_ok = _synthetic(mfu=42.0, step_ms=100.0,
+                            hbm_peak=int((1 << 30) * 1.03))
+    rows = diff(base, cur_hbm_ok)
+    checks.append(("3% hbm peak wiggle passes",
+                   not any(r["metric"] == "hbm_peak_bytes"
+                           and r["regressed"] for r in rows)))
+    # 12. stale re-emitted on-chip record is warn-only
     stale = dict(base)
     stale["detail"] = dict(base["detail"], stale_s=1234)
     checks.append(("stale on-chip record is warn-only",

@@ -30,14 +30,22 @@ viewer:
              verdict — from a devprof result, obs.snapshot(), a trace
              with an embedded snapshot, or a BENCH JSON
              (detail.device_profile)
+  mem        HBM memory post-mortem (ISSUE 14): the device-memory
+             ledger, per-op static temp attribution and any mem_oom
+             report — from a flight bundle (memory.json), a BENCH
+             JSON (detail.memory), a trace/snapshot JSON, or computed
+             fresh from a raw optimized-HLO dump (obs/memprof.py
+             walks it; --temp-bytes normalizes to the compiler's
+             temp total)
   selftest   build a synthetic multi-thread trace through the span
              layer, export it, summarize it, verify the invariants
              end to end, run the op-profile HLO walk + top-ops
              rendering over a synthetic HLO dump, round-trip
              synthetic xplane bytes through the devprof wire
-             reader/join/roofline, and drive the telemetry
+             reader/join/roofline, drive the telemetry
              collector/watchdog/flight-recorder over scripted
-             sources (wired into tools/ci.sh)
+             sources, and exercise the memprof attribution + ledger
+             + OOM-report math (wired into tools/ci.sh)
 
 stdlib-only; paddle_tpu.obs.tracing, obs.opprof and obs.telemetry are
 loaded by FILE PATH (the tpulint idiom), so this tool runs in
@@ -61,6 +69,7 @@ _TRACING = os.path.join(REPO_ROOT, "paddle_tpu", "obs", "tracing.py")
 _OPPROF = os.path.join(REPO_ROOT, "paddle_tpu", "obs", "opprof.py")
 _TELEMETRY = os.path.join(REPO_ROOT, "paddle_tpu", "obs", "telemetry.py")
 _DEVPROF = os.path.join(REPO_ROOT, "paddle_tpu", "obs", "devprof.py")
+_MEMPROF = os.path.join(REPO_ROOT, "paddle_tpu", "obs", "memprof.py")
 
 
 def _load_by_path(name: str, path: str):
@@ -90,6 +99,10 @@ def load_telemetry():
 
 def load_devprof():
     return _load_by_path("paddle_tpu_obs_devprof", _DEVPROF)
+
+
+def load_memprof():
+    return _load_by_path("paddle_tpu_obs_memprof", _MEMPROF)
 
 
 def load_trace(path: str) -> dict:
@@ -408,6 +421,128 @@ def roofline_cmd(path: str, top: int, as_json: bool) -> int:
 
 
 # ---------------------------------------------------------------------------
+# mem (HBM memory post-mortem, ISSUE 14)
+# ---------------------------------------------------------------------------
+
+def load_memory_doc(path: str,
+                    temp_bytes: Optional[int] = None) -> dict:
+    """Memory artifacts from any file that carries them:
+
+    * a raw optimized-HLO dump (non-JSON) -> walk it fresh via memprof
+      (`--temp-bytes` supplies the compiler's temp total to normalize
+      against)
+    * a flight bundle DIRECTORY or its memory.json (obs/telemetry.py
+      `_dump` / the mem_oom standalone bundle)
+    * a BENCH JSON (detail.memory), a trace JSON
+      (otherData.snapshot.memory) or a bare obs.snapshot()
+
+    Returns {"ledger", "profiles", "last_oom"} with absent pieces None
+    / empty.
+    """
+    if os.path.isdir(path):
+        path = os.path.join(path, "memory.json")
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        # not JSON: an optimized-HLO text dump
+        memprof = load_memprof()
+        memory = {"temp_bytes": int(temp_bytes)} if temp_bytes else None
+        prof = memprof.profile_memory_text(
+            text, label=os.path.basename(path), memory=memory)
+        return {"ledger": None,
+                "profiles": {prof["label"]: prof}, "last_oom": None}
+    out: dict = {"ledger": None, "profiles": {}, "last_oom": None}
+
+    def walk(node, label):
+        if not isinstance(node, dict):
+            return
+        if isinstance(node.get("rows"), list) \
+                and "attributed_temp_pct" in node:
+            out["profiles"].setdefault(
+                node.get("label") or label or "memory", node)
+            return
+        if "entries" in node and "total" in node \
+                and out["ledger"] is None:
+            out["ledger"] = node
+        if node.get("kind") == "mem_oom" and out["last_oom"] is None:
+            out["last_oom"] = node
+        for k, v in node.items():
+            if isinstance(v, dict):
+                walk(v, k)
+
+    walk(doc, None)
+    return out
+
+
+def print_mem_profile(label: str, prof: dict, top: int) -> None:
+    attributed = prof.get("attributed_temp_pct")
+    print(f"== {label}  (temp={prof.get('temp_bytes', 0):.4g} B, "
+          f"attributed "
+          f"{attributed if attributed is None else round(attributed, 2)}%"
+          f", {prof.get('buffer_count', '?')} buffers)")
+    print(f"{'op':<56}{'temp_bytes':>14}{'pct':>7}{'bufs':>6}"
+          f"{'largest':>14}")
+    for r in prof.get("rows", [])[:top]:
+        print(f"{r.get('op', '?'):<56}"
+              f"{r.get('temp_bytes', 0.0):>14.4g}"
+              f"{r.get('temp_pct', 0.0):>7.2f}"
+              f"{r.get('buffers', 0):>6}"
+              f"{r.get('largest_bytes', 0.0):>14.4g}")
+
+
+def print_memory(doc: dict, top: int) -> None:
+    led = doc.get("ledger")
+    if led:
+        in_use = led.get("bytes_in_use")
+        print(f"ledger: {led.get('total', 0)} B over "
+              f"{len(led.get('entries', {}))} entries, "
+              f"static temp {led.get('static_temp_bytes', 0)} B, "
+              f"device in_use "
+              f"{in_use if in_use is not None else 'n/a (no stats)'}, "
+              f"unattributed {led.get('unattributed')}, "
+              f"peak {led.get('peak_bytes', 0)} B")
+        for name, nbytes in sorted(led.get("entries", {}).items(),
+                                   key=lambda kv: -kv[1]):
+            print(f"  {name:<40}{nbytes:>16}")
+    for label, prof in doc.get("profiles", {}).items():
+        print_mem_profile(label, prof, top)
+    oom = doc.get("last_oom")
+    if oom:
+        print(f"mem_oom: {oom.get('label', '?')} — "
+              f"{oom.get('error', '')[:160]}")
+        for b in oom.get("top_buffers", [])[:top]:
+            print(f"  {b.get('instr', '?'):<40}"
+                  f"{b.get('opcode', ''):<16}"
+                  f"{b.get('bytes', b.get('bytes_raw', 0)):>14.4g}  "
+                  f"{b.get('op', '')}")
+
+
+def mem_cmd(path: str, top: int, temp_bytes: Optional[int],
+            as_json: bool) -> int:
+    doc = load_memory_doc(path, temp_bytes)
+    if not doc["ledger"] and not doc["profiles"] \
+            and not doc["last_oom"]:
+        print(f"tracetool mem: no memory artifacts found in {path} "
+              "(need a flight bundle / memory.json, a BENCH JSON with "
+              "detail.memory, a trace/snapshot JSON, or a raw HLO "
+              "dump)", file=sys.stderr)
+        return 1
+    if as_json:
+        memprof = load_memprof()
+        print(json.dumps({
+            "ledger": doc["ledger"],
+            "profiles": {lab: memprof.trim_profile(p, top)
+                         for lab, p in doc["profiles"].items()},
+            "last_oom": doc["last_oom"],
+        }))
+        return 0
+    print_memory(doc, top)
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # metrics (live-telemetry dump post-mortem)
 # ---------------------------------------------------------------------------
 
@@ -654,6 +789,91 @@ def _devprof_selftest_checks() -> List[tuple]:
     return checks
 
 
+def _memprof_selftest_checks() -> List[tuple]:
+    """The memory half of the selftest: walk the synthetic HLO through
+    memprof (loaded by file path), assert the attribution +
+    normalization invariants, then the ledger/gauge/OOM-report math
+    over injected device stats — no jax anywhere."""
+    memprof = load_memprof()
+    opprof = load_opprof()
+    checks: List[tuple] = []
+
+    op_prof = opprof.profile_hlo_text(_SELFTEST_HLO, label="selftest")
+    prof = memprof.profile_memory_text(
+        _SELFTEST_HLO, label="selftest",
+        memory={"temp_bytes": 40960},
+        instr_prov=op_prof.get("instr_prov"))
+    by_op = {r["op"]: r for r in prof["rows"]}
+    dot = by_op.get("program#7/block0/op1:mul", {})
+    relu = by_op.get(
+        "program#7/block0/op2:relu[pass=layout_optimize]", {})
+    checks.append(("memprof: dot owns its buffer AND its metadata-less "
+                   "transpose's (consumer inheritance via instr_prov)",
+                   dot.get("temp_bytes_raw") == 49152.0
+                   and dot.get("buffers") == 2))
+    checks.append(("memprof: fused interiors excluded — one boundary "
+                   "buffer per fusion",
+                   relu.get("buffers") == 1
+                   and relu.get("temp_bytes_raw") == 16384.0))
+    checks.append(("memprof: rows normalized to the compiler's temp "
+                   "total",
+                   abs(prof["temp_bytes"] - 40960.0) < 1e-6
+                   and abs(sum(r["temp_bytes"] for r in prof["rows"])
+                           - 40960.0) < 1e-6))
+    checks.append(("memprof: >=80% of temp bytes attributed",
+                   prof["attributed_temp_pct"] >= 80.0))
+    bare = memprof.profile_memory_text(_SELFTEST_HLO)
+    unattr = {r["op"]: r for r in bare["rows"]}.get(
+        memprof.UNATTRIBUTED)
+    checks.append(("memprof: provenance-less buffer lands in the "
+                   "explicit unattributed bin",
+                   unattr is not None
+                   and unattr["temp_bytes_raw"] == 32768.0))
+
+    memprof.reset_ledger()
+    try:
+        memprof.set_entry("scope_bytes", 1000)
+        memprof.add_entry("scope_bytes", 500)
+        memprof.register_source("kv",
+                                lambda: {"kv_cache_bytes": 300})
+        memprof.set_device_stats_fn(
+            lambda: {"bytes_in_use": 5000, "bytes_limit": 10000,
+                     "peak_bytes_in_use": 6000})
+        g = memprof.ledger_gauges()
+        checks.append(("memprof: gauges fold push + pull ledger "
+                       "entries",
+                       g.get("ledger_total_bytes") == 1800.0
+                       and g.get("ledger_scope_bytes") == 1500.0
+                       and g.get("ledger_kv_cache_bytes") == 300.0))
+        checks.append(("memprof: device truth surfaces as hbm_* gauges",
+                       g.get("hbm_bytes_in_use") == 5000.0
+                       and g.get("hbm_limit_bytes") == 10000.0
+                       and g.get("hbm_peak_bytes") == 6000.0))
+        led = memprof.memory_ledger()
+        checks.append(("memprof: ledger reconciles with an explicit "
+                       "unattributed residual",
+                       led["bytes_in_use"] == 5000
+                       and led["unattributed"] == 3200))
+        memprof.register_profile("selftest", prof)
+        oom = memprof.oom_report("selftest",
+                                 "RESOURCE_EXHAUSTED: 1.5G > 1G")
+        checks.append(("memprof: oom report carries ledger + top "
+                       "static buffers",
+                       oom["kind"] == "mem_oom"
+                       and oom["ledger"]["total"] == 1800
+                       and len(oom["top_buffers"]) > 0))
+        evs = memprof.chrome_counter_events()
+        checks.append(("memprof: ledger samples render as Chrome "
+                       "counter events",
+                       bool(evs) and evs[-1]["ph"] == "C"
+                       and evs[-1]["args"].get("scope_bytes") == 1500))
+    finally:
+        memprof.reset_ledger()
+        memprof.reset_profiles()
+        memprof.reset_oom()
+    return checks
+
+
 def _telemetry_selftest_checks() -> List[tuple]:
     """The live-telemetry half of the selftest: drive the collector,
     watchdog and flight recorder (loaded by file path — no jax) over
@@ -825,6 +1045,7 @@ def selftest(verbose: bool = True) -> int:
         ]
         checks += _opprof_selftest_checks()
         checks += _devprof_selftest_checks()
+        checks += _memprof_selftest_checks()
         checks += _telemetry_selftest_checks()
         failed = [name for name, ok in checks if not ok]
         if verbose:
@@ -878,11 +1099,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_roof.add_argument("artifact")
     p_roof.add_argument("--top", type=int, default=10)
     p_roof.add_argument("--json", action="store_true")
+    p_mem = sub.add_parser(
+        "mem", help="HBM memory post-mortem: ledger + per-op static "
+        "temp attribution + mem_oom report from a flight bundle / "
+        "BENCH / trace / snapshot JSON or a raw HLO dump")
+    p_mem.add_argument("artifact")
+    p_mem.add_argument("--top", type=int, default=10)
+    p_mem.add_argument("--temp-bytes", type=int, default=None,
+                       help="compiler temp total to normalize a raw "
+                            "HLO dump against")
+    p_mem.add_argument("--json", action="store_true")
     sub.add_parser("selftest", help="exercise the span layer, the "
                                     "op-profile HLO walk, the devprof "
-                                    "xplane parse/join/roofline and the "
-                                    "telemetry collector/watchdog end "
-                                    "to end")
+                                    "xplane parse/join/roofline, the "
+                                    "telemetry collector/watchdog and "
+                                    "the memprof attribution/ledger "
+                                    "end to end")
     args = ap.parse_args(argv)
 
     if args.cmd == "summarize":
@@ -907,6 +1139,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         return metrics_cmd(args.dump, args.json)
     if args.cmd == "roofline":
         return roofline_cmd(args.artifact, args.top, args.json)
+    if args.cmd == "mem":
+        return mem_cmd(args.artifact, args.top, args.temp_bytes,
+                       args.json)
     if args.cmd == "selftest":
         return selftest()
     ap.print_help()
