@@ -593,6 +593,70 @@ def bench_checkpoint(jax, jnp):
     }
 
 
+def bench_numerics(jax, jnp):
+    """`detail.numerics` (ISSUE 15 satellite): per-op numeric-stats
+    collection cost on a live fluid train loop.  Times N executor
+    steps with PADDLE_OBS_NUMERICS=off, then the same loop with stats
+    collection on — the mode joins the compile-cache signature, so the
+    flip is a clean recompile, never a stale cache hit — and reports
+    the on-vs-off overhead plus the training-health gauges the
+    instrumented run produced (grad_norm_total, update_ratio, AMP
+    loss_scale) so tools/bench_diff.py can gate
+    `numerics_overhead_pct`."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.obs import numerics
+
+    feed = {"x": np.random.RandomState(0)
+            .randn(8, 64).astype(np.float32)}
+    n_steps = 12
+
+    def run(mode):
+        prev = os.environ.get("PADDLE_OBS_NUMERICS")
+        os.environ["PADDLE_OBS_NUMERICS"] = mode
+        try:
+            main_prog, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main_prog, startup):
+                x = fluid.data("x", [8, 64], "float32")
+                h = fluid.layers.fc(x, size=64, act="relu",
+                                    name="num_fc1")
+                h = fluid.layers.fc(h, size=64, name="num_fc2")
+                loss = fluid.layers.reduce_mean(h)
+                fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+            exe = fluid.Executor()
+            exe.run(startup)
+            exe.run(main_prog, feed=feed,
+                    fetch_list=[loss.name])  # compile, outside timing
+            t0 = time.perf_counter()
+            for _ in range(n_steps):
+                exe.run(main_prog, feed=feed, fetch_list=[loss.name])
+            return (time.perf_counter() - t0) * 1e3 / n_steps
+        finally:
+            if prev is None:
+                os.environ.pop("PADDLE_OBS_NUMERICS", None)
+            else:
+                os.environ["PADDLE_OBS_NUMERICS"] = prev
+
+    numerics.reset()
+    step_ms_off = run("off")
+    step_ms_on = run("on")
+    gauges = numerics.health_gauges()  # drains the pending refs
+    doc = numerics.numerics_doc()
+    overhead = (step_ms_on / step_ms_off - 1.0) * 100.0 \
+        if step_ms_off > 0 else 0.0
+    return {
+        "mode": "on",
+        "steps": n_steps,
+        "step_ms_off": round(step_ms_off, 4),
+        "step_ms_on": round(step_ms_on, 4),
+        "overhead_pct": round(overhead, 2),
+        "ops_tracked": len(doc.get("ops") or []),
+        "nonfinite_ops_total": doc.get("nonfinite_ops_total"),
+        "grad_norm_total": gauges.get("grad_norm_total"),
+        "update_ratio": gauges.get("update_ratio"),
+        "loss_scale": doc.get("loss_scale"),
+    }
+
+
 def bench_sharding(jax, jnp):
     """`detail.sharding` (ISSUE 13 satellite): SPMD named-axis layout
     numbers on a small fluid train loop — the mesh axes used, params /
@@ -1325,6 +1389,12 @@ def main():
     # region over the real in-process sources, gated by bench_diff
     detail["telemetry"] = _run_with_watchdog(
         bench_telemetry, timeout_s=120, what="telemetry bench")
+    # numeric-stats collection cost (ISSUE 15): on-vs-off overhead of
+    # the instrumented lowering + the health gauges the run produced;
+    # bench_diff gates numerics_overhead_pct on this
+    detail["numerics"] = _run_with_watchdog(
+        lambda: bench_numerics(jax, jnp), timeout_s=120,
+        what="numerics bench")
     # measured device time + roofline (ISSUE 12): AFTER the timed
     # region — jax.profiler.trace around the toy ResNet dispatches
     detail["device_profile"] = _run_with_watchdog(

@@ -121,6 +121,18 @@ WATCHLIST: List[Tuple[str, str]] = [
     ("paddle_tpu/obs/memprof.py", "add_entry"),
     ("paddle_tpu/obs/memprof.py", "ledger_gauges"),
     ("paddle_tpu/obs/memprof.py", "oom_report"),
+    # numeric-health observability (ISSUE 15): note_dispatch_stats /
+    # note_loss_scale run ON the dispatch hot path (bounded host deque
+    # appends of device references — never a transfer); drain /
+    # health_gauges run on the telemetry sampler thread where the
+    # LazyFetch-style materialization is the sanctioned boundary;
+    # bisect_nonfinite is offline forensics whose materializations ARE
+    # the point — all marked sync-ok where they materialize
+    ("paddle_tpu/obs/numerics.py", "note_dispatch_stats"),
+    ("paddle_tpu/obs/numerics.py", "note_loss_scale"),
+    ("paddle_tpu/obs/numerics.py", "drain"),
+    ("paddle_tpu/obs/numerics.py", "health_gauges"),
+    ("paddle_tpu/obs/numerics.py", "bisect_nonfinite"),
 ]
 
 # blocking / transferring constructs that must not appear unsanctioned

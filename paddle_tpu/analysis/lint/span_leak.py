@@ -45,6 +45,8 @@ WATCHED = [
     # measured device-time profiler (ISSUE 12)
     "paddle_tpu/obs/memprof.py",  # explicit: same reasoning for the
     # HBM memory ledger (ISSUE 14)
+    "paddle_tpu/obs/numerics.py",  # explicit: same reasoning for the
+    # numeric-health layer (ISSUE 15)
     "paddle_tpu/ckpt",
     "paddle_tpu/profiler",
     "paddle_tpu/fluid/executor.py",

@@ -309,12 +309,19 @@ class _CompiledEntry:
     # (docs/observability.md): the first dispatch AOT-compiles `fn` and
     # caches the executable plus its XLA cost_analysis here, so
     # FLOPs/bytes live exactly as long as the CompileCache entry.
+    # `numerics_mode`/`numerics_keys`/`lowered_block`/`amp_scale_name`
+    # are the obs.numerics seam (docs/observability.md "Numerics"):
+    # the armed instrumentation mode at compile time, the (kind, a, b)
+    # key list matching the stacked stats array's rows, the TRANSFORMED
+    # block kept for bisection replay (so [pass=...] provenance
+    # survives), and the AMP dynamic-loss-scale output var, if any.
     __slots__ = ("fn", "state_in_names", "mutable_in_names", "const_in_names",
                  "mutable_out_names", "feed_names", "fetch_names", "program",
                  "scope", "check_nan", "check_names", "const_src",
                  "const_dev", "feed_shardings", "const_shardings",
                  "state_shardings", "dispatched", "fn_compiled", "cost",
-                 "label")
+                 "label", "numerics_mode", "numerics_keys", "lowered_block",
+                 "amp_scale_name")
 
 
 class _NanMonitor:
@@ -342,7 +349,7 @@ class _NanMonitor:
 
     def _loop(self):
         while True:
-            flags, names = self._q.get()
+            flags, names, context = self._q.get()
             try:
                 try:
                     bad = np.asarray(flags)  # background thread: off the
@@ -359,17 +366,34 @@ class _NanMonitor:
                         stat_add("nan_inf_hits_total", len(hits))
                     except Exception:  # noqa: BLE001 - telemetry only
                         pass
+                    step = (context or {}).get("step")
+                    at = f" at step {step}" if step is not None else ""
                     with self._lock:
                         self._errs.append(
                             f"NaN/Inf detected in variable {hits[0]!r} "
-                            f"after Executor.run (FLAGS_check_nan_inf is "
-                            f"set; async scan, all hits: {hits})")
+                            f"after Executor.run{at} (FLAGS_check_nan_inf "
+                            f"is set; async scan, all hits: {hits})")
+                    try:
+                        # numeric forensics (obs.numerics): record
+                        # nan_inf_first_step, run the first-NaN
+                        # bisection when a dispatch snapshot rode along
+                        # (PADDLE_OBS_NUMERICS=bisect), and publish the
+                        # non_finite_loss flight bundle
+                        from ..obs import numerics
+
+                        numerics.handle_nan_hit(hits, context)
+                    except Exception:  # noqa: BLE001 - forensics must
+                        # not take down the monitor thread
+                        pass
             finally:
                 self._q.task_done()
 
-    def submit(self, flags, names):
+    def submit(self, flags, names, context=None):
+        """Queue one dispatch's flag vector; `context` optionally
+        carries {step, label, record} for the numerics hit hook —
+        `record` is the bisect-mode input snapshot."""
         self._ensure()
-        self._q.put((flags, names))
+        self._q.put((flags, names, context))
 
     def poll(self):
         """Raise the first parked NaN/Inf report, if any."""
@@ -692,6 +716,97 @@ def _nan_flags(fetch_names, fetches, new_state):
             flags.append(jnp.logical_not(jnp.all(jnp.isfinite(arr))))
     stacked = jnp.stack(flags) if flags else jnp.zeros((0,), bool)
     return names, stacked
+
+
+_HEALTH_PREFIX_CAP = 16  # per-prefix gauge series kept per dispatch
+
+
+def _health_prefix(name: str) -> str:
+    """Telemetry-safe parameter-group prefix: the var name up to the
+    first '.'/'@', sanitized to a Prometheus-legal suffix."""
+    import re as _re
+
+    base = name.split("@")[0].split(".")[0]
+    return _re.sub(r"[^A-Za-z0-9_]", "_", base) or "var"
+
+
+def _health_rows(env, mutable_state, new_state):
+    """Training-health scalars traced INTO the step (obs.numerics):
+    total/per-prefix grad and param norms plus the update ratio
+    ‖Δw‖/‖w‖.  Device-side reductions only — they ride the same
+    stacked stats fetch as the per-op rows, zero extra sync."""
+    rows = []
+    f32 = jnp.float32
+    g_total, g_pref = None, {}
+    for name, v in env.items():
+        if not name.endswith("@GRAD"):
+            continue
+        # parameter gradients only — activation cotangents also live
+        # in env under @GRAD names and would inflate the norm
+        if name[: -len("@GRAD")] not in mutable_state:
+            continue
+        try:
+            if not jnp.issubdtype(jnp.result_type(v), jnp.floating):
+                continue
+        except Exception:  # noqa: BLE001 - non-array binding
+            continue
+        s = jnp.sum(jnp.square(jnp.asarray(v).astype(f32)))
+        g_total = s if g_total is None else g_total + s
+        p = _health_prefix(name)
+        g_pref[p] = s if p not in g_pref else g_pref[p] + s
+    p_total, d_total, p_pref = None, None, {}
+    for name, new in new_state.items():
+        old = mutable_state.get(name)
+        if old is None:
+            continue
+        try:
+            if not jnp.issubdtype(jnp.result_type(new), jnp.floating):
+                continue
+        except Exception:  # noqa: BLE001 - non-array binding
+            continue
+        nf = jnp.asarray(new).astype(f32)
+        of = jnp.asarray(old).astype(f32)
+        if nf.shape != of.shape:
+            continue
+        ps = jnp.sum(jnp.square(of))
+        ds = jnp.sum(jnp.square(nf - of))
+        p_total = ps if p_total is None else p_total + ps
+        d_total = ds if d_total is None else d_total + ds
+        p = _health_prefix(name)
+        p_pref[p] = ps if p not in p_pref else p_pref[p] + ps
+    if g_total is not None:
+        rows.append(("grad_norm_total", jnp.sqrt(g_total)))
+        for p, s in sorted(g_pref.items())[:_HEALTH_PREFIX_CAP]:
+            rows.append((f"grad_norm_{p}", jnp.sqrt(s)))
+    if p_total is not None:
+        rows.append(("param_norm_total", jnp.sqrt(p_total)))
+        rows.append(("update_ratio",
+                     jnp.sqrt(d_total)
+                     / jnp.maximum(jnp.sqrt(p_total), 1e-12)))
+        for p, s in sorted(p_pref.items())[:_HEALTH_PREFIX_CAP]:
+            rows.append((f"param_norm_{p}", jnp.sqrt(s)))
+    return rows
+
+
+def _numeric_stats(ctx, env, mutable_state, new_state):
+    """(keys, stacked stats) for one instrumented trace: the per-op
+    rows `registry._collect_numeric_stats` accumulated in
+    `ctx.numerics` plus the training-health rows, as ONE (N, 4)
+    float32 array so the dispatch hands a single device reference to
+    obs.numerics.note_dispatch_stats."""
+    from ..obs import numerics as _numerics
+
+    keys, vecs = [], []
+    for prov, var, vec in ctx.numerics:
+        keys.append((_numerics.KIND_OP, prov, var))
+        vecs.append(vec)
+    zero = jnp.zeros((), jnp.float32)
+    for name, v in _health_rows(env, mutable_state, new_state):
+        keys.append((_numerics.KIND_HEALTH, name, ""))
+        val = jnp.asarray(v).astype(jnp.float32)
+        vecs.append(jnp.stack([zero, zero, val, val]))
+    stats = jnp.stack(vecs) if vecs else jnp.zeros((0, 4), jnp.float32)
+    return keys, stats
 
 
 class Executor:
@@ -1131,7 +1246,16 @@ class Executor:
         const_in = sorted(n for n in state_in if n not in set(persistable_writes))
         mutable_out = sorted(set(persistable_writes))
 
+        # obs.numerics (docs/observability.md "Numerics"): the armed
+        # mode at compile time decides whether the trace collects
+        # per-op stat reductions.  The mode is part of
+        # enabled_signature(), so a flip re-enters this miss path —
+        # and `off` leaves the traced computation byte-identical.
+        from ..obs import numerics as _obs_numerics
+        numerics_mode = _obs_numerics.mode()
+
         check_names_box = []
+        numerics_keys_box = []
 
         def step_fn(mutable_state, const_state, feeds, seed):
             env: Dict[str, Any] = {}
@@ -1140,14 +1264,22 @@ class Executor:
             env.update(feeds)
             base_key = jax.random.PRNGKey(seed)
             ctx = registry.LowerCtx(base_key, block=block)
+            if numerics_mode != "off":
+                ctx.numerics = []
             registry.lower_block(ctx, block, env)
             fetches = [env[n] for n in fetch_names]
             new_state = {n: env[n] for n in mutable_out if n in env}
+            extra = []
             if check_nan:
                 names, flags = _nan_flags(fetch_names, fetches, new_state)
                 check_names_box[:] = names
-                return fetches, new_state, flags
-            return fetches, new_state
+                extra.append(flags)
+            if numerics_mode != "off":
+                keys, stats = _numeric_stats(ctx, env, mutable_state,
+                                             new_state)
+                numerics_keys_box[:] = keys
+                extra.append(stats)
+            return (fetches, new_state, *extra)
 
         entry = _CompiledEntry()
         entry.program = program
@@ -1161,6 +1293,19 @@ class Executor:
         entry.fetch_names = list(fetch_names)
         entry.check_nan = check_nan
         entry.check_names = check_names_box
+        entry.numerics_mode = numerics_mode
+        entry.numerics_keys = numerics_keys_box
+        # bisection replays the TRANSFORMED block so the report's
+        # provenance carries the [pass=...] tags of what actually ran
+        entry.lowered_block = block if numerics_mode == "bisect" else None
+        # AMP observability: the dynamic-loss-scale output var, so the
+        # dispatch can export the loss_scale gauge (obs.numerics)
+        entry.amp_scale_name = None
+        for op in block.ops:
+            if op.type == "update_loss_scaling":
+                outs = op.outputs.get("LossScaling") or []
+                if outs and outs[0] != EMPTY_VAR_NAME:
+                    entry.amp_scale_name = outs[0]
         entry.const_src = {}
         entry.const_dev = {}
         entry.feed_shardings = None
@@ -1234,7 +1379,26 @@ class Executor:
         t0 = time.perf_counter()
         mutable_state = self._seat_state(entry, scope)
         const_state = self._const_state(entry, scope)
+        step_no = self._step  # before _next_seed advances it
         seed = self._next_seed(entry.program)
+        bisect_rec = None
+        if entry.numerics_mode == "bisect" \
+                and entry.lowered_block is not None:
+            # first-NaN bisection input snapshot (obs.numerics): the
+            # mutable state is DONATED to the step below, so detach it
+            # with an async device-side copy now; feeds/consts are
+            # never donated and their references stay valid.  This is
+            # the declared cost of bisect mode — no copy in `on`/`off`.
+            bisect_rec = {
+                "block": entry.lowered_block,
+                "mutable": {n: jnp.copy(v)
+                            for n, v in mutable_state.items()},
+                "const": dict(const_state),
+                "feeds": dict(feed_arrays),
+                "seed": int(seed),
+                "step": step_no,
+                "label": entry.label,
+            }
         first_call = not entry.dispatched
         if first_call and entry.fn_compiled is None:
             from ..obs.cost import compile_with_cost
@@ -1276,12 +1440,29 @@ class Executor:
         if entry.cost is not None:
             entry.cost.observe_dispatch(t0)
         entry.dispatched = True
+        fetches, new_state = result[0], result[1]
+        extra = result[2:]
+        flags = stats = None
         if entry.check_nan:
-            fetches, new_state, flags = result
-            if entry.check_names:
-                self._nan_monitor.submit(flags, list(entry.check_names))
-        else:
-            fetches, new_state = result
+            flags, extra = extra[0], extra[1:]
+        if entry.numerics_mode != "off" and extra:
+            stats = extra[0]
+        if flags is not None and entry.check_names:
+            self._nan_monitor.submit(
+                flags, list(entry.check_names),
+                context={"step": step_no, "label": entry.label,
+                         "record": bisect_rec})
+        if stats is not None:
+            # hand the stacked stats array to the async drain as a
+            # DEVICE reference — a bounded host append, no transfer
+            obs.numerics.note_dispatch_stats(
+                entry.label, list(entry.numerics_keys), stats, step_no)
+        if entry.amp_scale_name is not None:
+            ref = new_state.get(entry.amp_scale_name)
+            if ref is not None:
+                # detach the scale scalar from the scope buffer the
+                # next step will donate (async device-side copy)
+                obs.numerics.note_loss_scale(jnp.copy(ref), step_no)
         for name, val in new_state.items():
             scope.set(name, val)
         if entry.mutable_out_names:

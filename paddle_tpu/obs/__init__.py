@@ -52,6 +52,7 @@ import threading as _threading
 from . import cost
 from . import devprof
 from . import memprof
+from . import numerics
 from . import opprof
 from . import telemetry
 from .tracing import NULL_SPAN, TRACER, Tracer  # noqa: F401
@@ -60,7 +61,9 @@ __all__ = ["span", "add_span", "new_flow", "attach_flow", "current_span",
            "enable", "disable", "enabled", "reset", "snapshot",
            "export_trace", "op_profile", "profile_window", "roofline",
            "mem_profile", "memory_ledger", "publish_mem_oom",
-           "cost", "devprof", "memprof", "opprof", "telemetry",
+           "bisect_nonfinite", "numerics_report",
+           "cost", "devprof", "memprof", "numerics", "opprof",
+           "telemetry",
            "start_telemetry", "stop_telemetry", "maybe_start_telemetry",
            "telemetry_epoch_refresh", "telemetry_handle", "TRACER",
            "NULL_SPAN", "Tracer"]
@@ -210,6 +213,28 @@ def publish_mem_oom(label: str = "", error: Any = "") -> Dict[str, Any]:
     return doc
 
 
+def bisect_nonfinite(program, feed=None, scope=None, fetch_list=None,
+                     transform: bool = True) -> Dict[str, Any]:
+    """First-NaN bisection (obs/numerics.py): transform `program`
+    exactly as the executor would, replay it op-by-op eagerly over
+    `scope` + `feed`, and name the FIRST op in program order whose
+    output goes non-finite — provenance with [pass=...] tags,
+    construction stack (`op_callstack`), and input stats.  Offline
+    forensics; under `PADDLE_OBS_NUMERICS=bisect` the executor runs
+    the same replay automatically when the async NaN monitor fires."""
+    return numerics.bisect_nonfinite(program, feed=feed, scope=scope,
+                                     fetch_list=fetch_list,
+                                     transform=transform)
+
+
+def numerics_report() -> Dict[str, Any]:
+    """The full numeric-health document (`numerics.json` in flight
+    bundles): per-op nan/inf/absmax/l2 aggregate keyed by provenance,
+    training-health gauges, the AMP loss scale, and the last hit +
+    bisection report.  Drains pending stats first."""
+    return numerics.numerics_doc()
+
+
 def _process_index() -> int:
     try:
         from ..distributed.parallel import _safe_process_index
@@ -278,6 +303,7 @@ def snapshot(all_hosts: bool = False) -> Dict[str, Any]:
         "op_profile": opprof.snapshot(),
         "devprof": devprof.snapshot(),
         "memory": memprof.snapshot(),
+        "numerics": numerics.snapshot(),
         **local,
     }
     if all_hosts:
@@ -376,7 +402,8 @@ def start_telemetry(port: Optional[int] = None,
             trace_cb=export_trace,
             snapshot_cb=snapshot,
             op_profile_cb=opprof.snapshot,
-            mem_cb=memprof.memory_doc)
+            mem_cb=memprof.memory_doc,
+            numerics_cb=numerics.numerics_doc)
         collector = telemetry.Collector(
             sources=telemetry.default_sources(),
             sample_s=sample_s, watchdog=watchdog)

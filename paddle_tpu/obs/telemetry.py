@@ -77,6 +77,7 @@ GAUGE_STATS = frozenset({
     "ring_occupancy", "ring_occupancy_max",
     "in_flight_steps", "in_flight_steps_max",
     "devprof_attributed_pct",
+    "loss_scale", "nan_inf_first_step",
 })
 # timer-table entries written with time_set (per-epoch gauges), not
 # time_add accumulators
@@ -205,6 +206,10 @@ DEFAULT_THRESHOLDS: Dict[str, float] = {
     "host_lost_stale_s": 300.0,   # pod-merged snapshot staleness limit
     "hbm_pressure_frac": 0.92,    # bytes_in_use / bytes_limit ceiling
     "hbm_headroom_temp_frac": 1.0,  # headroom vs biggest static temp
+    "grad_spike_x": 10.0,         # grad_norm_total > Nx rolling median
+    "grad_norm_min": 1e-3,        # ignore grad-norm noise below this
+    "loss_scale_collapse_frac": 0.0625,  # last <= frac * window peak
+    "loss_scale_min_peak": 4.0,   # scale peak before the rule arms
 }
 
 
@@ -366,6 +371,42 @@ def rule_hbm_pressure(v, cfg) -> Optional[str]:
     return None
 
 
+def rule_grad_norm_spike(v, cfg) -> Optional[str]:
+    """Exploding-gradient onset: the obs.numerics `grad_norm_total`
+    health gauge jumps far above its rolling median.  Silent until the
+    numerics health series exists (PADDLE_OBS_NUMERICS armed) and the
+    norm clears the noise floor."""
+    hit = _spike_vs_median(v.vals("grad_norm_total"),
+                           cfg["grad_spike_x"], int(cfg["min_points"]))
+    if hit is None:
+        return None
+    last, med = hit
+    if last < cfg["grad_norm_min"]:
+        return None
+    return (f"grad_norm_total {last:.3g} is {last / med:.1f}x the "
+            f"rolling median {med:.3g} (threshold "
+            f"{cfg['grad_spike_x']:.1f}x)")
+
+
+def rule_loss_scale_collapse(v, cfg) -> Optional[str]:
+    """AMP dynamic loss scale collapsed: repeated non-finite gradients
+    keep halving the scale (`decr_every_n_nan_or_inf`), so the last
+    sample sits at a small fraction of the window peak.  The
+    `loss_scale` gauge rides obs.numerics' drain of the
+    update_loss_scaling output; absent series -> silent."""
+    xs = v.vals("loss_scale")
+    if len(xs) < int(cfg["min_points"]):
+        return None
+    peak, last = max(xs), xs[-1]
+    if peak >= cfg["loss_scale_min_peak"] \
+            and last <= cfg["loss_scale_collapse_frac"] * peak:
+        return (f"loss_scale collapsed to {last:g} from a window peak "
+                f"of {peak:g} (repeated non-finite grads are shrinking "
+                f"the scale; threshold "
+                f"{cfg['loss_scale_collapse_frac']:g}x peak)")
+    return None
+
+
 RULES: List[Tuple[str, Callable]] = [
     ("step_time_spike", rule_step_time_spike),
     ("mfu_drop", rule_mfu_drop),
@@ -377,6 +418,8 @@ RULES: List[Tuple[str, Callable]] = [
     ("collective_bytes_jump", rule_collective_bytes_jump),
     ("host_lost", rule_host_lost),
     ("hbm_pressure", rule_hbm_pressure),
+    ("grad_norm_spike", rule_grad_norm_spike),
+    ("loss_scale_collapse", rule_loss_scale_collapse),
 ]
 
 
@@ -398,6 +441,7 @@ class Watchdog:
                  snapshot_cb: Optional[Callable[[], dict]] = None,
                  op_profile_cb: Optional[Callable[[], dict]] = None,
                  mem_cb: Optional[Callable[[], dict]] = None,
+                 numerics_cb: Optional[Callable[[], dict]] = None,
                  clock: Callable[[], float] = time.time):
         self.rules = list(RULES if rules is None else rules)
         self.cfg = dict(DEFAULT_THRESHOLDS)
@@ -409,6 +453,7 @@ class Watchdog:
         self.snapshot_cb = snapshot_cb
         self.op_profile_cb = op_profile_cb
         self.mem_cb = mem_cb
+        self.numerics_cb = numerics_cb
         self.clock = clock
         # back-reference for external trigger() firings (RESOURCE_
         # EXHAUSTED forensics); filled in by Collector.__init__
@@ -520,6 +565,7 @@ class Watchdog:
         _write_json("snapshot.json", self.snapshot_cb)
         _write_json("op_profile.json", self.op_profile_cb)
         _write_json("memory.json", self.mem_cb)
+        _write_json("numerics.json", self.numerics_cb)
         if self.trace_cb is not None:
             try:
                 self.trace_cb(os.path.join(tmp, "trace.json"))
@@ -646,6 +692,16 @@ def default_sources() -> Callable[[], Dict[str, Any]]:
 
             gauges.update(memprof.ledger_gauges())
         except Exception:  # noqa: BLE001 - memory gauges are optional
+            pass
+        try:
+            # training-health gauges (grad_norm_total, update_ratio,
+            # loss_scale, per-prefix norms): the numerics drain runs
+            # on demand right here — same no-extra-thread contract as
+            # the memory ledger above
+            from . import numerics
+
+            gauges.update(numerics.health_gauges())
+        except Exception:  # noqa: BLE001 - numerics gauges are optional
             pass
         # devprof's capture stats need no extra source: _publish writes
         # devprof_capture_ms / devprof_attributed_pct into the profiler

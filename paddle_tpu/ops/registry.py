@@ -102,6 +102,12 @@ class LowerCtx:
         # in-flight send_v2 payloads per ring, consumed FIFO by recv_v2
         # (functional p2p pairing, collective_ops.py)
         self.p2p_queue: Dict[int, list] = {}
+        # numeric-health collection (obs.numerics): when the executor
+        # arms PADDLE_OBS_NUMERICS this is a list lower_op appends
+        # (provenance, var_name, stats_vec) rows to; None = off, and
+        # the traced computation is byte-identical to the uninstrumented
+        # one (the compile-cache signature pins that contract)
+        self.numerics: Optional[list] = None
 
     def rng_key(self, op: Operator):
         """Deterministic per-op key: seed attr wins (OpTest reproducibility),
@@ -299,8 +305,51 @@ def _bind_outs(op: Operator, outs: InsOuts, env) -> None:
 def lower_op(ctx: LowerCtx, op: Operator, env: Dict[str, Any]) -> None:
     # provenance scope: every jax op this rule emits carries the source
     # Program op in its HLO metadata (obs.op_profile's attribution seam)
-    with jax.named_scope(op_provenance(op)):
+    prov = op_provenance(op)
+    with jax.named_scope(prov):
         _lower_op_inner(ctx, op, env)
+        # numeric-health stats (obs.numerics): emitted INSIDE the
+        # provenance scope so the stat reductions attribute to the op
+        # they measure.  The block-identity guard keeps sub-block
+        # tracers (control flow lowered under scan/cond) from leaking
+        # into the top-level stats list.
+        if ctx.numerics is not None and not ctx.abstract \
+                and (ctx.block is None or op.block is ctx.block):
+            _collect_numeric_stats(ctx, op, prov, env)
+
+
+def _collect_numeric_stats(ctx: LowerCtx, op: Operator, prov: str,
+                           env: Dict[str, Any]) -> None:
+    """Append one fused [nan_count, inf_count, absmax, l2] reduction
+    per float output of `op`.  Device-side only — the stacked result is
+    fetched asynchronously at dispatch end (obs.numerics.drain), so the
+    instrumented step stays zero-sync."""
+    seen = set()
+    for names in op.outputs.values():
+        for name in names:
+            if name == EMPTY_VAR_NAME or name in seen:
+                continue
+            seen.add(name)
+            v = env.get(name)
+            # structured bindings (TensorArrayVal, LoD tuples, ...) are
+            # not one array — only instrument dtype/shape-carrying values
+            if v is None or not (hasattr(v, "dtype")
+                                 and hasattr(v, "shape")):
+                continue
+            if not jnp.issubdtype(v.dtype, jnp.floating):
+                continue
+            if any(int(d) == 0 for d in v.shape):
+                continue
+            x = jnp.asarray(v)
+            finite = jnp.isfinite(x)
+            xf = jnp.where(finite, x, 0).astype(jnp.float32)
+            vec = jnp.stack([
+                jnp.sum(jnp.isnan(x)).astype(jnp.float32),
+                jnp.sum(jnp.isinf(x)).astype(jnp.float32),
+                jnp.max(jnp.abs(xf)),
+                jnp.sqrt(jnp.sum(xf * xf)),
+            ])
+            ctx.numerics.append((prov, name, vec))
 
 
 def _lower_op_inner(ctx: LowerCtx, op: Operator,

@@ -200,6 +200,13 @@ class CompiledProgram:
         entry.dispatched = False
         entry.fn_compiled = None
         entry.cost = None
+        # obs.numerics: SPMD/shard_map step_fns are not stats-
+        # instrumented (the Executor path is the instrumented one) —
+        # inert defaults so the shared _dispatch unpack stays uniform
+        entry.numerics_mode = "off"
+        entry.numerics_keys = []
+        entry.lowered_block = None
+        entry.amp_scale_name = None
         from ..fluid.executor import _program_label
 
         entry.label = _program_label(program, fetch_names)

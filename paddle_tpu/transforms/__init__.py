@@ -228,8 +228,21 @@ def enabled_signature() -> tuple:
     """The enabled-pass set as a hashable compile-cache key component:
     flipping FLAGS_graph_transforms changes what gets lowered, so it is
     part of the compiled program's identity (Executor._cache_key), the
-    same way FLAGS_check_nan_inf is."""
-    return tuple(n for n, on in _resolve_spec(_current_spec()) if on)
+    same way FLAGS_check_nan_inf is.  The obs.numerics instrumentation
+    mode joins the same signature when armed: stat collection changes
+    the traced computation, so flipping PADDLE_OBS_NUMERICS must be a
+    compile-cache miss too — and `off` contributes nothing, keeping
+    the uninstrumented signature byte-identical to pre-numerics."""
+    sig = tuple(n for n, on in _resolve_spec(_current_spec()) if on)
+    try:
+        from ..obs import numerics
+
+        m = numerics.mode()
+    except Exception:  # noqa: BLE001 - obs unavailable (minimal env)
+        m = "off"
+    if m != "off":
+        sig = sig + (f"numerics={m}",)
+    return sig
 
 
 class TransformDebugError(RuntimeError):

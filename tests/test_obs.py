@@ -442,7 +442,8 @@ class TestTracetoolRoundTrip:
     def test_snapshot_shape(self, clean_tracer):
         snap = obs.snapshot()
         assert set(snap) == {"spans", "counters", "timers_ms", "cost",
-                             "host", "op_profile", "devprof", "memory"}
+                             "host", "op_profile", "devprof", "memory",
+                             "numerics"}
         assert {"device_class", "peak_flops", "mfu_pct",
                 "programs", "collective_bytes"} <= set(snap["cost"])
         assert snap["host"] == 0  # tagged with jax.process_index()
@@ -481,7 +482,8 @@ class TestSpanLeakRule:
                     "paddle_tpu/analysis/verifier.py",
                     "paddle_tpu/obs/telemetry.py",
                     "paddle_tpu/obs/devprof.py",
-                    "paddle_tpu/obs/memprof.py", "bench.py"):
+                    "paddle_tpu/obs/memprof.py",
+                    "paddle_tpu/obs/numerics.py", "bench.py"):
             p = tmp_path / rel
             p.parent.mkdir(parents=True, exist_ok=True)
             p.write_text("")
@@ -508,7 +510,8 @@ class TestSpanLeakRule:
                     "paddle_tpu/analysis/verifier.py",
                     "paddle_tpu/obs/telemetry.py",
                     "paddle_tpu/obs/devprof.py",
-                    "paddle_tpu/obs/memprof.py", "bench.py"):
+                    "paddle_tpu/obs/memprof.py",
+                    "paddle_tpu/obs/numerics.py", "bench.py"):
             p = tmp_path / rel
             p.parent.mkdir(parents=True, exist_ok=True)
             p.write_text("")

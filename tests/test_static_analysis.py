@@ -428,6 +428,22 @@ def test_memprof_on_hot_path_watchlist():
     assert "paddle_tpu/obs/memprof.py" in lint.span_leak.WATCHED
 
 
+def test_numerics_on_hot_path_watchlist():
+    """ISSUE 15: the numeric-health entry points are lint-watched —
+    note_dispatch_stats/note_loss_scale run ON the dispatch hot path
+    (bounded host appends of device references), drain/health_gauges
+    on the telemetry sampler thread (the sanctioned LazyFetch-style
+    materialization boundary), and bisect_nonfinite is offline
+    forensics; obs/numerics.py is also in the span-leak watched set,
+    and test_shipped_tree_is_lint_clean above proves the shipped tree
+    honors both."""
+    watched = set(lint.hot_path_sync.WATCHLIST)
+    for qual in ("note_dispatch_stats", "note_loss_scale", "drain",
+                 "health_gauges", "bisect_nonfinite"):
+        assert ("paddle_tpu/obs/numerics.py", qual) in watched
+    assert "paddle_tpu/obs/numerics.py" in lint.span_leak.WATCHED
+
+
 def test_hot_path_rule_fires_on_unsanctioned_sync(tmp_path):
     bad = tmp_path / "paddle_tpu" / "fluid"
     bad.mkdir(parents=True)

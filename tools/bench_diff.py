@@ -33,6 +33,11 @@ Metrics compared (each only when present in BOTH files):
                    — the device-memory high-water mark grew; on CPU
                    the field is the framework-side ledger peak and the
                    usual warn-only fallback regime applies)
+  numerics_overhead_pct  detail.numerics.overhead_pct  (rise > 50% rel
+                         AND > 5 points abs — the per-op numeric-stats
+                         collection must stay a fused-reduction tax,
+                         not a sync; under cpu-fallback the usual
+                         warn-only regime applies)
 
 Exit status: 1 when any regression fires AND the current run is
 on-chip; under `device_class: cpu-fallback` (or a stale re-emitted
@@ -74,6 +79,11 @@ DEFAULT_THRESHOLDS = {
     # HBM high-water mark (ISSUE 14): a >5% rise in peak device bytes
     # means some subsystem started holding more than it used to
     "hbm_peak_bytes": ("down", 0.05, 0.0),
+    # numeric-stats collection tax (ISSUE 15): stats-on vs stats-off
+    # step time must stay a cheap fused reduction — a blowup means a
+    # host sync crept into the instrumented lowering.  The 5-point
+    # absolute floor keeps the gate from flapping on toy-model noise.
+    "numerics_overhead_pct": ("down", 0.5, 5.0),
 }
 
 
@@ -135,6 +145,9 @@ def extract_metrics(doc: dict) -> Dict[str, float]:
         if isinstance(hp, (int, float)) and hp > 0:
             out["hbm_peak_bytes"] = float(hp)
             break
+    num = _get(detail, "numerics", "overhead_pct")
+    if isinstance(num, (int, float)):
+        out["numerics_overhead_pct"] = float(num)
     return out
 
 
@@ -226,7 +239,8 @@ def _synthetic(mfu: float, step_ms: float, transposes: int = 0,
                telemetry_ms: float = 0.5,
                devprof_pct: float = 95.0,
                opt_bytes: int = 65536,
-               hbm_peak: int = 1 << 30) -> dict:
+               hbm_peak: int = 1 << 30,
+               numerics_pct: float = 8.0) -> dict:
     return {
         "metric": "bert_base_pretrain_mfu",
         "value": mfu, "unit": "%", "vs_baseline": mfu / 45.0,
@@ -244,6 +258,9 @@ def _synthetic(mfu: float, step_ms: float, transposes: int = 0,
             "memory": {"hbm_peak_bytes": hbm_peak,
                        "ledger_total_bytes": hbm_peak // 2,
                        "static_temp_bytes": hbm_peak // 8},
+            "numerics": {"mode": "on", "overhead_pct": numerics_pct,
+                         "ops_tracked": 25, "nonfinite_ops_total": 0,
+                         "grad_norm_total": 0.5},
             "obs": {"cost": {"collective_bytes":
                              {"c_allreduce_sum": coll_bytes}}},
             "resnet50": {"metric": "resnet50_images_per_sec_per_chip",
@@ -347,7 +364,20 @@ def selftest(verbose: bool = True) -> int:
     checks.append(("3% hbm peak wiggle passes",
                    not any(r["metric"] == "hbm_peak_bytes"
                            and r["regressed"] for r in rows)))
-    # 12. stale re-emitted on-chip record is warn-only
+    # 12. a numeric-stats overhead blowup fires (a host sync crept
+    # into the instrumented lowering); a sub-floor wiggle passes
+    cur_num = _synthetic(mfu=42.0, step_ms=100.0, numerics_pct=30.0)
+    rows = diff(base, cur_num)
+    checks.append(("numerics overhead blowup fires",
+                   any(r["metric"] == "numerics_overhead_pct"
+                       and r["regressed"] for r in rows)))
+    cur_num_ok = _synthetic(mfu=42.0, step_ms=100.0,
+                            numerics_pct=11.0)
+    rows = diff(base, cur_num_ok)
+    checks.append(("sub-floor numerics wiggle passes",
+                   not any(r["metric"] == "numerics_overhead_pct"
+                           and r["regressed"] for r in rows)))
+    # 13. stale re-emitted on-chip record is warn-only
     stale = dict(base)
     stale["detail"] = dict(base["detail"], stale_s=1234)
     checks.append(("stale on-chip record is warn-only",

@@ -28,7 +28,7 @@ python tools/run_lints.py --shape-check
 echo "== static analysis: shapecheck selftest (jax-free dump checker) =="
 python tools/shapecheck.py --selftest
 
-echo "== observability: tracetool selftest (spans + op-profile walk + telemetry metrics replay + memory ledger/attribution) =="
+echo "== observability: tracetool selftest (spans + op-profile walk + telemetry metrics replay + memory ledger/attribution + numerics fold/bisection) =="
 python tools/tracetool.py selftest
 
 echo "== perf gate: bench_diff selftest (regression detection) =="

@@ -70,6 +70,7 @@ _OPPROF = os.path.join(REPO_ROOT, "paddle_tpu", "obs", "opprof.py")
 _TELEMETRY = os.path.join(REPO_ROOT, "paddle_tpu", "obs", "telemetry.py")
 _DEVPROF = os.path.join(REPO_ROOT, "paddle_tpu", "obs", "devprof.py")
 _MEMPROF = os.path.join(REPO_ROOT, "paddle_tpu", "obs", "memprof.py")
+_NUMERICS = os.path.join(REPO_ROOT, "paddle_tpu", "obs", "numerics.py")
 
 
 def _load_by_path(name: str, path: str):
@@ -103,6 +104,10 @@ def load_devprof():
 
 def load_memprof():
     return _load_by_path("paddle_tpu_obs_memprof", _MEMPROF)
+
+
+def load_numerics():
+    return _load_by_path("paddle_tpu_obs_numerics", _NUMERICS)
 
 
 def load_trace(path: str) -> dict:
@@ -543,6 +548,114 @@ def mem_cmd(path: str, top: int, temp_bytes: Optional[int],
 
 
 # ---------------------------------------------------------------------------
+# numerics (numeric-health post-mortem)
+# ---------------------------------------------------------------------------
+
+def load_numerics_doc(path: str) -> Optional[dict]:
+    """The numeric-health document from any artifact that carries one:
+    a flight bundle DIRECTORY or its numerics.json
+    (obs/numerics.numerics_doc), a BENCH JSON (detail.numerics), a
+    trace JSON (otherData.snapshot.numerics) or a bare
+    obs.snapshot().  Returns None when nothing is found."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "numerics.json")
+    with open(path) as f:
+        doc = json.load(f)
+    found: List[dict] = []
+
+    def walk(node):
+        if not isinstance(node, dict):
+            return
+        if node.get("mode") in ("off", "on", "bisect") \
+                and ("ops" in node or "ops_tracked" in node
+                     or "overhead_pct" in node):
+            found.append(node)
+            return
+        for v in node.values():
+            if isinstance(v, dict):
+                walk(v)
+
+    walk(doc)
+    return found[0] if found else None
+
+
+def print_numerics(doc: dict, top: int) -> None:
+    print(f"mode: {doc.get('mode')}  "
+          f"first_nonfinite_step: {doc.get('first_nonfinite_step')}  "
+          f"loss_scale: {doc.get('loss_scale')}")
+    if "overhead_pct" in doc:  # BENCH detail.numerics summary
+        print(f"stats-mode overhead: {doc.get('overhead_pct')}% "
+              f"(step_ms {doc.get('step_ms_off')} -> "
+              f"{doc.get('step_ms_on')})")
+    health = doc.get("health") or {}
+    if health:
+        print("health gauges:")
+        for name in sorted(health):
+            print(f"  {name:<40}{health[name]:>14.6g}")
+    rows = doc.get("ops") or doc.get("nonfinite_ops") or []
+    bad = [r for r in rows
+           if r.get("nan_count", 0) + r.get("inf_count", 0) > 0]
+    if bad:
+        print(f"non-finite ops ({len(bad)}):")
+        print(f"{'provenance':<52}{'var':<24}{'nan':>8}{'inf':>8}"
+              f"{'absmax':>12}")
+        for r in bad[:top]:
+            print(f"{r.get('provenance', '?'):<52}"
+                  f"{r.get('var', ''):<24}"
+                  f"{r.get('nan_count', 0):>8}"
+                  f"{r.get('inf_count', 0):>8}"
+                  f"{r.get('absmax', 0.0):>12.4g}")
+    elif rows:
+        print(f"all {len(rows)} instrumented op outputs finite")
+    b = doc.get("bisection")
+    if b:
+        if b.get("found"):
+            op = b["op"]
+            print(f"bisection: FIRST non-finite op is "
+                  f"{op.get('provenance')} (type={op.get('type')}, "
+                  f"var={op.get('var')}, nan={op.get('nan_count')}, "
+                  f"inf={op.get('inf_count')}) at step {b.get('step')}"
+                  f" after {b.get('ops_replayed')} op(s)")
+            passes = op.get("passes") or []
+            if passes:
+                print(f"  rewritten by pass(es): {','.join(passes)}")
+            stack = op.get("op_callstack")
+            if stack:
+                tail = stack[-3:] if isinstance(stack, list) else [stack]
+                for fr in tail:
+                    print(f"  {str(fr).strip()}")
+            for i in op.get("inputs", []):
+                print(f"  input {i.get('slot')}/{i.get('var')}: "
+                      f"nan={i.get('nan_count')} "
+                      f"absmax={i.get('absmax')}")
+        elif b.get("replay_error"):
+            print(f"bisection: replay failed at "
+                  f"{(b.get('failed_op') or {}).get('provenance')}: "
+                  f"{b['replay_error']}")
+        else:
+            print(f"bisection: no non-finite output in "
+                  f"{b.get('ops_replayed')} replayed op(s)")
+    hit = doc.get("last_hit")
+    if hit:
+        print(f"last hit: step {hit.get('step')} vars {hit.get('hits')}")
+
+
+def numerics_cmd(path: str, top: int, as_json: bool) -> int:
+    doc = load_numerics_doc(path)
+    if doc is None:
+        print(f"tracetool numerics: no numeric-health document found "
+              f"in {path} (need a flight bundle / numerics.json, a "
+              f"BENCH JSON with detail.numerics, or a trace/snapshot "
+              f"JSON)", file=sys.stderr)
+        return 1
+    if as_json:
+        print(json.dumps(doc))
+        return 0
+    print_numerics(doc, top)
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # metrics (live-telemetry dump post-mortem)
 # ---------------------------------------------------------------------------
 
@@ -874,6 +987,60 @@ def _memprof_selftest_checks() -> List[tuple]:
     return checks
 
 
+def _numerics_selftest_checks() -> List[tuple]:
+    """Numeric-health layer (ISSUE 15): mode parsing, the synthetic
+    stats-array attribution fold, the bisection-order invariant and
+    the disabled-mode contract — all through the pure stdlib helpers,
+    no jax/numpy import."""
+    numerics = load_numerics()
+    keys = [
+        (numerics.KIND_OP,
+         "program#1/block0/op0:conv2d[pass=layout_nhwc]", "conv_out"),
+        (numerics.KIND_OP, "program#1/block0/op1:log", "log_out"),
+        (numerics.KIND_OP, "program#1/block0/op2:softmax", "sm_out"),
+        (numerics.KIND_HEALTH, "grad_norm_total", ""),
+    ]
+    rows = [
+        [0, 0, 3.5, 9.0],     # clean conv output
+        [4, 0, 88.0, 12.0],   # the FIRST non-finite op (4 nans)
+        [2, 1, 5.0, 2.0],     # a later casualty — must NOT win
+        [0, 0, 7.25, 7.25],   # health row (value in absmax/l2 cols)
+    ]
+    ops, health = numerics.fold_stats(keys, rows)
+    first = numerics.first_nonfinite(keys, rows)
+    clean = numerics.first_nonfinite(keys[:1], rows[:1])
+    health_only = numerics.first_nonfinite([keys[3]], [[9, 9, 1, 1]])
+    prov = numerics.parse_provenance(keys[0][1])
+    return [
+        ("numerics: mode parsing normalizes",
+         numerics.parse_mode("ON") == "on"
+         and numerics.parse_mode("Bisect") == "bisect"
+         and numerics.parse_mode("1") == "on"
+         and numerics.parse_mode(None) == "off"
+         and numerics.parse_mode("garbage") == "off"),
+        ("numerics: synthetic stats fold attributes per op",
+         len(ops) == 3 and ops[1]["provenance"] == keys[1][1]
+         and ops[1]["nan_count"] == 4 and ops[2]["inf_count"] == 1
+         and ops[0]["absmax"] == 3.5 and ops[0]["l2"] == 9.0),
+        ("numerics: health rows fold to gauges, not op rows",
+         health == {"grad_norm_total": 7.25}),
+        ("numerics: bisection-order invariant — FIRST flagged op wins",
+         first is not None and first["provenance"] == keys[1][1]
+         and first["index"] == 1 and first["nan_count"] == 4),
+        ("numerics: health rows never win the bisection",
+         health_only is None),
+        ("numerics: clean dispatch bisects to None",
+         clean is None),
+        ("numerics: provenance parse carries pass tags",
+         prov is not None and prov["type"] == "conv2d"
+         and prov["passes"] == ["layout_nhwc"] and prov["op"] == 0),
+        ("numerics: disabled mode folds to nothing",
+         numerics.parse_mode("off") == "off"
+         and numerics.fold_stats([], []) == ([], {})
+         and numerics.first_nonfinite([], []) is None),
+    ]
+
+
 def _telemetry_selftest_checks() -> List[tuple]:
     """The live-telemetry half of the selftest: drive the collector,
     watchdog and flight recorder (loaded by file path — no jax) over
@@ -1047,6 +1214,7 @@ def selftest(verbose: bool = True) -> int:
         checks += _devprof_selftest_checks()
         checks += _memprof_selftest_checks()
         checks += _telemetry_selftest_checks()
+        checks += _numerics_selftest_checks()
         failed = [name for name, ok in checks if not ok]
         if verbose:
             for name, ok in checks:
@@ -1109,12 +1277,21 @@ def main(argv: Optional[List[str]] = None) -> int:
                        help="compiler temp total to normalize a raw "
                             "HLO dump against")
     p_mem.add_argument("--json", action="store_true")
+    p_num = sub.add_parser(
+        "numerics", help="numeric-health post-mortem: top non-finite "
+        "ops, health gauges and the first-NaN bisection report from a "
+        "flight bundle / numerics.json, a BENCH JSON with "
+        "detail.numerics, or a trace/snapshot JSON")
+    p_num.add_argument("artifact")
+    p_num.add_argument("--top", type=int, default=10)
+    p_num.add_argument("--json", action="store_true")
     sub.add_parser("selftest", help="exercise the span layer, the "
                                     "op-profile HLO walk, the devprof "
                                     "xplane parse/join/roofline, the "
-                                    "telemetry collector/watchdog and "
-                                    "the memprof attribution/ledger "
-                                    "end to end")
+                                    "telemetry collector/watchdog, the "
+                                    "memprof attribution/ledger and "
+                                    "the numerics attribution/"
+                                    "bisection helpers end to end")
     args = ap.parse_args(argv)
 
     if args.cmd == "summarize":
@@ -1142,6 +1319,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.cmd == "mem":
         return mem_cmd(args.artifact, args.top, args.temp_bytes,
                        args.json)
+    if args.cmd == "numerics":
+        return numerics_cmd(args.artifact, args.top, args.json)
     if args.cmd == "selftest":
         return selftest()
     ap.print_help()
