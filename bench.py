@@ -725,6 +725,8 @@ def bench_sharding(jax, jnp):
             stats = profiler.get_int_stats()
             spmd_coll = sum(v for k, v in stats.items()
                             if k.startswith("collective_bytes_spmd_"))
+            from paddle_tpu.parallel import quant_collectives as qc
+
             return {
                 "mesh_axes": axes,
                 "devices": n_dev,
@@ -732,12 +734,86 @@ def bench_sharding(jax, jnp):
                 "optimizer_bytes_per_device": int(opt_bytes),
                 "specs_applied": stats.get("spmd_specs_applied", 0),
                 "spmd_collective_bytes": int(spmd_coll),
+                # flag stamp: tools/bench_diff.py treats a stamp flip as
+                # a deliberate collective_bytes baseline reset
+                "quant_collectives": qc.mode(),
                 "loss": float(np.asarray(out[0]).reshape(-1)[0]),
             }
     finally:
         # the bench process keeps running other sections — don't leak
         # the mesh context into them
         mesh_lib.set_current_mesh(None)
+
+
+def bench_collective(jax, jnp):
+    """`--mode collective` (docs/spmd.md): ring all-reduce bytes/ms at
+    a ladder of tensor sizes, full-width fp32 vs the int8 blockwise
+    path, on a 1-axis mesh over every local device.  Emits
+    `detail.collective` rows (bytes_on_wire, quant_overhead_ms,
+    effective_GBps) for tools/bench_diff.py to gate later.  Wire bytes
+    use the same wire-true convention as the opprof counters: a ring
+    all-reduce moves ~2x its payload; the quantized path moves its
+    all_to_all + all_gather payloads (int8 codes + fp32 scales)."""
+    import time as _time
+
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from paddle_tpu.parallel import quant_collectives as qc
+    from paddle_tpu.parallel.compiler import _shard_map_compat
+
+    devs = jax.devices()
+    n = len(devs)
+    mesh = Mesh(np.array(devs), ("data",))
+
+    def _timed(fn, x, iters=5):
+        out = fn(x)
+        jax.block_until_ready(out)  # compile outside the clock
+        t0 = _time.perf_counter()
+        for _ in range(iters):
+            out = fn(x)
+        jax.block_until_ready(out)
+        return (_time.perf_counter() - t0) * 1e3 / iters
+
+    rows = []
+    for elems in (1 << 14, 1 << 16, 1 << 18, 1 << 20):
+        rng = np.random.RandomState(7)
+        x = rng.randn(n, elems // n).astype("float32")
+
+        full = jax.jit(_shard_map_compat(
+            lambda s: jax.lax.psum(s, "data"), mesh=mesh,
+            in_specs=(P("data"),), out_specs=P("data")))
+        int8 = jax.jit(_shard_map_compat(
+            lambda s: qc.quant_allreduce_sum(s, "data"), mesh=mesh,
+            in_specs=(P("data"),), out_specs=P("data")))
+        full_ms = _timed(full, x)
+        int8_ms = _timed(int8, x)
+        payload = (elems // n) * 4  # per-device logical payload bytes
+        wire_full = 2 * payload
+        wire_int8 = 2 * qc.wire_bytes(x[0], axis_size=n)
+        rows.append({
+            "elems_per_device": elems // n,
+            "size_bytes": payload,
+            "bytes_on_wire_full": int(wire_full),
+            "bytes_on_wire_int8": int(wire_int8),
+            "full_ms": round(full_ms, 4),
+            "int8_ms": round(int8_ms, 4),
+            "quant_overhead_ms": round(int8_ms - full_ms, 4),
+            "effective_GBps_full": round(
+                wire_full / max(full_ms, 1e-6) / 1e6, 3),
+            "effective_GBps_int8": round(
+                wire_int8 / max(int8_ms, 1e-6) / 1e6, 3),
+        })
+    top = rows[-1]
+    return {
+        "devices": n,
+        "mode": qc.mode(),
+        "block": qc.BLOCK,
+        "sizes": rows,
+        "headline_GBps": top["effective_GBps_full"],
+        "wire_reduction_x": round(top["bytes_on_wire_full"]
+                                  / max(1, top["bytes_on_wire_int8"]), 2),
+    }
 
 
 def _run_with_watchdog(fn, timeout_s, what):
@@ -1206,10 +1282,12 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", choices=["bert", "resnet50", "both"],
                     default="both")
-    ap.add_argument("--mode", choices=["train", "serving"],
+    ap.add_argument("--mode", choices=["train", "serving", "collective"],
                     default="train",
                     help="train: MFU bench (default); serving: "
-                    "continuous-batching latency/occupancy bench")
+                    "continuous-batching latency/occupancy bench; "
+                    "collective: ring all-reduce microbench, full-width "
+                    "vs int8 blockwise (docs/spmd.md)")
     args = ap.parse_args()
 
     # decide the backend BEFORE jax loads: a wedged tunnel would block
@@ -1226,6 +1304,20 @@ def main():
 
     if args.mode == "serving":
         print(json.dumps(bench_serving(jax, jnp, on_tpu)))
+        return
+
+    if args.mode == "collective":
+        det = _run_with_watchdog(
+            lambda: bench_collective(jax, jnp), timeout_s=300,
+            what="collective microbench") or {}
+        print(json.dumps({
+            "metric": "collective_allreduce_effective_GBps",
+            "value": det.get("headline_GBps", 0.0),
+            "unit": "GB/s",
+            "detail": {
+                "device_class": "tpu" if on_tpu else "cpu-fallback",
+                "collective": det,
+            }}))
         return
 
     from paddle_tpu.models import bert

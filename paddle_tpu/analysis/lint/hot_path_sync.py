@@ -59,6 +59,13 @@ WATCHLIST: List[Tuple[str, str]] = [
     ("paddle_tpu/dataset/feed_pipeline.py", "DeviceRing.put"),
     ("paddle_tpu/dataset/feed_pipeline.py", "DeviceRing.get"),
     ("paddle_tpu/parallel/compiler.py", "CompiledProgram._run"),
+    # quantized collectives (ISSUE 16): the codec entry points trace
+    # INSIDE the jitted step — a host sync or numpy materialization
+    # here would stall every quantized gradient reduction
+    ("paddle_tpu/parallel/quant_collectives.py", "pack"),
+    ("paddle_tpu/parallel/quant_collectives.py", "quantize_blockwise"),
+    ("paddle_tpu/parallel/quant_collectives.py", "dequantize_blockwise"),
+    ("paddle_tpu/parallel/quant_collectives.py", "quant_allreduce_sum"),
     # graph-transform pipeline (ISSUE 5): runs ONLY on the compile-
     # cache-miss path and manipulates Program metadata — it must never
     # touch device arrays, so the zero-sync contract applies verbatim

@@ -51,6 +51,9 @@ WATCHED = [
     "paddle_tpu/profiler",
     "paddle_tpu/fluid/executor.py",
     "paddle_tpu/parallel/compiler.py",
+    "paddle_tpu/parallel/quant_collectives.py",  # explicit: the int8
+    # codec traces inside the jitted step (ISSUE 16) — span misuse
+    # there would wrap device-side code in host timers
     "paddle_tpu/dataset/feed_pipeline.py",
     "paddle_tpu/serving",
     "paddle_tpu/transforms/__init__.py",

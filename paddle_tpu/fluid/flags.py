@@ -157,6 +157,19 @@ _define("op_callstack", False,
         "record the Python construction stack on every appended op "
         "(attrs['op_callstack']); verifier findings then point at the "
         "user line that built the offending op")
+_define("quant_collectives", "off",
+        "quantized collectives over ICI (docs/spmd.md): off | int8. "
+        "int8 routes c_allreduce_sum / c_reducescatter / c_allgather "
+        "and the SPMD gradient reductions through a blockwise "
+        "quantize->reduce->dequantize path (~4x less wire traffic); "
+        "joins the compile-cache signature so flips never reuse a "
+        "stale executable",
+        env_var="PADDLE_QUANT_COLLECTIVES")
+_define("quant_collectives_min_bytes", 1024,
+        "per-tensor floor for FLAGS_quant_collectives: payloads "
+        "smaller than this many bytes stay full-width (quantizing "
+        "tiny tensors costs more in scales+padding than it saves)",
+        env_var="PADDLE_QUANT_COLLECTIVES_MIN_BYTES")
 
 
 def get_flags(flags):

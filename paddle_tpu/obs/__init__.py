@@ -394,6 +394,14 @@ def start_telemetry(port: Optional[int] = None,
             flight_min_interval_s = _obs_flag(
                 "obs_flight_min_interval_s",
                 "PADDLE_OBS_FLIGHT_MIN_INTERVAL_S", 60.0, float)
+        def _bundle_meta() -> dict:
+            # run-config stamp for the bundle manifest: a diff between
+            # two bundles can tell a deliberate quant_collectives flip
+            # (expected ~4x collective_bytes shift) from real drift
+            from ..parallel import quant_collectives as _qc
+
+            return {"quant_collectives": _qc.mode()}
+
         watchdog = telemetry.Watchdog(
             thresholds=thresholds,
             artifacts_dir=flight_dir or None,
@@ -403,7 +411,8 @@ def start_telemetry(port: Optional[int] = None,
             snapshot_cb=snapshot,
             op_profile_cb=opprof.snapshot,
             mem_cb=memprof.memory_doc,
-            numerics_cb=numerics.numerics_doc)
+            numerics_cb=numerics.numerics_doc,
+            meta_cb=_bundle_meta)
         collector = telemetry.Collector(
             sources=telemetry.default_sources(),
             sample_s=sample_s, watchdog=watchdog)

@@ -285,6 +285,29 @@ def _instr_bytes(ins: _Instr, shapes: Dict[str, _Shape]) -> float:
     return total
 
 
+def _collective_wire_bytes(ins: _Instr, shapes: Dict[str, _Shape]) -> float:
+    """Wire-true ICI traffic for one collective instruction.
+
+    The per-device output shape understates some collectives: a ring
+    all-reduce moves ~2x its payload (reduce-scatter phase + all-gather
+    phase), and reduce-scatter's OUTPUT is 1/n of the payload that
+    crossed the wire.  Counting these truthfully is what makes the
+    quantized-collective drop (docs/spmd.md, FLAGS_quant_collectives)
+    provable from `collective_bytes_spmd_*`: the int8 lowering
+    decomposes into all-to-all + all-gather whose shapes ARE their wire
+    payloads."""
+    if ins.opcode == "all-reduce":
+        return 2.0 * float(ins.shape.nbytes)
+    if ins.opcode == "reduce-scatter":
+        op0 = shapes.get(ins.operands[0]) if ins.operands else None
+        if op0 is not None:
+            return float(op0.nbytes)
+    # -start variants carry (operand, result) tuple shapes that already
+    # sum both phases; all-gather / all-to-all / collective-permute
+    # outputs equal their wire payloads
+    return float(ins.shape.nbytes)
+
+
 def _new_row(key: str) -> dict:
     return {"op": key, "flops_raw": 0.0, "bytes_raw": 0.0,
             "instructions": 0, "fusions": 0, "transposes": 0,
@@ -399,9 +422,10 @@ def profile_hlo_text(text: str, label: str = "",
             row["transposes"] += 1
             row["transpose_bytes"] += ins.shape.nbytes
         if ins.opcode in _COLLECTIVES:
-            row["collective_bytes"] += ins.shape.nbytes
+            wire = _collective_wire_bytes(ins, shapes)
+            row["collective_bytes"] += wire
             coll_by_op[ins.opcode] = (coll_by_op.get(ins.opcode, 0)
-                                      + ins.shape.nbytes)
+                                      + wire)
 
     for key, comps in fusion_sets.items():
         rows[key]["fusions"] = max(rows[key]["fusions"], len(comps))

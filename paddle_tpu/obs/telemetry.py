@@ -312,6 +312,14 @@ def rule_feed_starvation(v, cfg) -> Optional[str]:
 
 
 def rule_collective_bytes_jump(v, cfg) -> Optional[str]:
+    # quantization-aware (docs/spmd.md): a deliberate
+    # FLAGS_quant_collectives flip moves every collective_bytes_*
+    # counter by design (~4x) — when the quant_collectives_mode gauge
+    # changed inside this window, the flip IS the baseline reset, not
+    # an anomaly
+    mode_xs = v.vals("quant_collectives_mode")
+    if len(set(mode_xs)) > 1:
+        return None
     for name in v.names():
         if not name.startswith("collective_bytes_"):
             continue
@@ -442,6 +450,7 @@ class Watchdog:
                  op_profile_cb: Optional[Callable[[], dict]] = None,
                  mem_cb: Optional[Callable[[], dict]] = None,
                  numerics_cb: Optional[Callable[[], dict]] = None,
+                 meta_cb: Optional[Callable[[], dict]] = None,
                  clock: Callable[[], float] = time.time):
         self.rules = list(RULES if rules is None else rules)
         self.cfg = dict(DEFAULT_THRESHOLDS)
@@ -454,6 +463,10 @@ class Watchdog:
         self.op_profile_cb = op_profile_cb
         self.mem_cb = mem_cb
         self.numerics_cb = numerics_cb
+        # run-configuration metadata stamped into every bundle's
+        # reason.json (e.g. the quant_collectives flag): tools diffing
+        # two bundles can tell a deliberate mode flip from drift
+        self.meta_cb = meta_cb
         self.clock = clock
         # back-reference for external trigger() firings (RESOURCE_
         # EXHAUSTED forensics); filled in by Collector.__init__
@@ -571,12 +584,19 @@ class Watchdog:
                 self.trace_cb(os.path.join(tmp, "trace.json"))
             except Exception as e:  # noqa: BLE001
                 errors["trace.json"] = f"{type(e).__name__}: {e}"
+        meta: Dict[str, Any] = {}
+        if self.meta_cb is not None:
+            try:
+                meta = dict(self.meta_cb() or {})
+            except Exception as e:  # noqa: BLE001 - partial bundle
+                errors["meta"] = f"{type(e).__name__}: {e}"
         # reason.json LAST — it is the bundle's manifest
         with open(os.path.join(tmp, "reason.json"), "w") as f:
             json.dump({"t": round(now, 3),
                        "fired": [{"rule": n, "reason": r}
                                  for n, r in fired],
                        "health": self.health(),
+                       "meta": meta,
                        "errors": errors}, f)
         final = os.path.join(self.artifacts_dir, name)
         if os.path.exists(final):
@@ -702,6 +722,17 @@ def default_sources() -> Callable[[], Dict[str, Any]]:
 
             gauges.update(numerics.health_gauges())
         except Exception:  # noqa: BLE001 - numerics gauges are optional
+            pass
+        try:
+            # quantized-collectives mode as a 0/1 level: the
+            # collective_bytes jump rule reads this series to tell a
+            # deliberate flag flip (baseline reset) from real traffic
+            # growth (docs/spmd.md)
+            from ..parallel import quant_collectives as _qc
+
+            gauges["quant_collectives_mode"] = \
+                1.0 if _qc.mode() == "int8" else 0.0
+        except Exception:  # noqa: BLE001 - gauge is optional
             pass
         # devprof's capture stats need no extra source: _publish writes
         # devprof_capture_ms / devprof_attributed_pct into the profiler

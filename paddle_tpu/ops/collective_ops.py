@@ -45,26 +45,53 @@ def _axis_for(ctx, op):
     return axes.get("data")
 
 
-def _record_wire(ctx, op, x):
-    """Bytes-on-wire counter (docs/observability.md): the logical
+def _record_wire(ctx, op, x, wire_bytes=None):
+    """Bytes-on-wire counter (docs/observability.md): the **wire**
     payload bytes this collective moves over ICI, recorded at lowering
     (trace) time — once per compiled program — under
-    `collective_bytes_<op_type>`.  This is the seam the quantized-
-    allreduce ROADMAP item (EQuARX, arxiv 2506.17615) asserts against:
-    an int8 lowering shrinks exactly this number.  Skipped during
-    abstract InferShape traces so a payload is never double-counted."""
+    `collective_bytes_<op_type>`.  Defaults to the logical payload
+    (elements x itemsize); a lowering that changes the wire dtype (the
+    int8 quantized path: codes + fp32 scale sidecar) passes an explicit
+    `wire_bytes=` override so the counter stays truthful — this is the
+    number the EQuARX ~4x-drop proof (docs/spmd.md) asserts against.
+    Skipped during abstract InferShape traces so a payload is never
+    double-counted."""
     if getattr(ctx, "abstract", False):
         return
     try:
+        if wire_bytes is None:
+            size = 1
+            for d in jnp.shape(x):
+                size *= int(d)
+            wire_bytes = size * jnp.dtype(jnp.result_type(x)).itemsize
+        from ..obs.cost import record_collective
+
+        record_collective(op.type, int(wire_bytes))
+    except Exception:  # noqa: BLE001 - accounting must never break a trace
+        pass
+
+
+def _quant_cfg(ctx, x):
+    """The quant_collectives module when this payload should be
+    quantized (flag int8, float dtype, above the min-size floor), else
+    None.  Imported lazily: ops must not pull the parallel package at
+    import time (registry <- compiler cycle)."""
+    try:
+        from ..parallel import quant_collectives as qc
+
+        if qc.mode() != "int8":
+            return None
+        if not jnp.issubdtype(jnp.result_type(x), jnp.floating):
+            return None
         size = 1
         for d in jnp.shape(x):
             size *= int(d)
         nbytes = size * jnp.dtype(jnp.result_type(x)).itemsize
-        from ..obs.cost import record_collective
-
-        record_collective(op.type, nbytes)
-    except Exception:  # noqa: BLE001 - accounting must never break a trace
-        pass
+        if nbytes < qc.min_bytes():
+            return None
+        return qc
+    except Exception:  # noqa: BLE001 - gating must never break a trace
+        return None
 
 
 def _allreduce(reduce_fn):
@@ -79,12 +106,33 @@ def _allreduce(reduce_fn):
     return lower
 
 
-register_op("c_allreduce_sum")(_allreduce(lambda x, a: lax.psum(x, a)))
+def _sum_allreduce(ctx, op, ins):
+    """Sum all-reduce with the opt-in int8 blockwise path
+    (FLAGS_quant_collectives, docs/spmd.md): two-phase
+    reduce-scatter-of-quantized-blocks + all_gather so dequant error
+    enters twice total, never per ring hop."""
+    x = first(ins, "X")
+    axis = _axis_for(ctx, op)
+    if axis is None:
+        return {"Out": [x]}
+    qc = _quant_cfg(ctx, x)
+    if qc is not None:
+        # same once-per-logical-collective convention as the full-width
+        # branch (which records S for a ring that actually moves ~2S):
+        # one logical payload of int8 codes + fp32 scales
+        n = int(_axis_size(axis))
+        _record_wire(ctx, op, x, wire_bytes=qc.wire_bytes(x, axis_size=n))
+        return {"Out": [qc.quant_allreduce_sum(x, axis)]}
+    _record_wire(ctx, op, x)
+    return {"Out": [lax.psum(x, axis)]}
+
+
+register_op("c_allreduce_sum")(_sum_allreduce)
 register_op("c_allreduce_max")(_allreduce(lambda x, a: lax.pmax(x, a)))
 register_op("c_allreduce_min")(_allreduce(lambda x, a: lax.pmin(x, a)))
 register_op("c_allreduce_prod")(_allreduce(
     lambda x, a: jnp.exp(lax.psum(jnp.log(x), a))))
-register_op("mp_allreduce_sum")(_allreduce(lambda x, a: lax.psum(x, a)))
+register_op("mp_allreduce_sum")(_sum_allreduce)
 
 
 @register_op("c_reduce_sum")
@@ -118,6 +166,10 @@ def _c_allgather(ctx, op, ins):
     axis = _axis_for(ctx, op)
     if axis is None:
         return {"Out": [x]}
+    qc = _quant_cfg(ctx, x)
+    if qc is not None:
+        _record_wire(ctx, op, x, wire_bytes=qc.wire_bytes(x))
+        return {"Out": [qc.quant_allgather(x, axis)]}
     _record_wire(ctx, op, x)
     g = lax.all_gather(x, axis)  # (nranks, ...) leading axis
     return {"Out": [g.reshape((-1,) + x.shape[1:])]}
@@ -129,8 +181,13 @@ def _c_reducescatter(ctx, op, ins):
     axis = _axis_for(ctx, op)
     if axis is None:
         return {"Out": [x]}
-    _record_wire(ctx, op, x)
     n = _axis_size(axis)
+    qc = _quant_cfg(ctx, x)
+    if qc is not None and x.shape and int(x.shape[0]) % int(n) == 0:
+        _record_wire(ctx, op, x,
+                     wire_bytes=qc.wire_bytes(x, axis_size=int(n)))
+        return {"Out": [qc.quant_reducescatter(x, axis)]}
+    _record_wire(ctx, op, x)
     return {"Out": [lax.psum_scatter(x, axis, scatter_dimension=0,
                                      tiled=True)]}
 

@@ -177,7 +177,8 @@ class CompiledProgram:
     def _make_entry(self, program, scope, fn, state_in, mutable_in,
                     const_in, mutable_out, feed_arrays, fetch_names,
                     check_nan, check_names_box, feed_shardings,
-                    const_shardings, state_shardings=None):
+                    const_shardings, state_shardings=None,
+                    numerics_mode="off", numerics_keys=None):
         from ..fluid.executor import _CompiledEntry
 
         entry = _CompiledEntry()
@@ -200,17 +201,58 @@ class CompiledProgram:
         entry.dispatched = False
         entry.fn_compiled = None
         entry.cost = None
-        # obs.numerics: SPMD/shard_map step_fns are not stats-
-        # instrumented (the Executor path is the instrumented one) —
-        # inert defaults so the shared _dispatch unpack stays uniform
-        entry.numerics_mode = "off"
-        entry.numerics_keys = []
+        # obs.numerics: the SPMD step_fn traces the training-health
+        # rows (grad_norm/update_ratio) when PADDLE_OBS_NUMERICS is
+        # armed — the accuracy guard for quantized collectives
+        # (docs/spmd.md); per-op stats stay Executor-path-only
+        entry.numerics_mode = numerics_mode
+        entry.numerics_keys = numerics_keys if numerics_keys is not None \
+            else []
         entry.lowered_block = None
         entry.amp_scale_name = None
         from ..fluid.executor import _program_label
 
         entry.label = _program_label(program, fetch_names)
         return entry
+
+    def _quant_grad_split(self, block, mesh, feed_arrays, mutable_out):
+        """Gate + split point for the quantized SPMD gradient path
+        (FLAGS_quant_collectives=int8, docs/spmd.md): the jitted step
+        is split at the last parameter-gradient write; the forward+
+        backward segment runs per-shard inside a shard_map where each
+        param gradient crosses the batch axes through the int8
+        blockwise all-reduce, then the optimizer segment consumes the
+        reduced values.  Returns (split_idx, param_grads, batch_axes)
+        or None when the plain full-width lowering should run."""
+        from . import quant_collectives as qc
+
+        if qc.mode() != "int8":
+            return None
+        batch_axes = tuple(
+            ax for ax in (mesh_lib.DATA_AXIS, mesh_lib.FSDP_AXIS)
+            if ax in mesh.shape and mesh.shape[ax] > 1)
+        nbatch = 1
+        for ax in batch_axes:
+            nbatch *= mesh.shape[ax]
+        if nbatch <= 1:
+            return None
+        # every batched feed must split evenly across the batch axes,
+        # or per-shard tracing would see ragged leading dims
+        for a in feed_arrays.values():
+            if a.ndim >= 1 and a.shape[0] % nbatch != 0:
+                return None
+        mo = set(mutable_out)
+        split_idx = -1
+        param_grads = set()
+        for i, op in enumerate(block.ops):
+            for out_name in op.output_arg_names():
+                if out_name.endswith("@GRAD") \
+                        and out_name[: -len("@GRAD")] in mo:
+                    split_idx = max(split_idx, i)
+                    param_grads.add(out_name)
+        if split_idx < 0:
+            return None
+        return split_idx, param_grads, batch_axes
 
     def _compile_spmd(self, executor, program, feed_arrays, fetch_names,
                       scope):
@@ -262,26 +304,59 @@ class CompiledProgram:
 
         check_names_box = []
 
-        def step_fn(mutable_state, const_state, feeds, seed):
-            env: Dict[str, Any] = {}
-            env.update(const_state)
-            env.update(mutable_state)
-            env.update(feeds)
-            ctx = registry.LowerCtx(jax.random.PRNGKey(seed), block=block)
-            registry.lower_block(ctx, block, env)
-            fetches = [env[n] for n in fetch_names]
-            new_state = {n: env[n] for n in mutable_out if n in env}
+        # training-health numerics ride the SPMD step too (the accuracy
+        # guard for quantized collectives): armed by PADDLE_OBS_NUMERICS,
+        # independent of FLAGS_quant_collectives
+        from ..fluid.executor import _numeric_stats
+        from ..obs import numerics as obs_numerics
+
+        numerics_on = obs_numerics.mode() != "off"
+        numerics_keys_box = []
+
+        def _trace_extras(env, mutable_state, new_state, fetches):
+            import types
+
+            extras = []
             if check_nan:
                 names, flags = _nan_flags(fetch_names, fetches, new_state)
                 check_names_box[:] = names
-                return fetches, new_state, flags
-            return fetches, new_state
+                extras.append(flags)
+            if numerics_on:
+                keys, stats = _numeric_stats(
+                    types.SimpleNamespace(numerics=[]), env,
+                    mutable_state, new_state)
+                numerics_keys_box[:] = keys
+                extras.append(stats)
+            return extras
+
+        quant_split = self._quant_grad_split(block, mesh, feed_arrays,
+                                             mutable_out)
+        if quant_split is not None:
+            step_fn = self._quant_step_fn(block, mesh, feed_arrays,
+                                          fetch_names, mutable_out,
+                                          quant_split, _trace_extras)
+        else:
+            def step_fn(mutable_state, const_state, feeds, seed):
+                env: Dict[str, Any] = {}
+                env.update(const_state)
+                env.update(mutable_state)
+                env.update(feeds)
+                ctx = registry.LowerCtx(jax.random.PRNGKey(seed),
+                                        block=block)
+                registry.lower_block(ctx, block, env)
+                fetches = [env[n] for n in fetch_names]
+                new_state = {n: env[n] for n in mutable_out if n in env}
+                extras = _trace_extras(env, mutable_state, new_state,
+                                       fetches)
+                return tuple([fetches, new_state] + extras)
 
         state_shardings = {n: state_sharding(n)
                            for n in set(mutable_in) | set(const_in)
                            | set(mutable_out)}
         out_shardings = (None, {n: state_shardings[n] for n in mutable_out})
         if check_nan:
+            out_shardings = out_shardings + (None,)
+        if numerics_on:
             out_shardings = out_shardings + (None,)
         const_shardings = {n: state_shardings[n] for n in const_in}
         fn = jax.jit(
@@ -302,7 +377,114 @@ class CompiledProgram:
                                 const_in, mutable_out, feed_arrays,
                                 fetch_names, check_nan, check_names_box,
                                 feed_shardings, const_shardings,
-                                state_shardings)
+                                state_shardings,
+                                numerics_mode="on" if numerics_on
+                                else "off",
+                                numerics_keys=numerics_keys_box)
+
+    def _quant_step_fn(self, block, mesh, feed_arrays, fetch_names,
+                       mutable_out, quant_split, trace_extras):
+        """step_fn for the quantized SPMD gradient path: ops up to the
+        last param-gradient write run per-shard inside a shard_map over
+        the mesh; at its boundary every parameter gradient above the
+        min-size floor crosses the batch axes as int8 blocks + fp32
+        scales (quant_allreduce_sum / nbatch == a quantized pmean —
+        valid because fluid losses are batch means), other floats cross
+        as full-width pmean.  The optimizer segment then runs on the
+        reduced values under the jit's sharding constraints, so ZeRO
+        moment shardings and fsdp param layouts are preserved."""
+        import jax.numpy as jnp
+
+        from . import quant_collectives as qc
+        from ..ops import registry
+
+        split_idx, param_grads, batch_axes = quant_split
+        a_ops = list(block.ops[: split_idx + 1])
+        b_ops = list(block.ops[split_idx + 1:])
+        a_writes = set()
+        for op in a_ops:
+            a_writes.update(op.output_arg_names())
+        b_reads = set()
+        for op in b_ops:
+            b_reads.update(op.input_arg_names())
+        boundary = sorted((b_reads | set(fetch_names) | set(mutable_out))
+                          & a_writes)
+        nbatch = 1
+        for ax in batch_axes:
+            nbatch *= mesh.shape[ax]
+        min_b = qc.min_bytes()
+        batch_spec = P(batch_axes if len(batch_axes) > 1
+                       else batch_axes[0])
+        feed_specs = {n: (batch_spec if a.ndim >= 1 else P())
+                      for n, a in feed_arrays.items()}
+
+        def step_fn(mutable_state, const_state, feeds, seed):
+            env: Dict[str, Any] = {}
+            env.update(const_state)
+            env.update(mutable_state)
+            carried = dict(env)
+            # writes-analysis can include names a conditional trace
+            # never binds: noted during the (eager) shard_map trace,
+            # filtered from the env commit below
+            missing_box = set()
+
+            def per_shard(carried_state, shard_feeds, seed_):
+                senv = dict(carried_state)
+                senv.update(shard_feeds)
+                idx = jax.lax.axis_index(batch_axes[0])
+                for ax in batch_axes[1:]:
+                    idx = idx * mesh.shape[ax] + jax.lax.axis_index(ax)
+                key = jax.random.fold_in(jax.random.PRNGKey(seed_), idx)
+                ctx = registry.LowerCtx(key, block=block)
+                ctx.need_vjp |= registry.scan_need_vjp(block)
+                for op in a_ops:
+                    registry.lower_op(ctx, op, senv)
+                out = {}
+                for name in boundary:
+                    if name not in senv:
+                        missing_box.add(name)
+                        out[name] = jnp.zeros((), jnp.float32)
+                        continue
+                    v = senv[name]
+                    try:
+                        is_float = jnp.issubdtype(jnp.result_type(v),
+                                                  jnp.floating)
+                    except Exception:  # noqa: BLE001 - non-array binding
+                        missing_box.add(name)
+                        out[name] = jnp.zeros((), jnp.float32)
+                        continue
+                    if not is_float:
+                        # non-float boundary values (step counters, lod
+                        # bookkeeping) are replicated by construction
+                        out[name] = v
+                        continue
+                    nbytes = v.size * jnp.dtype(
+                        jnp.result_type(v)).itemsize
+                    if name in param_grads and nbytes >= min_b:
+                        out[name] = qc.quant_allreduce_sum(
+                            v, batch_axes) / nbatch
+                    else:
+                        out[name] = jax.lax.pmean(v, batch_axes)
+                return out
+
+            sharded = _shard_map_compat(
+                per_shard, mesh=mesh,
+                in_specs=({n: P() for n in carried},
+                          feed_specs, P()),
+                out_specs={n: P() for n in boundary})
+            reduced = sharded(carried, feeds, seed)
+            # shard_map traces eagerly, so missing_box is final here
+            env.update({n: v for n, v in reduced.items()
+                        if n not in missing_box})
+            ctx = registry.LowerCtx(jax.random.PRNGKey(seed), block=block)
+            for op in b_ops:
+                registry.lower_op(ctx, op, env)
+            fetches = [env[n] for n in fetch_names]
+            new_state = {n: env[n] for n in mutable_out if n in env}
+            extras = trace_extras(env, mutable_state, new_state, fetches)
+            return tuple([fetches, new_state] + extras)
+
+        return step_fn
 
     def _compile_shard_map(self, executor, program, feed_arrays,
                            fetch_names, scope):

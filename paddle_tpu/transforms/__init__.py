@@ -242,6 +242,14 @@ def enabled_signature() -> tuple:
         m = "off"
     if m != "off":
         sig = sig + (f"numerics={m}",)
+    try:
+        from ..parallel import quant_collectives as _qc
+
+        tok = _qc.signature_token()
+    except Exception:  # noqa: BLE001 - parallel unavailable (minimal env)
+        tok = None
+    if tok is not None:
+        sig = sig + (tok,)
     return sig
 
 

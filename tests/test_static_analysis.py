@@ -413,6 +413,21 @@ def test_devprof_on_hot_path_watchlist():
     assert "paddle_tpu/obs/devprof.py" in lint.span_leak.WATCHED
 
 
+def test_quant_collectives_on_hot_path_watchlist():
+    """ISSUE 16: the int8 collective codec's entry points are lint-
+    watched — pack/quantize/dequantize trace INSIDE the jitted step,
+    where a host sync or numpy materialization would stall every
+    quantized gradient reduction; parallel/quant_collectives.py is
+    also in the span-leak watched set."""
+    watched = set(lint.hot_path_sync.WATCHLIST)
+    for qual in ("pack", "quantize_blockwise", "dequantize_blockwise",
+                 "quant_allreduce_sum"):
+        assert ("paddle_tpu/parallel/quant_collectives.py",
+                qual) in watched
+    assert "paddle_tpu/parallel/quant_collectives.py" \
+        in lint.span_leak.WATCHED
+
+
 def test_memprof_on_hot_path_watchlist():
     """ISSUE 14: the memory-ledger entry points are lint-watched —
     set/add run on the dispatch/ring/ckpt hot paths, ledger_gauges on

@@ -162,6 +162,14 @@ def is_fallback(doc: dict) -> bool:
     return str(doc.get("metric", "")).endswith("_cpu")
 
 
+def quant_stamp(doc: dict) -> str:
+    """The FLAGS_quant_collectives value stamped into BENCH
+    detail.sharding (bench.py).  Missing stamp == 'off' so pre-stamp
+    baselines compare cleanly."""
+    return str(_get(doc, "detail", "sharding", "quant_collectives",
+                    default="off") or "off")
+
+
 def diff(baseline: dict, current: dict,
          thresholds: Optional[dict] = None) -> List[dict]:
     """Rows for every shared metric; each carries a `regressed` bool."""
@@ -169,10 +177,22 @@ def diff(baseline: dict, current: dict,
     base_m = extract_metrics(baseline)
     cur_m = extract_metrics(current)
     rows: List[dict] = []
+    b_q, c_q = quant_stamp(baseline), quant_stamp(current)
     for name, (direction, rel, floor) in thresholds.items():
         if name not in base_m or name not in cur_m:
             continue
         b, c = base_m[name], cur_m[name]
+        if name == "collective_bytes" and b_q != c_q:
+            # quantization-aware baseline reset (docs/spmd.md): a
+            # deliberate FLAGS_quant_collectives flip moves wire bytes
+            # ~4x BY DESIGN in either direction — the comparison is
+            # meaningless until a baseline with the new stamp lands
+            rows.append({"metric": name, "baseline": b, "current": c,
+                         "delta": round(c - b, 4), "rel_pct": 0.0,
+                         "direction": direction, "regressed": False,
+                         "note": f"quant_collectives {b_q}->{c_q}: "
+                                 "baseline reset, not compared"})
+            continue
         delta = c - b
         bad = delta < 0 if direction == "up" else delta > 0
         magnitude = abs(delta)
@@ -216,7 +236,8 @@ def run_gate(baseline_path: str, current_path: str, strict: bool,
         print(f"{'metric':<22}{'baseline':>14}{'current':>14}"
               f"{'delta':>12}{'rel%':>8}  verdict")
         for r in rows:
-            verdict = "REGRESSED" if r["regressed"] else "ok"
+            verdict = "REGRESSED" if r["regressed"] else \
+                "skipped" if r.get("note") else "ok"
             print(f"{r['metric']:<22}{r['baseline']:>14.3f}"
                   f"{r['current']:>14.3f}{r['delta']:>12.3f}"
                   f"{r['rel_pct']:>8.2f}  {verdict}")
@@ -240,7 +261,8 @@ def _synthetic(mfu: float, step_ms: float, transposes: int = 0,
                devprof_pct: float = 95.0,
                opt_bytes: int = 65536,
                hbm_peak: int = 1 << 30,
-               numerics_pct: float = 8.0) -> dict:
+               numerics_pct: float = 8.0,
+               quant: str = "off") -> dict:
     return {
         "metric": "bert_base_pretrain_mfu",
         "value": mfu, "unit": "%", "vs_baseline": mfu / 45.0,
@@ -249,7 +271,8 @@ def _synthetic(mfu: float, step_ms: float, transposes: int = 0,
             "step_ms": step_ms,
             "sharding": {"mesh_axes": {"data": 2, "fsdp": 2, "tp": 2},
                          "optimizer_bytes_per_device": opt_bytes,
-                         "specs_applied": 6},
+                         "specs_applied": 6,
+                         "quant_collectives": quant},
             "telemetry": {"sampler_overhead_ms": telemetry_ms,
                           "samples": 50, "drops": 0,
                           "rules_fired": 0},
@@ -377,7 +400,33 @@ def selftest(verbose: bool = True) -> int:
     checks.append(("sub-floor numerics wiggle passes",
                    not any(r["metric"] == "numerics_overhead_pct"
                            and r["regressed"] for r in rows)))
-    # 13. stale re-emitted on-chip record is warn-only
+    # 13. quantization-aware gate (docs/spmd.md): a deliberate
+    # FLAGS_quant_collectives flip resets the collective_bytes baseline
+    # in BOTH directions — int8->off quadruples wire bytes without
+    # firing, off->int8 shrinks them without firing — while an
+    # equal-stamp 4x growth (check 7 above) still fires
+    base_q = _synthetic(mfu=42.0, step_ms=100.0, coll_bytes=4096,
+                        quant="int8")
+    cur_unquant = _synthetic(mfu=42.0, step_ms=100.0, coll_bytes=16384,
+                             quant="off")
+    rows = diff(base_q, cur_unquant)
+    checks.append(("int8->off flip: 4x bytes rise does not fire",
+                   not any(r["metric"] == "collective_bytes"
+                           and r["regressed"] for r in rows)
+                   and any(r["metric"] == "collective_bytes"
+                           and r.get("note") for r in rows)))
+    cur_quant = _synthetic(mfu=42.0, step_ms=100.0, coll_bytes=1024,
+                           quant="int8")
+    rows = diff(base, cur_quant)
+    checks.append(("off->int8 flip: bytes drop does not fire",
+                   not any(r["metric"] == "collective_bytes"
+                           and r["regressed"] for r in rows)))
+    rows = diff(base_q, _synthetic(mfu=42.0, step_ms=100.0,
+                                   coll_bytes=16384, quant="int8"))
+    checks.append(("equal-stamp (int8) 4x bytes growth still fires",
+                   any(r["metric"] == "collective_bytes"
+                       and r["regressed"] for r in rows)))
+    # 14. stale re-emitted on-chip record is warn-only
     stale = dict(base)
     stale["detail"] = dict(base["detail"], stale_s=1234)
     checks.append(("stale on-chip record is warn-only",

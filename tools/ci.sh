@@ -22,6 +22,12 @@ echo "== SPMD sharding: dp vs dp*fsdp*tp parity on 8 virtual devices (docs/spmd.
 # data-parallel while holding ~4x less optimizer state per device
 python -m pytest tests/test_spmd_sharding.py -q
 
+echo "== quantized collectives: int8 vs full-width parity + ~4x wire drop (docs/spmd.md) =="
+# the blockwise int8 path must match full-width collectives within
+# quantization tolerance, keep the health series within 5%, and drop
+# the collective_bytes counters >=3.5x
+python -m pytest tests/test_quant_collectives.py -q
+
 echo "== static analysis: tpulint rules + op-test coverage floor + shape-consistency sweep =="
 python tools/run_lints.py --shape-check
 

@@ -762,8 +762,9 @@ def _opprof_selftest_checks() -> List[tuple]:
          relu.get("fusions", 0) >= 1),
         ("op-profile: metadata-less transpose inherits its consumer",
          dot.get("transposes", 0) >= 1),
-        ("op-profile: collective bytes attributed",
-         coll.get("collective_bytes", 0) == 64 * 64 * 4),
+        ("op-profile: collective bytes attributed (ring-true: "
+         "all-reduce moves ~2x its shape over the wire)",
+         coll.get("collective_bytes", 0) == 2 * 64 * 64 * 4),
         ("op-profile: >=95% of flops attributed",
          prof["attributed_flops_pct"] >= 95.0),
         ("op-profile: normalized total matches cost_analysis",
