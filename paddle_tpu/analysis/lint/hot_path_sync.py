@@ -82,7 +82,24 @@ WATCHLIST: List[Tuple[str, str]] = [
     ("paddle_tpu/serving/engine.py", "AutoregressiveEngine._decode"),
     ("paddle_tpu/serving/engine.py", "AutoregressiveEngine._retire"),
     ("paddle_tpu/serving/batcher.py", "DynamicBatcher.next_batch"),
+    # multi-tenant fleet (ISSUE 17): admission (submit -> quota check)
+    # and the registry request surface run on CLIENT threads racing the
+    # dispatch loop; the registry's cache-eviction accounting runs
+    # inside the compiler thread's put() — all of it is host-side
+    # bookkeeping, never a device materialization
+    ("paddle_tpu/serving/batcher.py", "DynamicBatcher.submit"),
+    ("paddle_tpu/serving/batcher.py", "DynamicBatcher._pop_best"),
+    ("paddle_tpu/serving/registry.py", "ModelRegistry.submit"),
+    ("paddle_tpu/serving/registry.py", "_TenantCache.put"),
+    ("paddle_tpu/serving/registry.py", "_TenantCache._evicted"),
     ("paddle_tpu/serving/bucketing.py", "BucketedRunner.run"),
+    # persistent AOT cache (ISSUE 17): load/store run on compile-miss
+    # paths (executor first dispatch, serving compiler thread) — disk
+    # I/O is their job, but they handle DEVICE executables and must
+    # never materialize arrays or block on the device
+    ("paddle_tpu/fluid/aot_cache.py", "try_load"),
+    ("paddle_tpu/fluid/aot_cache.py", "try_store"),
+    ("paddle_tpu/fluid/aot_cache.py", "compile_entry_with_cache"),
     ("paddle_tpu/inference/c_bridge.py", "run_f32"),
     # obs span/cost layer (ISSUE 6): these run INSIDE every watched loop
     # above — a sync creeping into the tracer or the live-MFU gauge

@@ -55,7 +55,10 @@ WATCHED = [
     # codec traces inside the jitted step (ISSUE 16) — span misuse
     # there would wrap device-side code in host timers
     "paddle_tpu/dataset/feed_pipeline.py",
-    "paddle_tpu/serving",
+    "paddle_tpu/fluid/aot_cache.py",  # explicit: the persistent AOT
+    # cache times its own load/store (ISSUE 17) — a leaked span there
+    # would misattribute disk I/O to whichever compile wrapped it
+    "paddle_tpu/serving",  # covers registry.py (multi-tenant fleet)
     "paddle_tpu/transforms/__init__.py",
     "paddle_tpu/analysis/verifier.py",
     "bench.py",

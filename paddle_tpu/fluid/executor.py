@@ -321,7 +321,7 @@ class _CompiledEntry:
                  "const_dev", "feed_shardings", "const_shardings",
                  "state_shardings", "dispatched", "fn_compiled", "cost",
                  "label", "numerics_mode", "numerics_keys", "lowered_block",
-                 "amp_scale_name")
+                 "amp_scale_name", "aot_sig")
 
 
 class _NanMonitor:
@@ -1315,6 +1315,20 @@ class Executor:
         entry.fn_compiled = None
         entry.cost = None
         entry.label = _program_label(program, fetch_names)
+        # persistent AOT cache identity (fluid/aot_cache.py): the
+        # process-stable half of this entry's compile signature —
+        # program structure + feed/fetch names; the dispatch-time aval
+        # signature and the volatile half (flags, jax fingerprint,
+        # mesh) join at the compile_entry_with_cache seam.  None keeps
+        # the entry off the persistent cache entirely (FLAGS_aot_cache
+        # off, or a program that cannot serialize).
+        entry.aot_sig = None
+        from .aot_cache import enabled as _aot_enabled, program_token
+        if _aot_enabled():
+            tok = program_token(program)
+            if tok is not None:
+                entry.aot_sig = [tok, entry.feed_names,
+                                 entry.fetch_names]
         self._cache.put(key, entry)
         return entry
 
@@ -1401,11 +1415,16 @@ class Executor:
             }
         first_call = not entry.dispatched
         if first_call and entry.fn_compiled is None:
-            from ..obs.cost import compile_with_cost
+            # persistent AOT cache consult (fluid/aot_cache.py): a
+            # fresh process serving a previously-compiled program loads
+            # the serialized executable instead of paying the XLA
+            # compile; falls through to the same compile_with_cost
+            # compile on any miss, byte-identically when the cache is
+            # off
+            from .aot_cache import compile_entry_with_cache
 
-            entry.fn_compiled, entry.cost = compile_with_cost(
-                entry.fn, (mutable_state, const_state, feed_arrays, seed),
-                entry.label)
+            entry.fn_compiled, entry.cost = compile_entry_with_cache(
+                entry, (mutable_state, const_state, feed_arrays, seed))
         with obs.span("executor.dispatch") as sp:
             # devprof window bookkeeping: a single attribute check when
             # no capture window is armed; never syncs, never transfers

@@ -170,6 +170,24 @@ _define("quant_collectives_min_bytes", 1024,
         "smaller than this many bytes stay full-width (quantizing "
         "tiny tensors costs more in scales+padding than it saves)",
         env_var="PADDLE_QUANT_COLLECTIVES_MIN_BYTES")
+# -- persistent AOT executable cache (fluid/aot_cache.py,
+# docs/serving.md "Multi-tenant fleet"): a fresh process serving a
+# previously-compiled model loads the serialized XLA executable from
+# disk instead of recompiling — compile time is an availability number
+# at restart
+_define("aot_cache", "on",
+        "persistent on-disk AOT executable cache: 'on' consults "
+        "aot_cache_dir on every compile-cache miss and stores freshly "
+        "compiled executables there; 'off' is byte-identical to the "
+        "pre-cache behavior (every signature component — transforms, "
+        "numerics, quant mode, jax/backend fingerprint — keys the "
+        "entry, so drift is a hard miss, never a stale load)",
+        env_var="PADDLE_AOT_CACHE")
+_define("aot_cache_dir", "artifacts/aot_cache",
+        "root directory of the persistent AOT executable cache "
+        "(entries commit via tmp-dir + os.replace, the ckpt idiom); "
+        "empty disables the cache like FLAGS_aot_cache='off'",
+        env_var="PADDLE_AOT_CACHE_DIR")
 
 
 def get_flags(flags):

@@ -400,7 +400,20 @@ def start_telemetry(port: Optional[int] = None,
             # (expected ~4x collective_bytes shift) from real drift
             from ..parallel import quant_collectives as _qc
 
-            return {"quant_collectives": _qc.mode()}
+            meta = {"quant_collectives": _qc.mode()}
+            try:
+                # which tenants shared the device at dump time
+                # (multi-tenant fleet, serving/registry.py) — an
+                # incident bundle without the co-tenant list cannot
+                # distinguish noisy-neighbour from self-inflicted
+                from ..serving.registry import active_tenants
+
+                tenants = active_tenants()
+                if tenants:
+                    meta["tenants"] = tenants
+            except Exception:  # noqa: BLE001 - meta only
+                pass
+            return meta
 
         watchdog = telemetry.Watchdog(
             thresholds=thresholds,

@@ -83,6 +83,15 @@ GAUGE_STATS = frozenset({
 # time_add accumulators
 GAUGE_TIMERS = frozenset({"shard_skew_ms"})
 
+
+def _is_gauge_stat(name: str) -> bool:
+    """Levels vs cumulative counters.  Beyond the fixed set, the
+    multi-tenant fleet mints one `serving_tenant_<t>_queued` depth
+    gauge PER REGISTERED MODEL (serving/batcher.py stat_set) — matched
+    by shape since tenant names are dynamic."""
+    return name in GAUGE_STATS or (
+        name.startswith("serving_tenant_") and name.endswith("_queued"))
+
 COUNTER = "counter"
 GAUGE = "gauge"
 
@@ -196,6 +205,8 @@ DEFAULT_THRESHOLDS: Dict[str, float] = {
     "mfu_floor_pct": 0.5,       # ignore MFU noise below this level
     "reject_min": 5,            # rejected requests per sample to arm
     "reject_rate": 0.5,         # rejected / (rejected + admitted)
+    "tenant_reject_min": 5,     # per-tenant rejections to arm
+    "tenant_reject_rate": 0.5,  # per-tenant rejected / offered
     "queue_spike_x": 3.0,       # queue depth > Nx rolling median
     "queue_min": 8,             # and at least this deep
     "ckpt_stall_ms": 500.0,     # ckpt backpressure per sample window
@@ -280,6 +291,35 @@ def rule_serving_rejection_spike(v, cfg) -> Optional[str]:
         return (f"rejection rate {rate:.0%} ({int(rej)} rejected vs "
                 f"{int(adm)} admitted this sample)")
     return None
+
+
+def rule_tenant_rejection_spike(v, cfg) -> Optional[str]:
+    """Per-tenant admission health (multi-tenant fleet,
+    serving/registry.py): one tenant hammering its quota fires with
+    the TENANT'S name even while the fleet-wide rejection rate stays
+    green — the global rule averages the noisy neighbour away; this
+    one scans every `serving_tenant_<t>_rejected_total` series the
+    collector folded from the profiler tables."""
+    worst = None
+    for name in v.names():
+        if not name.startswith("serving_tenant_") \
+                or not name.endswith("_rejected_total"):
+            continue
+        rej = v.last(name) or 0.0
+        if rej < cfg["tenant_reject_min"]:
+            continue
+        tenant = name[len("serving_tenant_"):-len("_rejected_total")]
+        adm = v.last(f"serving_tenant_{tenant}_requests_total") or 0.0
+        rate = rej / max(1.0, rej + adm)
+        if rate > cfg["tenant_reject_rate"] \
+                and (worst is None or rate > worst[1]):
+            worst = (tenant, rate, rej, adm)
+    if worst is None:
+        return None
+    tenant, rate, rej, adm = worst
+    return (f"tenant {tenant!r} rejection rate {rate:.0%} "
+            f"({int(rej)} rejected vs {int(adm)} admitted this "
+            f"sample; per-tenant quota, serving/registry.py)")
 
 
 def rule_serving_queue_saturation(v, cfg) -> Optional[str]:
@@ -420,6 +460,7 @@ RULES: List[Tuple[str, Callable]] = [
     ("mfu_drop", rule_mfu_drop),
     ("non_finite_loss", rule_non_finite_loss),
     ("serving_rejection_spike", rule_serving_rejection_spike),
+    ("tenant_rejection_spike", rule_tenant_rejection_spike),
     ("serving_queue_saturation", rule_serving_queue_saturation),
     ("ckpt_stall", rule_ckpt_stall),
     ("feed_starvation", rule_feed_starvation),
@@ -802,7 +843,7 @@ class Collector:
             return []
         now = self.clock()
         for name, raw in (data.get("counters") or {}).items():
-            if name in GAUGE_STATS:
+            if _is_gauge_stat(name):
                 self.store.record(now, name, GAUGE, raw)
             else:
                 self.store.record(now, name, COUNTER,

@@ -213,6 +213,19 @@ class CompiledProgram:
         from ..fluid.executor import _program_label
 
         entry.label = _program_label(program, fetch_names)
+        # persistent AOT cache identity (fluid/aot_cache.py), same seam
+        # as Executor._prepare_miss: CompiledProgram entries dispatch
+        # through Executor._dispatch, so the first call consults the
+        # on-disk cache before the one XLA compile.  The mesh axes ride
+        # the volatile signature via the entry's NamedShardings.
+        entry.aot_sig = None
+        from ..fluid.aot_cache import enabled as _aot_enabled, \
+            program_token
+        if _aot_enabled():
+            tok = program_token(program)
+            if tok is not None:
+                entry.aot_sig = ["compiled_program", tok,
+                                 entry.feed_names, entry.fetch_names]
         return entry
 
     def _quant_grad_split(self, block, mesh, feed_arrays, mutable_out):

@@ -31,7 +31,9 @@ from .bucketing import (BucketedRunner, bucket_for, bucket_ladder,
 from .engine import (AutoregressiveEngine, Engine, EngineConfig,
                      ProgramModel)
 from .kv_cache import PagedKVCache, PageTable
-from .metrics import latency_stats, mean_occupancy, reset_latency
+from .metrics import (latency_stats, mean_occupancy, reset_latency,
+                      tenant_stat)
+from .registry import ModelRegistry, active_tenants
 
 __all__ = [
     "AdmissionController",
@@ -42,12 +44,14 @@ __all__ = [
     "EngineClosed",
     "EngineConfig",
     "EngineOverloaded",
+    "ModelRegistry",
     "PagedKVCache",
     "PageTable",
     "ProgramModel",
     "Request",
     "RequestCancelled",
     "Response",
+    "active_tenants",
     "bucket_for",
     "bucket_ladder",
     "input_signature",
@@ -55,4 +59,5 @@ __all__ = [
     "mean_occupancy",
     "pad_batch",
     "reset_latency",
+    "tenant_stat",
 ]

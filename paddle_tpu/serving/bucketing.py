@@ -105,7 +105,8 @@ class BucketedRunner:
     def __init__(self, fn: Callable, buckets: Sequence[int],
                  donate: bool = False, bucketed: bool = True,
                  cache: Optional[CompileCache] = None,
-                 max_rows_per_call: Optional[int] = None):
+                 max_rows_per_call: Optional[int] = None,
+                 aot_token: Optional[str] = None):
         if not buckets:
             raise ValueError("BucketedRunner needs >= 1 bucket")
         self._fn = fn
@@ -115,6 +116,13 @@ class BucketedRunner:
         self._cache = cache if cache is not None else CompileCache(
             self.CACHE_CAPACITY, stat_prefix="serving")
         self._compile_lock = threading.Lock()
+        # persistent AOT cache opt-in (fluid/aot_cache.py): a stable
+        # token naming this model's computation + weights version lets
+        # a fresh process load the serialized bucket executables
+        # instead of recompiling (ModelRegistry derives it; raw
+        # callables must supply their own — a reused token would load
+        # another model's executable)
+        self.aot_token = aot_token
         # bucket key -> obs ProgramCost gauge (flops from the AOT
         # entry's cost_analysis; run() feeds it dispatch intervals)
         self._costs: dict = {}
@@ -157,8 +165,21 @@ class BucketedRunner:
             entry = self._cache.get(key)
             if entry is not None:
                 return entry
+            from ..fluid import aot_cache
             from ..profiler import stat_add, timed
 
+            stable = aot_cache.runner_stable_key(
+                self.aot_token, bucket, sig, self.donate)
+            loaded, _meta = aot_cache.try_load(
+                stable, label=f"serving.bucket{bucket}")
+            if loaded is not None:
+                from ..obs import cost as obs_cost
+
+                self._costs[key] = obs_cost.register_program(
+                    f"serving.bucket{bucket}",
+                    obs_cost.cost_of_compiled(loaded))
+                self._cache.put(key, loaded)
+                return loaded
             with timed("serving_compile_ms"):
                 specs = [
                     jax.ShapeDtypeStruct((bucket,) + tuple(a.shape[1:]),
@@ -173,6 +194,8 @@ class BucketedRunner:
                     warnings.filterwarnings(
                         "ignore", message=".*donated buffer.*")
                     entry = jitted.lower(*specs).compile()
+            aot_cache.try_store(stable, entry,
+                                label=f"serving.bucket{bucket}")
             # the entry is already AOT: reading its XLA cost_analysis
             # into the obs gauge registry is free (no extra compile) —
             # serving MFU reports per bucket (docs/observability.md)

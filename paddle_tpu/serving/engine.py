@@ -219,12 +219,23 @@ def _as_model(model, config: EngineConfig):
 
 
 class Engine:
-    """Continuous-batching inference engine over one loaded model."""
+    """Continuous-batching inference engine over one loaded model —
+    or, through `add_model`/`ModelRegistry` (serving/registry.py), a
+    fleet of named models sharing this one device pipeline.  `model`
+    may be None when every request will route to a named model."""
 
-    def __init__(self, model, config: Optional[EngineConfig] = None,
+    def __init__(self, model=None, config: Optional[EngineConfig] = None,
                  start: bool = True):
         self.config = config or EngineConfig()
-        self.model = _as_model(model, self.config)
+        self.model = _as_model(model, self.config) \
+            if model is not None else None
+        # named tenants (multi-tenant fleet): name -> wrapped model.
+        # Mutated live by add_model/remove_model WITHOUT draining —
+        # batches only ever resolve their model at dispatch time, and
+        # a batch never mixes tenants (the batcher groups by
+        # (tenant, signature))
+        self._models: dict = {}
+        self._models_lock = threading.Lock()
         self._batcher = DynamicBatcher(
             max_batch_size=self.config.max_batch_size,
             max_queue_delay_ms=self.config.max_queue_delay_ms,
@@ -303,13 +314,62 @@ class Engine:
     def __exit__(self, *exc) -> None:
         self.shutdown(drain=True)
 
+    # -- multi-tenant fleet (serving/registry.py) --------------------------
+    def add_model(self, name: str, model, quota: Optional[int] = None,
+                  priority: float = 0.0):
+        """Register (or hot-swap) a named model LIVE: no drain, no
+        pause — requests already dispatched complete against the model
+        object they resolved, requests submitted after this call see
+        the new one.  `quota` bounds the tenant's queued requests
+        (EngineOverloaded beyond it); `priority` is its base
+        scheduling priority (aged by waiting time)."""
+        wrapped = _as_model(model, self.config)
+        with self._models_lock:
+            self._models[str(name)] = wrapped
+        self._batcher.set_tenant(str(name), quota=quota,
+                                 priority=priority)
+        return wrapped
+
+    def remove_model(self, name: str, cancel_queued: bool = True):
+        """Unregister a named model without draining other tenants;
+        its still-queued requests are cancelled (batches already in
+        flight complete — they hold the model object)."""
+        with self._models_lock:
+            wrapped = self._models.pop(str(name), None)
+        if cancel_queued:
+            self._batcher.cancel_tenant(str(name))
+        self._batcher.clear_tenant(str(name))
+        return wrapped
+
+    def model_names(self) -> List[str]:
+        with self._models_lock:
+            return sorted(self._models)
+
+    def _model_of(self, tenant: Optional[str]):
+        if tenant is None:
+            if self.model is None:
+                raise EngineClosed(
+                    "engine has no default model — submit with "
+                    "model=<name> or register one via add_model")
+            return self.model
+        with self._models_lock:
+            m = self._models.get(tenant)
+        if m is None:
+            raise EngineClosed(f"model {tenant!r} is not registered")
+        return m
+
     # -- client surface ----------------------------------------------------
-    def submit(self, inputs: Sequence[Any]) -> Response:
+    def submit(self, inputs: Sequence[Any],
+               model: Optional[str] = None,
+               priority: float = 0.0) -> Response:
         """Queue one request (inputs share a leading batch dim).
-        Raises EngineOverloaded at the queue bound, EngineClosed after
-        shutdown."""
+        `model` routes to a named model registered via add_model (None
+        = the default model).  Raises EngineOverloaded at the queue
+        bound or the tenant's quota, EngineClosed after shutdown."""
         if self._closed:
             raise EngineClosed("engine is shut down")
+        if model is not None:
+            self._model_of(str(model))  # unknown tenant: fail fast
         arrays = []
         for a in inputs:
             a = a if isinstance(a, np.ndarray) else np.asarray(a)
@@ -318,12 +378,15 @@ class Engine:
                     "engine inputs need a leading batch dim (got a "
                     "scalar); wrap single examples as shape (1, ...)")
             arrays.append(a)
-        return self._batcher.submit(Request(arrays))
+        return self._batcher.submit(Request(
+            arrays, tenant=None if model is None else str(model),
+            priority=priority))
 
     def infer(self, inputs: Sequence[Any],
-              timeout: Optional[float] = None) -> List[np.ndarray]:
+              timeout: Optional[float] = None,
+              model: Optional[str] = None) -> List[np.ndarray]:
         """Synchronous convenience: submit + wait."""
-        return self.submit(inputs).result(timeout)
+        return self.submit(inputs, model=model).result(timeout)
 
     def reload_weights(self, path: str) -> int:
         """Model hot-swap (docs/fault_tolerance.md): load a
@@ -374,12 +437,20 @@ class Engine:
                              time.perf_counter() - t0,
                              flow=[r.flow for r in batch])
                 inputs = self._concat(batch)
-                if self.model.is_compiled(inputs):
-                    self._dispatch_batch(batch, inputs)
+                try:
+                    model = self._model_of(batch[0].tenant)
+                except EngineClosed as e:
+                    # tenant unregistered between admit and dispatch:
+                    # fail ITS batch; every other tenant keeps flowing
+                    for req in batch:
+                        req.set_exception(e)
+                    continue
+                if model.is_compiled(inputs):
+                    self._dispatch_batch(batch, inputs, model)
                 else:
                     with self._inflight_cond:
                         self._compiling += 1
-                    self._compile_q.put((batch, inputs))
+                    self._compile_q.put((batch, inputs, model))
             finally:
                 # registered (in flight / parked / discarded): the
                 # shutdown drain check may stop counting it as handed
@@ -395,12 +466,12 @@ class Engine:
             item = self._compile_q.get()
             if item is _SENTINEL:
                 return
-            batch, inputs = item
+            batch, inputs, model = item
             try:
                 with obs.span("serving.compile",
                               flow=[r.flow for r in batch]):
-                    self.model.ensure_compiled(inputs)
-                self._dispatch_batch(batch, inputs)
+                    model.ensure_compiled(inputs)
+                self._dispatch_batch(batch, inputs, model)
             except BaseException as e:  # noqa: BLE001 - fail the batch
                 for req in batch:
                     req.set_exception(e)
@@ -415,12 +486,15 @@ class Engine:
         return [np.concatenate([r.inputs[i] for r in batch], axis=0)
                 for i in range(len(batch[0].inputs))]
 
-    def _dispatch_batch(self, batch: List[Request], inputs) -> None:
+    def _dispatch_batch(self, batch: List[Request], inputs,
+                        model=None) -> None:
         """Dispatch one batch asynchronously; bounded dispatch-ahead:
         at most max_in_flight batches between here and the completer."""
         from .. import obs
         from ..profiler import stat_set, timed
 
+        if model is None:
+            model = self._model_of(batch[0].tenant)
         with self._inflight_cond:
             while (len(self._inflight) >= self.config.max_in_flight
                    and not self._stop.is_set()):
@@ -431,11 +505,11 @@ class Engine:
                         EngineClosed("engine stopped before dispatch"))
                 return
         rows = inputs[0].shape[0]
-        bucket, _sig = self.model.plan(inputs)
+        bucket, _sig = model.plan(inputs)
         with obs.span("serving.dispatch",
                       flow=[r.flow for r in batch]), \
                 timed("serving_dispatch_ms"):
-            outs = self.model.run(inputs)  # async: device arrays out
+            outs = model.run(inputs)  # async: device arrays out
         metrics.observe_batch(len(batch), rows,
                               max(0, bucket - rows))
         with self._inflight_cond:
@@ -447,7 +521,8 @@ class Engine:
         """The sanctioned device->host boundary: materialize the oldest
         in-flight batch, slice per request, fulfill futures."""
         from .. import obs
-        from ..profiler import count_sync, stat_add, stat_set, timed
+        from ..profiler import (count_sync, stat_add, stat_set, time_add,
+                                timed)
 
         while True:
             with self._inflight_cond:
@@ -480,9 +555,14 @@ class Engine:
                 offset += req.rows
                 req.set_result(sl)
                 stat_add("serving_completed_total")
-                metrics.record_latency(
-                    "serving_request_ms",
-                    (now - req.submitted_at) * 1e3)
+                latency_ms = (now - req.submitted_at) * 1e3
+                metrics.record_latency("serving_request_ms", latency_ms)
+                if req.tenant is not None:
+                    stat_add(metrics.tenant_stat(
+                        req.tenant, "completed_total"))
+                    name = metrics.tenant_stat(req.tenant, "request_ms")
+                    time_add(name, latency_ms)
+                    metrics.record_latency(name, latency_ms)
 
     # -- introspection -----------------------------------------------------
     @property

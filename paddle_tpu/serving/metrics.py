@@ -27,6 +27,24 @@ Int stats (get_int_stats):
 | serving_prefill_count         | prefill dispatches (autoregressive)     |
 | serving_decode_steps          | decode-step dispatches (autoregressive) |
 
+Per-tenant series (multi-tenant fleet, serving/registry.py): every
+registered model `<t>` gets its own family, written via
+`tenant_stat(t, suffix)` so the names stay collector-foldable
+(`serving_tenant_<t>_<suffix>`); the watchdog's
+`tenant_rejection_spike` rule scans exactly this namespace:
+
+| stat                                | meaning                              |
+|-------------------------------------|--------------------------------------|
+| serving_tenant_<t>_requests_total   | requests admitted for tenant t       |
+| serving_tenant_<t>_rejected_total   | tenant-quota rejections for t        |
+| serving_tenant_<t>_completed_total  | requests answered for tenant t       |
+| serving_tenant_<t>_queued           | gauge: t's requests currently queued |
+| serving_tenant_<t>_cache_evictions  | t's per-model compile-cache evictions|
+
+Per-tenant timers: `serving_tenant_<t>_request_ms` (summed
+submit->response latency; the same name also feeds a host-side
+latency reservoir for per-tenant p50/p99 via `latency_stats`).
+
 Time stats (get_time_stats, milliseconds):
 
 | timer                | meaning                                        |
@@ -44,11 +62,23 @@ per metric name, drained by `latency_stats()` for bench.py's p50/p99.
 
 from __future__ import annotations
 
+import re
 import threading
 from collections import deque
 from typing import Dict, Optional
 
 from ..profiler import stat_add, stat_set
+
+_TENANT_SAFE = re.compile(r"[^0-9A-Za-z_]")
+
+
+def tenant_stat(tenant: str, suffix: str) -> str:
+    """Stat name for one tenant's series: `serving_tenant_<t>_<suffix>`
+    with the tenant name sanitized to the profiler's identifier
+    alphabet (the telemetry collector folds every profiler stat into a
+    series, so these names ARE the /metrics per-tenant surface)."""
+    return f"serving_tenant_{_TENANT_SAFE.sub('_', str(tenant))}_{suffix}"
+
 
 _CAP = 8192
 _LAT: Dict[str, deque] = {}

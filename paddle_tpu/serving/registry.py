@@ -1,0 +1,326 @@
+"""Multi-tenant model fleet: N named models, one device, one Engine.
+
+`ModelRegistry` owns a single continuous-batching `Engine`
+(serving/engine.py) and multiplexes any number of NAMED models through
+its one dispatch pipeline.  The sharing contract:
+
+  * admission is per-tenant first, global second: a tenant at its
+    `quota` gets `EngineOverloaded` immediately — it can never
+    queue-squat the shared queue and starve its neighbours;
+  * scheduling is priority + aging: the batcher picks the queued
+    request with the highest `priority + waited_ms / aging_ms`, so a
+    low-priority tenant under a high-priority flood still wins once it
+    has waited long enough (starvation freedom, not strict priority);
+  * batches never mix tenants (the batcher groups by
+    (tenant, signature)), so one tenant's shapes never poison
+    another's bucket ladder;
+  * register/unregister/hot-swap are LIVE: requests already dispatched
+    complete against the model object they resolved, everything after
+    the swap sees the new one, and other tenants never drain or pause;
+  * each runner-backed tenant gets its OWN bounded `CompileCache`
+    whose eviction hook releases the executable's bytes back to the
+    memprof ledger (`serving.<tenant>.compile_cache` entries in
+    `obs.memory_ledger()`) — one tenant's churn can evict only its own
+    entries, never a neighbour's;
+  * every tenant exports its own `/metrics` family
+    (`serving_tenant_<t>_*`, serving/metrics.py) and the watchdog's
+    `tenant_rejection_spike` rule watches exactly those series.
+
+Cold starts ride the persistent AOT executable cache
+(fluid/aot_cache.py): ProgramModel tenants are covered by the executor
+seam automatically; runner-backed tenants persist their bucket
+executables when registered with a stable `aot_token` (pass the same
+token across processes to skip recompilation entirely).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..fluid.compile_cache import CompileCache
+from .engine import Engine, EngineConfig, ProgramModel, _as_model, \
+    _RunnerModel
+
+__all__ = ["ModelRegistry", "active_tenants"]
+
+# process-wide view of who is serving right now, for flight-recorder
+# bundle meta (obs/__init__.py stamps it into reason.json so an
+# incident bundle says WHICH tenants shared the device at dump time)
+_ACTIVE_LOCK = threading.Lock()
+_ACTIVE: Dict[int, "ModelRegistry"] = {}
+
+
+def active_tenants() -> List[str]:
+    """Sorted union of tenant names across live registries."""
+    with _ACTIVE_LOCK:
+        regs = list(_ACTIVE.values())
+    names: set = set()
+    for reg in regs:
+        names.update(reg.model_names())
+    return sorted(names)
+
+
+def _executable_bytes(entry) -> int:
+    """Device/host footprint of one compiled entry, for eviction
+    accounting.  Duck-typed on memory_analysis() (same fields memprof
+    reads); code size is the floor so the ledger never records a
+    zero-byte executable."""
+    try:
+        ma = entry.memory_analysis()
+        n = int(getattr(ma, "temp_size_in_bytes", 0) or 0) \
+            + int(getattr(ma, "output_size_in_bytes", 0) or 0) \
+            + int(getattr(ma, "generated_code_size_in_bytes", 0) or 0)
+        if n > 0:
+            return n
+    except Exception:  # noqa: BLE001 - accounting, not control
+        pass
+    return 1024  # unknown backend: nominal floor, keeps ledger moving
+
+
+class _TenantCache(CompileCache):
+    """Per-tenant bounded compile cache with byte-accurate eviction.
+
+    put() charges the executable's bytes to the tenant's memprof
+    ledger entry; eviction (LRU overflow or drain()) releases them and
+    bumps both the shared `compile_cache_evicted_bytes` stat and the
+    tenant's `serving_tenant_<t>_cache_evictions` series.  Isolation
+    is structural: this cache only ever holds ONE tenant's entries, so
+    cross-model eviction cannot happen."""
+
+    def __init__(self, capacity: int, tenant: str):
+        super().__init__(capacity, stat_prefix="serving",
+                         on_evict=self._evicted)
+        self._tenant = tenant
+        self._ledger_name = f"serving.{tenant}.compile_cache"
+        self._sizes: Dict[Any, int] = {}
+        self._sizes_lock = threading.Lock()
+
+    def put(self, key, value) -> None:
+        from ..obs import memprof
+
+        nbytes = _executable_bytes(value)
+        with self._sizes_lock:
+            old = self._sizes.get(key, 0)
+            self._sizes[key] = nbytes
+        memprof.add_entry(self._ledger_name, nbytes - old)
+        super().put(key, value)
+
+    def _evicted(self, key, value) -> None:
+        from ..obs import memprof
+        from ..profiler import stat_add
+
+        with self._sizes_lock:
+            nbytes = self._sizes.pop(key, 0)
+        memprof.add_entry(self._ledger_name, -nbytes)
+        stat_add("compile_cache_evicted_bytes", nbytes)
+        from . import metrics
+
+        stat_add(metrics.tenant_stat(self._tenant, "cache_evictions"))
+
+    def drain(self) -> None:
+        """Release EVERYTHING (tenant unregistered).  CompileCache
+        .clear() skips on_evict by design (reset semantics); a tenant
+        teardown must actually give the bytes back."""
+        for key, value in self.items():
+            self._evicted(key, value)
+        self.clear()
+
+
+class _Tenant:
+    __slots__ = ("name", "model", "cache", "quota", "priority")
+
+    def __init__(self, name, model, cache, quota, priority):
+        self.name = name
+        self.model = model
+        self.cache = cache
+        self.quota = quota
+        self.priority = priority
+
+
+class ModelRegistry:
+    """N named models sharing one device through one Engine.
+
+    >>> reg = ModelRegistry()
+    >>> reg.register("ranker", fn_a, quota=8, priority=1.0)
+    >>> reg.register("embedder", fn_b, quota=32)
+    >>> out = reg.infer("ranker", [x])
+    >>> reg.register("ranker", fn_a_v2, quota=8)   # live hot-swap
+    >>> reg.unregister("embedder")
+
+    Pass an existing `engine` to co-locate the fleet with a default
+    (anonymous) model; otherwise the registry owns a model-less Engine
+    and shuts it down in close().
+    """
+
+    def __init__(self, config: Optional[EngineConfig] = None,
+                 engine: Optional[Engine] = None):
+        self._engine = engine if engine is not None \
+            else Engine(model=None, config=config)
+        self._owns_engine = engine is None
+        self._lock = threading.RLock()
+        self._tenants: Dict[str, _Tenant] = {}
+        self._closed = False
+        with _ACTIVE_LOCK:
+            _ACTIVE[id(self)] = self
+
+    @property
+    def engine(self) -> Engine:
+        return self._engine
+
+    # -- fleet membership --------------------------------------------------
+    def register(self, name: str, model, quota: Optional[int] = None,
+                 priority: float = 0.0,
+                 cache_capacity: Optional[int] = None,
+                 aot_token: Optional[str] = None):
+        """Register (or hot-swap) a named model.  LIVE: no drain, no
+        pause for any tenant — including the one being swapped.
+
+        quota           max queued requests for this tenant
+                        (EngineOverloaded beyond it; None = unbounded
+                        up to the engine's global queue bound)
+        priority        base scheduling priority (aged by wait time)
+        cache_capacity  this tenant's bucket-entry budget (runner
+                        models; LRU-evicts with byte release beyond it)
+        aot_token       stable cross-process identity for the
+                        persistent AOT cache (runner models; None =
+                        no disk persistence for this tenant's buckets.
+                        ProgramModel tenants need none — the executor
+                        seam keys off the program itself)
+        """
+        name = str(name)
+        wrapped = _as_model(model, self._engine.config)
+        cache = None
+        if isinstance(wrapped, _RunnerModel):
+            cap = int(cache_capacity) if cache_capacity else \
+                wrapped.runner._cache.capacity
+            cache = _TenantCache(cap, name)
+            # the runner is freshly wrapped (or explicitly re-used);
+            # migrate anything already compiled so a re-register of
+            # the same wrapped model keeps its hot entries
+            for k, v in wrapped.runner._cache.items():
+                cache.put(k, v)
+            wrapped.runner._cache = cache
+            if aot_token is not None:
+                wrapped.runner.aot_token = str(aot_token)
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("registry is closed")
+            old = self._tenants.get(name)
+            self._tenants[name] = _Tenant(name, wrapped, cache,
+                                          quota, float(priority))
+            self._engine.add_model(name, wrapped, quota=quota,
+                                   priority=float(priority))
+            self._gauge_models()
+        if old is not None and old.cache is not None \
+                and old.cache is not cache:
+            old.cache.drain()  # swap: the replaced executables die now
+        return wrapped
+
+    def unregister(self, name: str, cancel_queued: bool = True):
+        """Remove a tenant; its queued requests are cancelled, its
+        compile-cache bytes are released, every other tenant keeps
+        serving without a hiccup."""
+        name = str(name)
+        with self._lock:
+            tenant = self._tenants.pop(name, None)
+            self._engine.remove_model(name, cancel_queued=cancel_queued)
+            self._gauge_models()
+        if tenant is not None and tenant.cache is not None:
+            tenant.cache.drain()
+        return tenant.model if tenant is not None else None
+
+    def model_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._tenants)
+
+    def __contains__(self, name) -> bool:
+        with self._lock:
+            return str(name) in self._tenants
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._tenants)
+
+    def _gauge_models(self) -> None:
+        from ..profiler import stat_set
+
+        stat_set("serving_fleet_models", len(self._tenants))
+
+    # -- request surface ---------------------------------------------------
+    def submit(self, name: str, inputs: Sequence[Any],
+               priority: float = 0.0):
+        """Queue one request for tenant `name` (see Engine.submit)."""
+        return self._engine.submit(inputs, model=str(name),
+                                   priority=priority)
+
+    def infer(self, name: str, inputs: Sequence[Any],
+              timeout: Optional[float] = None):
+        return self._engine.infer(inputs, timeout=timeout,
+                                  model=str(name))
+
+    def reload_weights(self, name: str, path: str) -> int:
+        """Hot-swap ONE tenant's parameters from a checkpoint
+        (ProgramModel tenants only; see ProgramModel.reload_weights)."""
+        with self._lock:
+            tenant = self._tenants.get(str(name))
+        if tenant is None:
+            raise KeyError(f"model {name!r} is not registered")
+        swap = getattr(tenant.model, "reload_weights", None)
+        if swap is None:
+            raise TypeError(
+                f"model {name!r} bakes its weights into the traced "
+                "computation; re-register it instead")
+        return swap(path)
+
+    # -- introspection -----------------------------------------------------
+    def stats(self, name: str) -> dict:
+        """One tenant's live series, folded from the profiler tables
+        (the exact numbers /metrics exports)."""
+        from ..profiler import get_int_stats, get_time_stats
+        from . import metrics
+
+        name = str(name)
+        ints = get_int_stats()
+        times = get_time_stats()
+        out = {}
+        for suffix in ("requests_total", "rejected_total",
+                       "completed_total", "queued", "cache_evictions"):
+            out[suffix] = ints.get(metrics.tenant_stat(name, suffix), 0)
+        out["request_ms"] = times.get(
+            metrics.tenant_stat(name, "request_ms"), 0.0)
+        lat = metrics.latency_stats(metrics.tenant_stat(name,
+                                                        "request_ms"))
+        if lat is not None:
+            out["latency"] = lat
+        with self._lock:
+            tenant = self._tenants.get(name)
+        if tenant is not None and tenant.cache is not None:
+            out["cache_entries"] = len(tenant.cache)
+        return out
+
+    def close(self, drain: bool = True) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            tenants = list(self._tenants.values())
+            self._tenants.clear()
+            self._gauge_models()
+        with _ACTIVE_LOCK:
+            _ACTIVE.pop(id(self), None)
+        if self._owns_engine:
+            self._engine.shutdown(drain=drain)
+        else:
+            for t in tenants:
+                self._engine.remove_model(t.name,
+                                          cancel_queued=not drain)
+        for t in tenants:
+            if t.cache is not None:
+                t.cache.drain()
+
+    def __enter__(self) -> "ModelRegistry":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close(drain=True)

@@ -13,6 +13,17 @@ _TPU_LANE = os.environ.get("PADDLE_TPU_TEST_LANE") == "1"
 
 if not _TPU_LANE:
     os.environ["JAX_PLATFORMS"] = "cpu"
+# hermetic persistent AOT cache (fluid/aot_cache.py): the default
+# artifacts/aot_cache dir would leak warm executables ACROSS pytest
+# runs (second run loads what the first compiled — masking compile-path
+# regressions); point it at a per-session tmp dir unless the caller
+# pinned one explicitly.  The cache stays default-ON so the suite
+# exercises the store/load seams.
+if "PADDLE_AOT_CACHE_DIR" not in os.environ:
+    import tempfile as _tempfile
+
+    os.environ["PADDLE_AOT_CACHE_DIR"] = _tempfile.mkdtemp(
+        prefix="paddle_aot_test_")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

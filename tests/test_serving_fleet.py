@@ -1,0 +1,415 @@
+"""Multi-tenant model fleet (serving/registry.py, ISSUE 17).
+
+Co-tenancy proofs: N named models share one Engine; per-tenant quotas
+reject without queue-squatting; priority aging un-starves low-priority
+tenants; register/unregister/hot-swap are live; per-tenant compile
+caches evict with byte release into the memprof ledger and never touch
+a neighbour's entries; every tenant exports its own metric family.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import obs, profiler, serving
+from paddle_tpu.serving import metrics as smetrics
+from paddle_tpu.serving.batcher import DynamicBatcher, Request
+from paddle_tpu.serving.registry import _TenantCache
+
+
+def _stat(name):
+    return profiler.get_int_stats().get(name, 0)
+
+
+def _mk_registry(**cfg_kw):
+    cfg = serving.EngineConfig(max_batch_size=8, max_queue_delay_ms=0.0,
+                               max_queue=64, **cfg_kw)
+    return serving.ModelRegistry(cfg)
+
+
+X = np.ones((2, 4), np.float32)
+
+
+class TestFleetBasics:
+    def test_three_models_route_independently(self):
+        with _mk_registry() as reg:
+            reg.register("double", lambda x: [x * 2.0], quota=16)
+            reg.register("inc", lambda x: [x + 1.0], quota=16)
+            reg.register("neg", lambda x: [-x], quota=16)
+            assert reg.model_names() == ["double", "inc", "neg"]
+            np.testing.assert_array_equal(
+                np.asarray(reg.infer("double", [X], timeout=120)[0]),
+                X * 2.0)
+            np.testing.assert_array_equal(
+                np.asarray(reg.infer("inc", [X], timeout=120)[0]),
+                X + 1.0)
+            np.testing.assert_array_equal(
+                np.asarray(reg.infer("neg", [X], timeout=120)[0]), -X)
+
+    def test_per_tenant_series_exported(self):
+        with _mk_registry() as reg:
+            reg.register("telemetry_t", lambda x: [x], quota=16)
+            reg.infer("telemetry_t", [X], timeout=120)
+            s = profiler.get_int_stats()
+            assert s.get(smetrics.tenant_stat(
+                "telemetry_t", "requests_total"), 0) >= 1
+            assert s.get(smetrics.tenant_stat(
+                "telemetry_t", "completed_total"), 0) >= 1
+            assert smetrics.latency_stats(smetrics.tenant_stat(
+                "telemetry_t", "request_ms"))["count"] >= 1
+
+    def test_tenant_series_reach_metrics_endpoint_series(self):
+        """The telemetry Collector folds EVERY profiler int stat into a
+        series — the per-tenant names ARE the /metrics surface."""
+        from paddle_tpu.obs import telemetry
+
+        with _mk_registry() as reg:
+            reg.register("scrape_t", lambda x: [x], quota=16)
+            reg.infer("scrape_t", [X], timeout=120)
+            c = telemetry.Collector(sources=telemetry.default_sources(),
+                                    sample_s=3600.0)
+            c.sample_once()
+            names = c.store.names()
+            assert smetrics.tenant_stat("scrape_t",
+                                        "requests_total") in names
+            rendered = telemetry.prometheus_text(c)
+            assert "serving_tenant_scrape_t_requests_total" in rendered
+            # the per-tenant queue depth is a LEVEL, not a counter —
+            # matched by shape since tenant names are dynamic
+            qname = smetrics.tenant_stat("scrape_t", "queued")
+            assert telemetry._is_gauge_stat(qname)
+            if qname in names:
+                assert c.store._series[qname].kind == telemetry.GAUGE
+
+    def test_unknown_model_fails_fast(self):
+        with _mk_registry() as reg:
+            reg.register("known", lambda x: [x], quota=4)
+            with pytest.raises(serving.EngineClosed):
+                reg.submit("ghost", [X])
+
+    def test_stats_view(self):
+        with _mk_registry() as reg:
+            reg.register("sv", lambda x: [x], quota=4)
+            reg.infer("sv", [X], timeout=120)
+            st = reg.stats("sv")
+            assert st["requests_total"] >= 1
+            assert st["completed_total"] >= 1
+            assert st["rejected_total"] == 0
+            assert "latency" in st
+
+    def test_bundle_meta_carries_tenants(self):
+        """Flight-recorder bundles must say WHICH tenants shared the
+        device (serving/registry.active_tenants feeds obs bundle
+        meta)."""
+        from paddle_tpu.serving.registry import active_tenants
+
+        with _mk_registry() as reg:
+            reg.register("meta_a", lambda x: [x], quota=4)
+            reg.register("meta_b", lambda x: [x * 2.0], quota=4)
+            names = active_tenants()
+            assert "meta_a" in names and "meta_b" in names
+        assert "meta_a" not in active_tenants()
+
+
+class TestQuotasAndPriority:
+    def test_over_quota_tenant_rejected_without_queue_squatting(self):
+        """quota=2 tenant: 3rd submit raises EngineOverloaded with the
+        tenant counter bumped, while a sibling tenant still admits —
+        the shared queue never filled."""
+        eng = serving.Engine(
+            config=serving.EngineConfig(max_queue=64), start=False)
+        eng.add_model("greedy", lambda x: [x], quota=2)
+        eng.add_model("polite", lambda x: [x], quota=2)
+        eng.submit([X], model="greedy")
+        eng.submit([X], model="greedy")
+        r0 = _stat(smetrics.tenant_stat("greedy", "rejected_total"))
+        with pytest.raises(serving.EngineOverloaded) as ei:
+            eng.submit([X], model="greedy")
+        assert ei.value.resource == "tenant:greedy"
+        assert ei.value.bound == 2
+        assert _stat(smetrics.tenant_stat(
+            "greedy", "rejected_total")) == r0 + 1
+        # the noisy neighbour consumed only ITS quota: the shared bound
+        # has room and the polite tenant admits instantly
+        eng.submit([X], model="polite")
+        assert eng._batcher.tenant_depth("greedy") == 2
+        assert eng._batcher.tenant_depth("polite") == 1
+
+    def test_quota_slots_return_on_dequeue(self):
+        b = DynamicBatcher(max_batch_size=8, max_queue_delay_ms=0.0)
+        b.set_tenant("t", quota=1)
+        b.submit(Request([X], tenant="t"))
+        with pytest.raises(serving.EngineOverloaded):
+            b.submit(Request([X], tenant="t"))
+        batch = b.next_batch(timeout=1.0)
+        assert [r.tenant for r in batch] == ["t"]
+        b.submit(Request([X], tenant="t"))  # slot came back
+
+    def test_batches_never_mix_tenants(self):
+        b = DynamicBatcher(max_batch_size=8, max_queue_delay_ms=0.0)
+        b.set_tenant("a", quota=None)
+        b.set_tenant("b", quota=None)
+        b.submit(Request([X], tenant="a"))
+        b.submit(Request([X], tenant="b"))
+        b.submit(Request([X], tenant="a"))
+        batch = b.next_batch(timeout=1.0)
+        assert len(set(r.tenant for r in batch)) == 1
+
+    def test_priority_wins_fresh(self):
+        b = DynamicBatcher(max_batch_size=8, max_queue_delay_ms=0.0,
+                           aging_ms=10_000.0)
+        b.set_tenant("lo", priority=0.0)
+        b.set_tenant("hi", priority=5.0)
+        b.submit(Request([X], tenant="lo"))
+        b.submit(Request([X], tenant="hi"))
+        batch = b.next_batch(timeout=1.0)
+        assert batch[0].tenant == "hi"
+
+    def test_aging_unstarves_low_priority(self):
+        """A request that waited longer than priority_gap * aging_ms
+        outbids a fresh high-priority one: starvation freedom."""
+        b = DynamicBatcher(max_batch_size=8, max_queue_delay_ms=0.0,
+                           aging_ms=5.0)
+        b.set_tenant("lo", priority=0.0)
+        b.set_tenant("hi", priority=5.0)
+        b.submit(Request([X], tenant="lo"))
+        time.sleep(0.06)  # 60ms / 5ms aging = +12 effective > 5
+        b.submit(Request([X], tenant="hi"))
+        batch = b.next_batch(timeout=1.0)
+        assert batch[0].tenant == "lo"
+
+    def test_aging_unstarves_under_continuous_flood(self):
+        """Integration: a high-priority flood plus one low-priority
+        request through a LIVE engine — the low request completes while
+        the flood is still running (aged past the fixed priority)."""
+        cfg = serving.EngineConfig(max_batch_size=4,
+                                   max_queue_delay_ms=0.0,
+                                   max_queue=256)
+        with serving.ModelRegistry(cfg) as reg:
+            reg.register("flood", lambda x: [x * 2.0], quota=None,
+                         priority=50.0)
+            reg.register("starved", lambda x: [x + 1.0], quota=None,
+                         priority=0.0)
+            reg.engine._batcher.aging_ms = 2.0
+            # warm both models so the flood loop is pure dispatch
+            reg.infer("flood", [X], timeout=120)
+            reg.infer("starved", [X], timeout=120)
+
+            stop = threading.Event()
+
+            def flooder():
+                while not stop.is_set():
+                    try:
+                        reg.submit("flood", [X])
+                    except serving.EngineOverloaded:
+                        time.sleep(0.001)
+
+            threads = [threading.Thread(target=flooder)
+                       for _ in range(2)]
+            for t in threads:
+                t.start()
+            try:
+                # the flood may hold the global queue at its bound;
+                # admission itself is allowed to bounce — starvation
+                # freedom is about what happens AFTER we're queued
+                deadline = time.time() + 10.0
+                resp = None
+                while resp is None:
+                    try:
+                        resp = reg.submit("starved", [X])
+                    except serving.EngineOverloaded:
+                        if time.time() > deadline:
+                            raise
+                        time.sleep(0.002)
+                out = resp.result(timeout=30.0)  # must NOT starve
+                np.testing.assert_array_equal(np.asarray(out[0]),
+                                              X + 1.0)
+            finally:
+                stop.set()
+                for t in threads:
+                    t.join()
+
+
+class TestLiveMembership:
+    def test_hot_swap_without_draining_sibling(self):
+        with _mk_registry() as reg:
+            reg.register("stable_t", lambda x: [x + 1.0], quota=16)
+            reg.register("swapped", lambda x: [x * 2.0], quota=16)
+            np.testing.assert_array_equal(
+                np.asarray(reg.infer("swapped", [X], timeout=120)[0]),
+                X * 2.0)
+            reg.register("swapped", lambda x: [x * 10.0], quota=16)
+            np.testing.assert_array_equal(
+                np.asarray(reg.infer("swapped", [X], timeout=120)[0]),
+                X * 10.0)
+            # the sibling never paused
+            np.testing.assert_array_equal(
+                np.asarray(reg.infer("stable_t", [X], timeout=120)[0]),
+                X + 1.0)
+
+    def test_unregister_cancels_only_that_tenant(self):
+        eng = serving.Engine(
+            config=serving.EngineConfig(max_queue=64), start=False)
+        eng.add_model("doomed", lambda x: [x], quota=8)
+        eng.add_model("survivor", lambda x: [x], quota=8)
+        doomed = [eng.submit([X], model="doomed") for _ in range(3)]
+        alive = eng.submit([X], model="survivor")
+        eng.remove_model("doomed")
+        for resp in doomed:
+            with pytest.raises(serving.RequestCancelled):
+                resp.result(timeout=1.0)
+        assert not alive.done()
+        assert eng._batcher.tenant_depth("survivor") == 1
+
+    def test_unregistered_tenant_requests_fail_not_hang(self):
+        """Race window: requests queued when their model is removed
+        with cancel_queued=False fail at dispatch resolution — the
+        dispatch loop keeps serving everyone else."""
+        with _mk_registry() as reg:
+            reg.register("vanish", lambda x: [x], quota=8)
+            reg.register("remain", lambda x: [x * 3.0], quota=8)
+            reg.infer("remain", [X], timeout=120)  # warm
+            reg.engine.remove_model("vanish", cancel_queued=False)
+            with pytest.raises(serving.EngineClosed):
+                reg.infer("vanish", [X], timeout=10.0)
+            np.testing.assert_array_equal(
+                np.asarray(reg.infer("remain", [X], timeout=120)[0]),
+                X * 3.0)
+
+
+class TestPerTenantCacheEviction:
+    def test_eviction_releases_bytes_every_time(self):
+        """capacity-1 tenant cache under signature pressure: every new
+        signature evicts the previous entry, the memprof ledger entry
+        shrinks (or vanishes) at EVERY eviction, and the shared +
+        per-tenant eviction counters advance."""
+        with _mk_registry() as reg:
+            reg.register("churn", lambda x: [x * 2.0], quota=16,
+                         cache_capacity=1)
+            ledger_name = "serving.churn.compile_cache"
+
+            def ledger_bytes():
+                return obs.memory_ledger()["entries"].get(
+                    ledger_name, 0)
+
+            widths = (4, 6, 8, 10)
+            evicted0 = _stat("compile_cache_evicted_bytes")
+            tenant0 = _stat(smetrics.tenant_stat("churn",
+                                                 "cache_evictions"))
+            peak = 0
+            for i, w in enumerate(widths):
+                x = np.ones((2, w), np.float32)
+                np.testing.assert_array_equal(
+                    np.asarray(reg.infer("churn", [x],
+                                         timeout=120)[0]), x * 2.0)
+                now = ledger_bytes()
+                assert now > 0
+                # capacity 1: the ledger never accumulates signatures —
+                # each eviction released the previous executable
+                if i > 0:
+                    assert now <= peak * 2
+                peak = max(peak, now)
+            assert _stat(smetrics.tenant_stat(
+                "churn", "cache_evictions")) >= tenant0 + len(widths) - 1
+            assert _stat("compile_cache_evicted_bytes") > evicted0
+
+    def test_no_cross_tenant_eviction(self):
+        """One tenant's churn can never evict a neighbour: per-tenant
+        caches make it structural (the victim search space IS the
+        tenant)."""
+        with _mk_registry() as reg:
+            reg.register("churner", lambda x: [x + 1.0], quota=16,
+                         cache_capacity=1)
+            reg.register("steady", lambda x: [x * 7.0], quota=16,
+                         cache_capacity=4)
+            xs = np.ones((2, 4), np.float32)
+            reg.infer("steady", [xs], timeout=120)
+            st0 = _stat(smetrics.tenant_stat("steady",
+                                             "cache_evictions"))
+            for w in (4, 6, 8, 10):
+                reg.infer("churner",
+                          [np.ones((2, w), np.float32)], timeout=120)
+            # steady's single entry is still compiled & still hot —
+            # and its eviction counter never moved
+            assert reg.stats("steady")["cache_entries"] == 1
+            assert _stat(smetrics.tenant_stat(
+                "steady", "cache_evictions")) == st0
+            np.testing.assert_array_equal(
+                np.asarray(reg.infer("steady", [xs], timeout=120)[0]),
+                xs * 7.0)
+
+    def test_serving_never_blocks_under_eviction_pressure(self):
+        """Concurrent churn on a capacity-1 cache: every request still
+        completes within its timeout (the eviction path never wedges
+        the dispatch/compiler loops)."""
+        with _mk_registry() as reg:
+            reg.register("pressure", lambda x: [x * 2.0], quota=None,
+                         cache_capacity=1)
+            errs = []
+
+            def client(seed):
+                r = np.random.RandomState(seed)
+                for _ in range(6):
+                    w = int(r.choice([4, 6, 8]))
+                    x = np.ones((2, w), np.float32)
+                    try:
+                        out = reg.infer("pressure", [x], timeout=120)
+                        np.testing.assert_array_equal(
+                            np.asarray(out[0]), x * 2.0)
+                    except Exception as e:  # noqa: BLE001
+                        errs.append(e)
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(3)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert not errs
+
+    def test_unregister_drains_cache_bytes(self):
+        with _mk_registry() as reg:
+            reg.register("drainee", lambda x: [x], quota=4,
+                         cache_capacity=4)
+            reg.infer("drainee", [X], timeout=120)
+            ledger_name = "serving.drainee.compile_cache"
+            assert obs.memory_ledger()["entries"].get(ledger_name,
+                                                      0) > 0
+            reg.unregister("drainee")
+            assert obs.memory_ledger()["entries"].get(ledger_name,
+                                                      0) == 0
+
+    def test_tenant_cache_put_get_accounting(self):
+        """_TenantCache unit: put charges the ledger, overflow evicts
+        with exact release (what the integration tests observe through
+        the registry)."""
+        from paddle_tpu.obs import memprof
+
+        class FakeExec:
+            def memory_analysis(self):
+                class MA:
+                    temp_size_in_bytes = 1000
+                    output_size_in_bytes = 24
+                    generated_code_size_in_bytes = 0
+                return MA()
+
+        cache = _TenantCache(2, "unit_t")
+        ledger = "serving.unit_t.compile_cache"
+        try:
+            cache.put("a", FakeExec())
+            assert memprof.get_entry(ledger) == 1024
+            cache.put("b", FakeExec())
+            assert memprof.get_entry(ledger) == 2048
+            e0 = _stat("compile_cache_evicted_bytes")
+            cache.put("c", FakeExec())  # evicts "a"
+            assert memprof.get_entry(ledger) == 2048
+            assert _stat("compile_cache_evicted_bytes") == e0 + 1024
+            assert _stat(smetrics.tenant_stat(
+                "unit_t", "cache_evictions")) >= 1
+            cache.drain()
+            assert memprof.get_entry(ledger) == 0
+            assert len(cache) == 0
+        finally:
+            cache.drain()

@@ -459,6 +459,31 @@ def test_numerics_on_hot_path_watchlist():
     assert "paddle_tpu/obs/numerics.py" in lint.span_leak.WATCHED
 
 
+def test_fleet_and_aot_cache_on_hot_path_watchlist():
+    """ISSUE 17: the multi-tenant fleet's admission/dispatch entry
+    points and the persistent AOT cache's load/store are lint-watched
+    — registry dispatch and quota checks run on client threads racing
+    the dispatch loop, and aot_cache load/store handle DEVICE
+    executables on compile-miss paths; both modules are also in the
+    span-leak watched set (serving/ via the directory entry,
+    fluid/aot_cache.py explicitly)."""
+    watched = set(lint.hot_path_sync.WATCHLIST)
+    for rel, qual in (
+            ("paddle_tpu/serving/batcher.py", "DynamicBatcher.submit"),
+            ("paddle_tpu/serving/batcher.py",
+             "DynamicBatcher._pop_best"),
+            ("paddle_tpu/serving/registry.py", "ModelRegistry.submit"),
+            ("paddle_tpu/serving/registry.py", "_TenantCache.put"),
+            ("paddle_tpu/serving/registry.py", "_TenantCache._evicted"),
+            ("paddle_tpu/fluid/aot_cache.py", "try_load"),
+            ("paddle_tpu/fluid/aot_cache.py", "try_store"),
+            ("paddle_tpu/fluid/aot_cache.py",
+             "compile_entry_with_cache")):
+        assert (rel, qual) in watched
+    assert "paddle_tpu/fluid/aot_cache.py" in lint.span_leak.WATCHED
+    assert "paddle_tpu/serving" in lint.span_leak.WATCHED
+
+
 def test_hot_path_rule_fires_on_unsanctioned_sync(tmp_path):
     bad = tmp_path / "paddle_tpu" / "fluid"
     bad.mkdir(parents=True)

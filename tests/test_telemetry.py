@@ -253,6 +253,49 @@ class TestWatchdogRules:
             _store(serving_rejected_total=[0, 2],
                    serving_requests_total=[0, 0]), self.CFG) is None
 
+    def test_tenant_rejection_spike_pos_neg(self):
+        # one tenant hammered past its quota: fires and NAMES it
+        msg = telemetry.rule_tenant_rejection_spike(
+            _store(serving_tenant_ranker_rejected_total=[0, 20],
+                   serving_tenant_ranker_requests_total=[0, 3]),
+            self.CFG)
+        assert msg and "'ranker'" in msg
+        # healthy tenant: plenty of traffic, rejects below the rate bar
+        assert telemetry.rule_tenant_rejection_spike(
+            _store(serving_tenant_ranker_rejected_total=[0, 6],
+                   serving_tenant_ranker_requests_total=[0, 100]),
+            self.CFG) is None
+        # trickle of rejects below the arm count never fires
+        assert telemetry.rule_tenant_rejection_spike(
+            _store(serving_tenant_ranker_rejected_total=[0, 3],
+                   serving_tenant_ranker_requests_total=[0, 0]),
+            self.CFG) is None
+        # no tenant series at all (single-model serving): rule is inert
+        assert telemetry.rule_tenant_rejection_spike(
+            _store(serving_rejected_total=[0, 50]), self.CFG) is None
+
+    def test_tenant_rule_fires_while_global_rule_stays_green(self):
+        # the fleet-wide rate averages the noisy neighbour away: 30
+        # rejects vs 1000 admitted is globally fine, but ALL 30 hit
+        # tenant "abuser" — the per-tenant rule must still name it,
+        # and of two spiking tenants it reports the WORST
+        st = _store(
+            serving_rejected_total=[0, 30],
+            serving_requests_total=[0, 1000],
+            serving_tenant_abuser_rejected_total=[0, 25],
+            serving_tenant_abuser_requests_total=[0, 2],
+            serving_tenant_bursty_rejected_total=[0, 5],
+            serving_tenant_bursty_requests_total=[0, 4],
+            serving_tenant_good_rejected_total=[0, 0],
+            serving_tenant_good_requests_total=[0, 994])
+        assert telemetry.rule_serving_rejection_spike(
+            st, self.CFG) is None
+        msg = telemetry.rule_tenant_rejection_spike(st, self.CFG)
+        assert msg and "'abuser'" in msg and "'bursty'" not in msg
+        assert ("tenant_rejection_spike",
+                telemetry.rule_tenant_rejection_spike) \
+            in telemetry.RULES
+
     def test_queue_saturation_pos_neg(self):
         assert telemetry.rule_serving_queue_saturation(
             _store(serving_queue_depth=[2, 3, 2, 3, 40]), self.CFG)
@@ -619,6 +662,29 @@ class TestTrainingAttach:
         assert obs.telemetry_handle() is h1
         obs.stop_telemetry()
         assert obs.telemetry_handle() is None
+
+    def test_bundle_meta_names_live_tenants(self, tmp_path):
+        """reason.json meta must list which tenants shared the device
+        at dump time — otherwise an incident bundle can't distinguish
+        noisy-neighbour from self-inflicted (serving/registry.py)."""
+        from paddle_tpu import serving
+
+        h = obs.start_telemetry(port=-1, sample_s=60.0,
+                                flight_dir=str(tmp_path))
+        try:
+            meta = h.watchdog.meta_cb()
+            assert "tenants" not in meta  # no fleet: key absent
+            cfg = serving.EngineConfig(max_batch_size=4,
+                                       max_queue_delay_ms=0.0)
+            with serving.ModelRegistry(cfg) as reg:
+                reg.register("ranker", lambda x: [x * 2.0], quota=8)
+                reg.register("embedder", lambda x: [x + 1.0], quota=8)
+                meta = h.watchdog.meta_cb()
+                assert meta["tenants"] == ["embedder", "ranker"]
+                assert "quant_collectives" in meta
+            assert "tenants" not in h.watchdog.meta_cb()
+        finally:
+            obs.stop_telemetry()
 
     def test_epoch_refresh_caches_merged_view(self):
         h = obs.start_telemetry(port=-1, sample_s=60.0)

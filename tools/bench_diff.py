@@ -84,6 +84,12 @@ DEFAULT_THRESHOLDS = {
     # host sync crept into the instrumented lowering.  The 5-point
     # absolute floor keeps the gate from flapping on toy-model noise.
     "numerics_overhead_pct": ("down", 0.5, 5.0),
+    # persistent AOT cache (ISSUE 17): first-dispatch latency of a
+    # fresh process with a WARM cache — a rise means warm starts
+    # stopped hitting the disk cache and fell back to full recompiles.
+    # Warn-only under cpu-fallback like everything else (CPU compile
+    # times are noisy); the 20-ms floor rides over load-time jitter.
+    "cold_start_compile_ms": ("down", 0.25, 20.0),
 }
 
 
@@ -148,6 +154,9 @@ def extract_metrics(doc: dict) -> Dict[str, float]:
     num = _get(detail, "numerics", "overhead_pct")
     if isinstance(num, (int, float)):
         out["numerics_overhead_pct"] = float(num)
+    cs = _get(detail, "fleet", "cold_start", "cold_start_compile_ms")
+    if isinstance(cs, (int, float)):
+        out["cold_start_compile_ms"] = float(cs)
     return out
 
 
@@ -262,7 +271,8 @@ def _synthetic(mfu: float, step_ms: float, transposes: int = 0,
                opt_bytes: int = 65536,
                hbm_peak: int = 1 << 30,
                numerics_pct: float = 8.0,
-               quant: str = "off") -> dict:
+               quant: str = "off",
+               cold_start_ms: float = 50.0) -> dict:
     return {
         "metric": "bert_base_pretrain_mfu",
         "value": mfu, "unit": "%", "vs_baseline": mfu / 45.0,
@@ -286,6 +296,8 @@ def _synthetic(mfu: float, step_ms: float, transposes: int = 0,
                          "grad_norm_total": 0.5},
             "obs": {"cost": {"collective_bytes":
                              {"c_allreduce_sum": coll_bytes}}},
+            "fleet": {"cold_start":
+                      {"cold_start_compile_ms": cold_start_ms}},
             "resnet50": {"metric": "resnet50_images_per_sec_per_chip",
                          "value": 1000.0,
                          "detail": {"mfu_pct": 30.0, "step_ms": 50.0,
@@ -426,7 +438,20 @@ def selftest(verbose: bool = True) -> int:
     checks.append(("equal-stamp (int8) 4x bytes growth still fires",
                    any(r["metric"] == "collective_bytes"
                        and r["regressed"] for r in rows)))
-    # 14. stale re-emitted on-chip record is warn-only
+    # 14. warm cold-start blowup fires (the persistent AOT cache
+    # stopped hitting and fresh processes recompile from scratch); a
+    # sub-floor wiggle passes (load-time jitter must not flap the gate)
+    cur_cs = _synthetic(mfu=42.0, step_ms=100.0, cold_start_ms=400.0)
+    rows = diff(base, cur_cs)
+    checks.append(("warm cold-start blowup fires",
+                   any(r["metric"] == "cold_start_compile_ms"
+                       and r["regressed"] for r in rows)))
+    cur_cs_ok = _synthetic(mfu=42.0, step_ms=100.0, cold_start_ms=60.0)
+    rows = diff(base, cur_cs_ok)
+    checks.append(("sub-floor cold-start wiggle passes",
+                   not any(r["metric"] == "cold_start_compile_ms"
+                           and r["regressed"] for r in rows)))
+    # 15. stale re-emitted on-chip record is warn-only
     stale = dict(base)
     stale["detail"] = dict(base["detail"], stale_s=1234)
     checks.append(("stale on-chip record is warn-only",

@@ -40,6 +40,43 @@ python tools/tracetool.py selftest
 echo "== perf gate: bench_diff selftest (regression detection) =="
 python tools/bench_diff.py --selftest
 
+echo "== multi-tenant fleet smoke: 2 models, restart, AOT warm start (docs/serving.md) =="
+# two named models through one ModelRegistry, then a process restart
+# against the same persistent AOT cache dir: the second process must
+# LOAD its bucket executables (aot_cache_hits >= 1), not recompile
+FLEET_DIR=$(mktemp -d /tmp/ci_fleet.XXXXXX)
+for FLEET_RUN in cold warm; do
+  PADDLE_AOT_CACHE=on PADDLE_AOT_CACHE_DIR="$FLEET_DIR" \
+  FLEET_RUN="$FLEET_RUN" python - <<'EOF'
+import os
+import numpy as np
+import jax.numpy as jnp
+from paddle_tpu import serving
+from paddle_tpu.profiler import get_int_stats
+
+reg = serving.ModelRegistry(serving.EngineConfig(max_batch_size=8))
+reg.register("ranker", lambda x: [jnp.tanh(x)], quota=16,
+             aot_token="ci-fleet-ranker")
+reg.register("scorer", lambda x: [x * 2.0], quota=16,
+             aot_token="ci-fleet-scorer")
+x = np.ones((2, 8), np.float32)
+a = reg.infer("ranker", [x], timeout=300)
+b = reg.infer("scorer", [x], timeout=300)
+assert abs(float(a[0][0, 0]) - np.tanh(1.0)) < 1e-6
+assert float(b[0][0, 0]) == 2.0
+s = get_int_stats()
+run = os.environ["FLEET_RUN"]
+print(f"fleet smoke [{run}]: aot_cache_hits={s.get('aot_cache_hits', 0)}"
+      f" misses={s.get('aot_cache_misses', 0)}"
+      f" stores={s.get('aot_cache_stores', 0)}")
+if run == "warm":
+    assert s.get("aot_cache_hits", 0) >= 1, \
+        "warm restart did not hit the persistent AOT cache"
+reg.close()
+EOF
+done
+rm -rf "$FLEET_DIR"
+
 # timeout: a wedged TPU tunnel blocks jax.devices() forever — treat a
 # hung probe as "no accelerator" and keep CI moving (rc 124 -> else)
 if timeout 90 python - <<'EOF'
