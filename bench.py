@@ -702,6 +702,7 @@ def bench_sharding(jax, jnp):
             rng = np.random.RandomState(0)
             X = rng.rand(32, 64).astype("float32")
             L = rng.randint(0, 8, (32, 1)).astype("int64")
+            pre = profiler.get_int_stats()
             for _ in range(3):
                 out = exe.run(compiled, feed={"x": X, "label": L},
                               fetch_list=[loss])
@@ -727,6 +728,23 @@ def bench_sharding(jax, jnp):
                             if k.startswith("collective_bytes_spmd_"))
             from paddle_tpu.parallel import quant_collectives as qc
 
+            # static predicted wire bytes (ISSUE 18): comm_report on
+            # the same program/mesh vs the measured counter delta (the
+            # spmd counters book once per compile, not per step) —
+            # err_pct drift is gated by tools/bench_diff.py
+            measured = sum(
+                v - pre.get(k, 0) for k, v in stats.items()
+                if k.startswith("collective_bytes_spmd_"))
+            try:
+                from paddle_tpu.analysis import comm_report
+                rep = comm_report(main, axes, batch_rows=32,
+                                  feed=["x", "label"])
+                predicted = int(rep["predicted_total"])
+            except Exception:
+                predicted = 0
+            err_pct = (abs(predicted - measured) / measured * 100.0
+                       if measured > 0 else 0.0)
+
             return {
                 "mesh_axes": axes,
                 "devices": n_dev,
@@ -737,6 +755,12 @@ def bench_sharding(jax, jnp):
                 # flag stamp: tools/bench_diff.py treats a stamp flip as
                 # a deliberate collective_bytes baseline reset
                 "quant_collectives": qc.mode(),
+                "predicted_collective_bytes": predicted,
+                "prediction": {
+                    "predicted_total": predicted,
+                    "measured_total": int(measured),
+                    "err_pct": round(err_pct, 2),
+                },
                 "loss": float(np.asarray(out[0]).reshape(-1)[0]),
             }
     finally:

@@ -15,8 +15,11 @@ Three halves:
   via an abstract interpreter with loop-carried-var widening, and
   `cross-program-collective-order` diffs collective issue-order
   signatures across programs in one clone family (train step vs eval
-  clone on the same mesh).  Importing this package registers both in
-  the verifier pipeline.
+  clone on the same mesh).  `analysis.shard_check` (ISSUE 18) adds
+  `shard-consistency`: GSPMD-style PartitionSpec propagation under the
+  current mesh with predicted collective cost (`comm_report`) and the
+  elastic re-shard precheck (`feasibility`).  Importing this package
+  registers all of them in the verifier pipeline.
 * `analysis.lint` — tpulint, the multi-rule source lint framework
   (hot-path sync discipline, serving lock order, untraced jit side
   effects), driven by `tools/tpulint.py` / `tools/run_lints.py` and
@@ -37,6 +40,9 @@ from .shape_check import (FALLBACK_SHAPE_RULES, ShapeInferBail,  # noqa: F401
 from .collective_order import (collective_signature,  # noqa: F401
                                reset_ring_registry,
                                ring_registry_snapshot)
+from . import shard_check  # noqa: F401  (registers shard-consistency)
+from .shard_check import (ShardAnalysis, comm_report,  # noqa: F401
+                          feasibility, propagated_shapes)
 
 __all__ = [
     "ERROR", "WARNING", "INFO", "Finding", "ProgramVerificationError",
@@ -47,4 +53,6 @@ __all__ = [
     "log_bailout_once",
     "collective_signature", "reset_ring_registry",
     "ring_registry_snapshot",
+    "ShardAnalysis", "comm_report", "feasibility",
+    "propagated_shapes", "shard_check",
 ]

@@ -157,6 +157,14 @@ WATCHLIST: List[Tuple[str, str]] = [
     ("paddle_tpu/obs/numerics.py", "drain"),
     ("paddle_tpu/obs/numerics.py", "health_gauges"),
     ("paddle_tpu/obs/numerics.py", "bisect_nonfinite"),
+    # static sharding analyzer (ISSUE 18): the shard-consistency pass
+    # runs on the compile path (once per cache miss) and comm_report /
+    # the checker walk are pure host-side graph interpretation — a
+    # device materialization here would charge every compile a sync
+    ("paddle_tpu/analysis/shard_check.py", "shard_consistency_pass"),
+    ("paddle_tpu/analysis/shard_check.py", "_ShardChecker.run"),
+    ("paddle_tpu/analysis/shard_check.py", "comm_report"),
+    ("paddle_tpu/analysis/shard_check.py", "feasibility"),
 ]
 
 # blocking / transferring constructs that must not appear unsanctioned

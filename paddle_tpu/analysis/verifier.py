@@ -552,6 +552,32 @@ def check_partition_specs(ctx: VerifyContext) -> List[Finding]:
         return []
     prog = ctx.program
     overrides = spec_layout.registered_specs()
+
+    # post-propagation shapes (ISSUE 18 satellite): a var whose shape a
+    # transform rewrote is validated against what actually flows, not
+    # the stale declared metadata.  Computed lazily — only when some
+    # var carries a spec to check.
+    _prop: Dict[str, tuple] = {}
+    _prop_done = [False]
+
+    def actual_shape(v, declared: tuple) -> tuple:
+        if not _prop_done[0]:
+            _prop_done[0] = True
+            try:
+                from . import shard_check
+                _prop.update(shard_check.propagated_shapes(prog))
+            except Exception:  # noqa: BLE001 - degrade to declared
+                pass
+        got = _prop.get(v.name)
+        if got is None:
+            return declared
+        shape = got[0]
+        if shape is None or len(shape) != len(declared):
+            return declared
+        # keep declared dims where propagation went symbolic
+        return tuple(d if p in (-1, None) else int(p)
+                     for p, d in zip(shape, declared))
+
     out = []
     seen: Set[str] = set()
     for blk in prog.blocks:
@@ -560,6 +586,9 @@ def check_partition_specs(ctx: VerifyContext) -> List[Finding]:
                 continue
             seen.add(name)
             shape = tuple(int(s) for s in (v.shape or ()))
+            if shape and (name in overrides
+                          or getattr(v, "_sharding_axes", None)):
+                shape = actual_shape(v, shape)
             problems: List[str] = []
             if name in overrides:
                 problems = spec_layout.validate_spec(
@@ -597,7 +626,19 @@ def check_partition_specs(ctx: VerifyContext) -> List[Finding]:
                     WARNING, "partition-spec",
                     f"partition spec for {name!r} degrades to "
                     f"replicated: {p}", block=blk, op=op, var=name))
-    return out
+    # repeated verifications of one program version (eval clones,
+    # cache-miss storms) re-reported identical misfits on every run —
+    # dedup through the same registry as the warn-mode fix, cleared by
+    # reset_finding_dedup()
+    if len(_REPORTED) > _MAX_REPORTED:
+        _REPORTED.clear()
+    fresh = []
+    for f in out:
+        key = _finding_key(prog, f)
+        if key not in _REPORTED:
+            _REPORTED.add(key)
+            fresh.append(f)
+    return fresh
 
 
 # ---------------------------------------------------------------------------
@@ -702,9 +743,27 @@ def maybe_verify_program(program, feed_names=None, fetch_names=None,
                                   fetch_list=fetch_names, scope=scope,
                                   donated=donated, tiers=(ERROR,))
         errors = [f for f in findings if f.severity == ERROR]
+        warns = [f for f in findings if f.severity == WARNING]
         stat_add("verifier_runs")
         if errors:
             stat_add("verifier_errors", len(errors))
+        if warns:
+            # ERROR-tier passes may emit WARNING-severity findings
+            # (shard-consistency clamps / resharding predictions);
+            # previously these were silently dropped here
+            stat_add("verifier_warnings", len(warns))
+    if warns and len(_REPORTED) <= _MAX_REPORTED:
+        fresh_warns = []
+        for f in warns:
+            key = _finding_key(program, f)
+            if key not in _REPORTED:
+                _REPORTED.add(key)
+                fresh_warns.append(f)
+        if fresh_warns:
+            import logging
+            logging.getLogger("paddle_tpu.verifier").warning(
+                "program verifier warnings:\n%s",
+                "\n".join(f"  {f}" for f in fresh_warns))
     if not errors:
         return
     if mode in ("warn", "warning"):

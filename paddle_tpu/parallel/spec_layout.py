@@ -26,19 +26,31 @@ Optimizer accumulators are named `<param>_<acc>_<n>` (e.g.
 moments their parameter's layout — that IS the ZeRO optimizer-state
 sharding: per-device optimizer bytes scale down by the fsdp(×tp)
 extent with XLA SPMD materializing the reduce-scatter/all-gather.
+
+The rule logic itself lives in `spec_rules.py` (stdlib-only, plain
+tuples + `{axis: size}` dicts) so the static sharding analyzer and the
+jax-free shardcheck CLI resolve the exact same layouts; this module is
+the jax adapter.  An explicit spec (override or annotation) that the
+mesh cannot carry is no longer a *silent* degrade: each clamp bumps the
+`spec_clamped` profiler stat, logs once per var name, and surfaces as a
+WARNING through the shard-consistency verifier pass.
 """
 
 from __future__ import annotations
 
-import re
+import logging
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from jax.sharding import Mesh, PartitionSpec as P
 
-DATA_AXIS = "data"
-FSDP_AXIS = "fsdp"
-TP_AXIS = "tp"
+from . import spec_rules
+
+DATA_AXIS = spec_rules.DATA_AXIS
+FSDP_AXIS = spec_rules.FSDP_AXIS
+TP_AXIS = spec_rules.TP_AXIS
+
+logger = logging.getLogger(__name__)
 
 
 @dataclass(frozen=True)
@@ -58,14 +70,13 @@ DEFAULT_LAYOUT = SpecLayout()
 # by the verifier's partition-spec pass and fitted to P() at compile.
 _OVERRIDES: Dict[str, P] = {}
 
-# name fragments that mark replicated-by-design variables: norm/bn
-# stats and scales, biases, scalar bookkeeping (Adam pow accumulators,
-# learning rate).
-_REPLICATED_PAT = re.compile(
-    r"(batch_norm|layer_norm|\bnorm\b|_norm|\bln_|\.b_0|_bias|\bbias"
-    r"|scale|beta|gamma|_mean|_variance|pow_acc|learning_rate)")
+# var names whose clamped spec has already been logged (log once per
+# name per process; the stat counts every clamp)
+_CLAMP_LOGGED: Set[str] = set()
 
-_EMBEDDING_PAT = re.compile(r"(embedding|emb_|word_emb|pos_emb|_emb\b)")
+# kept as public-ish aliases: the regexes moved to spec_rules
+_REPLICATED_PAT = spec_rules.REPLICATED_PAT
+_EMBEDDING_PAT = spec_rules.EMBEDDING_PAT
 
 
 def register_spec(var_name: str, spec) -> None:
@@ -73,121 +84,81 @@ def register_spec(var_name: str, spec) -> None:
     "tp"))`.  Pass None to clear one name."""
     if spec is None:
         _OVERRIDES.pop(var_name, None)
+        _CLAMP_LOGGED.discard(var_name)
     else:
         _OVERRIDES[var_name] = P(*spec) if not isinstance(spec, P) else spec
+        _CLAMP_LOGGED.discard(var_name)
 
 
 def clear_specs() -> None:
     _OVERRIDES.clear()
+    _CLAMP_LOGGED.clear()
 
 
 def registered_specs() -> Dict[str, P]:
     return dict(_OVERRIDES)
 
 
+def mesh_axes_dict(mesh) -> Dict[str, int]:
+    """`{axis_name: size}` view of a Mesh — the spec_rules currency."""
+    return {str(n): int(mesh.shape[n]) for n in mesh.axis_names}
+
+
 def _axis_size(mesh: Mesh, axis) -> int:
     """Product extent of one spec entry (str or tuple of axis names)."""
-    names = (axis,) if isinstance(axis, str) else tuple(axis)
-    size = 1
-    for n in names:
-        size *= mesh.shape[n]
-    return size
+    return spec_rules.axis_extent(mesh_axes_dict(mesh), axis)
 
 
 def validate_spec(spec, shape: Sequence[int], mesh: Mesh) -> List[str]:
     """Problem strings for a spec against a shape+mesh; empty == fits.
     Shared with the verifier's partition-spec pass."""
-    problems = []
-    entries = tuple(spec)
-    if len(entries) > len(shape):
-        problems.append(
-            f"spec {spec} has {len(entries)} entries for rank-"
-            f"{len(shape)} shape {tuple(shape)}")
-    for dim, axis in enumerate(entries):
-        if axis is None:
-            continue
-        names = (axis,) if isinstance(axis, str) else tuple(axis)
-        for n in names:
-            if n not in mesh.axis_names:
-                problems.append(
-                    f"axis {n!r} not in mesh axes {tuple(mesh.axis_names)}")
-        if any(n not in mesh.axis_names for n in names):
-            continue
-        if dim < len(shape):
-            size = _axis_size(mesh, axis)
-            if shape[dim] % size != 0:
-                problems.append(
-                    f"dim {dim} of size {shape[dim]} not divisible by "
-                    f"{axis!r} extent {size}")
-    return problems
+    return spec_rules.validate_entries(
+        tuple(spec), shape, mesh_axes_dict(mesh), spec_repr=str(spec))
+
+
+def _note_clamps(name: str, clamps: Sequence[str], mesh: Mesh) -> None:
+    """Book one explicit-spec degrade: `spec_clamped` stat per clamp,
+    one log line per var name (today a typo'd register_spec would just
+    silently replicate — now it shows up in stats, logs, and as a
+    shard-consistency WARNING)."""
+    if not clamps:
+        return
+    try:
+        from ..profiler import stat_add
+        stat_add("spec_clamped", len(clamps))
+    except Exception:
+        pass
+    if name not in _CLAMP_LOGGED:
+        _CLAMP_LOGGED.add(name)
+        logger.warning(
+            "partition spec for %r clamped on mesh %s: %s",
+            name, mesh_axes_dict(mesh), "; ".join(clamps))
 
 
 def _fit(spec, shape: Sequence[int], mesh: Mesh) -> P:
     """Clamp a spec to what the mesh+shape can actually carry: drop
     entries naming absent axes or not dividing their dim."""
-    out = []
-    for dim, axis in enumerate(tuple(spec)):
-        if axis is None or dim >= len(shape):
-            out.append(None)
-            continue
-        names = (axis,) if isinstance(axis, str) else tuple(axis)
-        ok = all(n in mesh.axis_names for n in names)
-        if ok and shape[dim] % _axis_size(mesh, axis) == 0:
-            out.append(axis)
-        else:
-            out.append(None)
-    while out and out[-1] is None:
-        out.pop()
-    return P(*out)
+    fitted, _ = spec_rules.fit_entries(
+        tuple(spec), shape, mesh_axes_dict(mesh))
+    return P(*fitted)
 
 
 def _annotation_spec(axes: Sequence[str], shape: Sequence[int],
                      mesh: Mesh) -> Optional[P]:
     """ZeRO `_sharding_axes` annotation: dim 0 over the first annotated
     axis present in the mesh that divides it."""
-    if not shape or len(shape) < 1 or shape[0] <= 1:
-        return None
-    for ax in axes:
-        if ax in mesh.axis_names and shape[0] % mesh.shape[ax] == 0:
-            return P(ax)
-    return None
+    entries = spec_rules.annotation_entries(
+        axes, tuple(int(s) for s in (shape or ())), mesh_axes_dict(mesh))
+    return None if entries is None else P(*entries)
 
 
 def _pattern_spec(name: str, shape: Sequence[int], mesh: Mesh,
                   layout: SpecLayout) -> P:
     """Name-pattern rule table (SNIPPETS [1]): active only on meshes
     that carry an fsdp or tp axis."""
-    fsdp, tp = layout.fsdp_axis, layout.tp_axis
-    has_fsdp = fsdp in mesh.axis_names
-    has_tp = tp in mesh.axis_names
-    if not (has_fsdp or has_tp):
-        return P()
-    ndim = len(shape)
-    if ndim == 0 or (ndim >= 1 and shape[0] <= 1 and ndim == 1):
-        return P()
-    if _REPLICATED_PAT.search(name):
-        return P()
-    if ndim == 4:
-        # conv kernels: replicated (spatial dims don't shard usefully
-        # at these sizes; the batch dim carries the parallelism)
-        return P()
-    if ndim == 2:
-        if _EMBEDDING_PAT.search(name):
-            # vocab dim over fsdp×tp when both divide; degrade to fsdp
-            if has_fsdp and has_tp:
-                fitted = _fit(P((fsdp, tp)), shape, mesh)
-                if tuple(fitted):
-                    return fitted
-            return _fit(P(fsdp if has_fsdp else tp), shape, mesh)
-        # dense weights: row-split (dim 0) over fsdp, col-split (dim 1)
-        # over tp — the qkv/ffn layout; _fit drops whichever doesn't
-        # divide
-        return _fit(P(fsdp if has_fsdp else None,
-                      tp if has_tp else None), shape, mesh)
-    # rank-1 / rank-3+: dim-0 over fsdp when it divides
-    if has_fsdp:
-        return _fit(P(fsdp), shape, mesh)
-    return P()
+    return P(*spec_rules.pattern_entries(
+        name, tuple(int(s) for s in (shape or ())), mesh_axes_dict(mesh),
+        fsdp_axis=layout.fsdp_axis, tp_axis=layout.tp_axis))
 
 
 def spec_for(name: str, shape: Sequence[int], mesh: Mesh, var=None,
@@ -197,14 +168,14 @@ def spec_for(name: str, shape: Sequence[int], mesh: Mesh, var=None,
     present.  Always returns a spec that FITS the mesh (the verifier
     reports misfits; the compiler never crashes on them)."""
     shape = tuple(int(s) for s in (shape or ()))
-    if name in _OVERRIDES:
-        return _fit(_OVERRIDES[name], shape, mesh)
     axes = getattr(var, "_sharding_axes", None) if var is not None else None
-    if axes:
-        spec = _annotation_spec(axes, shape, mesh)
-        if spec is not None:
-            return spec
-    return _pattern_spec(name, shape, mesh, layout)
+    entries, clamps = spec_rules.resolve_entries(
+        name, shape, mesh_axes_dict(mesh),
+        override=(tuple(_OVERRIDES[name]) if name in _OVERRIDES else None),
+        annotation=tuple(axes) if axes else None,
+        fsdp_axis=layout.fsdp_axis, tp_axis=layout.tp_axis)
+    _note_clamps(name, clamps, mesh)
+    return P(*entries)
 
 
 def spec_to_json(spec) -> Optional[list]:

@@ -1,0 +1,124 @@
+"""Deliberately mis-sharded programs (ISSUE 18 fault-injection
+harness).
+
+Each case below re-creates a real layout-bug class the
+shard-consistency verifier pass (analysis/shard_check.py) exists to
+catch, as a plain `Program.to_dict()`-shaped dict (the same currency
+tools/shardcheck.py consumes, so every case also works jax-free):
+
+* `axis_reused_in_override` — a `register_spec` override naming one
+  mesh axis on two dims of the same tensor (a spec no mesh can carry);
+* `nondividing_after_reshape` — a weight whose pattern-rule shard is
+  legal at declaration but stops dividing after a reshape carries it
+  onto a smaller dim;
+* `collective_on_absent_axis` — an explicit c_allreduce_sum whose ring
+  resolves to an axis the active mesh does not have (the classic
+  "works on the 2-D mesh, crashes on the tp-only mesh" bug);
+* `oversized_replicated_weight` — a multi-MB parameter that every
+  device holds in full because nothing shards it (WARNING tier: legal,
+  but the ZeRO memory win silently evaporated).
+
+Tests iterate BROKEN_SHARDINGS; each entry carries the mesh to analyze
+under, any spec_layout overrides to register first, and the
+severity + message substring the analyzer must report with
+`program#<id> block<idx> op<id>` provenance.
+"""
+
+
+def _axis_reuse():
+    return {
+        "blocks": [{
+            "idx": 0, "parent_idx": -1,
+            "vars": [
+                {"name": "x", "shape": [8, 16], "dtype": "float32",
+                 "is_data": True},
+                {"name": "dup_0.w_0", "shape": [16, 32],
+                 "dtype": "float32", "persistable": True},
+                {"name": "y", "shape": [8, 32], "dtype": "float32"},
+            ],
+            "ops": [{
+                "id": 1, "type": "mul",
+                "inputs": {"X": ["x"], "Y": ["dup_0.w_0"]},
+                "outputs": {"Out": ["y"]}, "attrs": {},
+            }],
+        }],
+    }
+
+
+def _nondividing_after_reshape():
+    return {
+        "blocks": [{
+            "idx": 0, "parent_idx": -1,
+            "vars": [
+                {"name": "fc_9.w_0", "shape": [6, 4],
+                 "dtype": "float32", "persistable": True},
+                {"name": "w2", "shape": [3, 8], "dtype": "float32"},
+            ],
+            "ops": [{
+                "id": 1, "type": "reshape2",
+                "inputs": {"X": ["fc_9.w_0"]},
+                "outputs": {"Out": ["w2"]},
+                "attrs": {"shape": [3, 8]},
+            }],
+        }],
+    }
+
+
+def _collective_on_absent_axis():
+    return {
+        "blocks": [{
+            "idx": 0, "parent_idx": -1,
+            "vars": [
+                {"name": "g", "shape": [8, 4], "dtype": "float32",
+                 "is_data": True},
+                {"name": "g_sum", "shape": [8, 4],
+                 "dtype": "float32"},
+            ],
+            "ops": [{
+                "id": 1, "type": "c_allreduce_sum",
+                "inputs": {"X": ["g"]}, "outputs": {"Out": ["g_sum"]},
+                "attrs": {"ring_id": 0},
+            }],
+        }],
+    }
+
+
+def _oversized_replicated_weight():
+    # (1024, 512) float32 = 2 MiB, over the 1 MiB default floor; on a
+    # pure data mesh nothing shards it, so all 8 devices hold a copy
+    return {
+        "blocks": [{
+            "idx": 0, "parent_idx": -1,
+            "vars": [
+                {"name": "x", "shape": [8, 1024], "dtype": "float32",
+                 "is_data": True},
+                {"name": "fc_big.w_0", "shape": [1024, 512],
+                 "dtype": "float32", "persistable": True},
+                {"name": "y", "shape": [8, 512], "dtype": "float32"},
+            ],
+            "ops": [{
+                "id": 1, "type": "mul",
+                "inputs": {"X": ["x"], "Y": ["fc_big.w_0"]},
+                "outputs": {"Out": ["y"]}, "attrs": {},
+            }],
+        }],
+    }
+
+
+# name -> (builder, mesh_axes, spec_layout overrides to register,
+#          expected severity, expected message substring)
+BROKEN_SHARDINGS = {
+    "axis_reused_in_override": (
+        _axis_reuse, {"data": 2, "fsdp": 2, "tp": 2},
+        {"dup_0.w_0": ("fsdp", "fsdp")},
+        "error", "used twice"),
+    "nondividing_after_reshape": (
+        _nondividing_after_reshape, {"fsdp": 2, "tp": 4}, {},
+        "error", "not divisible"),
+    "collective_on_absent_axis": (
+        _collective_on_absent_axis, {"tp": 8}, {},
+        "error", "absent from mesh axes"),
+    "oversized_replicated_weight": (
+        _oversized_replicated_weight, {"data": 8}, {},
+        "warning", "fully replicated"),
+}

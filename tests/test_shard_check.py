@@ -1,0 +1,318 @@
+"""Static sharding analyzer (ISSUE 18): PartitionSpec propagation as a
+verifier pass, predicted collective cost, and re-shard feasibility.
+
+Positive sweep: the shard-consistency analyzer reports zero ERROR
+findings over the fixture + book-model zoos under a pure data mesh,
+the 3-D acceptance mesh, and a degenerate-pipe mesh.  Negative sweep:
+each mis-sharded program in tests/fixtures/broken_shardings.py draws
+its finding with `program#<id> block<idx> op<id>` provenance.  Cost
+model: `comm_report` predicts the SPMD-inserted collective wire bytes
+of the acceptance transformer within ±25% of the measured
+`collective_bytes_spmd_*` counters, quant off AND int8.  Elastic:
+`feasibility` refuses a 16-row batch onto a 3-device mesh and accepts
+8→4 with a bytes-per-device delta.  Hot path: cache-hit steps pay zero
+verifier time with a mesh current (the pass rides the existing
+compile-miss seam).  Registry: a typo'd `register_spec` bumps the
+`spec_clamped` stat instead of degrading silently."""
+
+import os
+import re
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import profiler
+from paddle_tpu.analysis import comm_report, feasibility, shard_check
+from paddle_tpu.analysis.verifier import reset_finding_dedup
+from paddle_tpu.fluid import framework, unique_name
+from paddle_tpu.fluid.executor import Scope, scope_guard
+from paddle_tpu.parallel import mesh as mesh_lib
+from paddle_tpu.parallel import spec_layout
+from jax.sharding import PartitionSpec as P
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import test_book_models as book  # noqa: E402
+from fixtures import programs as fixture_programs  # noqa: E402
+from fixtures.broken_shardings import BROKEN_SHARDINGS  # noqa: E402
+from test_spmd_sharding import build_tiny_transformer  # noqa: E402
+
+_PROVENANCE = re.compile(r"program#\d+ block\d+ op\d+")
+
+SWEEP_MESHES = (
+    {"data": 8},
+    {"data": 2, "fsdp": 2, "tp": 2},
+    {"data": 2, "fsdp": 2, "tp": 2, "pipe": 1},
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_context():
+    saved = os.environ.get("PADDLE_QUANT_COLLECTIVES")
+    yield
+    if saved is None:
+        os.environ.pop("PADDLE_QUANT_COLLECTIVES", None)
+    else:
+        os.environ["PADDLE_QUANT_COLLECTIVES"] = saved
+    mesh_lib.set_current_mesh(None)
+    spec_layout.clear_specs()
+    reset_finding_dedup()
+
+
+def _errors(findings):
+    return [f for f in findings if f.severity == "error"]
+
+
+# ---------------------------------------------------------------------------
+# negative sweep: every broken-sharding fixture fires, with provenance
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", sorted(BROKEN_SHARDINGS))
+def test_broken_sharding_fires_with_provenance(name):
+    build, mesh, overrides, severity, substr = BROKEN_SHARDINGS[name]
+    for var, entries in overrides.items():
+        spec_layout.register_spec(var, P(*entries))
+    try:
+        findings = shard_check.check_program_dict(build(), mesh)
+    finally:
+        spec_layout.clear_specs()
+    hits = [f for f in findings
+            if f.severity == severity and substr in f.message]
+    assert hits, (name, [str(f) for f in findings])
+    for f in hits:
+        assert _PROVENANCE.search(f.location), (name, f.location)
+
+
+def test_clean_program_has_no_findings_under_every_sweep_mesh():
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup), unique_name.guard():
+        loss = build_tiny_transformer()
+        fluid.optimizer.Adam(0.01).minimize(loss)
+    for mesh in SWEEP_MESHES:
+        findings = shard_check.check_program(
+            main, mesh, batch_rows=16, fetch_list=[loss.name])
+        assert not findings, (mesh, [str(f) for f in findings])
+
+
+# ---------------------------------------------------------------------------
+# positive sweep: shipped zoos are shard-clean on every mesh
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", sorted(fixture_programs.FIXTURES))
+def test_fixture_zoo_shard_clean(name):
+    main, startup, fetch = fixture_programs.FIXTURES[name]()
+    fl = [v.name if hasattr(v, "name") else str(v) for v in fetch or ()]
+    for mesh in SWEEP_MESHES:
+        for prog, f in ((main, fl), (startup, None)):
+            errs = _errors(shard_check.check_program(
+                prog, mesh, fetch_list=f))
+            assert not errs, (name, mesh, [str(e) for e in errs])
+
+
+@pytest.mark.parametrize("name", sorted(book.BOOK_BUILDERS))
+def test_book_model_zoo_shard_clean(name):
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup), unique_name.guard():
+        fetch = book.BOOK_BUILDERS[name]()
+    fl = [v.name if hasattr(v, "name") else str(v) for v in fetch or ()]
+    for mesh in SWEEP_MESHES:
+        for prog, f in ((main, fl), (startup, None)):
+            errs = _errors(shard_check.check_program(
+                prog, mesh, fetch_list=f))
+            assert not errs, (name, mesh, [str(e) for e in errs])
+
+
+# ---------------------------------------------------------------------------
+# cost model: predicted vs measured wire bytes, quant off AND int8
+# ---------------------------------------------------------------------------
+
+def _train_and_measure(axes):
+    """One compile of the acceptance transformer under `axes`;
+    returns (program, measured collective_bytes_spmd_* delta)."""
+    rng = np.random.RandomState(0)
+    IDS = rng.randint(0, 32, size=(16, 1)).astype("int64")
+    L = rng.randint(0, 8, size=(16, 1)).astype("int64")
+    main, startup = framework.Program(), framework.Program()
+    scope = Scope()
+    try:
+        with framework.program_guard(main, startup), \
+                unique_name.guard(), scope_guard(scope):
+            loss = build_tiny_transformer()
+            fluid.optimizer.Adam(0.01).minimize(loss)
+            exe = fluid.Executor()
+            exe.run(startup)
+            bs = fluid.BuildStrategy()
+            bs.mesh_axes = axes
+            compiled = fluid.CompiledProgram(main).with_data_parallel(
+                loss_name=loss.name, build_strategy=bs)
+            pre = profiler.get_int_stats()
+            # the spmd counters book once per compile — one step is
+            # enough to materialize them
+            exe.run(compiled, feed={"ids": IDS, "label": L},
+                    fetch_list=[loss])
+            post = profiler.get_int_stats()
+        measured = sum(
+            v - pre.get(k, 0) for k, v in post.items()
+            if k.startswith("collective_bytes_spmd_"))
+        return main, measured
+    finally:
+        mesh_lib.set_current_mesh(None)
+
+
+@pytest.mark.parametrize("quant", [None, "int8"])
+def test_comm_report_within_25pct_of_measured(quant):
+    if quant is None:
+        os.environ.pop("PADDLE_QUANT_COLLECTIVES", None)
+    else:
+        os.environ["PADDLE_QUANT_COLLECTIVES"] = quant
+    axes = {"data": 2, "fsdp": 2, "tp": 2}
+    main, measured = _train_and_measure(axes)
+    assert measured > 0
+    rep = comm_report(main, axes, batch_rows=16,
+                      feed=["ids", "label"])
+    predicted = rep["predicted_total"]
+    assert rep["mode"] == "spmd"
+    assert bool(rep["quant"]) == (quant == "int8")
+    err = abs(predicted - measured) / measured
+    assert err <= 0.25, (quant, predicted, measured, rep["predicted"])
+
+
+def test_comm_report_explicit_regime_sums_collective_events():
+    d = {
+        "blocks": [{
+            "idx": 0, "parent_idx": -1,
+            "vars": [
+                {"name": "x", "shape": [8, 4], "dtype": "float32",
+                 "is_data": True},
+                {"name": "out", "shape": [8, 4], "dtype": "float32"},
+            ],
+            "ops": [{
+                "id": 1, "type": "c_allreduce_sum",
+                "inputs": {"X": ["x"]}, "outputs": {"Out": ["out"]},
+                "attrs": {"ring_id": 0},
+            }],
+        }],
+    }
+    rep = comm_report(shard_check.ProgramView(d), {"data": 2},
+                     feed=["x"])
+    assert rep["mode"] == "explicit"
+    assert rep["predicted_total"] > 0
+
+
+# ---------------------------------------------------------------------------
+# elastic feasibility precheck
+# ---------------------------------------------------------------------------
+
+def test_feasibility_refuses_nondividing_shrink_accepts_dividing():
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup), unique_name.guard():
+        loss = build_tiny_transformer()
+        fluid.optimizer.Adam(0.01).minimize(loss)
+
+    bad = feasibility(main, {"data": 8}, {"data": 3}, batch_rows=16)
+    assert not bad["feasible"]
+    assert any("does not divide" in p for p in bad["problems"]), bad
+
+    ok = feasibility(main, {"data": 8}, {"data": 4}, batch_rows=16)
+    assert ok["feasible"], ok["problems"]
+    assert ok["old_devices"] == 8 and ok["new_devices"] == 4
+    assert isinstance(ok["delta_bytes_per_device"], int)
+    assert ok["new_bytes_per_device"] >= ok["old_bytes_per_device"]
+
+    # growing onto the 3-D mesh shrinks resident bytes per device
+    grow = feasibility(main, {"data": 8},
+                       {"data": 2, "fsdp": 2, "tp": 2}, batch_rows=16)
+    assert grow["feasible"], grow["problems"]
+    assert grow["new_bytes_per_device"] < grow["old_bytes_per_device"]
+
+
+# ---------------------------------------------------------------------------
+# hot path: the pass rides the compile-miss seam only
+# ---------------------------------------------------------------------------
+
+def test_shard_consistency_not_paid_on_cache_hits():
+    main, startup = framework.Program(), framework.Program()
+    scope = Scope()
+    try:
+        with framework.program_guard(main, startup), \
+                unique_name.guard(), scope_guard(scope):
+            x = fluid.data("x", [-1, 8], "float32")
+            y = fluid.layers.fc(x, 4)
+            exe = fluid.Executor()
+            exe.run(startup)
+            bs = fluid.BuildStrategy()
+            bs.mesh_axes = {"data": 8}
+            compiled = fluid.CompiledProgram(main).with_data_parallel(
+                build_strategy=bs)
+            feed = {"x": np.ones((8, 8), "float32")}
+            exe.run(compiled, feed=feed, fetch_list=[y])  # miss
+            runs0 = profiler.get_int_stats().get("verifier_runs", 0)
+            ms0 = profiler.get_time_stats().get("verify_ms", 0.0)
+            assert runs0 >= 1
+            for _ in range(4):  # hits: zero verifier (and analyzer) time
+                exe.run(compiled, feed=feed, fetch_list=[y])
+            assert profiler.get_int_stats().get(
+                "verifier_runs", 0) == runs0
+            assert profiler.get_time_stats().get(
+                "verify_ms", 0.0) == ms0
+    finally:
+        mesh_lib.set_current_mesh(None)
+
+
+# ---------------------------------------------------------------------------
+# spec_layout: a typo'd override is clamped LOUDLY
+# ---------------------------------------------------------------------------
+
+def test_typod_register_spec_bumps_spec_clamped_stat():
+    mesh = mesh_lib.make_mesh({"data": 8})
+    spec_layout.register_spec("fc_7.w_0", P("bogus_axis"))
+    try:
+        before = profiler.get_int_stats().get("spec_clamped", 0)
+        spec = spec_layout.spec_for("fc_7.w_0", (16, 32), mesh)
+        after = profiler.get_int_stats().get("spec_clamped", 0)
+        assert spec == P()  # clamped to what the mesh carries
+        assert after > before
+    finally:
+        spec_layout.clear_specs()
+
+
+def test_typod_override_surfaces_as_clamp_warning():
+    d = {
+        "blocks": [{
+            "idx": 0, "parent_idx": -1,
+            "vars": [
+                {"name": "x", "shape": [8, 16], "dtype": "float32",
+                 "is_data": True},
+                {"name": "fc_7.w_0", "shape": [16, 32],
+                 "dtype": "float32", "persistable": True},
+                {"name": "y", "shape": [8, 32], "dtype": "float32"},
+            ],
+            "ops": [{
+                "id": 1, "type": "mul",
+                "inputs": {"X": ["x"], "Y": ["fc_7.w_0"]},
+                "outputs": {"Out": ["y"]}, "attrs": {},
+            }],
+        }],
+    }
+    spec_layout.register_spec("fc_7.w_0", P("bogus_axis"))
+    try:
+        findings = shard_check.check_program_dict(d, {"data": 8})
+    finally:
+        spec_layout.clear_specs()
+    warns = [f for f in findings if f.severity == "warning"
+             and "dropped" in f.message]
+    assert warns, [str(f) for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# quant byte model identities (the calibration the CLI also asserts)
+# ---------------------------------------------------------------------------
+
+def test_quant_phase_byte_formulas():
+    # 1024 elems over 4 ranks: 256-elem chunk = one 256-wide block ->
+    # 4*(256 int8 codes + 1 fp32 scale) = 1040 wire bytes per phase
+    assert shard_check._quant_phase_bytes(1024, 4) == 1040
+    # plain (ungrouped) path: 512 codes + 2 scales = 520... plus the
+    # 4-byte scale per 256-block: 512 + 2*4 = 520
+    assert shard_check._quant_plain_bytes(512) == 520

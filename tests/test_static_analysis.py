@@ -81,7 +81,8 @@ def test_book_model_zoo_verifies_clean(name):
 def test_all_passes_registered():
     names = set(registered_passes())
     assert {"op-registry", "def-before-use", "block-linkage",
-            "donation-safety", "collective-order"} <= names
+            "donation-safety", "collective-order",
+            "shard-consistency"} <= names
     assert {"dead-op", "write-never-read"} <= set(
         registered_passes(tier=WARNING))
 
@@ -482,6 +483,20 @@ def test_fleet_and_aot_cache_on_hot_path_watchlist():
         assert (rel, qual) in watched
     assert "paddle_tpu/fluid/aot_cache.py" in lint.span_leak.WATCHED
     assert "paddle_tpu/serving" in lint.span_leak.WATCHED
+
+
+def test_shard_check_on_hot_path_watchlist():
+    """ISSUE 18: the static sharding analyzer's entry points are
+    lint-watched — shard_consistency_pass runs on the compile-cache-
+    miss path inside the verifier pipeline, and run/comm_report/
+    feasibility must stay pure host-side metadata walks (the analyzer
+    predicts collective traffic, it must never CAUSE any);
+    test_shipped_tree_is_lint_clean above proves the shipped tree
+    honors it."""
+    watched = set(lint.hot_path_sync.WATCHLIST)
+    for qual in ("shard_consistency_pass", "_ShardChecker.run",
+                 "comm_report", "feasibility"):
+        assert ("paddle_tpu/analysis/shard_check.py", qual) in watched
 
 
 def test_hot_path_rule_fires_on_unsanctioned_sync(tmp_path):

@@ -90,7 +90,20 @@ DEFAULT_THRESHOLDS = {
     # Warn-only under cpu-fallback like everything else (CPU compile
     # times are noisy); the 20-ms floor rides over load-time jitter.
     "cold_start_compile_ms": ("down", 0.25, 20.0),
+    # static sharding analyzer (ISSUE 18): the comm_report prediction
+    # for the bench model is deterministic for a fixed program/mesh —
+    # a drift in predicted wire bytes means the analyzer's cost model
+    # or spec resolution changed; a rise in prediction error means it
+    # drifted away from what XLA actually inserts
+    "predicted_collective_bytes": ("down", 0.10, 1024.0),
+    "sharding_pred_err_pct": ("down", 0.5, 10.0),
 }
+
+# metrics whose value moves BY DESIGN when FLAGS_quant_collectives
+# flips: the baseline comparison is reset rather than gated
+_QUANT_RESET_METRICS = frozenset(
+    {"collective_bytes", "predicted_collective_bytes",
+     "sharding_pred_err_pct"})
 
 
 def _get(d: dict, *path, default=None):
@@ -146,6 +159,12 @@ def extract_metrics(doc: dict) -> Dict[str, float]:
     ob = _get(detail, "sharding", "optimizer_bytes_per_device")
     if isinstance(ob, (int, float)):
         out["optimizer_bytes_per_device"] = float(ob)
+    pb = _get(detail, "sharding", "predicted_collective_bytes")
+    if isinstance(pb, (int, float)) and pb > 0:
+        out["predicted_collective_bytes"] = float(pb)
+    pe = _get(detail, "sharding", "prediction", "err_pct")
+    if isinstance(pe, (int, float)):
+        out["sharding_pred_err_pct"] = float(pe)
     for mem in (_get(detail, "memory"), _get(rd, "memory")):
         hp = _get(mem or {}, "hbm_peak_bytes")
         if isinstance(hp, (int, float)) and hp > 0:
@@ -191,7 +210,7 @@ def diff(baseline: dict, current: dict,
         if name not in base_m or name not in cur_m:
             continue
         b, c = base_m[name], cur_m[name]
-        if name == "collective_bytes" and b_q != c_q:
+        if name in _QUANT_RESET_METRICS and b_q != c_q:
             # quantization-aware baseline reset (docs/spmd.md): a
             # deliberate FLAGS_quant_collectives flip moves wire bytes
             # ~4x BY DESIGN in either direction — the comparison is
@@ -272,7 +291,9 @@ def _synthetic(mfu: float, step_ms: float, transposes: int = 0,
                hbm_peak: int = 1 << 30,
                numerics_pct: float = 8.0,
                quant: str = "off",
-               cold_start_ms: float = 50.0) -> dict:
+               cold_start_ms: float = 50.0,
+               pred_bytes: int = 411720,
+               pred_err: float = 15.0) -> dict:
     return {
         "metric": "bert_base_pretrain_mfu",
         "value": mfu, "unit": "%", "vs_baseline": mfu / 45.0,
@@ -282,7 +303,11 @@ def _synthetic(mfu: float, step_ms: float, transposes: int = 0,
             "sharding": {"mesh_axes": {"data": 2, "fsdp": 2, "tp": 2},
                          "optimizer_bytes_per_device": opt_bytes,
                          "specs_applied": 6,
-                         "quant_collectives": quant},
+                         "quant_collectives": quant,
+                         "predicted_collective_bytes": pred_bytes,
+                         "prediction": {"predicted_total": pred_bytes,
+                                        "measured_total": pred_bytes,
+                                        "err_pct": pred_err}},
             "telemetry": {"sampler_overhead_ms": telemetry_ms,
                           "samples": 50, "drops": 0,
                           "rules_fired": 0},
@@ -456,6 +481,33 @@ def selftest(verbose: bool = True) -> int:
     stale["detail"] = dict(base["detail"], stale_s=1234)
     checks.append(("stale on-chip record is warn-only",
                    is_fallback(stale)))
+    # 16. static sharding prediction gates (ISSUE 18): a prediction
+    # error blowup fires (the comm_report cost model drifted away from
+    # the XLA-inserted collectives); a sub-floor wiggle passes; a
+    # predicted-bytes jump fires at an equal quant stamp but resets on
+    # a deliberate quant flip (the prediction is quant-aware)
+    cur_err = _synthetic(mfu=42.0, step_ms=100.0, pred_err=45.0)
+    rows = diff(base, cur_err)
+    checks.append(("prediction error blowup fires",
+                   any(r["metric"] == "sharding_pred_err_pct"
+                       and r["regressed"] for r in rows)))
+    cur_err_ok = _synthetic(mfu=42.0, step_ms=100.0, pred_err=19.0)
+    rows = diff(base, cur_err_ok)
+    checks.append(("sub-floor prediction error wiggle passes",
+                   not any(r["metric"] == "sharding_pred_err_pct"
+                           and r["regressed"] for r in rows)))
+    cur_pb = _synthetic(mfu=42.0, step_ms=100.0,
+                        pred_bytes=411720 * 2)
+    rows = diff(base, cur_pb)
+    checks.append(("predicted collective bytes jump fires",
+                   any(r["metric"] == "predicted_collective_bytes"
+                       and r["regressed"] for r in rows)))
+    cur_pb_q = _synthetic(mfu=42.0, step_ms=100.0,
+                          pred_bytes=411720 * 2, quant="int8")
+    rows = diff(base, cur_pb_q)
+    checks.append(("quant flip resets predicted bytes baseline",
+                   not any(r["metric"] == "predicted_collective_bytes"
+                           and r["regressed"] for r in rows)))
 
     failed = [name for name, ok in checks if not ok]
     if verbose:

@@ -31,8 +31,14 @@ python -m pytest tests/test_quant_collectives.py -q
 echo "== static analysis: tpulint rules + op-test coverage floor + shape-consistency sweep =="
 python tools/run_lints.py --shape-check
 
+echo "== static analysis: shard-consistency sweep (fixture + book zoos x 3 meshes, docs/spmd.md) =="
+python tools/run_lints.py --skip-op-coverage --shard-check
+
 echo "== static analysis: shapecheck selftest (jax-free dump checker) =="
 python tools/shapecheck.py --selftest
+
+echo "== static analysis: shardcheck selftest (jax-free sharding checker) =="
+python tools/shardcheck.py --selftest
 
 echo "== observability: tracetool selftest (spans + op-profile walk + telemetry metrics replay + memory ledger/attribution + numerics fold/bisection) =="
 python tools/tracetool.py selftest

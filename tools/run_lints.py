@@ -14,6 +14,9 @@ op_coverage counts the ops its passes insert.
   python tools/run_lints.py --shape-check    # + shape-consistency
                                              # sweep over the fixture
                                              # zoo (raw + transformed)
+  python tools/run_lints.py --shard-check    # + shard-consistency
+                                             # sweep over fixture +
+                                             # book zoos × 3 meshes
 
 Exit status: 0 all gates clean, 1 otherwise.
 """
@@ -78,6 +81,74 @@ def _shape_check_sweep() -> int:
     return 0
 
 
+# mesh axes the shard-consistency sweep runs every zoo program under:
+# pure data parallel, the 3-D acceptance mesh, and the same with a
+# degenerate pipe axis (exercises extent-1 trimming)
+SHARD_SWEEP_MESHES = (
+    {"data": 8},
+    {"data": 2, "fsdp": 2, "tp": 2},
+    {"data": 2, "fsdp": 2, "tp": 2, "pipe": 1},
+)
+
+
+def _shard_check_sweep() -> int:
+    """Run the shard-consistency analyzer (ISSUE 18) over the fixture
+    zoo AND the book-model zoo under each SHARD_SWEEP_MESHES mesh, raw
+    and after the shipped transform pipeline: zero ERROR findings
+    required (WARNINGs — e.g. predicted reshard events — are printed
+    but do not gate).  Needs jax to build the programs; the analysis
+    itself is stdlib-only."""
+    repo = os.path.dirname(_TOOLS)
+    for p in (repo, os.path.join(repo, "tests")):
+        if p not in sys.path:
+            sys.path.insert(0, p)
+    from fixtures import programs as fixture_programs
+    import test_book_models as book
+    from paddle_tpu.analysis import shard_check
+    from paddle_tpu.fluid import framework, unique_name
+    from paddle_tpu.transforms import apply_transforms
+
+    def zoo():
+        for name, main_p, startup, fetch in fixture_programs.build_all():
+            yield name, main_p, startup, fetch
+        for name, builder in sorted(book.BOOK_BUILDERS.items()):
+            main_p, startup = framework.Program(), framework.Program()
+            with framework.program_guard(main_p, startup), \
+                    unique_name.guard():
+                fetch = builder()
+            yield name, main_p, startup, fetch
+
+    shipped = ["fold_bn", "layout_optimize", "dead_op_elim"]
+    checked = bad = warned = 0
+    for name, main_p, startup, fetch in zoo():
+        fetch_names = [v.name if hasattr(v, "name") else str(v)
+                       for v in fetch or ()]
+        for label, prog, fl in (("main", main_p, fetch_names),
+                                ("startup", startup, None)):
+            tprog, _ = apply_transforms(prog, fetch_names=fl,
+                                        passes=shipped)
+            for kind, p in (("raw", prog), ("transformed", tprog)):
+                for mesh in SHARD_SWEEP_MESHES:
+                    findings = shard_check.check_program(
+                        p, mesh, fetch_list=fl)
+                    errs = [f for f in findings
+                            if f.severity == "error"]
+                    warned += len(findings) - len(errs)
+                    checked += 1
+                    if errs:
+                        bad += 1
+                        print(f"run_lints: shard-check {name}/{label} "
+                              f"({kind}, mesh {mesh}) reported "
+                              f"{len(errs)} error(s):", file=sys.stderr)
+                        for f in errs:
+                            print(f"  {f}", file=sys.stderr)
+    if bad:
+        return 1
+    print(f"run_lints: shard-check clean ({checked} program×mesh "
+          f"variants swept, {warned} warning(s))")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--skip-op-coverage", action="store_true",
@@ -87,6 +158,11 @@ def main(argv=None) -> int:
                     help="also sweep the fixture-program zoo (raw + "
                          "transformed) through the shape-consistency "
                          "checker (needs jax)")
+    ap.add_argument("--shard-check", action="store_true",
+                    help="also sweep the fixture + book-model zoos "
+                         "(raw + transformed) through the "
+                         "shard-consistency analyzer under each "
+                         "SHARD_SWEEP_MESHES mesh (needs jax)")
     ap.add_argument("--root", default=None,
                     help="repo root to lint (default: this repo)")
     args = ap.parse_args(argv)
@@ -116,6 +192,11 @@ def main(argv=None) -> int:
     if args.shape_check:
         if _shape_check_sweep():
             print("run_lints: shape-check gate failed", file=sys.stderr)
+            rc = 1
+
+    if args.shard_check:
+        if _shard_check_sweep():
+            print("run_lints: shard-check gate failed", file=sys.stderr)
             rc = 1
     return rc
 

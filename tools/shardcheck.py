@@ -1,0 +1,238 @@
+#!/usr/bin/env python
+"""shardcheck CLI: static sharding verification over a serialized
+Program (ISSUE 18 tooling satellite).
+
+Runs the `shard-consistency` PartitionSpec propagation
+(paddle_tpu/analysis/shard_check.py) over `Program.to_dict()` JSON
+dumps under a mesh you name on the command line — the same ERROR-tier
+checks the Executor runs at every compile-cache miss when a mesh is
+current — and can print the predicted collective wire bytes
+(`comm_report`) and an elastic re-shard precheck (`feasibility`)
+between two candidate meshes, all WITHOUT compiling anything.
+
+The analysis package is stdlib-only at module scope and is loaded by
+FILE PATH (tpulint idiom), so this tool runs in environments without
+jax: op spec rules that need the jax shape replay degrade to "unknown"
+instead of aborting, which keeps every reported finding trustworthy.
+
+Usage:
+  python tools/shardcheck.py prog.json --mesh data=2,fsdp=2,tp=2
+  python tools/shardcheck.py prog.json --mesh data=8 --report
+  python tools/shardcheck.py prog.json --mesh data=8 --new-mesh data=4 \
+      --batch-rows 16            # feasibility precheck
+  python tools/shardcheck.py --selftest
+
+Exit status: 0 clean/feasible, 1 findings/infeasible, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PKG = os.path.join(REPO_ROOT, "paddle_tpu", "analysis")
+_MOD = "paddle_tpu_analysis"
+
+
+def load_analysis():
+    """The analysis package, loaded by path so that importing it never
+    drags in paddle_tpu (and therefore jax)."""
+    existing = sys.modules.get(_MOD)
+    if existing is not None:
+        return existing
+    spec = importlib.util.spec_from_file_location(
+        _MOD, os.path.join(_PKG, "__init__.py"),
+        submodule_search_locations=[_PKG])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[_MOD] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def parse_mesh(arg: str) -> dict:
+    """`data=2,fsdp=2,tp=2` -> {"data": 2, "fsdp": 2, "tp": 2}."""
+    axes = {}
+    for part in (arg or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"bad mesh entry {part!r} (want axis=N)")
+        k, v = part.split("=", 1)
+        axes[k.strip()] = int(v)
+    if not axes:
+        raise ValueError("empty mesh")
+    return axes
+
+
+def _selftest(analysis) -> int:
+    """Prove the jax-free path catches what it must: a clean SPMD
+    program stays clean, a collective on an absent ring axis fires, a
+    post-reshape non-dividing shard fires, and the feasibility precheck
+    refuses a non-dividing shrink while accepting a dividing one."""
+    sc = analysis.shard_check
+
+    def coll_prog():
+        return {
+            "blocks": [{
+                "idx": 0, "parent_idx": -1,
+                "vars": [
+                    {"name": "x", "shape": [8, 4], "dtype": "float32",
+                     "is_data": True},
+                    {"name": "out", "shape": [8, 4],
+                     "dtype": "float32"},
+                ],
+                "ops": [{
+                    "id": 1, "type": "c_allreduce_sum",
+                    "inputs": {"X": ["x"]}, "outputs": {"Out": ["out"]},
+                    "attrs": {"ring_id": 0},
+                }],
+            }],
+        }
+
+    clean = sc.check_program_dict(coll_prog(), {"data": 2}, feed=["x"])
+    if [f for f in clean if f.severity == "error"]:
+        print("selftest: clean collective program reported errors:",
+              file=sys.stderr)
+        for f in clean:
+            print(f"  {f}", file=sys.stderr)
+        return 1
+    absent = sc.check_program_dict(coll_prog(), {"tp": 2}, feed=["x"])
+    if not any("absent from mesh axes" in f.message for f in absent):
+        print("selftest: collective on absent ring axis not caught",
+              file=sys.stderr)
+        return 1
+
+    # fc_9.w_0 (6,4) hits the dense-weight pattern rule -> dim 0 over
+    # fsdp=2; reshaped to (3,8) the carried shard no longer divides
+    rp = {
+        "blocks": [{
+            "idx": 0, "parent_idx": -1,
+            "vars": [
+                {"name": "fc_9.w_0", "shape": [6, 4],
+                 "dtype": "float32", "persistable": True},
+                {"name": "w2", "shape": [3, 8], "dtype": "float32"},
+            ],
+            "ops": [{
+                "id": 1, "type": "reshape2",
+                "inputs": {"X": ["fc_9.w_0"]},
+                "outputs": {"Out": ["w2"]},
+                "attrs": {"shape": [3, 8]},
+            }],
+        }],
+    }
+    div = sc.check_program_dict(rp, {"fsdp": 2, "tp": 4})
+    if not any("not divisible" in f.message
+               and f.severity == "error" for f in div):
+        print("selftest: post-reshape non-dividing shard not caught",
+              file=sys.stderr)
+        for f in div:
+            print(f"  {f}", file=sys.stderr)
+        return 1
+
+    view = sc.ProgramView(rp)
+    ok = sc.feasibility(view, {"data": 8}, {"data": 4}, batch_rows=16)
+    bad = sc.feasibility(view, {"data": 8}, {"data": 3}, batch_rows=16)
+    if not ok["feasible"] or bad["feasible"]:
+        print("selftest: feasibility precheck wrong "
+              f"(8->4 {ok['feasible']}, 8->3 {bad['feasible']})",
+              file=sys.stderr)
+        return 1
+    rep = sc.comm_report(sc.ProgramView(coll_prog()), {"data": 2},
+                         feed=["x"])
+    if rep["mode"] != "explicit" or rep["predicted_total"] <= 0:
+        print("selftest: explicit comm_report empty", file=sys.stderr)
+        return 1
+    print("shardcheck: selftest ok (clean/absent-axis/non-dividing-"
+          "reshape/feasibility/comm-report)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="shardcheck", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("dumps", nargs="*",
+                    help="Program.to_dict() JSON file(s)")
+    ap.add_argument("--mesh", default=None,
+                    help="mesh axes, e.g. data=2,fsdp=2,tp=2")
+    ap.add_argument("--new-mesh", default=None,
+                    help="candidate mesh for the feasibility precheck")
+    ap.add_argument("--batch-rows", type=int, default=None,
+                    help="global batch rows (feasibility/batch spec)")
+    ap.add_argument("--feed", default=None,
+                    help="comma-separated feed var names")
+    ap.add_argument("--report", action="store_true",
+                    help="print the predicted collective wire bytes")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the built-in jax-free self test and exit")
+    args = ap.parse_args(argv)
+
+    analysis = load_analysis()
+    if args.selftest:
+        return _selftest(analysis)
+    if not args.dumps or not args.mesh:
+        ap.print_usage(sys.stderr)
+        return 2
+    try:
+        mesh = parse_mesh(args.mesh)
+        new_mesh = parse_mesh(args.new_mesh) if args.new_mesh else None
+    except ValueError as e:
+        print(f"shardcheck: {e}", file=sys.stderr)
+        return 2
+
+    sc = analysis.shard_check
+    feed = [s for s in (args.feed or "").split(",") if s] or None
+    rc = 0
+    for path in args.dumps:
+        try:
+            with open(path) as fh:
+                d = json.load(fh)
+        except (OSError, ValueError) as e:
+            print(f"shardcheck: {path}: {e}", file=sys.stderr)
+            return 2
+        view = sc.ProgramView(d)
+        if new_mesh is not None:
+            rep = sc.feasibility(view, mesh, new_mesh,
+                                 batch_rows=args.batch_rows)
+            verdict = "feasible" if rep["feasible"] else "INFEASIBLE"
+            print(f"shardcheck: {path}: {dict(mesh)} -> "
+                  f"{dict(new_mesh)}: {verdict}, "
+                  f"bytes/device {rep['old_bytes_per_device']} -> "
+                  f"{rep['new_bytes_per_device']} "
+                  f"(delta {rep['delta_bytes_per_device']:+d})")
+            for p in rep["problems"]:
+                print(f"  problem: {p}", file=sys.stderr)
+            for c in rep["clamps"]:
+                print(f"  clamp: {c}", file=sys.stderr)
+            if not rep["feasible"]:
+                rc = 1
+            continue
+        findings = sc.check_program(view, mesh, feed=feed,
+                                    batch_rows=args.batch_rows)
+        errors = [f for f in findings if f.severity == "error"]
+        for f in findings:
+            print(f"  {f}", file=sys.stderr)
+        if errors:
+            print(f"shardcheck: {path}: {len(errors)} error(s), "
+                  f"{len(findings) - len(errors)} warning(s)",
+                  file=sys.stderr)
+            rc = 1
+        else:
+            print(f"shardcheck: {path}: clean "
+                  f"({len(findings)} warning(s))")
+        if args.report:
+            rep = sc.comm_report(view, mesh, feed=feed,
+                                 batch_rows=args.batch_rows)
+            print(f"  predicted [{rep['mode']}] "
+                  f"{rep['predicted']} total {rep['predicted_total']}"
+                  + (f" quant={rep['quant']}" if rep["quant"] else ""))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
