@@ -59,6 +59,9 @@ WATCHED = [
     # cache times its own load/store (ISSUE 17) — a leaked span there
     # would misattribute disk I/O to whichever compile wrapped it
     "paddle_tpu/serving",  # covers registry.py (multi-tenant fleet)
+    "paddle_tpu/tune",  # autotuner (ISSUE 19): search/trial spans wrap
+    # measured executor dispatches — a leaked span would fold a whole
+    # search into whatever profile runs next
     "paddle_tpu/transforms/__init__.py",
     "paddle_tpu/analysis/verifier.py",
     "bench.py",

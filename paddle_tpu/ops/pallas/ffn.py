@@ -461,11 +461,24 @@ def fused_ffn(x, w1, b1, w2, b2, activation="gelu", dropout_p=0.0,
         [(bt0, bf0), (min(bt0, 256), bf0), (min(bt0, 256),
                                             min(bf0, 256))]
         if T % bt == 0 and F % bf == 0))
+    # tuned kernel choice (docs/autotune.md): the thread-local tune
+    # scope pins this dispatch to one arm of the re-armed FFN A/B —
+    # "xla" forces the fallback path, "pallas" overrides the opt-in
+    # default (the 2026-07-31 on-chip verdict) but still requires a
+    # TPU backend plus a passing Mosaic probe, or interpret mode.
+    # None = untuned: the existing dispatch, byte-identical.
+    try:
+        from ... import tune as _tune
+
+        _choice = _tune.kernel_choice("ffn")
+    except Exception:  # noqa: BLE001 - tune unavailable (minimal env)
+        _choice = None
     block_t = block_f = None
-    if H % 128 == 0 and ladder:
+    if _choice != "xla" and H % 128 == 0 and ladder:
         if interpret or _FORCE_KERNEL:
             block_t, block_f = ladder[0]
-        elif _FFN_DISABLED is None and jax.default_backend() == "tpu":
+        elif (_FFN_DISABLED is None or _choice == "pallas") \
+                and jax.default_backend() == "tpu":
             for bt, bf in ladder:
                 if _ffn_ok(T, H, F, x.dtype, activation, dropout_p,
                            bt, bf):
@@ -482,6 +495,14 @@ def fused_ffn(x, w1, b1, w2, b2, activation="gelu", dropout_p=0.0,
                     "; falling back to XLA ops", RuntimeWarning,
                     stacklevel=2)
     usable = block_t is not None
+    try:
+        from ...profiler import stat_add
+
+        # trace-time only (inside a jit trace, never per step): the
+        # A/B arm that actually dispatched, assertable from counters
+        stat_add("ffn_dispatch_kernel" if usable else "ffn_dispatch_xla")
+    except Exception:  # noqa: BLE001 - profiler unavailable (minimal env)
+        pass
     if not usable:
         h = _act(jnp.dot(xt, w1, preferred_element_type=jnp.float32)
                  .astype(x.dtype) + b1, activation)

@@ -7,7 +7,11 @@ conv whose output nothing else reads,
 
 is exactly `conv(x, W * s) + (beta - mu * s)` with the per-output-
 channel factor `s = gamma / sqrt(var + eps)` — the reference's
-conv_bn_fuse_pass.  The fold is expressed IN-GRAPH (a handful of [C]
+conv_bn_fuse_pass.  A `conv -> elementwise_add(bias [C], axis=1) ->
+batch_norm` chain (the layer builder's conv2d(..., bias_attr=...)
+shape) folds the same way with the bias riding the shifted mean:
+`conv(x, W * s) + (beta - s * (mu - b))` — the reference's
+conv_eltwiseadd_bn_fuse_pass.  The fold is expressed IN-GRAPH (a handful of [C]
 vector ops plus one weight-sized multiply inserted before the conv),
 not by mutating scope values, so it needs no runtime state, stays
 correct even if the running stats later change, and costs O(|W|) per
@@ -74,14 +78,48 @@ def _fold_one(ctx: TransformContext) -> bool:
         if xvar is None or xvar.persistable or xname in fetch:
             continue
         writers = _writers(prog, xname)
-        if len(writers) != 1 or writers[0].type not in _FOLDABLE_CONVS \
-                or writers[0].block is not block:
-            continue
-        conv = writers[0]
-        if conv.attr("data_format", "NCHW") not in ("NCHW", "AnyLayout"):
+        if len(writers) != 1 or writers[0].block is not block:
             continue
         if any(r is not bn for r in _readers(prog, xname)):
-            continue  # conv output has another consumer
+            continue  # bn input has another consumer
+        producer = writers[0]
+        bias_add = None
+        bias_n = None
+        conv_out = xname
+        if producer.type == "elementwise_add":
+            # conv -> elementwise_add(bias) -> bn chain (the layer
+            # builder's conv2d(..., bias_attr=...) shape, nn.py): with
+            # a per-channel bias b folded into the shifted mean,
+            #   y = conv(x, W*s) + (beta - s*(mu - b))
+            # axis=1 is the NCHW channel broadcast the builder emits;
+            # the bias must be rank-1 [C] so the shift stays a vector
+            if producer.attr("axis", -1) != 1:
+                continue
+            add_xs = producer.input("X")
+            add_ys = producer.input("Y")
+            if len(add_xs) != 1 or len(add_ys) != 1:
+                continue
+            bvar = _find_var(block, add_ys[0])
+            if bvar is None or not bvar.shape or len(bvar.shape) != 1:
+                continue
+            conv_out = add_xs[0]
+            cvar = _find_var(block, conv_out)
+            if cvar is None or cvar.persistable or conv_out in fetch:
+                continue
+            cwriters = _writers(prog, conv_out)
+            if len(cwriters) != 1 \
+                    or cwriters[0].type not in _FOLDABLE_CONVS \
+                    or cwriters[0].block is not block:
+                continue
+            if any(r is not producer for r in _readers(prog, conv_out)):
+                continue  # conv output has another consumer
+            bias_add, bias_n, conv = producer, add_ys[0], cwriters[0]
+        elif producer.type in _FOLDABLE_CONVS:
+            conv = producer
+        else:
+            continue
+        if conv.attr("data_format", "NCHW") not in ("NCHW", "AnyLayout"):
+            continue
         # bn side outputs (SavedMean/SavedVariance/ReserveSpace) vanish
         # with the op; MeanOut/VarianceOut alias the running stats and
         # simply stop being rewritten (is_test passes them through
@@ -132,7 +170,17 @@ def _fold_one(ctx: TransformContext) -> bool:
             # per-output-channel weight scale: W (O, I/g, kh, kw) * s[O]
             ("elementwise_mul", {"X": [w_n], "Y": [s]}, {"Out": [wf]},
              {"axis": 0, **role}),
-            ("elementwise_mul", {"X": [mean_n], "Y": [s]}, {"Out": [ms]},
+        ]
+        mean_src = mean_n
+        if bias_add is not None:
+            # the conv bias rides the shifted mean: mu' = mu - b, so
+            # the folded output bias becomes beta - s * (mu - b)
+            mean_src = mk("mshift", svar.shape)
+            ins.append(("elementwise_sub",
+                        {"X": [mean_n], "Y": [bias_n]},
+                        {"Out": [mean_src]}, {"axis": -1, **role}))
+        ins += [
+            ("elementwise_mul", {"X": [mean_src], "Y": [s]}, {"Out": [ms]},
              {"axis": -1, **role}),
             ("elementwise_sub", {"X": [beta_n], "Y": [ms]}, {"Out": [bf]},
              {"axis": -1, **role}),
@@ -148,12 +196,16 @@ def _fold_one(ctx: TransformContext) -> bool:
         tag_provenance(conv, "fold_bn")
         bn_pos = block.ops.index(bn)
         add_op = block.insert_op(bn_pos, "elementwise_add",
-                                 inputs={"X": [xname], "Y": [bf]},
+                                 inputs={"X": [conv_out], "Y": [bf]},
                                  outputs={"Out": [yname]},
                                  attrs={"axis": 1, **role},
                                  infer_shape=False)
         inherit_provenance(add_op, bn, "fold_bn")
         block.ops.remove(bn)
+        if bias_add is not None:
+            # the chain's bias add is absorbed into bf; its output var
+            # goes dead and dead_op_elim sweeps anything left behind
+            block.ops.remove(bias_add)
         return True
     return False
 

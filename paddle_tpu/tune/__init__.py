@@ -1,0 +1,256 @@
+"""Self-tuning compile pipeline (docs/autotune.md).
+
+The first closed loop in the stack: measurement driving compilation.
+Per program signature, the tuner A/Bs candidate compile configurations
+(transform-pass toggles, Pallas-vs-XLA kernel choice behind the
+existing dispatch seams, serving bucket ladders, mesh shapes
+pre-filtered by `analysis.feasibility`/`comm_report`) by actually
+dispatching each candidate for K measured steps, scores on measured
+step time with roofline-verdict tie-breaks (obs.roofline, PR 12), and
+commits the winner into a persistent record next to the AOT cache
+(tune/record.py) — so every LATER process resolves the tuned config on
+first compile with zero search cost.
+
+`PADDLE_AUTOTUNE` (FLAGS_autotune) modes:
+
+* `off`   — byte-identical bypass: no token joins any signature, no
+            record is read, lowered HLO matches pre-autotune behavior;
+* `on`    — (default) resolve persisted winners on compile-cache
+            misses; never searches;
+* `force` — additionally run the measured search on a miss with no
+            persisted record (the documented cost: K real dispatches
+            per candidate, which advance training state exactly like
+            running K steps — tune inference/eval programs, or accept
+            the steps).
+
+Signature join (the correctness story): the winning config's content
+hash rides the compile-cache key (`Executor._cache_key`) and the AOT
+stable half (`entry.aot_sig`) as an `autotune=<token>` component —
+flipping any tuned dimension recompiles, never a stale executable
+reuse.  A trial's candidate config joins the same way through the
+thread-local `config_override`, so trial executables and steady-state
+executables for the same config share compile-cache entries.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Tuple
+
+from .space import TunedConfig, TUNABLE_KERNELS  # noqa: F401
+from . import record  # noqa: F401
+from . import space  # noqa: F401
+
+_TLS = threading.local()
+
+# (id(program), program.version, tune_dir) -> Optional[TunedConfig];
+# one record probe per program, then a dict hit per step
+_RESOLVED: Dict[Tuple, Optional[TunedConfig]] = {}
+# programs a force-mode search already ran (or was skipped) for, so an
+# unpersistable search is not repeated on every new feed signature
+_SEARCHED: set = set()
+# aot_token -> Optional[List[int]] (BucketedRunner ladder records)
+_RUNNER_BUCKETS: Dict[str, Optional[List[int]]] = {}
+
+
+def mode() -> str:
+    from ..fluid.flags import flag
+
+    m = str(flag("autotune", "on")).strip().lower()
+    if m in ("off", "0", "false", "no", "none"):
+        return "off"
+    return "force" if m == "force" else "on"
+
+
+def enabled() -> bool:
+    return mode() != "off"
+
+
+# -- thread-local trial override ---------------------------------------------
+
+def active_config() -> Optional[TunedConfig]:
+    """The config a trial (or a kernel-choice replay scope) is running
+    under on THIS thread — None outside `config_override`."""
+    return getattr(_TLS, "config", None)
+
+
+def in_search() -> bool:
+    return bool(getattr(_TLS, "in_search", False))
+
+
+@contextmanager
+def config_override(cfg: Optional[TunedConfig]):
+    """Run the body under candidate `cfg`: the config's token joins
+    the compile-cache/AOT signatures via `cache_token`, its pass
+    overrides steer `maybe_transform_program`, and its kernel choices
+    steer the ops/pallas dispatch seams — all thread-local, so a
+    concurrent serving thread keeps the untuned behavior."""
+    prev = getattr(_TLS, "config", None)
+    _TLS.config = cfg
+    try:
+        yield cfg
+    finally:
+        _TLS.config = prev
+
+
+@contextmanager
+def _search_scope():
+    prev = getattr(_TLS, "in_search", False)
+    _TLS.in_search = True
+    try:
+        yield
+    finally:
+        _TLS.in_search = prev
+
+
+# -- per-program resolution (the steady-state fast path) ---------------------
+
+_MISSING = object()
+
+
+def resolve(program) -> Optional[TunedConfig]:
+    """The persisted winner for `program` (possibly the default
+    config, which `cache_token` then renders as nothing), or None when
+    no record resolves.  One record-store probe per (program,
+    version); every later call is a dict hit — this sits on the
+    per-step `Executor._cache_key` path."""
+    if mode() == "off":
+        return None
+    key = (id(program), getattr(program, "version", 0), record.tune_dir())
+    hit = _RESOLVED.get(key, _MISSING)
+    if hit is not _MISSING:
+        return hit
+    cfg = None
+    stable = record.stable_for_program(program)
+    if stable:
+        rec = record.try_load(stable)
+        if rec is not None:
+            try:
+                cfg = TunedConfig.from_dict(rec["config"])
+            except Exception:  # noqa: BLE001 - malformed config: untuned
+                cfg = None
+    _RESOLVED[key] = cfg
+    return cfg
+
+
+def _prime(program, cfg: Optional[TunedConfig]) -> None:
+    """Seat a just-committed winner so the very next `_cache_key` read
+    resolves it without re-probing the record store."""
+    key = (id(program), getattr(program, "version", 0), record.tune_dir())
+    _RESOLVED[key] = cfg
+
+
+def _effective(program) -> Optional[TunedConfig]:
+    """Trial override first, then the persisted winner."""
+    cfg = active_config()
+    return cfg if cfg is not None else resolve(program)
+
+
+def cache_token(program) -> tuple:
+    """Compile-cache key component (`Executor._cache_key`): the
+    effective config's content hash, or () — so `off` and untuned
+    programs key exactly as before this module existed."""
+    if mode() == "off":
+        return ()
+    cfg = _effective(program)
+    if cfg is None or cfg.is_default():
+        return ()
+    return (f"autotune={cfg.token()}",)
+
+
+def aot_token_component(program) -> Optional[str]:
+    """AOT stable-half component (`entry.aot_sig`): same token as
+    `cache_token`, as a single string or None."""
+    tok = cache_token(program)
+    return tok[0] if tok else None
+
+
+def pass_overrides(program) -> Optional[Dict[str, bool]]:
+    """Per-pass enable overrides for `maybe_transform_program`."""
+    if mode() == "off":
+        return None
+    cfg = _effective(program)
+    return dict(cfg.passes) if cfg is not None and cfg.passes else None
+
+
+def kernel_choice(op_name: str) -> Optional[str]:
+    """The tuned implementation for one TUNABLE_KERNELS seam ('xla' |
+    'pallas' | None = untuned default).  Thread-local: trace-time
+    consumers (ops/pallas/ffn.py) see a choice only inside
+    `config_override` — the Executor re-enters the scope around a
+    winning entry's trace, so persisted kernel winners replay too."""
+    if mode() == "off":
+        return None
+    cfg = active_config()
+    if cfg is None:
+        return None
+    return cfg.kernels.get(op_name)
+
+
+def buckets_for(aot_token: str) -> Optional[List[int]]:
+    """The tuned bucket ladder for one BucketedRunner `aot_token`, or
+    None.  Memoized per token — the record probe happens once, at
+    runner construction."""
+    if mode() == "off" or not aot_token:
+        return None
+    hit = _RUNNER_BUCKETS.get(aot_token, _MISSING)
+    if hit is not _MISSING:
+        return hit
+    buckets = None
+    rec = record.try_load(record.stable_for_runner(aot_token))
+    if rec is not None:
+        try:
+            cfg = TunedConfig.from_dict(rec["config"])
+            if cfg.buckets:
+                buckets = [int(b) for b in cfg.buckets]
+        except Exception:  # noqa: BLE001 - malformed record: untuned
+            buckets = None
+    _RUNNER_BUCKETS[aot_token] = buckets
+    return buckets
+
+
+def resolve_callable(token: str) -> Optional[TunedConfig]:
+    """The persisted winner for a functional-path computation tuned
+    under `token` (tuner.tune_callable) — replay it with
+    `config_override(resolve_callable(token))` around the jit."""
+    if mode() == "off" or not token:
+        return None
+    rec = record.try_load(record.stable_for_runner(token))
+    if rec is None:
+        return None
+    try:
+        return TunedConfig.from_dict(rec["config"])
+    except Exception:  # noqa: BLE001 - malformed record: untuned
+        return None
+
+
+def reset_memo() -> None:
+    """Drop the in-process resolution memos (tests; a changed record
+    on disk is otherwise only seen by a fresh process — exactly like
+    the in-memory compile cache over the AOT store)."""
+    _RESOLVED.clear()
+    _SEARCHED.clear()
+    _RUNNER_BUCKETS.clear()
+
+
+# -- the Executor force-search hook ------------------------------------------
+
+def maybe_search(exe, program, feed_arrays, fetch_names, scope) -> bool:
+    """Compile-cache-miss hook (`Executor._prepare`): under
+    FLAGS_autotune='force', run the measured candidate search for
+    `program` unless a persisted winner already resolves or a search
+    already ran this process.  Returns True when a search committed
+    (the caller re-keys: the winner's token changed the cache key)."""
+    if mode() != "force" or in_search():
+        return False
+    key = (id(program), getattr(program, "version", 0))
+    if key in _SEARCHED:
+        return False
+    _SEARCHED.add(key)
+    if resolve(program) is not None:
+        return False  # a persisted winner already resolves: no search
+    from . import tuner
+
+    return tuner.search_program(exe, program, feed_arrays, fetch_names,
+                                scope) is not None

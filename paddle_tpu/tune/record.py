@@ -1,0 +1,179 @@
+"""Persistent tuning records (docs/autotune.md).
+
+A search (tune/tuner.py) is expensive — K measured dispatches per
+candidate config — so its winner must be paid for ONCE per fleet, not
+once per process.  This module persists committed winners next to the
+AOT executable cache under the exact same key discipline
+(fluid/aot_cache.py):
+
+* **stable half** — what program (or serving entry) this record tunes:
+  the `aot_cache.program_token` content hash, or the bucketed runner's
+  caller-supplied model token.
+* **volatile half** — everything that can invalidate a measured
+  verdict without changing the program: the full
+  `aot_cache.volatile_signature` (transform signature incl. numerics
+  and quant tokens, FLAGS_check_nan_inf, jax/jaxlib versions, backend
+  platform + device kind/count) plus this module's schema version.
+
+A record is one JSON file named `<stable>-<hash(volatile)>.json`.
+Volatile drift (jax upgrade, backend change, transform-signature flip)
+is a counted hard miss (`autotune_record_drift`) that forces a
+re-tune; a corrupted/truncated record is a counted miss
+(`autotune_record_errors`) — never a crash.  Commits ride the ckpt
+tmp + `os.replace` idiom: a crashed writer leaves only `.tmp-*`
+litter, never a half record.
+
+Profiler surface: `autotune_record_hits` / `autotune_record_misses` /
+`autotune_record_drift` / `autotune_record_errors` /
+`autotune_record_stores` counters — a fresh process replaying a
+persisted winner is provable from counters alone
+(`autotune_record_hits >= 1` with `autotune_trials == 0`).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+from typing import Any, Dict, Optional
+
+from ..fluid import aot_cache
+
+# bump when the record layout or the TunedConfig dict shape changes:
+# old records become drift misses, never misloads
+SCHEMA = 1
+
+_TMP_IDS = itertools.count()
+
+
+def tune_dir() -> str:
+    """Record root: FLAGS_autotune_dir, defaulting to a `tuning/`
+    subdirectory of the AOT cache so winners ride next to the
+    executables they key.  Empty ('' with no AOT dir either) disables
+    persistence — searches still run under 'force' but nothing
+    survives the process."""
+    from ..fluid.flags import flag
+
+    explicit = str(flag("autotune_dir", "") or "")
+    if explicit:
+        return explicit
+    aot_root = aot_cache.cache_dir()
+    return os.path.join(aot_root, "tuning") if aot_root else ""
+
+
+def persist_enabled() -> bool:
+    from . import mode
+
+    return mode() != "off" and bool(tune_dir())
+
+
+def volatile() -> Dict[str, Any]:
+    """Everything that can invalidate a measured verdict without
+    changing the program.  Rides `aot_cache.volatile_signature` whole:
+    a measured winner under one transform/numerics/quant signature or
+    jax/backend fingerprint says nothing about another."""
+    return aot_cache._canon({
+        "schema": SCHEMA,
+        "aot": aot_cache.volatile_signature(""),
+    })
+
+
+def stable_for_program(program) -> Optional[str]:
+    """Stable half for one Program: the same content hash the AOT
+    cache keys executables by, so record and executable invalidate
+    together."""
+    tok = aot_cache.program_token(program)
+    if tok is None:
+        return None
+    return aot_cache._hash(["autotune", tok])
+
+
+def stable_for_runner(token: str) -> Optional[str]:
+    """Stable half for one BucketedRunner ladder record: the
+    caller-supplied model token (the `aot_token` contract)."""
+    if not token:
+        return None
+    return aot_cache._hash(["autotune_runner", str(token)])
+
+
+def try_load(stable: str) -> Optional[dict]:
+    """Consult the record store for `stable` under the CURRENT
+    volatile signature.  Returns the committed record dict or None;
+    every outcome is counted (hit / miss / drift / error) and a
+    corrupted record is a counted miss — never a crash."""
+    if not persist_enabled() or not stable:
+        return None
+    from ..profiler import stat_add
+
+    root = tune_dir()
+    vol = volatile()
+    name = f"{stable}-{aot_cache._hash(vol)}.json"
+    path = os.path.join(root, name)
+    if not os.path.isfile(path):
+        # the same stable program was tuned under a DIFFERENT volatile
+        # signature: drift (jax upgrade, backend change, transform
+        # flip) — a hard miss by construction, counted so a forced
+        # re-tune is provable from the counter
+        try:
+            drifted = any(n.startswith(stable + "-") and n != name
+                          for n in os.listdir(root))
+        except OSError:
+            drifted = False
+        if drifted:
+            stat_add("autotune_record_drift")
+        stat_add("autotune_record_misses")
+        return None
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+        if rec.get("volatile") != vol or "config" not in rec:
+            # hash-prefix collision or hand-edited record: the full
+            # spelled-out signature is the authority
+            stat_add("autotune_record_drift")
+            stat_add("autotune_record_misses")
+            return None
+    except Exception:  # noqa: BLE001 - corrupt/truncated record: counted miss
+        stat_add("autotune_record_errors")
+        stat_add("autotune_record_misses")
+        return None
+    stat_add("autotune_record_hits")
+    return rec
+
+
+def try_store(stable: str, config_dict: dict,
+              extra: Optional[dict] = None) -> bool:
+    """Commit a winner under `stable` + the current volatile
+    signature via tmp file + `os.replace` (the ckpt idiom: a crash
+    leaves a `.tmp-*` file, never a half record)."""
+    if not persist_enabled() or not stable:
+        return False
+    from ..profiler import stat_add
+
+    root = tune_dir()
+    vol = volatile()
+    name = f"{stable}-{aot_cache._hash(vol)}.json"
+    rec = aot_cache._canon({
+        "schema": SCHEMA,
+        "stable": stable,
+        "volatile": vol,
+        "config": config_dict,
+        "extra": extra or {},
+    })
+    tmp = os.path.join(root,
+                       f".tmp-{name}-{os.getpid()}-{next(_TMP_IDS)}")
+    try:
+        os.makedirs(root, exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(rec, f, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(root, name))
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        stat_add("autotune_record_errors")
+        return False
+    stat_add("autotune_record_stores")
+    return True

@@ -1,0 +1,245 @@
+"""The autotune search space (docs/autotune.md).
+
+A `TunedConfig` is one point in the space of compile configurations:
+
+* `passes`   — per-pass overrides over the FLAGS_graph_transforms
+               defaults (transforms/__init__.py registry names);
+* `kernels`  — per-op Pallas-vs-XLA choice behind the existing
+               dispatch seams (TUNABLE_KERNELS below; today: "ffn" —
+               ops/pallas/ffn.py);
+* `buckets`  — a serving bucket ladder for BucketedRunner;
+* `mesh_axes`— a mesh shape for SPMD lowering (candidates pre-filtered
+               by analysis.feasibility / comm_report so infeasible or
+               collective-heavy shapes never compile).
+
+Candidate generation is CONTENT-GATED: a program with no convolutions
+gets no layout-flip candidate, no eval-mode batch_norm means no
+fold_bn candidate, and a program where only the default survives is
+never searched at all — startup blocks and glue programs cost zero.
+The default config is ALWAYS candidate 0 and is never dropped by the
+FLAGS_autotune_max_candidates cap, so a committed winner can never be
+slower than the default the tuner measured it against.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from ..fluid import aot_cache
+
+# op name -> the implementation choices the dispatch seam understands.
+# "ffn" re-arms the Pallas FFN A/B that lost its baseline (BENCH_r05):
+# ops/pallas/ffn.py consults tune.kernel_choice("ffn") before its
+# _FFN_DISABLED default.
+TUNABLE_KERNELS: Dict[str, Sequence[str]] = {
+    "ffn": ("xla", "pallas"),
+}
+
+
+class TunedConfig:
+    """One candidate compile configuration.  Hashable-by-token: the
+    canonical-dict hash is the `autotune=<token>` component that joins
+    the compile-cache and AOT-cache signatures, so flipping any tuned
+    dimension recompiles — never a stale executable reuse."""
+
+    __slots__ = ("passes", "kernels", "buckets", "mesh_axes")
+
+    def __init__(self, passes: Optional[Dict[str, bool]] = None,
+                 kernels: Optional[Dict[str, str]] = None,
+                 buckets: Optional[Sequence[int]] = None,
+                 mesh_axes: Optional[Dict[str, int]] = None):
+        self.passes = dict(passes or {})
+        self.kernels = dict(kernels or {})
+        self.buckets = list(buckets) if buckets is not None else None
+        self.mesh_axes = dict(mesh_axes) if mesh_axes is not None else None
+
+    def is_default(self) -> bool:
+        return not self.passes and not self.kernels \
+            and self.buckets is None and self.mesh_axes is None
+
+    def overrides(self) -> int:
+        """How far from the default — the last tie-break (fewer wins:
+        an override that does not measurably help is not kept)."""
+        return (len(self.passes) + len(self.kernels)
+                + (0 if self.buckets is None else 1)
+                + (0 if self.mesh_axes is None else 1))
+
+    def to_dict(self) -> dict:
+        return aot_cache._canon({
+            "passes": self.passes,
+            "kernels": self.kernels,
+            "buckets": self.buckets,
+            "mesh_axes": self.mesh_axes,
+        })
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TunedConfig":
+        return cls(passes={str(k): bool(v)
+                           for k, v in (d.get("passes") or {}).items()},
+                   kernels={str(k): str(v)
+                            for k, v in (d.get("kernels") or {}).items()},
+                   buckets=d.get("buckets"),
+                   mesh_axes=d.get("mesh_axes"))
+
+    def token(self) -> str:
+        return aot_cache._hash(self.to_dict())
+
+    def label(self) -> str:
+        if self.is_default():
+            return "default"
+        parts = [f"{k}={'on' if v else 'off'}"
+                 for k, v in sorted(self.passes.items())]
+        parts += [f"{k}:{v}" for k, v in sorted(self.kernels.items())]
+        if self.buckets is not None:
+            parts.append(f"buckets={self.buckets}")
+        if self.mesh_axes is not None:
+            parts.append(f"mesh={sorted(self.mesh_axes.items())}")
+        return ",".join(parts)
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"TunedConfig({self.label()})"
+
+
+# -- content-gated candidate generation --------------------------------------
+
+_CONV_OPS = ("conv2d", "depthwise_conv2d")
+
+
+def _op_census(program) -> Dict[str, int]:
+    census: Dict[str, int] = {}
+    for blk in program.blocks:
+        for op in blk.ops:
+            census[op.type] = census.get(op.type, 0) + 1
+    return census
+
+
+def _has_eval_bn_chain(program) -> bool:
+    """fold_bn only fires on inference-mode batch_norm downstream of a
+    conv — same preconditions the pass itself checks."""
+    for blk in program.blocks:
+        for op in blk.ops:
+            if op.type == "batch_norm" and (
+                    op.attr("is_test") or op.attr("use_global_stats")):
+                return True
+    return False
+
+
+def program_candidates(program) -> List[TunedConfig]:
+    """Candidate configs for one static Program, default first.
+
+    Content gating keeps the space honest: every non-default candidate
+    flips a pass that can actually rewrite THIS graph, so a program
+    that generates only [default] (startup blocks, pure-elementwise
+    glue) is never worth a search — the tuner skips it entirely."""
+    from ..transforms import enabled_passes
+
+    census = _op_census(program)
+    grad = any(op.attr("fwd_op_id") is not None
+               for blk in program.blocks for op in blk.ops)
+    defaults = enabled_passes()
+    out = [TunedConfig()]
+
+    has_conv = any(census.get(t) for t in _CONV_OPS)
+    if has_conv and "layout_optimize" in defaults:
+        # NCHW-vs-NHWC is a measured question, not a static one: the
+        # rewrite wins on real convs but the boundary transposes can
+        # lose on small shapes
+        out.append(TunedConfig(
+            passes={"layout_optimize": not defaults["layout_optimize"]}))
+    if has_conv and not grad and _has_eval_bn_chain(program) \
+            and "fold_bn" in defaults and not defaults["fold_bn"]:
+        out.append(TunedConfig(passes={"fold_bn": True}))
+        if "layout_optimize" in defaults and defaults["layout_optimize"]:
+            out.append(TunedConfig(passes={"fold_bn": True,
+                                           "layout_optimize": False}))
+    if "transpose_sink" in defaults and not defaults["transpose_sink"] \
+            and (census.get("transpose2") or has_conv):
+        # convs gate it too: layout_optimize inserts the NCHW-external
+        # boundary transposes this pass sinks/cancels
+        out.append(TunedConfig(passes={"transpose_sink": True}))
+
+    from ..fluid.flags import flag
+
+    cap = max(1, int(flag("autotune_max_candidates", 6)))
+    return out[:max(1, cap)]
+
+
+def kernel_candidates(ops: Sequence[str]) -> List[TunedConfig]:
+    """Candidate kernel assignments for a functional-path computation
+    that dispatches through the named TUNABLE_KERNELS seams (eager /
+    serving fns — static Programs do not trace these)."""
+    out = [TunedConfig()]
+    for name in ops:
+        for choice in TUNABLE_KERNELS.get(name, ()):
+            out.append(TunedConfig(kernels={name: choice}))
+    return out
+
+
+def bucket_candidates(max_batch: int) -> List[TunedConfig]:
+    """Candidate serving bucket ladders: the default power-of-two
+    ladder plus coarser starts (fewer compiles, more padding) and the
+    single-bucket extreme (one compile, max padding)."""
+    from ..serving.bucketing import bucket_ladder
+
+    seen = []
+    out = [TunedConfig()]
+    for min_bucket in (8, 16, max_batch):
+        ladder = bucket_ladder(max_batch, min_bucket=min_bucket)
+        if ladder in seen:
+            continue
+        seen.append(ladder)
+        out.append(TunedConfig(buckets=ladder))
+    return out
+
+
+def mesh_candidates(program, device_count: int,
+                    base_mesh: Optional[Dict[str, int]] = None,
+                    batch_rows: Optional[int] = None,
+                    axis_names: Sequence[str] = ("data", "fsdp", "tp"),
+                    ) -> List[TunedConfig]:
+    """Candidate mesh_axes shapes for `device_count` devices,
+    STATICALLY pre-filtered so infeasible or collective-heavy shapes
+    never reach a compile:
+
+    * `analysis.feasibility` refuses non-dividing moves (a var that
+      cannot shard over the candidate axes);
+    * `analysis.comm_report` ranks the survivors by predicted
+      collective wire bytes — candidates are returned cheapest first,
+      so a candidate cap keeps the heavy shapes out of the trial set.
+    """
+    from ..analysis import shard_check
+
+    base = dict(base_mesh or {"data": device_count})
+
+    def factorizations(n: int, axes: Sequence[str]):
+        if len(axes) == 1:
+            yield {axes[0]: n}
+            return
+        d = 1
+        while d <= n:
+            if n % d == 0:
+                for rest in factorizations(n // d, axes[1:]):
+                    yield {axes[0]: d, **rest}
+            d *= 2
+
+    ranked = []
+    for mesh in factorizations(max(1, int(device_count)),
+                               list(axis_names)):
+        mesh = {k: v for k, v in mesh.items() if v > 1} or \
+            {axis_names[0]: 1}
+        if mesh == base:
+            continue
+        try:
+            feas = shard_check.feasibility(program, base, mesh,
+                                           batch_rows=batch_rows)
+            if not feas.get("feasible", False):
+                continue
+            rep = shard_check.comm_report(program, mesh,
+                                          batch_rows=batch_rows)
+            cost = float(rep.get("predicted_total", 0.0))
+        except Exception:  # noqa: BLE001 - precheck unavailable: skip shape
+            continue
+        ranked.append((cost, mesh))
+    ranked.sort(key=lambda cm: (cm[0], sorted(cm[1].items())))
+    return [TunedConfig()] + [TunedConfig(mesh_axes=m)
+                              for _, m in ranked]

@@ -1,0 +1,323 @@
+"""The measured candidate search (docs/autotune.md).
+
+A trial is K REAL dispatches of the program under one candidate
+config (`tune.config_override`), wall-clocked host-side with an
+explicit device sync at each step boundary — the one place in the
+stack allowed to block on the device by design, because the answer IS
+the wall time.  An `obs.profile_window` is armed around the scored
+steps best-effort: when the capture succeeds (on-chip, or a CPU build
+with profiling available), the roofline bound verdicts
+(compute/memory/relayout) break near-ties; when it fails the search
+degrades to pure wall time.
+
+Scoring: median step time over the scored steps (the first dispatch
+per candidate is the compile step and is discarded when K > 1).  The
+default config is always candidate 0 and a tie-break can never
+displace a strictly-faster default — the committed winner's measured
+step time is <= the default's by construction.
+
+Profiler surface: `autotune_trials` (one per measured dispatch),
+`autotune_searches`, `autotune_commits` counters; `autotune_trial_ms`
+/ `autotune_search_ms` timers; `autotune.search` / `autotune.trial`
+obs spans.
+"""
+
+from __future__ import annotations
+
+import statistics
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from . import record, space
+from .space import TunedConfig
+
+# near-tie band: candidates within 2% of the fastest compete on
+# roofline verdicts and override count instead of timer noise
+_TIE_BAND = 1.02
+
+
+def _trial_steps() -> int:
+    from ..fluid.flags import flag
+
+    return max(1, int(flag("autotune_trial_steps", 3)))
+
+
+def _sync(values) -> None:
+    import jax
+
+    jax.block_until_ready(values)  # sync-ok: trial measurement boundary
+
+
+def _bound_badness(program) -> Optional[int]:
+    """Roofline tie-break input: how many measured ops are
+    memory-/relayout-bound (lower is better — compute-bound is where a
+    TPU wants to live).  None when no window attributed this
+    program."""
+    try:
+        from .. import obs
+
+        rl = obs.roofline(program=program)
+        if not rl:
+            return None
+        rows = rl.get("ops") or []
+        return sum(1 for r in rows
+                   if r.get("bound") in ("memory-bound", "relayout-bound"))
+    except Exception:  # noqa: BLE001 - roofline is best-effort here
+        return None
+
+
+class Trial:
+    """One candidate's measured outcome."""
+
+    __slots__ = ("config", "step_ms", "steps", "badness", "error")
+
+    def __init__(self, config: TunedConfig):
+        self.config = config
+        self.step_ms: Optional[float] = None
+        self.steps = 0
+        self.badness: Optional[int] = None
+        self.error: Optional[str] = None
+
+    def row(self) -> dict:
+        return {"config": self.config.label(),
+                "token": self.config.token(),
+                "step_ms": self.step_ms,
+                "steps": self.steps,
+                "bound_bad_ops": self.badness,
+                "error": self.error}
+
+
+def _measure_program(exe, program, feed_arrays, fetch_names, scope,
+                     config: TunedConfig, steps: int) -> Trial:
+    """Dispatch `program` for `steps` scored steps (plus one discarded
+    compile step when steps > 1) under `config`.  The candidate's
+    token joins the compile-cache key through the thread-local
+    override, so each candidate compiles exactly once and its
+    executable is shared with a later steady-state run of the same
+    config."""
+    from .. import obs, tune
+    from ..profiler import stat_add, timed
+
+    trial = Trial(config)
+    times: List[float] = []
+    total = steps + 1 if steps > 1 else steps
+    try:
+        with obs.span("autotune.trial"), tune.config_override(config):
+            window = None
+            try:
+                window = obs.profile_window(
+                    label=f"autotune:{config.token()[:8]}")
+            except Exception:  # noqa: BLE001 - window busy/unavailable
+                window = None
+            try:
+                for k in range(total):
+                    with timed("autotune_trial_ms"):
+                        t0 = time.perf_counter()
+                        outs = exe.run(program=program, feed=feed_arrays,
+                                       fetch_list=list(fetch_names),
+                                       scope=scope, return_numpy=False)
+                        _sync(outs)
+                        dt_ms = (time.perf_counter() - t0) * 1e3
+                    stat_add("autotune_trials")
+                    trial.steps += 1
+                    if k > 0 or total == 1:
+                        times.append(dt_ms)
+            finally:
+                if window is not None:
+                    try:
+                        window.finish()
+                    except Exception:  # noqa: BLE001 - capture is best-effort
+                        pass
+        trial.step_ms = statistics.median(times)
+        trial.badness = _bound_badness(program)
+    except Exception as e:  # noqa: BLE001 - a failing candidate loses, only
+        trial.error = f"{type(e).__name__}: {e}"
+    return trial
+
+
+def _pick_winner(trials: Sequence[Trial]) -> Trial:
+    """Fastest median wins; within the 2% band, fewer memory-/
+    relayout-bound ops win, then fewer overrides.  The default
+    (candidate 0) can never lose to a band-mate that measured slower
+    than it — the acceptance contract is winner.step_ms <=
+    default.step_ms."""
+    scored = [t for t in trials if t.step_ms is not None]
+    if not scored:
+        return trials[0]
+    fastest = min(scored, key=lambda t: t.step_ms)
+    band = [t for t in scored if t.step_ms <= fastest.step_ms * _TIE_BAND]
+
+    def rank(t: Trial):
+        bad = t.badness if t.badness is not None else 1 << 30
+        return (bad, t.config.overrides(), t.step_ms)
+
+    winner = min(band, key=rank)
+    default = trials[0]
+    if default.step_ms is not None and winner.step_ms > default.step_ms:
+        winner = fastest if fastest.step_ms < default.step_ms else default
+    return winner
+
+
+def search_program(exe, program, feed_arrays, fetch_names,
+                   scope) -> Optional[TunedConfig]:
+    """Run the full candidate search for one static Program: generate
+    content-gated candidates, measure each, commit the winner into the
+    persistent record, and seat it in the in-process resolution memo.
+    Returns the winner, or None when the space degenerates to the
+    default alone (nothing to tune — no record, no token)."""
+    from .. import obs, tune
+    from ..profiler import stat_add, timed
+
+    candidates = space.program_candidates(program)
+    if len(candidates) < 2:
+        return None
+    steps = _trial_steps()
+    with obs.span("autotune.search"), timed("autotune_search_ms"), \
+            tune._search_scope():
+        stat_add("autotune_searches")
+        trials = [_measure_program(exe, program, feed_arrays, fetch_names,
+                                   scope, cfg, steps)
+                  for cfg in candidates]
+        winner = _pick_winner(trials)
+        stable = record.stable_for_program(program)
+        if stable:
+            record.try_store(stable, winner.config.to_dict(), extra={
+                "objective": "median_step_ms",
+                "trial_steps": steps,
+                "trials": [t.row() for t in trials],
+                "label": getattr(program, "prog_id", None),
+            })
+        stat_add("autotune_commits")
+        tune._prime(program, winner.config)
+    return winner.config
+
+
+# -- functional-path search (kernel choices, bucket ladders) -----------------
+
+def _measure_callable(fn, args, config: TunedConfig, steps: int) -> Trial:
+    """Measure one kernel-choice candidate over a plain jax callable:
+    a FRESH jit wrapper per candidate (so jax re-traces under the
+    override — the dispatch seams read `tune.kernel_choice` at trace
+    time), one discarded compile call, then K scored calls."""
+    import jax
+
+    from .. import obs, tune
+    from ..profiler import stat_add, timed
+
+    trial = Trial(config)
+    times: List[float] = []
+    try:
+        with obs.span("autotune.trial"), tune.config_override(config):
+            jitted = jax.jit(lambda *a: fn(*a))
+            for k in range(steps + 1):
+                with timed("autotune_trial_ms"):
+                    t0 = time.perf_counter()
+                    out = jitted(*args)
+                    _sync(out)
+                    dt_ms = (time.perf_counter() - t0) * 1e3
+                stat_add("autotune_trials")
+                trial.steps += 1
+                if k > 0:
+                    times.append(dt_ms)
+        trial.step_ms = statistics.median(times)
+    except Exception as e:  # noqa: BLE001 - a failing candidate loses, only
+        trial.error = f"{type(e).__name__}: {e}"
+    return trial
+
+
+def tune_callable(fn, args: Sequence[Any], kernels: Sequence[str] = ("ffn",),
+                  token: Optional[str] = None,
+                  steps: Optional[int] = None) -> TunedConfig:
+    """A/B the TUNABLE_KERNELS choices for a functional-path
+    computation (the re-armed Pallas-FFN A/B rides this): measure
+    `fn(*args)` under each kernel assignment, return the winner, and —
+    when `token` names the computation — persist it so
+    `tune.config_override(tune.resolve_callable(token))` replays the
+    choice in a later process."""
+    from .. import obs, tune
+    from ..profiler import stat_add, timed
+
+    if mode_off():
+        return TunedConfig()
+    steps = steps or _trial_steps()
+    candidates = space.kernel_candidates(kernels)
+    with obs.span("autotune.search"), timed("autotune_search_ms"), \
+            tune._search_scope():
+        stat_add("autotune_searches")
+        trials = [_measure_callable(fn, args, cfg, steps)
+                  for cfg in candidates]
+        winner = _pick_winner(trials)
+        if token:
+            record.try_store(record.stable_for_runner(token),
+                             winner.config.to_dict(), extra={
+                                 "objective": "median_step_ms",
+                                 "kind": "callable",
+                                 "trials": [t.row() for t in trials]})
+        stat_add("autotune_commits")
+    return winner.config
+
+
+def tune_buckets(fn, sample_rows: Sequence[int], max_batch: int,
+                 token: str, trailing: Sequence[int] = (),
+                 dtype="float32",
+                 steps: Optional[int] = None) -> List[int]:
+    """A/B candidate serving bucket ladders for one model `token`:
+    replay a sample row-count traffic mix through a throwaway
+    BucketedRunner per ladder (more buckets = more compiles + tighter
+    padding; fewer = the opposite — a measured question), commit the
+    winning ladder, which `BucketedRunner(aot_token=token)` then
+    resolves at construction in every later process."""
+    import numpy as np
+
+    from .. import obs, tune
+    from ..profiler import stat_add, timed
+    from ..serving.bucketing import BucketedRunner, bucket_ladder
+
+    if mode_off():
+        return bucket_ladder(max_batch)
+    steps = steps or _trial_steps()
+    candidates = space.bucket_candidates(max_batch)
+    feeds = [np.ones((max(1, int(r)), *trailing), dtype=dtype)
+             for r in sample_rows]
+    with obs.span("autotune.search"), timed("autotune_search_ms"), \
+            tune._search_scope():
+        stat_add("autotune_searches")
+        trials = []
+        for cfg in candidates:
+            ladder = cfg.buckets or bucket_ladder(max_batch)
+            trial = Trial(cfg)
+            try:
+                with obs.span("autotune.trial"), tune.config_override(cfg):
+                    runner = BucketedRunner(fn, ladder)
+                    times = []
+                    for k in range(steps + 1):
+                        with timed("autotune_trial_ms"):
+                            t0 = time.perf_counter()
+                            for x in feeds:
+                                _sync(runner([x]))
+                            dt_ms = (time.perf_counter() - t0) * 1e3
+                        stat_add("autotune_trials")
+                        trial.steps += 1
+                        if k > 0:
+                            times.append(dt_ms)
+                trial.step_ms = statistics.median(times)
+            except Exception as e:  # noqa: BLE001 - failing ladder loses
+                trial.error = f"{type(e).__name__}: {e}"
+            trials.append(trial)
+        winner = _pick_winner(trials)
+        ladder = winner.config.buckets or bucket_ladder(max_batch)
+        record.try_store(record.stable_for_runner(token),
+                         TunedConfig(buckets=ladder).to_dict(), extra={
+                             "objective": "median_mix_ms",
+                             "kind": "bucket_ladder",
+                             "sample_rows": [int(r) for r in sample_rows],
+                             "trials": [t.row() for t in trials]})
+        stat_add("autotune_commits")
+        tune._RUNNER_BUCKETS.pop(token, None)
+    return ladder
+
+
+def mode_off() -> bool:
+    from . import mode
+
+    return mode() == "off"

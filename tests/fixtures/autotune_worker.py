@@ -1,0 +1,72 @@
+"""Autotune subprocess worker (tests/test_autotune.py).
+
+Builds the SAME deterministic eval-mode conv+bn trunk in every process
+(`aot_cache.program_token` hashes prog_id + the program dict, and
+prog_id is sequential per process — an identical build order gives
+identical stable record keys across processes), runs AT_STEPS executor
+dispatches under the env-configured PADDLE_AUTOTUNE mode, and dumps
+the fetched output plus every autotune_* counter as JSON to argv[1].
+
+The tuning configuration comes entirely from the environment
+(PADDLE_AUTOTUNE / PADDLE_AUTOTUNE_DIR / PADDLE_AUTOTUNE_TRIAL_STEPS,
+plus PADDLE_QUANT_COLLECTIVES to drift the volatile signature), so the
+calling test composes cold-search / warm-replay / off / drifted runs
+from one deterministic program.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import profiler
+from paddle_tpu.fluid import framework
+
+
+def build():
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        x = fluid.data("x", [4, 3, 12, 12], "float32")
+        y = fluid.layers.conv2d(x, 8, 3, padding=1, bias_attr=True)
+        y = fluid.layers.batch_norm(y, act="relu", is_test=True)
+        y = fluid.layers.conv2d(y, 8, 3, padding=1, bias_attr=False)
+        y = fluid.layers.batch_norm(y, act="relu", is_test=True)
+    return main, startup, y.name
+
+
+def main(out_path: str) -> None:
+    steps = int(os.environ.get("AT_STEPS", "2"))
+    main_prog, startup, yname = build()
+    exe = fluid.Executor()
+    exe.run(startup)
+    # give the running bn stats non-default values (fixed seed: every
+    # process bakes the same statistics, so outputs compare exactly)
+    rng = np.random.RandomState(23)
+    scope = fluid.executor.global_scope()
+    for v in main_prog.list_vars():
+        if not v.persistable or scope.get(v.name) is None:
+            continue
+        cur = np.asarray(scope.get(v.name))
+        if cur.ndim != 1:
+            continue
+        scope.set(v.name, rng.uniform(0.5, 1.5,
+                                      cur.shape).astype(cur.dtype))
+    feed = {"x": np.linspace(-1.0, 1.0, 4 * 3 * 12 * 12,
+                             dtype=np.float32).reshape(4, 3, 12, 12)}
+    out = None
+    for _ in range(steps):
+        (out,) = exe.run(main_prog, feed=feed, fetch_list=[yname])
+    s = profiler.get_int_stats()
+    with open(out_path, "w") as f:
+        json.dump({
+            "out": np.asarray(out).tolist(),
+            "stats": {k: v for k, v in s.items()
+                      if k.startswith("autotune")},
+            "compiles": s.get("executor_compile_count", 0),
+        }, f)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

@@ -1,0 +1,496 @@
+"""Self-tuning compile pipeline (paddle_tpu/tune, ISSUE 19).
+
+Three layers of proof, mirroring the AOT-cache suite it rides beside:
+
+* in-process unit tests — candidate-space content gating, the
+  TunedConfig token discipline (flipping any tuned dimension changes
+  the signature-join token), winner selection (a committed winner can
+  never be slower than the measured default), record store/load with
+  drift + corruption as counted misses, and `PADDLE_AUTOTUNE=off` as a
+  byte-identical bypass (empty cache-key component, no overrides);
+* in-process acceptance — a force-mode Executor run on the toy
+  conv+bn trunk evaluates >= 3 distinct candidates, commits a winner
+  whose measured step time is <= the default's, and a memo-reset
+  replay resolves it from the record with zero new trials;
+* cross-process acceptance — a FRESH process replays the persisted
+  winner (`autotune_trials == 0`, `autotune_record_hits >= 1`) with
+  outputs identical to the searching process, and a volatile-signature
+  drift (quantized-collectives flip) forces a full re-tune.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+import paddle_tpu.fluid as fluid
+from paddle_tpu import profiler, tune
+from paddle_tpu.fluid import flags, framework, unique_name
+from paddle_tpu.fluid.executor import Scope, scope_guard
+from paddle_tpu.tune import TunedConfig, record, space, tuner
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "fixtures", "autotune_worker.py")
+
+
+def _stat(name):
+    return profiler.get_int_stats().get(name, 0)
+
+
+@pytest.fixture
+def tuned_at(tmp_path):
+    """Point the tuner at a test-local record dir in 'on' mode; drop
+    the in-process memos and restore every flag after."""
+    old = {k: flags.flag(k) for k in
+           ("autotune", "autotune_dir", "autotune_trial_steps")}
+    flags.set_flags({"FLAGS_autotune": "on",
+                     "FLAGS_autotune_dir": str(tmp_path),
+                     "FLAGS_autotune_trial_steps": 2})
+    tune.reset_memo()
+    try:
+        yield str(tmp_path)
+    finally:
+        flags.set_flags({f"FLAGS_{k}": v for k, v in old.items()})
+        tune.reset_memo()
+
+
+def _conv_bn_eval_program():
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup), unique_name.guard():
+        x = fluid.data("x", [4, 3, 12, 12], "float32")
+        y = fluid.layers.conv2d(x, 8, 3, padding=1, bias_attr=True)
+        y = fluid.layers.batch_norm(y, act="relu", is_test=True)
+    return main, startup, y.name
+
+
+# ---------------------------------------------------------------------------
+# candidate space
+# ---------------------------------------------------------------------------
+
+class TestCandidateSpace:
+    def test_conv_bn_program_yields_three_plus(self, tuned_at):
+        main, _, _ = _conv_bn_eval_program()
+        cands = space.program_candidates(main)
+        assert len(cands) >= 3
+        assert cands[0].is_default()
+        tokens = [c.token() for c in cands]
+        assert len(set(tokens)) == len(tokens)  # all distinct points
+        labels = " ".join(c.label() for c in cands)
+        assert "fold_bn=on" in labels
+        assert "layout_optimize=off" in labels
+
+    def test_glue_program_is_never_searched(self, tuned_at):
+        main, startup = framework.Program(), framework.Program()
+        with framework.program_guard(main, startup), \
+                unique_name.guard():
+            x = fluid.data("x", [4, 8], "float32")
+            fluid.layers.relu(x)
+        assert len(space.program_candidates(main)) == 1
+
+    def test_grad_program_gets_no_fold_bn_candidate(self, tuned_at):
+        main, startup = framework.Program(), framework.Program()
+        with framework.program_guard(main, startup), \
+                unique_name.guard():
+            x = fluid.data("x", [4, 3, 12, 12], "float32")
+            y = fluid.layers.conv2d(x, 8, 3, padding=1)
+            y = fluid.layers.batch_norm(y, is_test=True)
+            loss = fluid.layers.reduce_mean(y)
+            fluid.append_backward(loss)
+        labels = " ".join(c.label()
+                          for c in space.program_candidates(main))
+        assert "fold_bn" not in labels
+
+    def test_candidate_cap_never_drops_default(self, tuned_at):
+        flags.set_flags({"FLAGS_autotune_max_candidates": 1})
+        try:
+            main, _, _ = _conv_bn_eval_program()
+            cands = space.program_candidates(main)
+            assert len(cands) == 1 and cands[0].is_default()
+        finally:
+            flags.set_flags({"FLAGS_autotune_max_candidates": 6})
+
+    def test_kernel_and_bucket_candidates(self, tuned_at):
+        ks = space.kernel_candidates(["ffn"])
+        assert [c.kernels.get("ffn") for c in ks] == \
+            [None, "xla", "pallas"]
+        bs = space.bucket_candidates(64)
+        assert bs[0].is_default()
+        assert [8, 16, 32, 64] in [c.buckets for c in bs[1:]]
+        assert [64] in [c.buckets for c in bs[1:]]
+
+
+# ---------------------------------------------------------------------------
+# TunedConfig token discipline (the signature join)
+# ---------------------------------------------------------------------------
+
+class TestTokenDiscipline:
+    def test_every_dimension_moves_the_token(self):
+        base = TunedConfig()
+        variants = [
+            TunedConfig(passes={"fold_bn": True}),
+            TunedConfig(passes={"fold_bn": False}),
+            TunedConfig(kernels={"ffn": "pallas"}),
+            TunedConfig(kernels={"ffn": "xla"}),
+            TunedConfig(buckets=[8, 16]),
+            TunedConfig(mesh_axes={"data": 4}),
+        ]
+        tokens = [base.token()] + [v.token() for v in variants]
+        assert len(set(tokens)) == len(tokens)
+
+    def test_roundtrip_through_record_dict(self):
+        cfg = TunedConfig(passes={"layout_optimize": False},
+                          kernels={"ffn": "pallas"}, buckets=[16, 64])
+        back = TunedConfig.from_dict(
+            json.loads(json.dumps(cfg.to_dict())))
+        assert back.token() == cfg.token()
+        assert not back.is_default()
+
+    def test_cache_key_joins_effective_config(self, tuned_at):
+        main, _, _ = _conv_bn_eval_program()
+        assert tune.cache_token(main) == ()  # untuned: empty component
+        cfg = TunedConfig(passes={"fold_bn": True})
+        with tune.config_override(cfg):
+            tok = tune.cache_token(main)
+            assert tok == (f"autotune={cfg.token()}",)
+            assert tune.aot_token_component(main) == tok[0]
+            assert tune.pass_overrides(main) == {"fold_bn": True}
+        assert tune.cache_token(main) == ()
+
+    def test_off_mode_is_total_bypass(self, tuned_at):
+        """With a committed NON-default record on disk, off-mode still
+        reports the empty token/overrides — the compile-cache key and
+        lowered graph are byte-identical to pre-autotune."""
+        main, _, _ = _conv_bn_eval_program()
+        stable = record.stable_for_program(main)
+        assert record.try_store(
+            stable, TunedConfig(passes={"fold_bn": True}).to_dict())
+        flags.set_flags({"FLAGS_autotune": "off"})
+        tune.reset_memo()
+        c0 = _stat("autotune_record_hits")
+        assert tune.cache_token(main) == ()
+        assert tune.aot_token_component(main) is None
+        assert tune.pass_overrides(main) is None
+        assert tune.kernel_choice("ffn") is None
+        assert tune.resolve(main) is None
+        assert _stat("autotune_record_hits") == c0  # record never read
+
+
+# ---------------------------------------------------------------------------
+# record store: drift and corruption are counted misses
+# ---------------------------------------------------------------------------
+
+class TestRecordStore:
+    def test_store_load_roundtrip(self, tuned_at):
+        main, _, _ = _conv_bn_eval_program()
+        stable = record.stable_for_program(main)
+        cfg = TunedConfig(passes={"fold_bn": True})
+        h0, s0 = _stat("autotune_record_hits"), \
+            _stat("autotune_record_stores")
+        assert record.try_store(stable, cfg.to_dict(),
+                                extra={"objective": "median_step_ms"})
+        assert _stat("autotune_record_stores") == s0 + 1
+        rec = record.try_load(stable)
+        assert rec is not None
+        assert _stat("autotune_record_hits") == h0 + 1
+        assert TunedConfig.from_dict(rec["config"]).token() == \
+            cfg.token()
+        # commit is atomic: one .json, no .tmp-* litter
+        names = os.listdir(tuned_at)
+        assert [n for n in names if n.startswith(".tmp-")] == []
+
+    def test_volatile_drift_is_counted_hard_miss(self, tuned_at):
+        main, _, _ = _conv_bn_eval_program()
+        stable = record.stable_for_program(main)
+        record.try_store(stable, TunedConfig().to_dict())
+        old_q = flags.flag("quant_collectives")
+        flags.set_flags({"FLAGS_quant_collectives": "int8"})
+        try:
+            d0, m0 = (_stat("autotune_record_drift"),
+                      _stat("autotune_record_misses"))
+            assert record.try_load(stable) is None
+            assert _stat("autotune_record_drift") == d0 + 1
+            assert _stat("autotune_record_misses") == m0 + 1
+        finally:
+            flags.set_flags({"FLAGS_quant_collectives": old_q})
+        assert record.try_load(stable) is not None  # original hits
+
+    def test_corrupted_record_is_counted_miss_never_crash(
+            self, tuned_at):
+        main, _, _ = _conv_bn_eval_program()
+        stable = record.stable_for_program(main)
+        record.try_store(stable, TunedConfig().to_dict())
+        (name,) = os.listdir(tuned_at)
+        with open(os.path.join(tuned_at, name), "w") as f:
+            f.write('{"truncat')
+        e0, m0 = (_stat("autotune_record_errors"),
+                  _stat("autotune_record_misses"))
+        assert record.try_load(stable) is None
+        assert _stat("autotune_record_errors") == e0 + 1
+        assert _stat("autotune_record_misses") == m0 + 1
+        tune.reset_memo()
+        assert tune.resolve(main) is None  # resolution degrades, only
+
+
+# ---------------------------------------------------------------------------
+# winner selection
+# ---------------------------------------------------------------------------
+
+def _trial(cfg, step_ms, badness=None):
+    t = tuner.Trial(cfg)
+    t.step_ms = step_ms
+    t.badness = badness
+    return t
+
+
+class TestWinnerSelection:
+    def test_fastest_wins_outside_band(self):
+        trials = [_trial(TunedConfig(), 10.0),
+                  _trial(TunedConfig(passes={"fold_bn": True}), 7.0)]
+        assert tuner._pick_winner(trials) is trials[1]
+
+    def test_tie_break_prefers_better_roofline(self):
+        """Within the 2% band the roofline verdict decides — but only
+        among candidates not slower than the measured default."""
+        fold = TunedConfig(passes={"fold_bn": True})
+        sink = TunedConfig(passes={"transpose_sink": True})
+        trials = [_trial(TunedConfig(), 10.3, badness=5),
+                  _trial(fold, 10.0, badness=5),
+                  _trial(sink, 10.1, badness=1)]
+        assert tuner._pick_winner(trials) is trials[2]
+
+    def test_tie_break_prefers_fewer_overrides(self):
+        one = TunedConfig(passes={"fold_bn": True})
+        two = TunedConfig(passes={"fold_bn": True,
+                                  "layout_optimize": False})
+        trials = [_trial(two, 10.0, badness=1),
+                  _trial(one, 10.1, badness=1)]
+        # two is fastest but within the band `one` ranks higher
+        trials = [_trial(TunedConfig(), 20.0, badness=1)] + trials
+        assert tuner._pick_winner(trials).config is one
+
+    def test_winner_never_slower_than_default(self):
+        """The acceptance contract: a tie-break can never commit a
+        config that measured slower than the default."""
+        slow = TunedConfig(passes={"fold_bn": True})
+        trials = [_trial(TunedConfig(), 10.0, badness=5),
+                  _trial(slow, 10.15, badness=0)]
+        w = tuner._pick_winner(trials)
+        assert w.step_ms <= trials[0].step_ms
+        assert w.config.is_default()
+
+    def test_all_failed_falls_back_to_default(self):
+        t0 = tuner.Trial(TunedConfig())
+        t1 = tuner.Trial(TunedConfig(passes={"fold_bn": True}))
+        t0.error = t1.error = "Boom"
+        assert tuner._pick_winner([t0, t1]) is t0
+
+
+# ---------------------------------------------------------------------------
+# in-process acceptance: force-mode search on the Executor path
+# ---------------------------------------------------------------------------
+
+class TestForcedSearch:
+    def test_search_commits_winner_and_replays_from_record(
+            self, tuned_at):
+        main, startup, yname = _conv_bn_eval_program()
+        rng = np.random.RandomState(5)
+        xv = rng.rand(4, 3, 12, 12).astype("float32")
+        scope = Scope()
+        flags.set_flags({"FLAGS_autotune": "force"})
+        with scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup)
+            s0, t0, c0 = (_stat("autotune_searches"),
+                          _stat("autotune_trials"),
+                          _stat("autotune_commits"))
+            (ref,) = exe.run(main, feed={"x": xv}, fetch_list=[yname])
+            assert _stat("autotune_searches") == s0 + 1
+            assert _stat("autotune_commits") == c0 + 1
+            trials_run = _stat("autotune_trials") - t0
+            assert trials_run >= 3  # >= 3 candidates, >= 1 step each
+            # the committed record names >= 3 distinct measured
+            # candidates and the winner is not slower than default
+            (name,) = [n for n in os.listdir(tuned_at)
+                       if n.endswith(".json")]
+            with open(os.path.join(tuned_at, name)) as f:
+                rec = json.load(f)
+            rows = rec["extra"]["trials"]
+            assert len(rows) >= 3
+            assert len({r["token"] for r in rows}) == len(rows)
+            scored = [r for r in rows if r["step_ms"] is not None]
+            default_ms = rows[0]["step_ms"]
+            winner_tok = TunedConfig.from_dict(rec["config"]).token()
+            (winner_row,) = [r for r in scored
+                             if r["token"] == winner_tok]
+            assert winner_row["step_ms"] <= default_ms
+            # a second run is a pure cache hit: no new search/trials
+            t1 = _stat("autotune_trials")
+            (got,) = exe.run(main, feed={"x": xv}, fetch_list=[yname])
+            assert _stat("autotune_trials") == t1
+            assert _stat("autotune_searches") == s0 + 1
+            np.testing.assert_allclose(np.asarray(got),
+                                       np.asarray(ref),
+                                       rtol=1e-4, atol=1e-5)
+            # memo reset = fresh-process approximation: the winner
+            # resolves from the record with zero trial dispatches
+            tune.reset_memo()
+            h0 = _stat("autotune_record_hits")
+            (rep,) = exe.run(main, feed={"x": xv}, fetch_list=[yname])
+            assert _stat("autotune_record_hits") >= h0 + 1
+            assert _stat("autotune_trials") == t1
+            np.testing.assert_allclose(np.asarray(rep),
+                                       np.asarray(ref),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_glue_program_force_mode_never_searches(self, tuned_at):
+        flags.set_flags({"FLAGS_autotune": "force"})
+        main, startup = framework.Program(), framework.Program()
+        with framework.program_guard(main, startup), \
+                unique_name.guard():
+            x = fluid.data("x", [4, 8], "float32")
+            y = fluid.layers.relu(x)
+        with scope_guard(Scope()):
+            exe = fluid.Executor()
+            exe.run(startup)
+            s0 = _stat("autotune_searches")
+            exe.run(main, feed={"x": np.ones((4, 8), "float32")},
+                    fetch_list=[y.name])
+            assert _stat("autotune_searches") == s0
+
+
+# ---------------------------------------------------------------------------
+# functional-path tuning: kernel choice + bucket ladders
+# ---------------------------------------------------------------------------
+
+class TestFunctionalPath:
+    def test_kernel_choice_reads_thread_local_only(self, tuned_at):
+        assert tune.kernel_choice("ffn") is None
+        with tune.config_override(
+                TunedConfig(kernels={"ffn": "pallas"})):
+            assert tune.kernel_choice("ffn") == "pallas"
+            assert tune.kernel_choice("other") is None
+        assert tune.kernel_choice("ffn") is None
+
+    def test_tune_callable_commits_and_resolves(self, tuned_at):
+        import jax.numpy as jnp
+
+        def fn(x):
+            return jnp.tanh(x) * 2.0
+
+        args = (jnp.ones((8, 8), jnp.float32),)
+        cfg = tuner.tune_callable(fn, args, kernels=["ffn"],
+                                  token="test-callable", steps=1)
+        assert cfg is not None
+        resolved = tune.resolve_callable("test-callable")
+        assert resolved is not None
+        assert resolved.token() == cfg.token()
+
+    def test_tune_buckets_commits_ladder_runner_resolves(
+            self, tuned_at):
+        import jax.numpy as jnp
+
+        from paddle_tpu.serving.bucketing import BucketedRunner
+
+        def fn(x):
+            return jnp.maximum(x, 0.0)
+
+        ladder = tuner.tune_buckets(fn, sample_rows=[3, 9, 20],
+                                    max_batch=32, token="test-model",
+                                    trailing=(4,), steps=1)
+        assert ladder and ladder == sorted(set(ladder))
+        runner = BucketedRunner(fn, [8, 16, 32],
+                                aot_token="test-model")
+        assert runner.buckets == sorted(set(ladder))
+        # a different token keeps the caller's ladder
+        other = BucketedRunner(fn, [8, 16, 32], aot_token="other")
+        assert other.buckets == [8, 16, 32]
+
+
+# ---------------------------------------------------------------------------
+# cross-process acceptance (the aot_worker subprocess idiom)
+# ---------------------------------------------------------------------------
+
+def _run_worker(out, tune_dir, mode="force", quant=None, steps=2):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PADDLE_AOT_CACHE"] = "off"
+    env["PADDLE_AUTOTUNE"] = mode
+    env["PADDLE_AUTOTUNE_DIR"] = str(tune_dir)
+    env["PADDLE_AUTOTUNE_TRIAL_STEPS"] = "2"
+    env["AT_STEPS"] = str(steps)
+    env.pop("PADDLE_QUANT_COLLECTIVES", None)
+    if quant is not None:
+        env["PADDLE_QUANT_COLLECTIVES"] = quant
+    proc = subprocess.run([sys.executable, WORKER, str(out)], env=env,
+                          cwd=REPO, capture_output=True, text=True,
+                          timeout=560)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    with open(out) as f:
+        return json.load(f)
+
+
+@pytest.fixture(scope="module")
+def cold_and_warm(tmp_path_factory):
+    """One cold force-mode search populating a record dir + one warm
+    restart replaying against it (shared below — subprocesses are the
+    expensive part)."""
+    root = tmp_path_factory.mktemp("autotune_accept")
+    tdir = root / "tuning"
+    tdir.mkdir()
+    cold = _run_worker(root / "cold.json", tdir)
+    warm = _run_worker(root / "warm.json", tdir)
+    return {"dir": tdir, "root": root, "cold": cold, "warm": warm}
+
+
+@pytest.mark.slow
+class TestCrossProcessAcceptance:
+    def test_cold_searches_and_commits(self, cold_and_warm):
+        cold = cold_and_warm["cold"]
+        assert cold["stats"].get("autotune_searches", 0) == 1
+        assert cold["stats"].get("autotune_commits", 0) == 1
+        assert cold["stats"].get("autotune_trials", 0) >= 3
+        recs = [n for n in os.listdir(cold_and_warm["dir"])
+                if n.endswith(".json")]
+        assert len(recs) == 1
+
+    def test_warm_replays_with_zero_trials(self, cold_and_warm):
+        # THE acceptance line: a fresh process resolves the persisted
+        # winner on first compile with zero search cost
+        warm = cold_and_warm["warm"]
+        assert warm["stats"].get("autotune_trials", 0) == 0
+        assert warm["stats"].get("autotune_searches", 0) == 0
+        assert warm["stats"].get("autotune_record_hits", 0) >= 1
+
+    def test_warm_outputs_match_cold(self, cold_and_warm):
+        np.testing.assert_array_equal(
+            np.asarray(cold_and_warm["cold"]["out"]),
+            np.asarray(cold_and_warm["warm"]["out"]))
+
+    def test_off_bypasses_and_matches_untuned_numerics(
+            self, cold_and_warm, tmp_path):
+        off = _run_worker(tmp_path / "off.json", cold_and_warm["dir"],
+                          mode="off")
+        assert off["stats"] == {}  # no autotune_* counter ever moved
+        # the tuned config may fold/relayout (float reassociation):
+        # tolerance-level parity, not byte equality, is the contract
+        np.testing.assert_allclose(
+            np.asarray(off["out"]),
+            np.asarray(cold_and_warm["cold"]["out"]),
+            rtol=1e-4, atol=1e-5)
+
+    def test_volatile_drift_forces_retune(self, cold_and_warm,
+                                          tmp_path):
+        """PADDLE_QUANT_COLLECTIVES flipped between processes: the
+        committed winner rides the OLD volatile signature — the new
+        process must drift-miss and re-run the search."""
+        drifted = _run_worker(tmp_path / "drift.json",
+                              cold_and_warm["dir"], quant="int8")
+        assert drifted["stats"].get("autotune_record_hits", 0) == 0
+        assert drifted["stats"].get("autotune_record_drift", 0) >= 1
+        assert drifted["stats"].get("autotune_searches", 0) == 1

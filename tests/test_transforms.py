@@ -364,6 +364,90 @@ def test_fold_bn_predictor_path_parity(tmp_path):
     np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
 
 
+def _conv_bias_bn_infer_programs():
+    """conv2d(bias_attr=True) -> elementwise_add -> batch_norm: the
+    conv_eltwiseadd_bn_fuse_pass chain shape (ISSUE 19)."""
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup), unique_name.guard():
+        x = fluid.data("x", [4, 3, 16, 16], "float32")
+        y = fluid.layers.conv2d(x, 8, 3, padding=1, bias_attr=True)
+        y = fluid.layers.batch_norm(y, act="relu", is_test=True)
+    return main, startup, y.name
+
+
+def test_fold_bn_chain_conv_bias_bn_matches():
+    """The conv -> add(bias) -> bn chain folds in one rewrite: the
+    bias rides the shifted mean (beta - s*(mu - b)) and both the bn
+    AND the standalone bias add disappear."""
+    main, startup, yname = _conv_bias_bn_infer_programs()
+    rng = np.random.RandomState(13)
+    xv = rng.rand(4, 3, 16, 16).astype("float32")
+    scope = Scope()
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        _perturb_bn_stats(scope, main, rng)
+        paddle_tpu.set_flags({"FLAGS_graph_transforms": "off"})
+        (ref,) = exe.run(main, feed={"x": xv}, fetch_list=[yname])
+        paddle_tpu.set_flags({"FLAGS_graph_transforms": "on,fold_bn=on"})
+        (got,) = exe.run(main, feed={"x": xv}, fetch_list=[yname])
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+    tprog, stats = transforms.apply_transforms(
+        main, feed_names=["x"], fetch_names=[yname],
+        passes=["fold_bn", "dead_op_elim"])
+    assert stats["fold_bn"] == 1
+    types = [op.type for op in tprog.global_block().ops]
+    assert "batch_norm" not in types
+    # the chain's bias add is absorbed: exactly ONE elementwise_add
+    # remains (the folded output bias)
+    assert types.count("elementwise_add") == 1
+    findings = verifier.verify_program(tprog, feed=["x"],
+                                       fetch_list=[yname])
+    assert not [f for f in findings if f.severity == verifier.ERROR]
+
+
+def test_fold_bn_chain_predictor_path_parity(tmp_path):
+    """ISSUE 19 satellite: the chain fold survives the Predictor path
+    (save/load_inference_model) with fp32-tolerance parity."""
+    main, startup, yname = _conv_bias_bn_infer_programs()
+    rng = np.random.RandomState(14)
+    xv = rng.rand(4, 3, 16, 16).astype("float32")
+    scope = Scope()
+    with scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        _perturb_bn_stats(scope, main, rng)
+        fluid.io.save_inference_model(
+            str(tmp_path / "m"), ["x"],
+            [main.global_block().var(yname)], exe, main_program=main)
+    load_scope = Scope()
+    with scope_guard(load_scope):
+        exe = fluid.Executor()
+        prog, feed_names, fetch_vars = fluid.io.load_inference_model(
+            str(tmp_path / "m"), exe)
+        paddle_tpu.set_flags({"FLAGS_graph_transforms": "off"})
+        (ref,) = exe.run(prog, feed={"x": xv}, fetch_list=fetch_vars)
+        paddle_tpu.set_flags({"FLAGS_graph_transforms": "on,fold_bn=on"})
+        (got,) = exe.run(prog, feed={"x": xv}, fetch_list=fetch_vars)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_fold_bn_chain_skips_nonchannel_bias():
+    """An elementwise_add that is NOT the conv-bias shape (axis != 1
+    or non-vector operand) blocks the chain fold — bn survives."""
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup), unique_name.guard():
+        x = fluid.data("x", [4, 3, 16, 16], "float32")
+        a = fluid.layers.conv2d(x, 8, 3, padding=1, bias_attr=False)
+        b = fluid.layers.conv2d(x, 8, 3, padding=1, bias_attr=False)
+        y = fluid.layers.elementwise_add(a, b)  # tensor-tensor add
+        y = fluid.layers.batch_norm(y, is_test=True)
+    tprog, stats = transforms.apply_transforms(
+        main, feed_names=["x"], fetch_names=[y.name], passes=["fold_bn"])
+    assert stats["fold_bn"] == 0
+    assert "batch_norm" in [op.type for op in tprog.global_block().ops]
+
+
 def test_fold_bn_skips_train_mode_and_grad_programs():
     main, startup = framework.Program(), framework.Program()
     with framework.program_guard(main, startup), unique_name.guard():
@@ -376,6 +460,143 @@ def test_fold_bn_skips_train_mode_and_grad_programs():
         main, feed_names=["x"], fetch_names=[loss.name], passes=["fold_bn"])
     assert stats["fold_bn"] == 0
     assert "batch_norm" in [op.type for op in tprog.global_block().ops]
+
+
+# ---------------------------------------------------------------------------
+# transpose_sink
+# ---------------------------------------------------------------------------
+
+def _transpose_sandwich_programs():
+    """transpose(0,2,3,1) -> relu -> transpose(0,3,1,2): the NCHW-
+    external boundary shape the pass exists for."""
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup), unique_name.guard():
+        x = fluid.data("x", [2, 3, 4, 5], "float32")
+        t = fluid.layers.transpose(x, [0, 2, 3, 1])
+        r = fluid.layers.relu(t)
+        u = fluid.layers.transpose(r, [0, 3, 1, 2])
+        out = fluid.layers.scale(u, scale=2.0)
+    return main, startup, out.name
+
+
+def test_transpose_sink_cancels_inverse_pair():
+    main, startup, oname = _transpose_sandwich_programs()
+    tprog, stats = transforms.apply_transforms(
+        main, feed_names=["x"], fetch_names=[oname],
+        passes=["transpose_sink", "dead_op_elim"])
+    assert stats["transpose_sink"] == 2  # one sink + one cancel
+    types = [op.type for op in tprog.global_block().ops]
+    assert "transpose2" not in types
+    assert types[0] == "relu"
+    findings = verifier.verify_program(tprog, feed=["x"],
+                                       fetch_list=[oname])
+    assert not [f for f in findings if f.severity == verifier.ERROR]
+    # numeric parity through the Executor, flag-gated
+    rng = np.random.RandomState(3)
+    xv = rng.rand(2, 3, 4, 5).astype("float32")
+    with scope_guard(Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        paddle_tpu.set_flags({"FLAGS_graph_transforms": "off"})
+        (ref,) = exe.run(main, feed={"x": xv}, fetch_list=[oname])
+        paddle_tpu.set_flags(
+            {"FLAGS_graph_transforms": "on,transpose_sink=on"})
+        (got,) = exe.run(main, feed={"x": xv}, fetch_list=[oname])
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
+
+
+def test_transpose_sink_keeps_fetched_intermediate():
+    """A fetched permuted intermediate is observable: neither the sink
+    nor the cancel may fire across it."""
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup), unique_name.guard():
+        x = fluid.data("x", [2, 3, 4, 5], "float32")
+        t = fluid.layers.transpose(x, [0, 2, 3, 1])
+        r = fluid.layers.relu(t)
+        u = fluid.layers.transpose(r, [0, 3, 1, 2])
+    tprog, stats = transforms.apply_transforms(
+        main, feed_names=["x"], fetch_names=[t.name, u.name],
+        passes=["transpose_sink"])
+    assert stats["transpose_sink"] == 0
+    types = [op.type for op in tprog.global_block().ops]
+    assert types.count("transpose2") == 2
+    rng = np.random.RandomState(4)
+    xv = rng.rand(2, 3, 4, 5).astype("float32")
+    with scope_guard(Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        paddle_tpu.set_flags({"FLAGS_graph_transforms": "off"})
+        ref = exe.run(main, feed={"x": xv},
+                      fetch_list=[t.name, u.name])
+        paddle_tpu.set_flags(
+            {"FLAGS_graph_transforms": "on,transpose_sink=on"})
+        got = exe.run(main, feed={"x": xv},
+                      fetch_list=[t.name, u.name])
+    for r_, g_ in zip(ref, got):
+        np.testing.assert_allclose(np.asarray(g_), np.asarray(r_),
+                                   rtol=1e-6, atol=1e-6)
+
+
+def test_transpose_sink_never_crosses_dropout():
+    """dropout's stateless mask hashes coordinates — permuting its
+    input permutes WHICH elements drop, so it is not sink-through."""
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup), unique_name.guard():
+        x = fluid.data("x", [2, 3, 4, 5], "float32")
+        t = fluid.layers.transpose(x, [0, 2, 3, 1])
+        d = fluid.layers.dropout(t, 0.5)
+        u = fluid.layers.transpose(d, [0, 3, 1, 2])
+    tprog, stats = transforms.apply_transforms(
+        main, feed_names=["x"], fetch_names=[u.name],
+        passes=["transpose_sink"])
+    assert stats["transpose_sink"] == 0
+    assert [op.type for op in tprog.global_block().ops
+            ].count("transpose2") == 2
+
+
+def test_transpose_sink_skips_non_inverse_pairs():
+    """Adjacent transposes whose composition is NOT the identity stay
+    (the sink may reorder, but nothing cancels)."""
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup), unique_name.guard():
+        x = fluid.data("x", [2, 3, 4, 5], "float32")
+        t = fluid.layers.transpose(x, [0, 2, 3, 1])
+        u = fluid.layers.transpose(t, [0, 2, 3, 1])  # not inverse
+        out = fluid.layers.relu(u)
+    tprog, stats = transforms.apply_transforms(
+        main, feed_names=["x"], fetch_names=[out.name],
+        passes=["transpose_sink"])
+    assert [op.type for op in tprog.global_block().ops
+            ].count("transpose2") == 2
+    rng = np.random.RandomState(6)
+    xv = rng.rand(2, 3, 4, 5).astype("float32")
+    with scope_guard(Scope()):
+        exe = fluid.Executor()
+        exe.run(startup)
+        paddle_tpu.set_flags({"FLAGS_graph_transforms": "off"})
+        (ref,) = exe.run(main, feed={"x": xv}, fetch_list=[out.name])
+        paddle_tpu.set_flags(
+            {"FLAGS_graph_transforms": "on,transpose_sink=on"})
+        (got,) = exe.run(main, feed={"x": xv}, fetch_list=[out.name])
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
+
+
+def test_transpose_sink_skips_grad_programs():
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup), unique_name.guard():
+        x = fluid.data("x", [2, 3, 4, 5], "float32")
+        y = fluid.layers.conv2d(x, 4, 1, bias_attr=False)
+        t = fluid.layers.transpose(y, [0, 2, 3, 1])
+        r = fluid.layers.relu(t)
+        u = fluid.layers.transpose(r, [0, 3, 1, 2])
+        loss = fluid.layers.reduce_mean(u)
+        fluid.append_backward(loss)
+    assert any(op.attr("fwd_op_id") is not None
+               for op in main.global_block().ops)  # real grad ops
+    tprog, stats = transforms.apply_transforms(
+        main, feed_names=["x"], fetch_names=[loss.name],
+        passes=["transpose_sink"])
+    assert stats["transpose_sink"] == 0
 
 
 # ---------------------------------------------------------------------------
@@ -422,11 +643,13 @@ def test_flag_gating_and_registration():
     # passes process-wide, so only require that any extras are test
     # fixtures that stay default-off
     regs = transforms.registered_transforms()
-    assert regs[:3] == ["fold_bn", "layout_optimize", "dead_op_elim"]
+    assert regs[:4] == ["fold_bn", "transpose_sink", "layout_optimize",
+                        "dead_op_elim"]
     assert all(n.startswith("broken_") and
                transforms.transform_info(n)["default"] is False
-               for n in regs[3:]), regs
+               for n in regs[4:]), regs
     assert transforms.transform_info("fold_bn")["default"] is False
+    assert transforms.transform_info("transpose_sink")["default"] is False
     paddle_tpu.set_flags({"FLAGS_graph_transforms": "off"})
     assert transforms.enabled_signature() == ()
     p = framework.Program()
