@@ -1208,6 +1208,96 @@ def bench_resnet50(jax, jnp, on_tpu, batch=None):
 SERVING_TARGET_P99_MS = 50.0  # north-star interactive-serving budget
 
 
+def _decode_detail(jax, jnp, on_tpu):
+    """Autoregressive fast-decode scenario (ISSUE 20 satellite): a toy
+    LM through the AutoregressiveEngine — decode-step latency at
+    steady state, chunked-prefill chunk time, time-to-first-token for
+    a long prompt admitted mid-decode-flood, and the lazy-growth
+    pages-per-sequence footprint.  `decode_token_ms` is gated by
+    bench_diff (rise > 10% fails on-chip)."""
+    from paddle_tpu import profiler, serving
+    from paddle_tpu.serving import metrics as smetrics
+
+    V, D = 64, 16
+    rng = np.random.RandomState(7)
+    emb = jnp.asarray(rng.randn(V, D).astype(np.float32))
+    w = jnp.asarray(rng.randn(D, V).astype(np.float32))
+
+    def qkv_fn(tokens, positions):
+        x = emb[tokens]
+        q = x[:, :, None, :]
+        return q, q, q
+
+    def out_fn(attn):
+        return attn[:, :, 0, :] @ w
+
+    eng = serving.AutoregressiveEngine(
+        qkv_fn, out_fn, num_heads=1, head_dim=D, num_pages=256,
+        page_size=4, max_slots=4, max_pages_per_seq=32,
+        prompt_buckets=(8, 16), prefill_chunk=8)
+    try:
+        # warm the prefill/chunk/decode compile caches so the timed
+        # window measures dispatch, not tracing — max_new_tokens must
+        # match the flood's budget: the out_tokens ring is sized to
+        # the largest live budget and resizing retraces _decode_fn
+        eng.generate(np.arange(40) % V, max_new_tokens=8)
+        eng.generate(np.arange(5) % V, max_new_tokens=96)
+        smetrics.reset_latency("serving_prefill_chunk_ms")
+        smetrics.reset_latency("serving_ttft_ms")
+
+        # decode flood: fill every other slot with long generations
+        flood = [eng.submit(rng.randint(0, V, size=5).astype(np.int32),
+                            max_new_tokens=96) for _ in range(3)]
+        for _ in range(8):   # admit + prefill: all slots decoding
+            eng.step()
+        step_ms = []
+        for _ in range(32):  # steady state: one token per step
+            t0 = time.perf_counter()
+            eng.step()
+            step_ms.append((time.perf_counter() - t0) * 1e3)
+
+        # long prompt admitted mid-flood: chunked prefill interleaves
+        # with the decode batch instead of head-of-line blocking it
+        long_req = eng.submit(
+            rng.randint(0, V, size=40).astype(np.int32),
+            max_new_tokens=8)
+        pages_per_seq = []
+        while not long_req.done():
+            eng.step()
+            seqs = eng.kv.table.seqs
+            if seqs:
+                pages_per_seq.append(eng.kv.table.in_use / seqs)
+        eng.run_until_idle()
+        long_req.result(timeout=60)
+        for r in flood:
+            r.result(timeout=60)
+
+        step_ms.sort()
+
+        def pct(p):
+            i = min(len(step_ms) - 1,
+                    int(round(p / 100.0 * (len(step_ms) - 1))))
+            return step_ms[i]
+
+        chunk = smetrics.latency_stats("serving_prefill_chunk_ms") or {}
+        ttft = smetrics.latency_stats("serving_ttft_ms") or {}
+        stats = profiler.get_int_stats()
+        return {
+            "decode_token_ms": round(pct(50.0), 3),
+            "decode_token_p99_ms": round(pct(99.0), 3),
+            "prefill_chunk_ms": round(chunk.get("mean_ms", 0.0), 3),
+            "prefill_chunks": stats.get("serving_prefill_chunks", 0),
+            "ttft_long_prompt_ms": round(ttft.get("max_ms", 0.0), 3),
+            "kv_pages_per_seq": round(
+                sum(pages_per_seq) / len(pages_per_seq), 2)
+            if pages_per_seq else 0.0,
+            "ragged_fallbacks": stats.get(
+                "serving_ragged_fallback_total", 0),
+        }
+    finally:
+        eng.shutdown(drain=False)
+
+
 def bench_serving(jax, jnp, on_tpu):
     """Continuous-batching serving scenario (ISSUE 2 satellite): mixed
     batch-size requests from concurrent clients through the
@@ -1286,6 +1376,7 @@ def bench_serving(jax, jnp, on_tpu):
             "buckets": list(cfg.buckets),
             "feature_dim": d_in,
             "tpu_probe": _tpu_probe_detail(),
+            "decode": _decode_detail(jax, jnp, on_tpu),
         }
         return {
             "metric": "serving_p99_latency_ms",

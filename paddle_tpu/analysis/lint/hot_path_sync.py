@@ -81,6 +81,17 @@ WATCHLIST: List[Tuple[str, str]] = [
     ("paddle_tpu/serving/engine.py", "AutoregressiveEngine._admit"),
     ("paddle_tpu/serving/engine.py", "AutoregressiveEngine._decode"),
     ("paddle_tpu/serving/engine.py", "AutoregressiveEngine._retire"),
+    # fast decode (ISSUE 20): the chunk scheduler and the lazy-growth /
+    # extend-backpressure path run every engine step between decode
+    # dispatches — host-side bookkeeping plus async device calls only;
+    # the ragged-kernel dispatch seam traces INSIDE the decode jit, so
+    # a sync there would stall every decoded token
+    ("paddle_tpu/serving/engine.py",
+     "AutoregressiveEngine._prefill_tick"),
+    ("paddle_tpu/serving/engine.py",
+     "AutoregressiveEngine._ensure_pages"),
+    ("paddle_tpu/serving/engine.py", "AutoregressiveEngine._grow_to"),
+    ("paddle_tpu/ops/pallas/attention.py", "paged_attention"),
     ("paddle_tpu/serving/batcher.py", "DynamicBatcher.next_batch"),
     # multi-tenant fleet (ISSUE 17): admission (submit -> quota check)
     # and the registry request surface run on CLIENT threads racing the

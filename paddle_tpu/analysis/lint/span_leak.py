@@ -59,6 +59,10 @@ WATCHED = [
     # cache times its own load/store (ISSUE 17) — a leaked span there
     # would misattribute disk I/O to whichever compile wrapped it
     "paddle_tpu/serving",  # covers registry.py (multi-tenant fleet)
+    "paddle_tpu/ops/pallas/attention.py",  # explicit: the ragged
+    # paged-attention dispatch seam (ISSUE 20) traces inside the
+    # decode jit — a leaked span there would wrap device-side kernel
+    # work in a host timer on every decoded token
     "paddle_tpu/tune",  # autotuner (ISSUE 19): search/trial spans wrap
     # measured executor dispatches — a leaked span would fold a whole
     # search into whatever profile runs next

@@ -221,6 +221,7 @@ DEFAULT_THRESHOLDS: Dict[str, float] = {
     "grad_norm_min": 1e-3,        # ignore grad-norm noise below this
     "loss_scale_collapse_frac": 0.0625,  # last <= frac * window peak
     "loss_scale_min_peak": 4.0,   # scale peak before the rule arms
+    "kv_pressure_frac": 0.90,     # serving KV pages in-use / capacity
 }
 
 
@@ -455,6 +456,29 @@ def rule_loss_scale_collapse(v, cfg) -> Optional[str]:
     return None
 
 
+def rule_kv_pressure(v, cfg) -> Optional[str]:
+    """Serving KV page pool nearly exhausted.  Under lazy page growth
+    (serving/engine.py) admission reserves only what the prompt needs,
+    so `serving_kv_pages_in_use` tracks real demand — when it nears
+    `serving_kv_pages_capacity`, the next decode-time `extend` starts
+    pausing slots (typed kv_pages backpressure) and admission starts
+    parking requests.  Both gauges come from PageTable._publish; on a
+    host with no serving engine the series are absent and this rule is
+    silent by construction."""
+    used = v.last("serving_kv_pages_in_use")
+    cap = v.last("serving_kv_pages_capacity")
+    if used is None or cap is None or cap <= 0:
+        return None
+    frac = used / cap
+    if frac > cfg["kv_pressure_frac"]:
+        return (f"serving_kv_pages_in_use {used:.0f} is {frac:.0%} of "
+                f"the {cap:.0f}-page pool (threshold "
+                f"{cfg['kv_pressure_frac']:.0%}) — decode slots are "
+                f"about to hit extend backpressure; shed load or raise "
+                f"num_pages")
+    return None
+
+
 RULES: List[Tuple[str, Callable]] = [
     ("step_time_spike", rule_step_time_spike),
     ("mfu_drop", rule_mfu_drop),
@@ -467,6 +491,7 @@ RULES: List[Tuple[str, Callable]] = [
     ("collective_bytes_jump", rule_collective_bytes_jump),
     ("host_lost", rule_host_lost),
     ("hbm_pressure", rule_hbm_pressure),
+    ("kv_pressure", rule_kv_pressure),
     ("grad_norm_spike", rule_grad_norm_spike),
     ("loss_scale_collapse", rule_loss_scale_collapse),
 ]

@@ -740,10 +740,12 @@ def _try_compile(compile_fn, cache, key, fail_msg, allow_hint_retry=True):
             _USE_DIM_SEMANTICS = False
             _flash_forward.clear_cache()
             _flash_backward.clear_cache()
+            _ragged_paged_forward.clear_cache()
             try:
                 compile_fn()
                 _PROBE_CACHE.clear()
                 _EXACT_PROBE_CACHE.clear()
+                _RAGGED_PROBE_CACHE.clear()
                 cache[key] = True
                 warnings.warn(
                     "paddle_tpu: this Mosaic rejects Pallas grid "
@@ -756,6 +758,7 @@ def _try_compile(compile_fn, cache, key, fail_msg, allow_hint_retry=True):
                 _USE_DIM_SEMANTICS = True
                 _flash_forward.clear_cache()
                 _flash_backward.clear_cache()
+                _ragged_paged_forward.clear_cache()
         warnings.warn(
             fail_msg.format(err=f"{type(first_err).__name__}: "
                             f"{first_err}"),
@@ -764,15 +767,15 @@ def _try_compile(compile_fn, cache, key, fail_msg, allow_hint_retry=True):
         return False
 
 
-def _compiler_params():
-    """Grid dimension semantics (parallel/parallel/arbitrary) let Mosaic
-    pipeline DMA across grid steps; if this Mosaic version rejects them
-    the probe flips the switch and retries plain — losing the pipelining
-    must never cost the whole Pallas path."""
+def _compiler_params(semantics=("parallel", "parallel", "arbitrary")):
+    """Grid dimension semantics (parallel over independent output
+    blocks, arbitrary over accumulation axes) let Mosaic pipeline DMA
+    across grid steps; if this Mosaic version rejects them the probe
+    flips the switch and retries plain — losing the pipelining must
+    never cost the whole Pallas path."""
     if not _USE_DIM_SEMANTICS or _CompilerParams is None:
         return None
-    return _CompilerParams(
-        dimension_semantics=("parallel", "parallel", "arbitrary"))
+    return _CompilerParams(dimension_semantics=tuple(semantics))
 
 
 def disable_flash(reason):
@@ -887,24 +890,157 @@ def _seed_from_key(key):
     return lax.bitcast_convert_type(folded, jnp.int32).reshape((1,))
 
 
-def paged_attention(q, k_pages, v_pages, page_rows, lengths, scale=None):
-    """Attention over PAGED keys/values (serving decode path).
+# -- ragged paged attention (serving decode path) -----------------------------
 
-    q: (B, T, H, D) — the T newest query positions per sequence
-    (decode: T == 1); k_pages/v_pages: (P, S, H, D) device-resident
-    page pools (serving/kv_cache.py); page_rows: (B, max_pages) int32
-    page ids per sequence (unused entries -> scratch page 0);
-    lengths: (B,) int32 — valid key count per sequence.
+def _ragged_paged_kernel(rows_ref, len_ref, q_ref, k_ref, v_ref,
+                         qp_ref, o_ref, m_scr, l_scr, acc_scr,
+                         *, page_size, scale):
+    """One grid step = one (sequence, page) pair.
 
-    The pages are gathered into a contiguous (B, Lmax, H, D) view
-    (Lmax = max_pages * S, static) and dispatched through
-    `scaled_dot_product_attention` with an additive key-padding bias,
-    so on TPU the bias runs inside the flash kernel and the gather is
-    XLA's fused dynamic-gather.  A Mosaic kernel that consumes the
-    page table DIRECTLY (no gather materialization — *Ragged Paged
-    Attention*, arxiv 2604.15464) is the documented next step; this
-    entry point is the dispatch seam it will slot into.
-    """
+    The page table rides in as SCALAR-PREFETCH operands (rows_ref,
+    len_ref live in SMEM before the body runs), so the k/v BlockSpec
+    index_maps below dereference `rows[b, i]` to DMA page i of
+    sequence b straight out of the pool — the dense (B, Lmax, H, D)
+    gather the XLA path materializes never exists here (*Ragged Paged
+    Attention*, arxiv 2604.15464).  Online softmax accumulates across
+    the page axis exactly like the flash kernel's key-block axis."""
+    b = pl.program_id(0)
+    i = pl.program_id(1)
+    npg = pl.num_programs(1)
+
+    @pl.when(i == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    length = len_ref[b]
+
+    # pages wholly beyond the sequence are skipped (their row entries
+    # point at scratch page 0); page 0 of the grid always runs so a
+    # length-0 lane still produces the finite uniform-softmax output
+    # the dense reference yields for an all-masked row
+    @pl.when(jnp.logical_or(i == 0, i * page_size < length))
+    def _accumulate():
+        q = q_ref[0]                      # (T, H, D)
+        k = k_ref[0]                      # (S, H, D)
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((1,), (1,))),
+            preferred_element_type=jnp.float32) * scale  # (H, T, S)
+        kpos = i * page_size + lax.broadcasted_iota(
+            jnp.int32, s.shape, 2)
+        qpos = qp_ref[0][None, :, None]   # (1, T, 1)
+        s = jnp.where(kpos <= qpos, s, DEFAULT_MASK_VALUE)
+        m_prev, l_prev = m_scr[:], l_scr[:]
+        m_cur = jnp.max(s, axis=2, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        m_scr[:] = m_new
+        l_scr[:] = alpha * l_prev + jnp.sum(p, axis=2, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0],
+            (((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)          # (H, T, D)
+        acc_scr[:] = acc_scr[:] * alpha + pv
+
+    @pl.when(i == npg - 1)
+    def _finalize():
+        o_ref[0] = jnp.transpose(acc_scr[:] / l_scr[:],
+                                 (1, 0, 2)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("page_size", "scale",
+                                             "interpret"))
+def _ragged_paged_forward(page_rows, lengths, q, k_pages, v_pages,
+                          qpos, *, page_size, scale, interpret=False):
+    """page_rows: (B, W) i32; lengths: (B,) i32; q: (B, T, H, D);
+    k/v_pages: (P, S, H, D); qpos: (B, T) i32 -> (B, T, H, D).
+
+    Head and head_dim stay whole per block ((1, S, H, D) k/v blocks,
+    last two dims equal to the array dims — the Mosaic divisibility
+    escape hatch), so one grid step feeds the MXU all heads of one
+    page and the grid is just (sequences, pages)."""
+    b, t, h, d = q.shape
+    w = page_rows.shape[1]
+    kernel = functools.partial(_ragged_paged_kernel,
+                               page_size=page_size, scale=scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, w),
+        in_specs=[
+            pl.BlockSpec((1, t, h, d),
+                         lambda b_, i, rows, lens: (b_, 0, 0, 0)),
+            pl.BlockSpec((1, page_size, h, d),
+                         lambda b_, i, rows, lens:
+                         (rows[b_, i], 0, 0, 0)),
+            pl.BlockSpec((1, page_size, h, d),
+                         lambda b_, i, rows, lens:
+                         (rows[b_, i], 0, 0, 0)),
+            pl.BlockSpec((1, t), lambda b_, i, rows, lens: (b_, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, t, h, d),
+                               lambda b_, i, rows, lens:
+                               (b_, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, t, 1), jnp.float32),
+            pltpu.VMEM((h, t, 1), jnp.float32),
+            pltpu.VMEM((h, t, d), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, t, h, d), q.dtype),
+        # sequences write disjoint outputs -> parallel; the page axis
+        # accumulates in scratch -> arbitrary
+        compiler_params=_compiler_params(("parallel", "arbitrary")),
+        interpret=interpret,
+    )(page_rows, lengths, q, k_pages, v_pages, qpos)
+
+
+_RAGGED_PROBE_CACHE = {}
+
+
+def _probe_ragged(q_shape, pool_shape, rows_shape, dtype, page_size,
+                  scale):
+    """Compile (never run) the exact ragged-kernel instance once per
+    configuration; False means Mosaic rejected it and the caller takes
+    the dense-gather XLA path — counted via
+    serving_ragged_fallback_total so a fleet silently running the slow
+    path shows up in the stats, not just in a scrolled-away warning."""
+    key = (q_shape, pool_shape, rows_shape, jnp.dtype(dtype).name,
+           page_size)
+    if key not in _RAGGED_PROBE_CACHE:
+        def compile_probe():
+            sds = jax.ShapeDtypeStruct
+            b, t = q_shape[0], q_shape[1]
+            _ragged_paged_forward.lower(
+                sds(rows_shape, jnp.int32), sds((b,), jnp.int32),
+                sds(q_shape, dtype), sds(pool_shape, dtype),
+                sds(pool_shape, dtype), sds((b, t), jnp.int32),
+                page_size=page_size, scale=scale).compile()
+
+        _try_compile(
+            compile_probe, _RAGGED_PROBE_CACHE, key,
+            "paddle_tpu: ragged paged-attention kernel "
+            f"q{q_shape} pool{pool_shape} failed to compile ({{err}}); "
+            "serving decode falls back to the dense-gather XLA path "
+            "for this shape (correct but slower).")
+        if not _RAGGED_PROBE_CACHE[key]:
+            from ...profiler import stat_add
+
+            stat_add("serving_ragged_fallback_total")
+    return _RAGGED_PROBE_CACHE[key]
+
+
+def _dense_paged_attention(q, k_pages, v_pages, page_rows, lengths,
+                           qpos, scale):
+    """XLA reference/fallback: gather the pages into a contiguous
+    (B, Lmax, H, D) view (Lmax = max_pages * S, static) and dispatch
+    through `scaled_dot_product_attention` with an additive bias.  For
+    T == 1 the bias is constant over queries, so on TPU it rides the
+    flash kernel's key-bias fast path."""
     b, t, h, d = q.shape
     p, s = k_pages.shape[0], k_pages.shape[1]
     max_pages = page_rows.shape[1]
@@ -916,10 +1052,58 @@ def paged_attention(q, k_pages, v_pages, page_rows, lengths, scale=None):
     vflat = v_pages.reshape(p * s, h, d)
     k = kflat[gidx]                                      # (B, Lmax, H, D)
     v = vflat[gidx]
-    bias = jnp.where(pos[None, :] < lengths[:, None], 0.0,
+    bias = jnp.where(pos[None, None, :] <= qpos[:, :, None], 0.0,
                      DEFAULT_MASK_VALUE).astype(jnp.float32)
     return scaled_dot_product_attention(
-        q, k, v, mask=bias[:, None, None, :], scale=scale)
+        q, k, v, mask=bias[:, None, :, :], scale=scale)
+
+
+def paged_attention(q, k_pages, v_pages, page_rows, lengths, scale=None,
+                    q_positions=None, interpret=False):
+    """Attention over PAGED keys/values (serving decode path).
+
+    q: (B, T, H, D) — the T newest query positions per sequence
+    (decode: T == 1; chunked prefill: T == chunk bucket);
+    k_pages/v_pages: (P, S, H, D) device-resident page pools
+    (serving/kv_cache.py); page_rows: (B, max_pages) int32 page ids
+    per sequence (unused entries -> scratch page 0); lengths: (B,)
+    int32 — valid key count per sequence.
+
+    Masking: query j of sequence b attends keys at positions
+    <= q_positions[b, j].  The default q_positions places the T
+    queries at the newest T positions (lengths - T .. lengths - 1),
+    i.e. plain length masking for T == 1 and causal-tail masking for
+    a multi-token tail; chunked prefill passes its chunk's absolute
+    positions explicitly.  Query lanes whose position is >= lengths
+    (chunk padding) produce finite but unspecified output — callers
+    slice them away.
+
+    Dispatch: the ragged Pallas kernel above consumes `page_rows`
+    directly via scalar prefetch — no dense (B, Lmax) gather is ever
+    materialized — on TPU when the per-shape Mosaic probe accepts it,
+    or anywhere under `interpret=True` (CPU tier-1 parity tests);
+    otherwise the dense-gather XLA path, with the fallback counted in
+    serving_ragged_fallback_total."""
+    b, t, h, d = q.shape
+    s = k_pages.shape[1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    if q_positions is None:
+        q_positions = lengths[:, None] - t \
+            + jnp.arange(t, dtype=jnp.int32)[None, :]
+    qpos = q_positions.astype(jnp.int32)
+    use_kernel = bool(interpret)
+    if not use_kernel and _FLASH_DISABLED is None \
+            and _HAS_PALLAS and on_tpu():
+        use_kernel = _probe_ragged(
+            q.shape, k_pages.shape, page_rows.shape, q.dtype, s,
+            float(scale))
+    if use_kernel:
+        return _ragged_paged_forward(
+            page_rows.astype(jnp.int32), lengths.astype(jnp.int32),
+            q, k_pages, v_pages, qpos, page_size=s,
+            scale=float(scale), interpret=bool(interpret))
+    return _dense_paged_attention(q, k_pages, v_pages, page_rows,
+                                  lengths, qpos, scale)
 
 
 def scaled_dot_product_attention(q, k, v, mask=None, is_causal=False,

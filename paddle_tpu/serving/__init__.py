@@ -29,7 +29,7 @@ from .batcher import DynamicBatcher, Request, Response
 from .bucketing import (BucketedRunner, bucket_for, bucket_ladder,
                         input_signature, pad_batch)
 from .engine import (AutoregressiveEngine, Engine, EngineConfig,
-                     ProgramModel)
+                     LayeredDecoder, ProgramModel)
 from .kv_cache import PagedKVCache, PageTable
 from .metrics import (latency_stats, mean_occupancy, reset_latency,
                       tenant_stat)
@@ -44,6 +44,7 @@ __all__ = [
     "EngineClosed",
     "EngineConfig",
     "EngineOverloaded",
+    "LayeredDecoder",
     "ModelRegistry",
     "PagedKVCache",
     "PageTable",

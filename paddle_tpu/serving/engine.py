@@ -614,42 +614,113 @@ class _GenRequest:
         self._event.set()
 
 
+class LayeredDecoder:
+    """Multi-layer decoder contract for `AutoregressiveEngine`.
+
+        embed(tokens, positions) -> x        # (B, T) int32 -> hidden
+        layers: sequence of (qkv, merge) pairs, applied in order:
+            qkv(x, positions) -> (q, k, v)   # each (B, T, H, D)
+            merge(x, attn)    -> x           # residual / FFN half
+        unembed(x) -> logits                 # (B, T, V)
+
+    `x` is an opaque pytree the engine only threads through, so any
+    hidden representation works.  All layers share one `PagedKVCache`
+    pool with a leading layer dim (serving/kv_cache.py) — one page
+    allocation covers the whole stack and the engine runs the full
+    depth inside ONE fused decode step."""
+
+    def __init__(self, embed: Callable, layers: Sequence,
+                 unembed: Callable):
+        if not layers:
+            raise ValueError("LayeredDecoder needs >= 1 layer")
+        self.embed = embed
+        self.layers = [tuple(layer) for layer in layers]
+        self.unembed = unembed
+
+
+def _classic_decoder(qkv_fn: Callable, out_fn: Callable) -> LayeredDecoder:
+    """Adapt the historical single-layer contract
+    (qkv_fn(tokens, positions), out_fn(attn)) onto LayeredDecoder:
+    the 'hidden state' is just the (tokens, positions) pair."""
+    return LayeredDecoder(
+        embed=lambda tokens, positions: (tokens, positions),
+        layers=[(lambda x, positions: qkv_fn(x[0], x[1]),
+                 lambda x, attn: attn)],
+        unembed=out_fn)
+
+
+class _PrefillJob:
+    """Host-side progress of one prompt through (chunked) prefill."""
+
+    __slots__ = ("req", "slot", "chunks", "idx")
+
+    def __init__(self, req: _GenRequest, slot: int, chunks: List):
+        self.req = req
+        self.slot = slot
+        self.chunks = chunks  # [(padded_np, bucket, offset, chunk_len)]
+        self.idx = 0
+
+
 class AutoregressiveEngine:
     """Continuous-batching token generation over paged KV state.
 
-    Model contract (single attention layer; stack engines or widen the
-    contract for deep models — ROADMAP open item):
+    Model contract: either the single-layer pair
 
         qkv_fn(tokens, positions) -> (q, k, v)   # (B, T) -> (B, T, H, D)
         out_fn(attn)              -> logits      # (B, T, H, D) -> (B, T, V)
 
+    or `model=LayeredDecoder(...)` for an N-layer decoder — every
+    layer reads/writes its own plane of ONE multi-layer KV pool inside
+    the same fused decode step.
+
     Slots: `max_slots` sequences decode together in ONE fused jitted
     step (greedy argmax), each reading/writing its own KV pages; free
-    slots ride along masked.  Page allocation is all-at-admission
-    (prompt + max_new_tokens), so a request either decodes to
-    completion or is never admitted — no mid-stream OOM; lazy page
-    growth is the documented next step.  Host bookkeeping mirrors
-    lengths exactly, so the decode loop performs ZERO device->host
-    transfers; tokens materialize once, at retirement.
+    slots ride along masked.  Prompts longer than `prefill_chunk`
+    tokens prefill in fixed-size CHUNKS, at most one chunk per engine
+    step, interleaved with the decode batch — a long prompt can no
+    longer head-of-line-block in-flight decodes for more than one
+    chunk's step time.  Pages are allocated LAZILY: admission reserves
+    `pages_needed(prompt_len) + page_slack` and decode extends
+    page-by-page; pool exhaustion mid-decode PAUSES the starved slot
+    (typed backpressure via EngineOverloaded("kv_pages")) until pages
+    free up, never killing co-batched requests.  Host bookkeeping
+    mirrors lengths exactly, so the decode loop performs ZERO
+    device->host transfers; tokens materialize once, at retirement.
     """
 
-    def __init__(self, qkv_fn: Callable, out_fn: Callable,
-                 num_heads: int, head_dim: int, *, num_pages: int = 64,
+    def __init__(self, qkv_fn: Optional[Callable] = None,
+                 out_fn: Optional[Callable] = None,
+                 num_heads: int = None, head_dim: int = None, *,
+                 model: Optional[LayeredDecoder] = None,
+                 num_pages: int = 64,
                  page_size: int = 16, max_slots: int = 4,
                  max_pages_per_seq: int = 8, max_queue: int = 16,
                  prompt_buckets: Sequence[int] = (16, 32, 64),
-                 dtype=None):
+                 dtype=None, prefill_chunk: Optional[int] = None,
+                 page_slack: int = 1):
         import jax.numpy as jnp
 
         from ..fluid.compile_cache import CompileCache
         from .kv_cache import PagedKVCache
 
-        self.qkv_fn, self.out_fn = qkv_fn, out_fn
+        if model is None:
+            if qkv_fn is None or out_fn is None:
+                raise ValueError("pass (qkv_fn, out_fn) or model=")
+            model = _classic_decoder(qkv_fn, out_fn)
+        self.model = model
+        self.num_layers = len(model.layers)
         self.max_slots = int(max_slots)
         self.max_pages_per_seq = int(max_pages_per_seq)
         self.prompt_buckets = sorted(prompt_buckets)
+        # chunk budget: prompts longer than this prefill in chunks of
+        # this many tokens; default = the top prompt bucket, so the
+        # chunk entry reuses the ladder's compiled shapes
+        self.prefill_chunk = int(prefill_chunk) if prefill_chunk \
+            else self.prompt_buckets[-1]
+        self.page_slack = max(0, int(page_slack))
         self.kv = PagedKVCache(num_pages, page_size, num_heads,
-                               head_dim, dtype=dtype)
+                               head_dim, dtype=dtype,
+                               num_layers=self.num_layers)
         self._admission = AdmissionController(
             max_queue, resource="queue",
             gauge_stat="serving_queue_depth")
@@ -670,6 +741,9 @@ class AutoregressiveEngine:
         self._slots: List[Optional[_GenRequest]] = [None] * s
         self._slot_gen: List[int] = [0] * s
         self._slot_len: List[int] = [0] * s
+        self._slot_pages: List[int] = [0] * s
+        self._paused: List[bool] = [False] * s
+        self._prefilling: dict = {}  # slot -> _PrefillJob
         self._prefill_cache = CompileCache(16, stat_prefix="serving")
         self._decode_step = None
         self._serve_thread: Optional[threading.Thread] = None
@@ -710,10 +784,17 @@ class AutoregressiveEngine:
 
     # -- engine loop -------------------------------------------------------
     def step(self) -> bool:
-        """One engine iteration: admit -> decode -> retire.  Returns
+        """One engine iteration: admit -> one prefill chunk -> grow
+        pages -> decode -> retire.  At most ONE prefill chunk runs per
+        step, so in-flight decode slots stall by at most one chunk's
+        step time no matter how long the incoming prompt is.  Returns
         True while there is (or may be) work left."""
         self._admit()
-        if any(s is not None for s in self._slots):
+        self._prefill_tick()
+        self._ensure_pages()
+        if any(req is not None and i not in self._prefilling
+               and not self._paused[i]
+               for i, req in enumerate(self._slots)):
             self._decode()
         self._retire()
         with self._lock:
@@ -785,13 +866,56 @@ class AutoregressiveEngine:
     def _free_slots(self) -> List[int]:
         return [i for i, s in enumerate(self._slots) if s is None]
 
-    def _admit(self) -> None:
-        import jax.numpy as jnp
+    def _target_pages(self, n_tokens: int) -> int:
+        """The lazy-growth invariant: a live sequence holding
+        n_tokens owns pages_needed(n_tokens) + page_slack pages,
+        capped at the row width (tests/test_fast_decode.py asserts
+        this at every step)."""
+        return min(self.kv.table.pages_needed(n_tokens)
+                   + self.page_slack, self.max_pages_per_seq)
 
+    def _grow_to(self, req: _GenRequest, n_tokens: int) -> bool:
+        """Extend-backpressure path: ensure `req` owns pages covering
+        `n_tokens` (plus opportunistic slack).  Returns False on pool
+        exhaustion — the caller pauses/stalls the ONE starved slot and
+        retries next step; co-batched requests keep decoding.  Raises
+        EngineOverloaded("kv_rows") only if the sequence can never fit
+        its row (caller retires the slot early)."""
+        from ..profiler import stat_add
+
+        table = self.kv.table
+        need = table.pages_needed(n_tokens)
+        if need > self.max_pages_per_seq:
+            raise EngineOverloaded(
+                "kv_rows", need, self.max_pages_per_seq,
+                detail="sequence outgrew its page row")
+        owned = len(table.pages_of(id(req)))
+        if owned < need:
+            try:
+                table.extend(id(req), need - owned)
+                stat_add("serving_kv_pages_extended", need - owned)
+                owned = need
+            except EngineOverloaded:
+                stat_add("serving_kv_backpressure_total")
+                return False
+        target = self._target_pages(n_tokens)
+        if owned < target:
+            # slack beyond the hard requirement is opportunistic: it
+            # keeps the next extends off the hot path, but missing it
+            # under pressure is not a reason to stall
+            try:
+                table.extend(id(req), target - owned)
+                stat_add("serving_kv_pages_extended", target - owned)
+            except EngineOverloaded:
+                pass
+        return True
+
+    def _admit(self) -> None:
         from ..profiler import stat_add
 
         while True:
-            free = self._free_slots()
+            free = [i for i in self._free_slots()
+                    if i not in self._prefilling]
             if not free:
                 return
             with self._lock:
@@ -804,11 +928,21 @@ class AutoregressiveEngine:
                     stat_add("serving_cancelled_total")
                     req._finish(exc=RequestCancelled("cancelled"))
                     continue
-                total = len(req.prompt) + req.max_new_tokens - 1
+                # LAZY reservation: pages for the prompt only (plus
+                # slack), not the worst-case prompt + max_new_tokens —
+                # admission-time KV held is proportional to the prompt
+                # (serving_kv_pages_in_use), decode grows page-by-page
                 try:
-                    pages = self.kv.table.allocate(id(req), total)
+                    self.kv.table.allocate(id(req), len(req.prompt))
                 except EngineOverloaded:
                     return  # pool full: stay pending, retry next step
+                extra = self._target_pages(len(req.prompt)) \
+                    - len(self.kv.table.pages_of(id(req)))
+                if extra > 0:
+                    try:
+                        self.kv.table.extend(id(req), extra)
+                    except EngineOverloaded:
+                        pass  # slack is opportunistic at admission too
                 self._pending.popleft()
                 self._admission.release()
                 # visible to the shutdown drain check across the
@@ -816,26 +950,13 @@ class AutoregressiveEngine:
                 self._admitting += 1
             try:
                 slot = free[0]
-                rows_np = self.kv.table.rows(id(req),
-                                             self.max_pages_per_seq)
-                first_tok, k, v, bucket = self._prefill(req)
-                st = self._state
-                st["kc"], st["vc"] = self._write_prefill_entry(bucket)(
-                    st["kc"], st["vc"], rows_np,
-                    np.int32(len(req.prompt)), k, v)
-                st["page_rows"] = st["page_rows"].at[slot].set(
-                    jnp.asarray(rows_np))
-                st["lengths"] = st["lengths"].at[slot].set(
-                    len(req.prompt))
-                st["last_tok"] = st["last_tok"].at[slot].set(first_tok)
-                st["gen_counts"] = st["gen_counts"].at[slot].set(1)
-                self._ensure_token_buffer(req.max_new_tokens)
-                st["out_tokens"] = st["out_tokens"].at[slot, 0].set(
-                    first_tok)
-                st["active"] = st["active"].at[slot].set(True)
                 self._slots[slot] = req
-                self._slot_gen[slot] = 1
-                self._slot_len[slot] = len(req.prompt)
+                self._slot_gen[slot] = 0
+                self._slot_len[slot] = 0
+                self._slot_pages[slot] = 0
+                self._paused[slot] = False
+                self._prefilling[slot] = _PrefillJob(
+                    req, slot, self._plan_chunks(req))
             finally:
                 with self._lock:
                     self._admitting -= 1
@@ -854,22 +975,204 @@ class AutoregressiveEngine:
         self._out_tokens_cap = cap
         self._decode_step = None  # shape changed: re-stage the step
 
-    def _pad_prompt(self, req: _GenRequest):
-        t = len(req.prompt)
-        bucket = bucket_for(t, self.prompt_buckets)
-        if bucket is None:
-            bucket = 1 << (t - 1).bit_length()
-        padded = np.zeros((bucket,), np.int32)
-        padded[:t] = req.prompt
-        return padded, bucket
+    def _plan_chunks(self, req: _GenRequest) -> List:
+        """Split a prompt into prefill chunks of <= prefill_chunk
+        tokens, each padded up to a prompt bucket.  Prompts that fit
+        one chunk stay single-shot (in-register causal attention);
+        longer ones run the chunk entry per piece, interleaved with
+        decode by _prefill_tick."""
+        toks = req.prompt
+        n = len(toks)
+        chunks = []
+        off = 0
+        while True:
+            clen = min(self.prefill_chunk, n - off)
+            bucket = bucket_for(clen, self.prompt_buckets)
+            if bucket is None:
+                bucket = 1 << (max(1, clen) - 1).bit_length()
+            padded = np.zeros((bucket,), np.int32)
+            padded[:clen] = toks[off:off + clen]
+            chunks.append((padded, bucket, off, clen))
+            off += clen
+            if off >= n:
+                return chunks
+
+    def _prefill_tick(self) -> None:
+        """Chunk scheduler: advance AT MOST ONE prefill job by one
+        chunk per engine step — the bound that keeps a long incoming
+        prompt from head-of-line-blocking the decode batch.  A job
+        whose next chunk cannot get pages stalls in place (typed
+        backpressure) and retries next step."""
+        import jax.numpy as jnp
+
+        from ..profiler import stat_add, timed
+
+        for slot in sorted(self._prefilling):
+            job = self._prefilling[slot]
+            req = job.req
+            if req._cancelled:
+                self._abort_prefill(slot)
+                continue
+            padded, bucket, off, clen = job.chunks[job.idx]
+            try:
+                if not self._grow_to(req, off + clen):
+                    continue  # pool pressure: job stalls, others may run
+            except EngineOverloaded:
+                # kv_rows: can never fit (submit() precheck makes this
+                # unreachable; belt-and-braces for direct table use)
+                self._abort_prefill(slot, exc=EngineOverloaded(
+                    "kv_rows", self.kv.table.pages_needed(off + clen),
+                    self.max_pages_per_seq,
+                    detail="prompt outgrew its page row"))
+                continue
+            rows_np = self.kv.table.rows(id(req), self.max_pages_per_seq)
+            st = self._state
+            t0 = time.perf_counter()
+            if len(job.chunks) == 1:
+                # single-shot: fused embed -> in-register causal
+                # attention -> first token, then one page scatter
+                entry = self._prefill_entry(bucket)
+                with timed("serving_dispatch_ms"):
+                    first_tok, k, v = entry(padded, np.int32(clen))
+                st["kc"], st["vc"] = self._write_prefill_entry(bucket)(
+                    st["kc"], st["vc"], rows_np, np.int32(clen), k, v)
+            else:
+                # chunk step: write this chunk's K/V into the pages,
+                # then ragged paged attention over everything written
+                # so far (causal within the chunk via q_positions)
+                entry = self._chunk_entry(bucket)
+                with timed("serving_dispatch_ms"):
+                    st["kc"], st["vc"], first_tok = entry(
+                        st["kc"], st["vc"], jnp.asarray(rows_np),
+                        np.int32(off), np.int32(clen), padded)
+                stat_add("serving_prefill_chunks")
+            metrics.record_latency(
+                "serving_prefill_chunk_ms",
+                (time.perf_counter() - t0) * 1e3)
+            job.idx += 1
+            if job.idx >= len(job.chunks):
+                stat_add("serving_prefill_count")
+                self._finish_prefill(slot, first_tok, rows_np)
+            return  # ONE chunk per engine step, by design
+
+    def _finish_prefill(self, slot: int, first_tok, rows_np) -> None:
+        import jax.numpy as jnp
+
+        job = self._prefilling.pop(slot)
+        req = job.req
+        n = len(req.prompt)
+        st = self._state
+        st["page_rows"] = st["page_rows"].at[slot].set(
+            jnp.asarray(rows_np))
+        st["lengths"] = st["lengths"].at[slot].set(n)
+        st["last_tok"] = st["last_tok"].at[slot].set(first_tok)
+        st["gen_counts"] = st["gen_counts"].at[slot].set(1)
+        self._ensure_token_buffer(req.max_new_tokens)
+        st["out_tokens"] = st["out_tokens"].at[slot, 0].set(first_tok)
+        st["active"] = st["active"].at[slot].set(True)
+        self._slot_gen[slot] = 1
+        self._slot_len[slot] = n
+        self._slot_pages[slot] = len(self.kv.table.pages_of(id(req)))
+        metrics.record_latency(
+            "serving_ttft_ms",
+            (time.perf_counter() - req.submitted_at) * 1e3)
+
+    def _abort_prefill(self, slot: int, exc=None) -> None:
+        from ..profiler import stat_add
+
+        job = self._prefilling.pop(slot)
+        req = job.req
+        self.kv.table.free(id(req))
+        self._slots[slot] = None
+        if exc is None:
+            stat_add("serving_cancelled_total")
+            exc = RequestCancelled("cancelled")
+        req._finish(exc=exc)
+
+    def _ensure_pages(self) -> None:
+        """Lazy growth, decode side: before the fused step appends at
+        position lengths[i], make sure slot i's page row covers it.
+        Pool exhaustion PAUSES the slot (active=False; the step
+        redirects its write to the scratch page and freezes its
+        length) until extend succeeds; row-width overflow
+        (EngineOverloaded("kv_rows")) retires the slot early with the
+        tokens generated so far.  Either way, co-batched slots keep
+        decoding."""
+        from ..profiler import stat_add
+
+        import jax.numpy as jnp
+
+        st = self._state
+        table = self.kv.table
+        for i, req in enumerate(self._slots):
+            if req is None or i in self._prefilling:
+                continue
+            try:
+                ok = self._grow_to(req, self._slot_len[i] + 1)
+            except EngineOverloaded as e:
+                self._early_retire(i, reason=e.resource)
+                continue
+            if ok:
+                owned = len(table.pages_of(id(req)))
+                if owned != self._slot_pages[i]:
+                    rows_np = table.rows(id(req), self.max_pages_per_seq)
+                    st["page_rows"] = st["page_rows"].at[i].set(
+                        jnp.asarray(rows_np))
+                    self._slot_pages[i] = owned
+                if self._paused[i]:
+                    self._paused[i] = False
+                    st["active"] = st["active"].at[i].set(True)
+            elif not self._paused[i]:
+                self._paused[i] = True
+                st["active"] = st["active"].at[i].set(False)
+                stat_add("serving_kv_paused_total")
+        # livelock escape: every decoding slot paused and zero free
+        # pages means nobody can ever extend — preempt (truncate) the
+        # slot with the most tokens so the rest of the batch survives
+        decoding = [i for i, r in enumerate(self._slots)
+                    if r is not None and i not in self._prefilling]
+        if decoding and all(self._paused[i] for i in decoding) \
+                and table.available == 0:
+            victim = max(decoding, key=lambda i: self._slot_gen[i])
+            stat_add("serving_kv_preempt_total")
+            self._early_retire(victim, reason="kv_preempt")
+
+    def _early_retire(self, i: int, reason: str) -> None:
+        """Finish slot i NOW with the tokens generated so far (a
+        truncated-but-successful generation), freeing its pages for
+        the co-batched slots.  Used for kv_rows overflow and the
+        all-paused preemption escape."""
+        from ..profiler import count_sync, stat_add
+
+        req = self._slots[i]
+        st = self._state
+        count_sync()
+        tokens = np.asarray(  # sync-ok: response boundary (early)
+            st["out_tokens"][i, :self._slot_gen[i]])
+        req._finish(tokens=tokens)
+        stat_add("serving_completed_total")
+        metrics.record_latency(
+            "serving_request_ms",
+            (time.perf_counter() - req.submitted_at) * 1e3)
+        self.kv.table.free(id(req))
+        st["active"] = st["active"].at[i].set(False)
+        self._slots[i] = None
+        self._slot_gen[i] = 0
+        self._slot_len[i] = 0
+        self._slot_pages[i] = 0
+        self._paused[i] = False
 
     def _prefill_entry(self, bucket: int):
-        """Fused prefill for one prompt bucket: embed -> causal self
-        attention -> first-token logits; compiled once per bucket."""
+        """Fused single-shot prefill for one prompt bucket: embed ->
+        per-layer in-register causal attention -> first-token logits
+        plus the stacked (L, Tb, H, D) K/V; compiled once per
+        bucket."""
         import jax
 
         def build():
             import jax.numpy as jnp
+
+            model = self.model
 
             def prefill(tokens, length):
                 from ..ops.pallas.attention import (
@@ -877,16 +1180,22 @@ class AutoregressiveEngine:
 
                 tb = tokens.shape[0]
                 pos = jnp.arange(tb, dtype=jnp.int32)
-                q, k, v = self.qkv_fn(tokens[None], pos[None])
+                x = model.embed(tokens[None], pos[None])
                 bias = jnp.where(pos < length, 0.0,
                                  DEFAULT_MASK_VALUE)[None]
-                attn = scaled_dot_product_attention(
-                    q, k, v, mask=bias[:, None, None, :],
-                    is_causal=True)
-                logits = self.out_fn(attn)
+                ks, vs = [], []
+                for qkv, merge in model.layers:
+                    q, k, v = qkv(x, pos[None])
+                    attn = scaled_dot_product_attention(
+                        q, k, v, mask=bias[:, None, None, :],
+                        is_causal=True)
+                    x = merge(x, attn)
+                    ks.append(k[0])
+                    vs.append(v[0])
+                logits = model.unembed(x)
                 last = logits[0, length - 1]
                 return (jnp.argmax(last).astype(jnp.int32),
-                        k[0], v[0])
+                        jnp.stack(ks), jnp.stack(vs))
 
             from ..profiler import stat_add, timed
 
@@ -902,7 +1211,9 @@ class AutoregressiveEngine:
 
     def _write_prefill_entry(self, bucket: int):
         """Compiled page scatter for one prompt bucket (donates the
-        pools so the write is in-place in HBM)."""
+        pools so the write is in-place in HBM); the (L, Tb, H, D)
+        stacked K/V from _prefill_entry scatters every layer through
+        one shared flat index."""
         import jax
 
         def build():
@@ -912,7 +1223,7 @@ class AutoregressiveEngine:
 
             kc = self._state["kc"]
             with timed("serving_compile_ms"):
-                h, d = kc.shape[2], kc.shape[3]
+                lyr, h, d = kc.shape[0], kc.shape[3], kc.shape[4]
                 return jax.jit(
                     write_prefill, donate_argnums=(0, 1)).lower(
                     jax.ShapeDtypeStruct(kc.shape, kc.dtype),
@@ -920,39 +1231,89 @@ class AutoregressiveEngine:
                     jax.ShapeDtypeStruct((self.max_pages_per_seq,),
                                          np.int32),
                     jax.ShapeDtypeStruct((), np.int32),
-                    jax.ShapeDtypeStruct((bucket, h, d), kc.dtype),
-                    jax.ShapeDtypeStruct((bucket, h, d),
+                    jax.ShapeDtypeStruct((lyr, bucket, h, d), kc.dtype),
+                    jax.ShapeDtypeStruct((lyr, bucket, h, d),
                                          kc.dtype)).compile()
 
         return self._prefill_cache.get_or_build(
             ("write_prefill", bucket), build)
 
-    def _prefill(self, req: _GenRequest):
-        from ..profiler import stat_add, timed
+    def _chunk_entry(self, bucket: int):
+        """Fused prefill-CHUNK step for one chunk bucket: write the
+        chunk's K/V into the sequence's pages at `offset`, then ragged
+        paged attention over everything written so far (causal within
+        the chunk via q_positions) — per layer, one lowered
+        computation, pools donated.  The same step serves every chunk
+        of every long prompt at this bucket."""
+        import jax
 
-        padded, bucket = self._pad_prompt(req)
-        entry = self._prefill_entry(bucket)
-        with timed("serving_dispatch_ms"):
-            first_tok, k, v = entry(padded, np.int32(len(req.prompt)))
-        stat_add("serving_prefill_count")
-        return first_tok, k, v, bucket
+        def build():
+            import jax.numpy as jnp
+
+            model = self.model
+
+            def chunk_step(kc, vc, rows, offset, clen, tokens):
+                from ..ops.pallas.attention import paged_attention
+                from .kv_cache import write_prefill
+
+                tb = tokens.shape[0]
+                pos = offset + jnp.arange(tb, dtype=jnp.int32)
+                x = model.embed(tokens[None], pos[None])
+                lengths = jnp.reshape(offset + clen, (1,))
+                for li, (qkv, merge) in enumerate(model.layers):
+                    q, k, v = qkv(x, pos[None])
+                    kcl, vcl = write_prefill(
+                        kc[li], vc[li], rows, clen, k[0], v[0],
+                        start=offset)
+                    kc = kc.at[li].set(kcl)
+                    vc = vc.at[li].set(vcl)
+                    attn = paged_attention(
+                        q, kcl, vcl, rows[None], lengths,
+                        q_positions=pos[None])
+                    x = merge(x, attn)
+                logits = model.unembed(x)
+                last = logits[0, clen - 1]
+                return kc, vc, jnp.argmax(last).astype(jnp.int32)
+
+            from ..profiler import stat_add, timed
+
+            kc = self._state["kc"]
+            with timed("serving_compile_ms"):
+                sds = jax.ShapeDtypeStruct
+                jitted = jax.jit(
+                    chunk_step, donate_argnums=(0, 1)).lower(
+                    sds(kc.shape, kc.dtype), sds(kc.shape, kc.dtype),
+                    sds((self.max_pages_per_seq,), np.int32),
+                    sds((), np.int32), sds((), np.int32),
+                    sds((bucket,), np.int32)).compile()
+            stat_add("serving_trace_count")
+            return jitted
+
+        return self._prefill_cache.get_or_build(("chunk", bucket),
+                                                build)
 
     def _decode_fn(self, state):
-        """One fused decode step over every slot (traced once)."""
+        """One fused decode step over every slot and every layer
+        (traced once)."""
         import jax.numpy as jnp
 
         from ..ops.pallas.attention import paged_attention
         from .kv_cache import append_token
 
         pos = state["lengths"]
-        q, k, v = self.qkv_fn(state["last_tok"][:, None],
-                              pos[:, None])
-        kc, vc = append_token(state["kc"], state["vc"],
-                              state["page_rows"], pos, k[:, 0],
-                              v[:, 0], state["active"])
-        attn = paged_attention(q, kc, vc, state["page_rows"],
-                               pos + 1)
-        logits = self.out_fn(attn)[:, 0]
+        kc, vc = state["kc"], state["vc"]
+        x = self.model.embed(state["last_tok"][:, None], pos[:, None])
+        for li, (qkv, merge) in enumerate(self.model.layers):
+            q, k, v = qkv(x, pos[:, None])
+            kcl, vcl = append_token(kc[li], vc[li],
+                                    state["page_rows"], pos, k[:, 0],
+                                    v[:, 0], state["active"])
+            kc = kc.at[li].set(kcl)
+            vc = vc.at[li].set(vcl)
+            attn = paged_attention(q, kcl, vcl, state["page_rows"],
+                                   pos + 1)
+            x = merge(x, attn)
+        logits = self.model.unembed(x)[:, 0]
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         sl = jnp.arange(self.max_slots)
         gidx = jnp.minimum(state["gen_counts"],
@@ -989,7 +1350,8 @@ class AutoregressiveEngine:
             self._state = self._decode_step(self._state)
         stat_add("serving_decode_steps")
         for i, req in enumerate(self._slots):
-            if req is not None:
+            if req is not None and i not in self._prefilling \
+                    and not self._paused[i]:
                 self._slot_gen[i] += 1
                 self._slot_len[i] += 1
 
@@ -997,8 +1359,8 @@ class AutoregressiveEngine:
         from ..profiler import count_sync, stat_add, timed
 
         for i, req in enumerate(self._slots):
-            if req is None:
-                continue
+            if req is None or i in self._prefilling:
+                continue  # prefilling cancels run in _prefill_tick
             done = self._slot_gen[i] >= req.max_new_tokens
             if not (done or req._cancelled):
                 continue
@@ -1021,3 +1383,5 @@ class AutoregressiveEngine:
             self._slots[i] = None
             self._slot_gen[i] = 0
             self._slot_len[i] = 0
+            self._slot_pages[i] = 0
+            self._paused[i] = False

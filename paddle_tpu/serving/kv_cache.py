@@ -18,6 +18,13 @@ one-lowered-computation discipline applied to decode.
 Page 0 is reserved as a scratch page: masked lanes (inactive slots,
 padded prefill positions) redirect their writes there, which keeps the
 scatter shape static without corrupting live pages.
+
+Multi-layer models share ONE pool and ONE PageTable: pass
+`num_layers=N` and the pools grow a leading layer dim
+(N, num_pages, page_size, heads, dim).  A page id then names the same
+row in every layer, so one allocation covers the whole decoder stack
+and the ledger carries one `kv_cache_bytes` entry — N separate pools
+would fragment the free list N ways for no extra information.
 """
 
 from __future__ import annotations
@@ -93,11 +100,22 @@ class PageTable:
     def in_use(self) -> int:
         return self.capacity - self.available
 
+    @property
+    def seqs(self) -> int:
+        """Live sequences holding pages (bench's kv_pages_per_seq
+        denominator)."""
+        with self._lock:
+            return len(self._owned)
+
     def _publish(self) -> None:
         from ..profiler import stat_set
 
         used = self.capacity - len(self._free)
         stat_set("serving_kv_pages_in_use", used)
+        # capacity rides along so the kv_pressure watchdog rule
+        # (obs/telemetry.py) can compute used/capacity without knowing
+        # the engine's construction parameters
+        stat_set("serving_kv_pages_capacity", self.capacity)
         if self.bytes_per_page:
             # bytes backing the pages currently handed out — the
             # admission-pressure view; the ledger's kv_cache_bytes
@@ -150,33 +168,50 @@ class PageTable:
 
     def rows(self, seq_id, width: int) -> np.ndarray:
         """(width,) int32 page-id row for the device page table;
-        unused entries point at the scratch page 0."""
+        unused entries point at the scratch page 0.
+
+        Width overflow raises typed `EngineOverloaded("kv_rows", ...)`
+        — this runs mid-decode in the dispatch loop, where an untyped
+        ValueError would kill the whole co-batched step; the engine
+        handles it like pool exhaustion (retire or pause the one slot,
+        keep the batch decoding)."""
         pages = self.pages_of(seq_id)
         if len(pages) > width:
-            raise ValueError(
-                f"seq {seq_id!r} holds {len(pages)} pages > row width "
-                f"{width} (raise max_pages_per_seq)")
+            raise EngineOverloaded(
+                "kv_rows", len(pages), width,
+                detail=f"seq {seq_id!r} outgrew its page row "
+                       "(raise max_pages_per_seq)")
         out = np.zeros((width,), np.int32)
         out[:len(pages)] = pages
         return out
 
 
 class PagedKVCache:
-    """Device-resident paged K/V pool for ONE attention layer.
+    """Device-resident paged K/V pool.
 
-    k/v: (num_pages, page_size, num_heads, head_dim).  Stack one
-    instance per layer for deep models (a leading layer dim is the
-    obvious extension; the engine contract here is single-layer).
+    Single-layer (num_layers=None, the historical contract): k/v are
+    (num_pages, page_size, num_heads, head_dim).  Multi-layer
+    (num_layers=N): one leading layer dim —
+    (N, num_pages, page_size, num_heads, head_dim) — backed by ONE
+    PageTable; a page id indexes the same row of every layer, so one
+    allocation serves the whole decoder stack and `bytes_per_page`
+    (hence serving_kv_bytes) counts all N layers of a handed-out page.
     The arrays are plain jax device arrays — the engine threads them
     through its donated step state, so updates are in-place in HBM."""
 
     def __init__(self, num_pages: int, page_size: int, num_heads: int,
-                 head_dim: int, dtype=None):
+                 head_dim: int, dtype=None,
+                 num_layers: Optional[int] = None):
         import jax.numpy as jnp
 
         dtype = dtype or jnp.float32
+        self.num_layers = num_layers
         self.table = PageTable(num_pages, page_size)
         shape = (num_pages, page_size, num_heads, head_dim)
+        if num_layers is not None:
+            if num_layers < 1:
+                raise ValueError("num_layers must be >= 1")
+            shape = (int(num_layers),) + shape
         self.k = jnp.zeros(shape, dtype)
         self.v = jnp.zeros(shape, dtype)
         self.table.note_pool_bytes(int(self.k.nbytes)
@@ -196,21 +231,40 @@ class PagedKVCache:
 
 # -- device-side page ops (pure jnp; composed into the engine's jits) --------
 
-def write_prefill(kc, vc, rows, length, k, v):
+def write_prefill(kc, vc, rows, length, k, v, start=0):
     """Scatter one sequence's prefill K/V into its pages.
 
-    kc/vc: (P, S, H, D) pools; rows: (max_pages,) int32 page ids;
-    length: scalar int32 — positions >= length (prompt padding)
-    redirect to scratch page 0; k/v: (Tb, H, D) padded prompt K/V.
-    Returns the updated pools."""
+    kc/vc: (P, S, H, D) pools — or (L, P, S, H, D) multi-layer pools,
+    in which case k/v carry a matching leading layer dim and one call
+    scatters every layer through the SAME flat index (the page row is
+    shared across layers).  rows: (max_pages,) int32 page ids; length:
+    scalar int32 — row i of k/v lands at global position start + i and
+    rows with i >= length (padding) redirect to scratch page 0; k/v:
+    (Tb, H, D) (or (L, Tb, H, D)) padded prompt K/V.  `start` is the
+    chunk offset for chunked prefill (serving/engine.py): chunk c of
+    budget C passes start = c*C and writes the same fused step as
+    single-shot prefill, just shifted.  Returns the updated pools."""
     import jax.numpy as jnp
 
-    P, S, H, D = kc.shape
-    tb = k.shape[0]
+    layered = kc.ndim == 5
+    P, S, H, D = kc.shape[-4:]
+    tb = k.shape[-3]
     pos = jnp.arange(tb, dtype=jnp.int32)
     valid = pos < length
-    page_ids = rows[pos // S]
-    flat_idx = jnp.where(valid, page_ids * S + pos % S, 0)
+    gpos = start + pos
+    page_ids = rows[gpos // S]
+    flat_idx = jnp.where(valid, page_ids * S + gpos % S, 0)
+    if layered:
+        L = kc.shape[0]
+        kflat = kc.reshape(L, P * S, H, D)
+        vflat = vc.reshape(L, P * S, H, D)
+        kw = jnp.where(valid[None, :, None, None], k.astype(kc.dtype),
+                       kflat[:, flat_idx])
+        vw = jnp.where(valid[None, :, None, None], v.astype(vc.dtype),
+                       vflat[:, flat_idx])
+        kflat = kflat.at[:, flat_idx].set(kw)
+        vflat = vflat.at[:, flat_idx].set(vw)
+        return kflat.reshape(kc.shape), vflat.reshape(vc.shape)
     kflat = kc.reshape(P * S, H, D)
     vflat = vc.reshape(P * S, H, D)
     kw = jnp.where(valid[:, None, None], k.astype(kc.dtype),

@@ -22,9 +22,24 @@ Int stats (get_int_stats):
 | serving_in_flight             | gauge: batches dispatched, not complete |
 | serving_trace_count           | bucketed-cache compiles (engine + Predictor) |
 | serving_pad_rows_total        | padding rows added by bucketing         |
-| serving_kv_pages_in_use       | gauge: PageTable pages allocated        |
+| serving_kv_pages_in_use       | gauge: PageTable pages allocated — under |
+|                               | lazy growth this tracks REAL demand, so |
+|                               | it is the admission-pressure signal the |
+|                               | kv_pressure watchdog rule divides by    |
+|                               | serving_kv_pages_capacity               |
+| serving_kv_pages_capacity     | gauge: allocatable pages (num_pages - 1;|
+|                               | page 0 is the reserved scratch page)    |
 | serving_kv_bytes              | gauge: device bytes backing in-use KV pages |
+| serving_kv_pages_extended     | decode-time PageTable.extend successes  |
+| serving_kv_backpressure_total | extend refusals (pool exhausted) that   |
+|                               | paused a slot instead of killing batch  |
+| serving_kv_paused_total       | slots paused awaiting free KV pages     |
+| serving_kv_preempt_total      | paused-livelock preemptions (one slot   |
+|                               | early-retired to free pages)            |
 | serving_prefill_count         | prefill dispatches (autoregressive)     |
+| serving_prefill_chunks        | chunked-prefill chunk dispatches        |
+| serving_ragged_fallback_total | ragged paged-attention Mosaic rejections|
+|                               | that fell back to the dense XLA path    |
 | serving_decode_steps          | decode-step dispatches (autoregressive) |
 
 Per-tenant series (multi-tenant fleet, serving/registry.py): every
@@ -58,6 +73,10 @@ Time stats (get_time_stats, milliseconds):
 Latency percentiles are host-side only (they need the full per-request
 distribution, which a counter table cannot carry): a bounded reservoir
 per metric name, drained by `latency_stats()` for bench.py's p50/p99.
+Reservoir names in use: `serving_request_ms` (submit -> response),
+`serving_prefill_chunk_ms` (host wall time per chunked-prefill chunk),
+and `serving_ttft_ms` (admission -> first token, recorded when the
+last prefill chunk lands).
 """
 
 from __future__ import annotations

@@ -117,8 +117,12 @@ class TestPageTable:
         row = t.rows("a", 5)
         assert row.dtype == np.int32 and row.shape == (5,)
         assert list(row[2:]) == [0, 0, 0]
-        with pytest.raises(ValueError):
+        # width overflow is TYPED (kv_rows) — it fires mid-decode in
+        # the dispatch loop, where an untyped ValueError would kill
+        # every co-batched request (ISSUE 20 satellite)
+        with pytest.raises(EngineOverloaded) as ei:
             t.rows("a", 1)
+        assert ei.value.resource == "kv_rows"
 
 
 class TestPagedAttention:

@@ -519,6 +519,26 @@ def test_shard_check_on_hot_path_watchlist():
         assert ("paddle_tpu/analysis/shard_check.py", qual) in watched
 
 
+def test_fast_decode_on_hot_path_watchlist():
+    """ISSUE 20: the fast-decode entry points are lint-watched — the
+    chunk scheduler (_prefill_tick) and the lazy-growth /
+    extend-backpressure path (_ensure_pages, _grow_to) run every
+    engine step between decode dispatches, and the ragged
+    paged-attention dispatch seam traces INSIDE the decode jit;
+    ops/pallas/attention.py is also in the span-leak watched set, and
+    test_shipped_tree_is_lint_clean above proves the shipped tree
+    honors both."""
+    watched = set(lint.hot_path_sync.WATCHLIST)
+    for qual in ("AutoregressiveEngine._prefill_tick",
+                 "AutoregressiveEngine._ensure_pages",
+                 "AutoregressiveEngine._grow_to"):
+        assert ("paddle_tpu/serving/engine.py", qual) in watched
+    assert ("paddle_tpu/ops/pallas/attention.py",
+            "paged_attention") in watched
+    assert "paddle_tpu/ops/pallas/attention.py" \
+        in lint.span_leak.WATCHED
+
+
 def test_hot_path_rule_fires_on_unsanctioned_sync(tmp_path):
     bad = tmp_path / "paddle_tpu" / "fluid"
     bad.mkdir(parents=True)

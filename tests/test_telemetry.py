@@ -307,6 +307,23 @@ class TestWatchdogRules:
             _store(serving_queue_depth=[1, 1, 1, 1, 5]),
             self.CFG) is None
 
+    def test_kv_pressure_pos_neg(self):
+        # 60 of 63 pages handed out: past the 90% threshold
+        msg = telemetry.rule_kv_pressure(
+            _store(serving_kv_pages_in_use=[10, 60],
+                   serving_kv_pages_capacity=[63, 63]), self.CFG)
+        assert msg and "serving_kv_pages_in_use" in msg
+        # healthy pool: below threshold
+        assert telemetry.rule_kv_pressure(
+            _store(serving_kv_pages_in_use=[10, 20],
+                   serving_kv_pages_capacity=[63, 63]),
+            self.CFG) is None
+        # no serving engine on this host: series absent, rule silent
+        assert telemetry.rule_kv_pressure(
+            _store(step_ms=[10, 10]), self.CFG) is None
+        assert ("kv_pressure", telemetry.rule_kv_pressure) \
+            in telemetry.RULES
+
     def test_ckpt_stall_pos_neg(self):
         assert telemetry.rule_ckpt_stall(
             _store(ckpt_stall_ms=[0, 900]), self.CFG)

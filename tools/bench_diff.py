@@ -14,6 +14,9 @@ Metrics compared (each only when present in BOTH files):
   resnet50_mfu     detail.resnet50.detail.mfu_pct      (drop  > 5% rel)
   resnet50_step_ms detail.resnet50.detail.step_ms      (rise  > 10% rel)
   serving_p99_ms   headline of serving_p99_latency_ms  (rise  > 15% rel)
+  decode_token_ms  detail.decode.decode_token_ms       (rise  > 10% rel
+                   — steady-state autoregressive decode-step latency;
+                   the fast-decode path must not regress)
   collective_bytes sum of detail.obs.cost.collective_bytes (rise > 10%)
   interior_transposes  detail...layout.interior_transposes (ANY rise)
   op_attribution_pct   detail...op_profile.attributed_flops_pct
@@ -77,6 +80,11 @@ DEFAULT_THRESHOLDS = {
     "resnet50_mfu": ("up", 0.05, 0.05),
     "resnet50_step_ms": ("down", 0.10, 0.05),
     "serving_p99_ms": ("down", 0.15, 0.5),
+    # fast decode (ISSUE 20): steady-state per-token decode-step
+    # latency from bench --mode serving detail.decode — a >10% rise
+    # means the ragged-kernel / chunked-prefill / lazy-growth path
+    # slowed; warn-only under cpu-fallback like everything else
+    "decode_token_ms": ("down", 0.10, 0.05),
     "collective_bytes": ("down", 0.10, 1024.0),
     "interior_transposes": ("down", 0.0, 0.0),
     "op_attribution_pct": ("up", 0.0, 5.0),
@@ -195,6 +203,9 @@ def extract_metrics(doc: dict) -> Dict[str, float]:
     cs = _get(detail, "fleet", "cold_start", "cold_start_compile_ms")
     if isinstance(cs, (int, float)):
         out["cold_start_compile_ms"] = float(cs)
+    dt = _get(detail, "decode", "decode_token_ms")
+    if isinstance(dt, (int, float)) and dt > 0:
+        out["decode_token_ms"] = float(dt)
     at_t = _get(detail, "autotune", "tuned_step_ms")
     if isinstance(at_t, (int, float)):
         out["autotune_tuned_step_ms"] = float(at_t)
@@ -341,7 +352,8 @@ def _synthetic(mfu: float, step_ms: float, transposes: int = 0,
                pred_bytes: int = 411720,
                pred_err: float = 15.0,
                tuned_ms: float = 9.0,
-               default_ms: float = 10.0) -> dict:
+               default_ms: float = 10.0,
+               decode_ms: float = 1.0) -> dict:
     return {
         "metric": "bert_base_pretrain_mfu",
         "value": mfu, "unit": "%", "vs_baseline": mfu / 45.0,
@@ -375,6 +387,11 @@ def _synthetic(mfu: float, step_ms: float, transposes: int = 0,
                          "tuned_step_ms": tuned_ms,
                          "winner": "fold_bn=on", "searches": 1,
                          "trials": 12, "commits": 1},
+            "decode": {"decode_token_ms": decode_ms,
+                       "decode_token_p99_ms": decode_ms * 1.5,
+                       "prefill_chunk_ms": 0.3,
+                       "ttft_long_prompt_ms": 10.0,
+                       "kv_pages_per_seq": 13.0},
             "resnet50": {"metric": "resnet50_images_per_sec_per_chip",
                          "value": 1000.0,
                          "detail": {"mfu_pct": 30.0, "step_ms": 50.0,
@@ -591,6 +608,27 @@ def selftest(verbose: bool = True) -> int:
                    any(r["metric"] == "autotune_tuned_vs_default"
                        and r["regressed"] for r in rows)
                    and is_fallback(cur_at_cpu)))
+
+    # 18. fast-decode gate (ISSUE 20): a >10% decode-step latency rise
+    # fires on-chip; a sub-floor wiggle passes; under cpu-fallback the
+    # same regression is warn-only (decode timings on CPU are noise)
+    cur_dec = _synthetic(mfu=42.0, step_ms=100.0, decode_ms=1.25)
+    rows = diff(base, cur_dec)
+    checks.append(("25% decode_token_ms rise fires",
+                   any(r["metric"] == "decode_token_ms"
+                       and r["regressed"] for r in rows)))
+    cur_dec_ok = _synthetic(mfu=42.0, step_ms=100.0, decode_ms=1.04)
+    rows = diff(base, cur_dec_ok)
+    checks.append(("sub-floor decode_token_ms wiggle passes",
+                   not any(r["metric"] == "decode_token_ms"
+                           and r["regressed"] for r in rows)))
+    cur_dec_cpu = _synthetic(mfu=42.0, step_ms=100.0, decode_ms=1.25,
+                             device_class="cpu-fallback")
+    rows = diff(base, cur_dec_cpu)
+    checks.append(("cpu-fallback decode regression is warn-only",
+                   any(r["metric"] == "decode_token_ms"
+                       and r["regressed"] for r in rows)
+                   and is_fallback(cur_dec_cpu)))
 
     failed = [name for name, ok in checks if not ok]
     if verbose:

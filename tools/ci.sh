@@ -132,6 +132,65 @@ N_REC=$(ls "$AT_DIR"/*.json 2>/dev/null | wc -l)
 [ "$N_REC" -ge 1 ] || { echo "autotune smoke: no record persisted"; exit 1; }
 rm -rf "$AT_DIR"
 
+echo "== fast-decode smoke: chunked prefill + decode flood, zero per-token d2h (docs/serving.md) =="
+# a long prompt admitted during a decode flood must prefill in chunks
+# (serving_prefill_chunks >= 2) while the flood keeps decoding, and
+# the whole run must keep the zero device->host-transfers-per-token
+# contract: executor_sync_count only moves at response boundaries
+# (one materialization per retired request)
+python - <<'EOF'
+import numpy as np
+import jax.numpy as jnp
+from paddle_tpu import serving
+from paddle_tpu.profiler import get_int_stats, stat_reset
+
+V, D = 32, 8
+rng = np.random.RandomState(0)
+emb = jnp.asarray(rng.randn(V, D).astype(np.float32))
+w = jnp.asarray(rng.randn(D, V).astype(np.float32))
+
+
+def qkv_fn(tokens, positions):
+    x = emb[tokens]
+    q = x[:, :, None, :]
+    return q, q, q
+
+
+def out_fn(attn):
+    return attn[:, :, 0, :] @ w
+
+
+eng = serving.AutoregressiveEngine(
+    qkv_fn, out_fn, num_heads=1, head_dim=D, num_pages=128,
+    page_size=4, max_slots=4, max_pages_per_seq=24,
+    prompt_buckets=(8, 16), prefill_chunk=8)
+eng.generate(np.arange(40) % V, max_new_tokens=4)  # warm compiles
+eng.generate(np.arange(5) % V, max_new_tokens=32)
+stat_reset("executor_sync_count")
+stat_reset("serving_prefill_chunks")
+flood = [eng.submit(rng.randint(0, V, size=5).astype(np.int32),
+                    max_new_tokens=32) for _ in range(3)]
+for _ in range(8):
+    eng.step()
+long_req = eng.submit(rng.randint(0, V, size=40).astype(np.int32),
+                      max_new_tokens=8)
+eng.run_until_idle()
+toks = long_req.result(timeout=60)
+assert len(toks) == 8, toks
+for r in flood:
+    assert len(r.result(timeout=60)) == 32
+s = get_int_stats()
+chunks = s.get("serving_prefill_chunks", 0)
+syncs = s.get("executor_sync_count", 0)
+print(f"decode smoke: prefill_chunks={chunks} sync_count={syncs} "
+      f"decode_steps={s.get('serving_decode_steps', 0)}")
+assert chunks >= 2, "long prompt did not prefill in chunks"
+# 4 retired requests -> exactly 4 sanctioned materializations; any
+# more means a per-token device->host transfer crept into the loop
+assert syncs == 4, f"expected 4 response-boundary syncs, got {syncs}"
+eng.shutdown(drain=False)
+EOF
+
 # timeout: a wedged TPU tunnel blocks jax.devices() forever — treat a
 # hung probe as "no accelerator" and keep CI moving (rc 124 -> else)
 if timeout 90 python - <<'EOF'
